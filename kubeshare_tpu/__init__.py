@@ -1,0 +1,24 @@
+"""kubeshare_tpu: fractional, topology-aware TPU sharing on Kubernetes.
+
+A TPU-native rebuild of the capabilities of KubeShare 2.0 (reference:
+Iamlovingit/KubeShare). Four planes:
+
+- ``scheduler``  — a scheduling-framework-style engine placing pods on
+  ``<node, chip>`` using a hierarchical *cell* model that encodes ICI
+  topology (chip -> tray -> slice -> pod), with gang/co-scheduling and
+  opportunistic-vs-guarantee scoring.
+- ``metrics``    — chip-capacity collector and requirement aggregator
+  (Prometheus text exposition), the cross-component data bus.
+- ``nodeconfig`` — the per-node daemon converting cluster requirements
+  into per-chip config files consumed by the isolation runtime.
+- ``runtime``    — Python side of the device-isolation runtime: token
+  client, multi-tenant chip executor, JAX dispatch hook, HBM accounting.
+  The native side (token arbiter ``tpu-schd``, pod manager ``tpu-pmgr``,
+  client library ``libtpuhook``) lives in ``runtime_native/`` (C++).
+
+Workload-side libraries (``models``, ``ops``, ``parallel``) provide the
+JAX equivalents of the reference's test workloads plus TPU-first
+sharding/long-context machinery.
+"""
+
+__version__ = "0.1.0"

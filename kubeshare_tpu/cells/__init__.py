@@ -1,0 +1,18 @@
+from .spec import CellSpec, CellTypeSpec, TopologyConfig, load_topology
+from .cell import Cell, CellState, CellTree, ChipInfo, build_cell_elements
+from .topology import ici_distance, id_path_distance, torus_distance
+
+__all__ = [
+    "CellSpec",
+    "CellTypeSpec",
+    "TopologyConfig",
+    "load_topology",
+    "Cell",
+    "CellState",
+    "CellTree",
+    "ChipInfo",
+    "build_cell_elements",
+    "ici_distance",
+    "id_path_distance",
+    "torus_distance",
+]

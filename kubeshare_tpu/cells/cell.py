@@ -1,0 +1,421 @@
+"""Runtime cell tree: fractional-chip accounting over the ICI hierarchy.
+
+A *cell* is a node in the topology tree (chip / tray / node / slice /
+pod). Leaves (level 1) are physical TPU chips bound to real chip ids and
+HBM sizes from the collector's inventory. Every cell tracks:
+
+- ``available``            — fractional chip capacity left underneath;
+- ``available_whole_cell`` — count of fully-free leaf chips underneath
+  (what integer multi-chip pods consume);
+- ``free_memory``/``full_memory`` — HBM bytes underneath.
+
+Reservations walk leaf -> root so feasibility checks at any level are
+O(1) reads (reference: pkg/scheduler/cell.go:131-153, pod.go:479-526,
+node.go:127-285 — rebuilt here with torus coordinates and a
+deterministic binding order).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .spec import CellSpec, CellTypeSpec, TopologyConfig
+from .topology import unravel
+
+_EPS = 1e-6
+
+
+def feq(a: float, b: float) -> bool:
+    return abs(a - b) <= _EPS
+
+
+def fge(a: float, b: float) -> bool:
+    return a >= b - _EPS
+
+
+class CellState(enum.Enum):
+    FREE = "FREE"      # constructed, not yet bound to hardware
+    BOUND = "BOUND"    # leaf uuids/memory bound from inventory
+
+
+@dataclass
+class ChipInfo:
+    """One physical chip as reported by the collector."""
+
+    uuid: str
+    model: str
+    memory: int  # HBM bytes
+    index: int = 0
+
+
+@dataclass
+class CellElement:
+    """Preprocessed per-type info derived from the cell-type chain."""
+
+    cell_type: str
+    level: int
+    priority: int
+    child_cell_type: str
+    child_cell_number: int
+    leaf_cell_type: str
+    leaf_cell_number: int
+    is_node: bool
+    is_multi_node: bool
+    torus: Optional[Tuple[int, ...]] = None
+
+
+def build_cell_elements(
+    cell_types: Dict[str, CellTypeSpec]
+) -> Tuple[Dict[str, CellElement], Dict[str, int]]:
+    """Derive level/leaf-count/priority per type; returns (elements,
+    chip_priority). Unknown child types are leaf chip models whose
+    priority is the parent's ``child_cell_priority`` (heterogeneity
+    preference, reference cell.go:46-129)."""
+    elements: Dict[str, CellElement] = {}
+    chip_priority: Dict[str, int] = {}
+
+    def add(cell_type: str, priority: int) -> CellElement:
+        if cell_type in elements:
+            return elements[cell_type]
+        cts = cell_types.get(cell_type)
+        if cts is None:  # leaf chip model
+            el = CellElement(
+                cell_type=cell_type,
+                level=1,
+                priority=priority,
+                child_cell_type="",
+                child_cell_number=0,
+                leaf_cell_type=cell_type,
+                leaf_cell_number=1,
+                is_node=False,
+                is_multi_node=False,
+            )
+            elements[cell_type] = el
+            chip_priority[cell_type] = priority
+            return el
+        child = add(cts.child_cell_type, cts.child_cell_priority)
+        el = CellElement(
+            cell_type=cell_type,
+            level=child.level + 1,
+            priority=child.priority,
+            child_cell_type=child.cell_type,
+            child_cell_number=cts.child_cell_number,
+            leaf_cell_type=child.leaf_cell_type,
+            leaf_cell_number=child.leaf_cell_number * cts.child_cell_number,
+            is_node=cts.is_node_level,
+            is_multi_node=child.is_node or child.is_multi_node,
+            torus=tuple(cts.torus) if cts.torus else None,
+        )
+        elements[cell_type] = el
+        return el
+
+    for name in cell_types:
+        add(name, 0)
+    for name, el in elements.items():
+        if el.torus is not None:
+            cells_in_torus = 1
+            for d in el.torus:
+                cells_in_torus *= d
+            if cells_in_torus != el.leaf_cell_number:
+                raise ValueError(
+                    f"cell type {name}: torus {list(el.torus)} holds "
+                    f"{cells_in_torus} chips but the type has "
+                    f"{el.leaf_cell_number} leaves"
+                )
+    return elements, chip_priority
+
+
+class Cell:
+    __slots__ = (
+        "cell_type", "id", "level", "is_node", "higher_than_node", "priority",
+        "uuid", "leaf_cell_type", "leaf_cell_number", "available",
+        "available_whole_cell", "free_memory", "full_memory", "node",
+        "healthy", "state", "parent", "children",
+        "coord", "torus_dims", "torus_domain",
+    )
+
+    def __init__(self, el: CellElement, cell_id: str):
+        self.cell_type = el.cell_type
+        self.id = cell_id
+        self.level = el.level
+        self.is_node = el.is_node
+        self.higher_than_node = el.is_multi_node
+        self.priority = el.priority
+        self.uuid = ""
+        self.leaf_cell_type = el.leaf_cell_type
+        self.leaf_cell_number = el.leaf_cell_number
+        # Capacity accrues only as physical chips are bound — a tree
+        # fresh from config has zero available until the collector
+        # reports inventory (divergence from the reference, which
+        # initializes available=leafCellNumber at construction and so
+        # over-reports unbound capacity).
+        self.available = 0.0
+        self.available_whole_cell = 0
+        self.free_memory = 0
+        self.full_memory = 0
+        self.node = ""
+        self.healthy = False
+        self.state = CellState.FREE
+        self.parent: Optional[Cell] = None
+        self.children: List[Cell] = []
+        # torus metadata (leaves only)
+        self.coord: Optional[Tuple[int, ...]] = None
+        self.torus_dims: Optional[Tuple[int, ...]] = None
+        self.torus_domain: Optional[str] = None
+
+    def __repr__(self) -> str:
+        return (
+            f"Cell({self.cell_type} id={self.id} node={self.node or '-'} "
+            f"avail={self.available:.3f}/{self.leaf_cell_number} "
+            f"whole={self.available_whole_cell} mem={self.free_memory}/"
+            f"{self.full_memory} healthy={self.healthy})"
+        )
+
+    def iter_leaves(self) -> Iterable["Cell"]:
+        if self.level == 1:
+            yield self
+            return
+        for child in self.children:
+            yield from child.iter_leaves()
+
+    @property
+    def is_whole_free(self) -> bool:
+        """A bound leaf with its full fractional capacity untouched."""
+        return self.state == CellState.BOUND and feq(self.available, 1.0)
+
+
+class CellTree:
+    """All physical cell trees plus the indexes the scheduler needs."""
+
+    def __init__(self, cfg: TopologyConfig):
+        self.elements, self.chip_priority = build_cell_elements(cfg.cell_types)
+        self.models_by_priority: List[str] = sorted(
+            self.chip_priority, key=lambda m: -self.chip_priority[m]
+        )
+        # free_list[leaf_type][level] -> roots of trees with that leaf type
+        self.free_list: Dict[str, Dict[int, List[Cell]]] = {}
+        self.leaf_cells: Dict[str, Cell] = {}  # chip uuid -> leaf
+        self._leaves_by_node: Dict[str, List[Cell]] = {}
+        self.roots: List[Cell] = []
+        for spec in cfg.cells:
+            root = self._build_tree(spec)
+            self.roots.append(root)
+            by_level = self.free_list.setdefault(root.leaf_cell_type, {})
+            by_level.setdefault(root.level, []).append(root)
+
+    # -- construction -------------------------------------------------
+
+    def _build_tree(self, spec: CellSpec) -> Cell:
+        el = self.elements.get(spec.cell_type)
+        if el is None:
+            raise ValueError(f"cells: unknown cell type {spec.cell_type!r}")
+        if not (el.is_node or el.is_multi_node):
+            raise ValueError(
+                f"top cell {spec.cell_id} ({spec.cell_type}) must be node-level "
+                "or above"
+            )
+        root = self._build_cell(spec, el, current_node="")
+        self._assign_torus_coords(root)
+        for leaf in root.iter_leaves():
+            self._leaves_by_node.setdefault(leaf.node, []).append(leaf)
+        return root
+
+    def _build_cell(self, spec: CellSpec, el: CellElement, current_node: str) -> Cell:
+        if el.is_node:
+            # node-level cell id's last path segment is the k8s node name
+            current_node = spec.cell_id.rsplit("/", 1)[-1]
+        cell = Cell(el, spec.cell_id)
+        if not el.is_multi_node:
+            cell.node = current_node
+        if el.level == 1:
+            return cell
+        child_el = self.elements[el.child_cell_type]
+        for child_spec in spec.cell_children:
+            child = self._build_cell(child_spec, child_el, current_node)
+            child.parent = cell
+            cell.children.append(child)
+        return cell
+
+    def _assign_torus_coords(self, root: Cell) -> None:
+        """Each leaf gets coordinates in its *outermost* torus domain —
+        the widest contiguous ICI fabric declared in the topology."""
+
+        next_index: Dict[str, int] = {}
+
+        def walk(cell: Cell, domain: Optional[Cell]) -> None:
+            el = self.elements[cell.cell_type]
+            if domain is None and el.torus is not None:
+                domain = cell
+            if cell.level == 1:
+                if domain is not None:
+                    dims = self.elements[domain.cell_type].torus
+                    assert dims is not None
+                    idx = next_index.get(domain.id, 0)
+                    next_index[domain.id] = idx + 1
+                    cell.coord = unravel(idx, dims)
+                    cell.torus_dims = dims
+                    cell.torus_domain = domain.id
+                return
+            for child in cell.children:
+                walk(child, domain)
+
+        walk(root, None)
+
+    # -- inventory binding & health -----------------------------------
+
+    def bind_node(self, node: str, chips: Sequence[ChipInfo]) -> int:
+        """Sync a node's leaf cells to the collector's chip inventory.
+
+        Already-bound leaves whose uuid is still reported stay bound
+    (idempotent). Leaves whose chip vanished are *unbound* —
+        capacity, HBM, and health withdrawn — and newly reported chips
+        bind onto unbound leaves in tree order per model. Returns the
+        number of leaves newly bound."""
+        reported: Dict[str, List[ChipInfo]] = {}
+        for chip in sorted(chips, key=lambda c: c.index):
+            reported.setdefault(chip.model, []).append(chip)
+        node_leaves = self._leaves_by_node.get(node, [])
+        # pass 1: reconcile already-bound leaves against the report
+        seen_uuids = {c.uuid for c in chips}
+        for leaf in node_leaves:
+            if leaf.state == CellState.BOUND:
+                if leaf.uuid in seen_uuids:
+                    pool = reported.get(leaf.leaf_cell_type, [])
+                    for i, chip in enumerate(pool):
+                        if chip.uuid == leaf.uuid:
+                            pool.pop(i)
+                            break
+                    self._set_health(leaf, True)
+                else:
+                    self._unbind_leaf(leaf)
+        # pass 2: bind remaining chips onto unbound leaves
+        bound = 0
+        for leaf in node_leaves:
+            if leaf.state == CellState.BOUND:
+                continue
+            pool = reported.get(leaf.leaf_cell_type)
+            if not pool:
+                continue
+            chip = pool.pop(0)
+            leaf.uuid = chip.uuid
+            leaf.full_memory = chip.memory
+            leaf.free_memory = chip.memory
+            leaf.available = 1.0
+            leaf.available_whole_cell = 1
+            leaf.state = CellState.BOUND
+            self.leaf_cells[chip.uuid] = leaf
+            self._propagate(leaf, 1.0, 1, chip.memory, chip.memory)
+            self._set_health(leaf, True)
+            bound += 1
+        return bound
+
+    def _unbind_leaf(self, leaf: Cell) -> None:
+        """Withdraw a vanished chip: capacity and memory leave the tree,
+        reservations on it are the scheduler's problem (it sees the leaf
+        unhealthy and unbound)."""
+        self._propagate(
+            leaf,
+            -leaf.available,
+            -1 if leaf.is_whole_free else 0,
+            -leaf.free_memory,
+            -leaf.full_memory,
+        )
+        self.leaf_cells.pop(leaf.uuid, None)
+        leaf.uuid = ""
+        leaf.available = 0.0
+        leaf.available_whole_cell = 0
+        leaf.free_memory = 0
+        leaf.full_memory = 0
+        leaf.state = CellState.FREE
+        self._set_health(leaf, False)
+
+    def _propagate(
+        self, leaf: Cell, avail: float, whole: int, free_mem: int, full_mem: int
+    ) -> None:
+        """Apply capacity/memory deltas to all ancestors of ``leaf``."""
+        cell = leaf.parent
+        while cell is not None:
+            cell.available += avail
+            cell.available_whole_cell += whole
+            cell.free_memory += free_mem
+            cell.full_memory += full_mem
+            cell = cell.parent
+
+    def set_node_health(self, node: str, healthy: bool) -> None:
+        """Flood health over a node's leaves and re-derive ancestors.
+
+        Divergence from the reference (node.go:216-254, which floods
+        unhealthy up through shared multi-node parents): an ancestor is
+        healthy iff *any* descendant leaf is healthy, so one dead node
+        doesn't disable a whole multi-node cell."""
+        for leaf in self._leaves_by_node.get(node, []):
+            self._set_health(leaf, healthy)
+
+    def _set_health(self, leaf: Cell, healthy: bool) -> None:
+        leaf.healthy = healthy
+        cell = leaf.parent
+        while cell is not None:
+            cell.healthy = any(c.healthy for c in cell.children)
+            cell = cell.parent
+
+    # -- accounting ----------------------------------------------------
+
+    def reserve(self, leaf: Cell, request: float, memory: int) -> None:
+        if leaf.level != 1:
+            raise ValueError(f"reserve targets leaf cells, got {leaf!r}")
+        if leaf.state != CellState.BOUND:
+            raise ValueError(f"reserve on unbound leaf {leaf.id}")
+        if not fge(leaf.available, request) or leaf.free_memory < memory:
+            raise ValueError(
+                f"over-reservation on {leaf.id}: request={request} "
+                f"mem={memory} vs {leaf!r}"
+            )
+        was_whole = leaf.is_whole_free and not feq(request, 0.0)
+        cell: Optional[Cell] = leaf
+        while cell is not None:
+            cell.available = max(0.0, cell.available - request)
+            cell.free_memory -= memory
+            if was_whole:
+                cell.available_whole_cell -= 1
+            cell = cell.parent
+
+    def reclaim(self, leaf: Cell, request: float, memory: int) -> None:
+        if leaf.level != 1:
+            raise ValueError(f"reclaim targets leaf cells, got {leaf!r}")
+        if leaf.state != CellState.BOUND:
+            raise ValueError(f"reclaim on unbound leaf {leaf.id}")
+        if leaf.available + request > 1.0 + _EPS or (
+            leaf.free_memory + memory > leaf.full_memory
+        ):
+            raise ValueError(
+                f"over-reclaim on {leaf.id}: request={request} mem={memory} "
+                f"vs {leaf!r}"
+            )
+        becomes_whole = feq(leaf.available + request, 1.0) and not feq(request, 0.0)
+        cell: Optional[Cell] = leaf
+        while cell is not None:
+            cell.available += request
+            cell.free_memory += memory
+            if becomes_whole:
+                cell.available_whole_cell += 1
+            cell = cell.parent
+
+    # -- queries -------------------------------------------------------
+
+    def leaves_on_node(self, node: str, model: Optional[str] = None) -> List[Cell]:
+        leaves = [
+            l
+            for l in self._leaves_by_node.get(node, [])
+            if l.state == CellState.BOUND
+        ]
+        if model is not None:
+            leaves = [l for l in leaves if l.leaf_cell_type == model]
+        return leaves
+
+    def nodes(self) -> List[str]:
+        return sorted(n for n in self._leaves_by_node if n)
+
+    def models_on_node(self, node: str) -> List[str]:
+        return sorted({l.leaf_cell_type for l in self.leaves_on_node(node)})

@@ -1,0 +1,217 @@
+"""Topology configuration: cell types and physical cells.
+
+The admin describes the cluster as a small YAML file — a map of *cell
+types* (the shape of the hierarchy: chip -> tray -> node -> slice ->
+pod) and a list of *physical cells* (instances of those types rooted at
+or above node level). Mirrors the reference's kubeshare-config.yaml
+contract (pkg/scheduler/config.go:15-35, deploy/config/
+kubeshare-config-final.yaml) with one TPU-native addition: a cell type
+may declare ``torus: [x, y(, z)]`` — the ICI torus formed by the leaf
+chips underneath it — which the scheduler uses for true wraparound hop
+distance instead of string-ID arithmetic.
+
+Example::
+
+    cell_types:
+      v5e-tray:
+        child_cell_type: tpu-v5e      # unknown type => leaf chip model
+        child_cell_number: 4
+        child_cell_priority: 100
+      v5e-node:
+        child_cell_type: v5e-tray
+        child_cell_number: 2
+        is_node_level: true
+        torus: [4, 2]
+      v5e-slice-16:
+        child_cell_type: v5e-node
+        child_cell_number: 2
+        torus: [4, 4]
+    cells:
+      - cell_type: v5e-slice-16
+        cell_children:
+          - cell_id: tpu-node-a       # node-level id == k8s node name
+          - cell_id: tpu-node-b
+
+Child ids left blank are inferred breadth-first as ``parent/i``
+(reference: inferCellSpec, pkg/scheduler/config.go:77-120).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Union
+
+try:
+    import yaml
+except ImportError:  # pragma: no cover - PyYAML is in the base image
+    yaml = None
+
+
+class TopologyError(ValueError):
+    pass
+
+
+@dataclass
+class CellTypeSpec:
+    child_cell_type: str
+    child_cell_number: int
+    child_cell_priority: int = 0
+    is_node_level: bool = False
+    torus: Optional[List[int]] = None
+
+    def validate(self, name: str) -> None:
+        if not self.child_cell_type:
+            raise TopologyError(f"cell type {name}: child_cell_type required")
+        if self.child_cell_number <= 0:
+            raise TopologyError(f"cell type {name}: child_cell_number must be > 0")
+        if not 0 <= self.child_cell_priority <= 100:
+            raise TopologyError(
+                f"cell type {name}: child_cell_priority must be in 0..100"
+            )
+        if self.torus is not None:
+            if not self.torus or any(d <= 0 for d in self.torus):
+                raise TopologyError(f"cell type {name}: torus dims must be positive")
+
+
+@dataclass
+class CellSpec:
+    cell_type: str = ""
+    cell_id: str = ""
+    cell_children: List["CellSpec"] = field(default_factory=list)
+
+
+@dataclass
+class TopologyConfig:
+    cell_types: Dict[str, CellTypeSpec] = field(default_factory=dict)
+    cells: List[CellSpec] = field(default_factory=list)
+
+
+_KEY_ALIASES = {
+    # accept both snake_case (ours) and camelCase (reference yaml dialect)
+    "childcelltype": "child_cell_type",
+    "childcellnumber": "child_cell_number",
+    "childcellpriority": "child_cell_priority",
+    "isnodelevel": "is_node_level",
+    "celltype": "cell_type",
+    "cellid": "cell_id",
+    "cellchildren": "cell_children",
+    "celltypes": "cell_types",
+    "torus": "torus",
+}
+
+
+def _norm_key(key: str) -> str:
+    return _KEY_ALIASES.get(key.replace("_", "").lower(), key)
+
+
+def _parse_cell_spec(raw: dict) -> CellSpec:
+    norm = {_norm_key(k): v for k, v in raw.items()}
+    children = [_parse_cell_spec(c) for c in norm.get("cell_children") or []]
+    return CellSpec(
+        cell_type=str(norm.get("cell_type", "") or ""),
+        cell_id=str(norm.get("cell_id", "") or ""),
+        cell_children=children,
+    )
+
+
+def parse_topology(data: dict) -> TopologyConfig:
+    norm = {_norm_key(k): v for k, v in (data or {}).items()}
+    cell_types: Dict[str, CellTypeSpec] = {}
+    for name, raw in (norm.get("cell_types") or {}).items():
+        fields = {_norm_key(k): v for k, v in (raw or {}).items()}
+        cts = CellTypeSpec(
+            child_cell_type=str(fields.get("child_cell_type", "") or ""),
+            child_cell_number=int(fields.get("child_cell_number", 0) or 0),
+            child_cell_priority=int(fields.get("child_cell_priority", 0) or 0),
+            is_node_level=bool(fields.get("is_node_level", False)),
+            torus=list(fields["torus"]) if fields.get("torus") else None,
+        )
+        cts.validate(name)
+        cell_types[name] = cts
+    cells = [_parse_cell_spec(c) for c in norm.get("cells") or []]
+    cfg = TopologyConfig(cell_types=cell_types, cells=cells)
+    infer_config(cfg)
+    return cfg
+
+
+def load_topology(source: Union[str, dict]) -> TopologyConfig:
+    """Load from a YAML path or an already-parsed dict."""
+    if isinstance(source, dict):
+        return parse_topology(source)
+    if yaml is None:
+        raise TopologyError("PyYAML unavailable; pass a dict instead of a path")
+    with open(source) as f:
+        return parse_topology(yaml.safe_load(f) or {})
+
+
+def infer_config(cfg: TopologyConfig) -> None:
+    for idx, cell in enumerate(cfg.cells):
+        if cell.cell_type not in cfg.cell_types:
+            raise TopologyError(
+                f"cells[{idx}]: unknown cell_type {cell.cell_type!r}"
+            )
+        infer_cell_spec(cell, cfg.cell_types, idx + 1)
+    # Cell ids key torus domains and node names — collisions would alias
+    # distinct hardware, so reject them outright.
+    seen: Dict[str, str] = {}
+    stack = list(cfg.cells)
+    while stack:
+        cell = stack.pop()
+        if cell.cell_id in seen:
+            raise TopologyError(
+                f"duplicate cell id {cell.cell_id!r} "
+                f"({seen[cell.cell_id]} vs {cell.cell_type})"
+            )
+        seen[cell.cell_id] = cell.cell_type
+        stack.extend(cell.cell_children)
+
+
+def infer_cell_spec(
+    spec: CellSpec, cell_types: Dict[str, CellTypeSpec], default_id: int
+) -> None:
+    """Fill missing ids (``parent/i``), child types, and child lists, BFS.
+
+    Explicit child ids are *prefixed* with the parent id so every cell id
+    is a full path from the root — the property the locality distance
+    relies on.
+    """
+    if not spec.cell_id:
+        spec.cell_id = str(default_id)
+    q = deque([spec])
+    while q:
+        current = q.popleft()
+        cts = cell_types.get(current.cell_type)
+        if cts is None:  # leaf chip
+            if current.cell_children:
+                raise TopologyError(
+                    f"leaf cell {current.cell_id} must not have children"
+                )
+            continue
+        if not current.cell_children:
+            current.cell_children = [CellSpec() for _ in range(cts.child_cell_number)]
+        if len(current.cell_children) != cts.child_cell_number:
+            raise TopologyError(
+                f"cell {current.cell_id} ({current.cell_type}): expected "
+                f"{cts.child_cell_number} children, got {len(current.cell_children)}"
+            )
+        for i, child in enumerate(current.cell_children, start=1):
+            if not child.cell_type:
+                child.cell_type = cts.child_cell_type
+            child.cell_id = (
+                f"{current.cell_id}/{child.cell_id}"
+                if child.cell_id
+                else f"{current.cell_id}/{i}"
+            )
+            q.append(child)
+
+
+def leaf_types(cfg: TopologyConfig) -> Sequence[str]:
+    """Chip model names: child types never defined as a cell type."""
+    return sorted(
+        {
+            cts.child_cell_type
+            for cts in cfg.cell_types.values()
+            if cts.child_cell_type not in cfg.cell_types
+        }
+    )

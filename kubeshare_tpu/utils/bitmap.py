@@ -1,0 +1,100 @@
+"""Bit-set allocators.
+
+The scheduler allocates one pod-manager TCP port per sharing pod from a
+fixed per-node range (reference: pkg/lib/bitmap/bitmap.go:11-51,
+rrbitmap.go:3-56; used for ports 50050.. in pkg/scheduler/node.go:14).
+``Bitmap`` is a plain growable bit set; ``RRBitmap`` adds a round-robin
+cursor so consecutive allocations spread across the range instead of
+reusing the lowest free slot (which would hand a just-freed port to a new
+pod while the old pod's manager may still be draining).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_WORD = 64
+
+
+class Bitmap:
+    """Fixed-capacity bit set with thread-safe mutation."""
+
+    def __init__(self, size: int):
+        if size <= 0:
+            raise ValueError(f"bitmap size must be positive, got {size}")
+        self._size = size
+        self._words = [0] * ((size + _WORD - 1) // _WORD)
+        self._lock = threading.Lock()
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def _check(self, idx: int) -> None:
+        if not 0 <= idx < self._size:
+            raise IndexError(f"bit {idx} out of range [0, {self._size})")
+
+    def get(self, idx: int) -> bool:
+        self._check(idx)
+        return bool(self._words[idx // _WORD] >> (idx % _WORD) & 1)
+
+    def set(self, idx: int, value: bool = True) -> None:
+        self._check(idx)
+        with self._lock:
+            if value:
+                self._words[idx // _WORD] |= 1 << (idx % _WORD)
+            else:
+                self._words[idx // _WORD] &= ~(1 << (idx % _WORD))
+
+    def clear(self, idx: int) -> None:
+        self.set(idx, False)
+
+    def count(self) -> int:
+        return sum(bin(w).count("1") for w in self._words)
+
+    def find_first_clear(self) -> int:
+        """Index of the lowest unset bit, or -1 if full."""
+        for wi, word in enumerate(self._words):
+            if word != (1 << _WORD) - 1:
+                for bi in range(_WORD):
+                    idx = wi * _WORD + bi
+                    if idx >= self._size:
+                        return -1
+                    if not word >> bi & 1:
+                        return idx
+        return -1
+
+
+class RRBitmap(Bitmap):
+    """Bitmap with a round-robin allocation cursor.
+
+    ``find_next_and_set`` starts scanning just past the previous
+    allocation and wraps, so freed slots are not immediately reissued.
+    """
+
+    def __init__(self, size: int):
+        super().__init__(size)
+        self._cursor = -1
+
+    def find_next_from_current(self) -> int:
+        """Next clear bit after the cursor (wrapping), or -1 if full."""
+        for off in range(1, self._size + 1):
+            idx = (self._cursor + off) % self._size
+            if not self.get(idx):
+                return idx
+        return -1
+
+    def find_next_and_set(self) -> int:
+        """Allocate the next clear bit round-robin; -1 if full."""
+        with self._lock:
+            for off in range(1, self._size + 1):
+                idx = (self._cursor + off) % self._size
+                if not self._words[idx // _WORD] >> (idx % _WORD) & 1:
+                    self._words[idx // _WORD] |= 1 << (idx % _WORD)
+                    self._cursor = idx
+                    return idx
+            return -1
+
+    def mask(self, idx: int) -> None:
+        """Mark ``idx`` used without moving the cursor (bound-pod resync)."""
+        self.set(idx, True)

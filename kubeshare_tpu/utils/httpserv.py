@@ -1,0 +1,54 @@
+"""Tiny threaded HTTP server for metric exposition endpoints.
+
+Each exporter (collector :9004, aggregator :9005 in the reference —
+cmd/kubeshare-collector/main.go:23-24, cmd/kubeshare-aggregator/
+main.go:23-24) serves one path returning text exposition produced by a
+callback at scrape time.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict
+
+
+class MetricServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._routes: Dict[str, Callable[[], str]] = {}
+        routes = self._routes
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                fn = routes.get(self.path)
+                if fn is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = fn().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr noise
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def route(self, path: str, fn: Callable[[], str]) -> None:
+        self._routes[path] = fn
+
+    def start(self) -> "MetricServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

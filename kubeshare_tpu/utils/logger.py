@@ -1,0 +1,48 @@
+"""Component loggers.
+
+Every plane logs to ``<log_dir>/<component>.log`` with a fixed
+``"YYYY-mm-dd HH:MM:SS LEVL: file:line msg"`` layout so node-side logs
+are grep-able across components (reference: pkg/logger/logger.go:14-57).
+Verbosity is an integer 0..3 mapping to WARNING..DEBUG, matching the
+reference's ``--level`` flag semantics (logger.go:40-44).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_LEVELS = {0: logging.WARNING, 1: logging.INFO, 2: logging.DEBUG, 3: logging.DEBUG}
+
+_FMT = "%(asctime)s %(levelname).4s: %(filename)s:%(lineno)d %(message)s"
+_DATEFMT = "%Y-%m-%d %H:%M:%S"
+
+
+def get_logger(
+    component: str,
+    level: int = 1,
+    log_dir: Optional[str] = None,
+    stderr: bool = True,
+) -> logging.Logger:
+    """Create (or reconfigure) the logger for one component."""
+    logger = logging.getLogger(f"kubeshare_tpu.{component}")
+    logger.setLevel(_LEVELS.get(level, logging.DEBUG))
+    logger.propagate = False
+    for h in list(logger.handlers):
+        logger.removeHandler(h)
+        h.close()
+    formatter = logging.Formatter(_FMT, datefmt=_DATEFMT)
+    if stderr:
+        sh = logging.StreamHandler(sys.stderr)
+        sh.setFormatter(formatter)
+        logger.addHandler(sh)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        fh = logging.FileHandler(os.path.join(log_dir, f"{component}.log"))
+        fh.setFormatter(formatter)
+        logger.addHandler(fh)
+    if not logger.handlers:
+        logger.addHandler(logging.NullHandler())
+    return logger

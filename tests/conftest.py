@@ -1,0 +1,11 @@
+import os
+
+# Hermetic TPU-free testing: an 8-device virtual CPU mesh so sharding
+# paths (dp/fsdp/tp, ring attention) compile and run without chips.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

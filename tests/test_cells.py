@@ -1,0 +1,315 @@
+import pytest
+
+from kubeshare_tpu.cells import (
+    CellState,
+    CellTree,
+    ChipInfo,
+    load_topology,
+    ici_distance,
+    id_path_distance,
+    torus_distance,
+)
+from kubeshare_tpu.cells.spec import TopologyError, leaf_types
+from kubeshare_tpu.cells.topology import unravel
+
+V5E_16 = {
+    "cell_types": {
+        "v5e-tray": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 100,
+        },
+        "v5e-node": {
+            "child_cell_type": "v5e-tray",
+            "child_cell_number": 2,
+            "is_node_level": True,
+        },
+        "v5e-slice-16": {
+            "child_cell_type": "v5e-node",
+            "child_cell_number": 2,
+            "torus": [4, 4],
+        },
+    },
+    "cells": [
+        {
+            "cell_type": "v5e-slice-16",
+            "cell_children": [{"cell_id": "node-a"}, {"cell_id": "node-b"}],
+        }
+    ],
+}
+
+HETERO = {
+    "cell_types": {
+        "v5e-node": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 50,
+            "is_node_level": True,
+        },
+        "v5p-node": {
+            "child_cell_type": "tpu-v5p",
+            "child_cell_number": 4,
+            "child_cell_priority": 100,
+            "is_node_level": True,
+        },
+    },
+    "cells": [
+        {"cell_type": "v5e-node", "cell_id": "lite-1"},
+        {"cell_type": "v5p-node", "cell_id": "perf-1"},
+    ],
+}
+
+
+def chips(node, model, n, mem=16 << 30):
+    return [ChipInfo(uuid=f"{node}-chip-{i}", model=model, memory=mem, index=i) for i in range(n)]
+
+
+class TestSpec:
+    def test_inference_fills_ids_and_types(self):
+        cfg = load_topology(V5E_16)
+        root = cfg.cells[0]
+        assert root.cell_id == "1"
+        assert [c.cell_id for c in root.cell_children] == ["1/node-a", "1/node-b"]
+        tray = root.cell_children[0].cell_children[0]
+        assert tray.cell_type == "v5e-tray"
+        assert tray.cell_id == "1/node-a/1"
+        assert [c.cell_id for c in tray.cell_children] == [
+            "1/node-a/1/1", "1/node-a/1/2", "1/node-a/1/3", "1/node-a/1/4"
+        ]
+        assert leaf_types(cfg) == ["tpu-v5e"]
+
+    def test_camel_case_accepted(self):
+        cfg = load_topology(
+            {
+                "cellTypes": {
+                    "N": {"childCellType": "chip", "childCellNumber": 2, "isNodeLevel": True}
+                },
+                "cells": [{"cellType": "N", "cellId": "n1"}],
+            }
+        )
+        assert cfg.cells[0].cell_id == "n1"
+        assert cfg.cell_types["N"].is_node_level
+
+    def test_validation_errors(self):
+        with pytest.raises(TopologyError):
+            load_topology({"cell_types": {"N": {"child_cell_type": "c", "child_cell_number": 0}}})
+        with pytest.raises(TopologyError):
+            load_topology(
+                {
+                    "cell_types": {
+                        "N": {"child_cell_type": "c", "child_cell_number": 1, "child_cell_priority": 101}
+                    }
+                }
+            )
+        with pytest.raises(TopologyError):
+            load_topology({"cells": [{"cell_type": "nope"}]})
+
+    def test_duplicate_cell_ids_rejected(self):
+        types = {
+            "N": {"child_cell_type": "c", "child_cell_number": 1, "is_node_level": True}
+        }
+        with pytest.raises(TopologyError, match="duplicate cell id"):
+            load_topology(
+                {"cell_types": types, "cells": [{"cell_type": "N", "cell_id": "2"}, {"cell_type": "N"}]}
+            )
+
+    def test_torus_size_mismatch_rejected(self):
+        bad = {
+            "cell_types": {
+                "node": {
+                    "child_cell_type": "chip",
+                    "child_cell_number": 16,
+                    "is_node_level": True,
+                    "torus": [4, 2],
+                }
+            },
+            "cells": [{"cell_type": "node", "cell_id": "n1"}],
+        }
+        with pytest.raises(ValueError, match="torus"):
+            CellTree(load_topology(bad))
+
+
+class TestTreeBuild:
+    def test_elements_and_priority(self):
+        tree = CellTree(load_topology(HETERO))
+        assert tree.chip_priority == {"tpu-v5e": 50, "tpu-v5p": 100}
+        assert tree.models_by_priority == ["tpu-v5p", "tpu-v5e"]
+        el = tree.elements["v5e-node"]
+        assert el.level == 2 and el.leaf_cell_number == 4 and el.is_node
+
+    def test_tree_shape_and_node_names(self):
+        tree = CellTree(load_topology(V5E_16))
+        [root] = tree.free_list["tpu-v5e"][4]
+        assert root.leaf_cell_number == 16
+        assert root.higher_than_node and root.node == ""
+        node_a = root.children[0]
+        assert node_a.is_node and node_a.node == "node-a"
+        assert all(l.node == "node-a" for l in node_a.iter_leaves())
+        assert len(list(root.iter_leaves())) == 16
+        # no capacity until inventory binds
+        assert root.available == 0.0 and root.available_whole_cell == 0
+
+    def test_top_cell_must_be_node_level(self):
+        bad = {
+            "cell_types": {
+                "tray": {"child_cell_type": "chip", "child_cell_number": 4}
+            },
+            "cells": [{"cell_type": "tray"}],
+        }
+        with pytest.raises(ValueError, match="node-level"):
+            CellTree(load_topology(bad))
+
+    def test_torus_coords_outermost_domain(self):
+        tree = CellTree(load_topology(V5E_16))
+        [root] = tree.free_list["tpu-v5e"][4]
+        leaves = list(root.iter_leaves())
+        assert all(l.torus_domain == root.id for l in leaves)
+        assert leaves[0].coord == (0, 0)
+        assert leaves[5].coord == (1, 1)
+        assert leaves[15].coord == (3, 3)
+
+
+class TestBindingAndHealth:
+    def test_bind_inventory(self):
+        tree = CellTree(load_topology(V5E_16))
+        assert tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8)) == 8
+        [root] = tree.free_list["tpu-v5e"][4]
+        node_a, node_b = root.children
+        assert node_a.healthy and root.healthy and not node_b.healthy
+        assert node_a.full_memory == 8 * (16 << 30)
+        assert root.free_memory == 8 * (16 << 30)
+        # capacity reflects only bound chips
+        assert root.available == 8.0 and root.available_whole_cell == 8
+        assert node_b.available == 0.0
+        leaf = tree.leaf_cells["node-a-chip-0"]
+        assert leaf.state == CellState.BOUND and leaf.free_memory == 16 << 30
+        # rebind is idempotent
+        assert tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8)) == 0
+        assert node_a.full_memory == 8 * (16 << 30)
+        assert root.available == 8.0 and root.available_whole_cell == 8
+
+    def test_resync_swapped_chip(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        [root] = tree.free_list["tpu-v5e"][4]
+        inv = chips("node-a", "tpu-v5e", 8)
+        gone = inv[3]
+        inv[3] = ChipInfo("node-a-chip-new", "tpu-v5e", 16 << 30, 3)
+        assert tree.bind_node("node-a", inv) == 1
+        assert gone.uuid not in tree.leaf_cells
+        assert "node-a-chip-new" in tree.leaf_cells
+        assert root.available == 8.0 and root.available_whole_cell == 8
+        assert root.full_memory == 8 * (16 << 30)
+
+    def test_shrunk_inventory_withdraws_capacity(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        [root] = tree.free_list["tpu-v5e"][4]
+        assert tree.bind_node("node-a", chips("node-a", "tpu-v5e", 4)) == 0
+        assert root.available == 4.0 and root.available_whole_cell == 4
+        assert root.full_memory == 4 * (16 << 30)
+        assert len(tree.leaves_on_node("node-a")) == 4
+
+    def test_wrong_model_not_bound(self):
+        tree = CellTree(load_topology(V5E_16))
+        assert tree.bind_node("node-a", chips("node-a", "tpu-v4", 8)) == 0
+
+    def test_health_flood_multi_node(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        tree.bind_node("node-b", chips("node-b", "tpu-v5e", 8))
+        [root] = tree.free_list["tpu-v5e"][4]
+        tree.set_node_health("node-a", False)
+        # multi-node root stays healthy while node-b lives (divergence
+        # from reference's unconditional flood)
+        assert root.healthy
+        assert not root.children[0].healthy
+        tree.set_node_health("node-b", False)
+        assert not root.healthy
+        tree.set_node_health("node-a", True)
+        assert root.healthy and root.children[0].healthy
+
+
+class TestAccounting:
+    def test_reserve_reclaim_fractional(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        leaf = tree.leaf_cells["node-a-chip-0"]
+        [root] = tree.free_list["tpu-v5e"][4]
+        tree.reserve(leaf, 0.5, 4 << 30)
+        assert leaf.available == pytest.approx(0.5)
+        assert leaf.available_whole_cell == 0
+        assert root.available == pytest.approx(7.5)
+        assert root.available_whole_cell == 7
+        tree.reserve(leaf, 0.5, 4 << 30)
+        assert leaf.available == pytest.approx(0.0)
+        with pytest.raises(ValueError):
+            tree.reserve(leaf, 0.1, 0)
+        tree.reclaim(leaf, 0.5, 4 << 30)
+        tree.reclaim(leaf, 0.5, 4 << 30)
+        assert leaf.is_whole_free and leaf.available_whole_cell == 1
+        assert root.available_whole_cell == 8
+        assert root.free_memory == 8 * (16 << 30)
+
+    def test_over_reclaim_raises(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        leaf = tree.leaf_cells["node-a-chip-0"]
+        [root] = tree.free_list["tpu-v5e"][4]
+        tree.reserve(leaf, 1.0, 8 << 30)
+        tree.reclaim(leaf, 1.0, 8 << 30)
+        with pytest.raises(ValueError, match="over-reclaim"):
+            tree.reclaim(leaf, 1.0, 8 << 30)
+        with pytest.raises(ValueError, match="over-reclaim"):
+            tree.reclaim(leaf, 0.0, 1)
+        assert root.available == 8.0  # accounting intact after rejections
+
+    def test_reserve_unbound_leaf_raises(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        [root] = tree.free_list["tpu-v5e"][4]
+        unbound = next(iter(root.children[1].iter_leaves()))
+        with pytest.raises(ValueError, match="unbound"):
+            tree.reserve(unbound, 0.5, 0)
+
+    def test_memory_guard(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        leaf = tree.leaf_cells["node-a-chip-1"]
+        with pytest.raises(ValueError):
+            tree.reserve(leaf, 0.1, (16 << 30) + 1)
+
+
+class TestDistance:
+    def test_unravel(self):
+        assert unravel(0, (4, 4)) == (0, 0)
+        assert unravel(7, (4, 4)) == (1, 3)
+        assert unravel(13, (2, 2, 4)) == (1, 1, 1)
+
+    def test_torus_wraparound(self):
+        assert torus_distance((0, 0), (3, 0), (4, 4)) == 1
+        assert torus_distance((0, 0), (2, 2), (4, 4)) == 4
+        assert torus_distance((0,), (1,), (2,)) == 1
+
+    def test_id_path_distance(self):
+        assert id_path_distance("1/n/1/2", "1/n/1/2") == 0
+        assert id_path_distance("1/n/1/1", "1/n/1/4") == 3
+        assert id_path_distance("1/a/1/1", "1/b/1/1") == 100
+        assert id_path_distance("1/n/1", "1/n/1/2") == 100
+
+    def test_ici_distance_prefers_torus(self):
+        tree = CellTree(load_topology(V5E_16))
+        [root] = tree.free_list["tpu-v5e"][4]
+        leaves = list(root.iter_leaves())
+        # leaf 0 (0,0) and leaf 12 (3,0): 1 hop via wraparound, though the
+        # id-path distance (different nodes) would be 100+.
+        assert ici_distance(leaves[0], leaves[12]) == 1.0
+        assert id_path_distance(leaves[0].id, leaves[12].id) >= 100
+
+    def test_ici_distance_cross_tree_fallback(self):
+        tree = CellTree(load_topology(HETERO))
+        tree.bind_node("lite-1", chips("lite-1", "tpu-v5e", 4))
+        tree.bind_node("perf-1", chips("perf-1", "tpu-v5p", 4))
+        a = tree.leaf_cells["lite-1-chip-0"]
+        b = tree.leaf_cells["perf-1-chip-0"]
+        assert ici_distance(a, b) >= 100

@@ -1,0 +1,117 @@
+import threading
+
+import pytest
+
+from kubeshare_tpu.utils.bitmap import Bitmap, RRBitmap
+from kubeshare_tpu.utils import expfmt
+from kubeshare_tpu.utils.httpserv import MetricServer
+
+
+class TestBitmap:
+    def test_set_get_clear(self):
+        b = Bitmap(130)
+        assert not b.get(0)
+        b.set(0)
+        b.set(129)
+        assert b.get(0) and b.get(129)
+        assert b.count() == 2
+        b.clear(0)
+        assert not b.get(0)
+
+    def test_bounds(self):
+        b = Bitmap(8)
+        with pytest.raises(IndexError):
+            b.get(8)
+        with pytest.raises(ValueError):
+            Bitmap(0)
+
+    def test_find_first_clear(self):
+        b = Bitmap(3)
+        assert b.find_first_clear() == 0
+        b.set(0), b.set(1), b.set(2)
+        assert b.find_first_clear() == -1
+
+
+class TestRRBitmap:
+    def test_round_robin_order(self):
+        b = RRBitmap(4)
+        assert [b.find_next_and_set() for _ in range(4)] == [0, 1, 2, 3]
+        assert b.find_next_and_set() == -1
+        # Freed slot is not immediately reissued: cursor wraps past it.
+        b.clear(1)
+        b.clear(3)
+        assert b.find_next_and_set() == 1  # cursor at 3 -> wraps to 0(set),1
+        b.clear(0)
+        assert b.find_next_and_set() == 3
+
+    def test_mask_does_not_move_cursor(self):
+        b = RRBitmap(4)
+        b.mask(0)
+        assert b.find_next_and_set() == 1
+
+    def test_concurrent_alloc_unique(self):
+        b = RRBitmap(512)
+        got = []
+        lock = threading.Lock()
+
+        def worker():
+            for _ in range(64):
+                idx = b.find_next_and_set()
+                with lock:
+                    got.append(idx)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert -1 not in got
+        assert len(set(got)) == 512
+
+
+class TestExpfmt:
+    def test_roundtrip(self):
+        samples = [
+            expfmt.Sample("tpu_capacity", {"node": "n1", "uuid": "chip-0", "model": "v5e"}, 16.0),
+            expfmt.Sample("tpu_capacity", {"node": "n2", "uuid": "chip-1", "model": "v5e"}, 16.0),
+            expfmt.Sample("up", {}, 1.0),
+        ]
+        text = expfmt.render(samples, help_text={"tpu_capacity": "chips"})
+        assert "# HELP tpu_capacity chips" in text
+        parsed = expfmt.parse(text)
+        assert sorted(s.name for s in parsed) == ["tpu_capacity", "tpu_capacity", "up"]
+        sel = expfmt.select(parsed, "tpu_capacity", node="n1")
+        assert len(sel) == 1 and sel[0].labels["uuid"] == "chip-0"
+
+    def test_escaping(self):
+        s = expfmt.Sample("m", {"k": 'a"b\\c\nd'}, 2.5)
+        [back] = expfmt.parse(expfmt.render([s]))
+        assert back.labels["k"] == 'a"b\\c\nd'
+        assert back.value == 2.5
+
+    def test_malformed_lines_skipped(self):
+        text = (
+            'good{a="1"} 2\n'
+            'truncated{node="n1\n'      # scrape cut mid-line
+            "noval\n"
+            "bad{x=unquoted} 1\n"
+            "ok 3\n"
+        )
+        parsed = expfmt.parse(text)
+        assert [(s.name, s.value) for s in parsed] == [("good", 2.0), ("ok", 3.0)]
+
+
+class TestMetricServer:
+    def test_scrape(self):
+        import urllib.request
+
+        srv = MetricServer(host="127.0.0.1", port=0)
+        srv.route("/metrics", lambda: expfmt.render([expfmt.Sample("up", {}, 1)]))
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics"
+            ).read().decode()
+            assert "up 1" in body
+            with pytest.raises(Exception):
+                urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/nope")
+        finally:
+            srv.stop()
