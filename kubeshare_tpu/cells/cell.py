@@ -76,9 +76,16 @@ def build_cell_elements(
     elements: Dict[str, CellElement] = {}
     chip_priority: Dict[str, int] = {}
 
+    in_progress: set = set()
+
     def add(cell_type: str, priority: int) -> CellElement:
         if cell_type in elements:
             return elements[cell_type]
+        if cell_type in in_progress:
+            raise ValueError(
+                f"cell type chain contains a cycle through {cell_type!r}"
+            )
+        in_progress.add(cell_type)
         cts = cell_types.get(cell_type)
         if cts is None:  # leaf chip model
             el = CellElement(
@@ -94,8 +101,10 @@ def build_cell_elements(
             )
             elements[cell_type] = el
             chip_priority[cell_type] = priority
+            in_progress.discard(cell_type)
             return el
         child = add(cts.child_cell_type, cts.child_cell_priority)
+        in_progress.discard(cell_type)
         el = CellElement(
             cell_type=cell_type,
             level=child.level + 1,
@@ -182,8 +191,15 @@ class Cell:
 
     @property
     def is_whole_free(self) -> bool:
-        """A bound leaf with its full fractional capacity untouched."""
-        return self.state == CellState.BOUND and feq(self.available, 1.0)
+        """A bound leaf with full fractional capacity AND full HBM free —
+        what a multi-chip reservation (which takes the whole chip and all
+        its memory) can consume. A memory-only reservation (request=0,
+        tpu_mem>0) makes a chip not-whole."""
+        return (
+            self.state == CellState.BOUND
+            and feq(self.available, 1.0)
+            and self.free_memory == self.full_memory
+        )
 
 
 class CellTree:
@@ -285,31 +301,61 @@ class CellTree:
                     pool = reported.get(leaf.leaf_cell_type, [])
                     for i, chip in enumerate(pool):
                         if chip.uuid == leaf.uuid:
+                            if chip.memory != leaf.full_memory:
+                                # HBM correction from the collector (e.g.
+                                # firmware-reserved memory): apply the delta
+                                delta = chip.memory - leaf.full_memory
+                                leaf.full_memory += delta
+                                leaf.free_memory += delta
+                                self._propagate(leaf, 0.0, 0, delta, delta)
                             pool.pop(i)
                             break
                     self._set_health(leaf, True)
                 else:
                     self._unbind_leaf(leaf)
-        # pass 2: bind remaining chips onto unbound leaves
+        # pass 2: bind remaining chips onto unbound leaves. Chip index is
+        # matched to the leaf's position among its node+model peers first,
+        # so a returning chip recovers its physical torus coordinate; only
+        # chips with no positional home fall back to tree order.
         bound = 0
+        unbound: Dict[str, List[Tuple[int, Cell]]] = {}
+        position: Dict[str, int] = {}
         for leaf in node_leaves:
-            if leaf.state == CellState.BOUND:
-                continue
-            pool = reported.get(leaf.leaf_cell_type)
+            model = leaf.leaf_cell_type
+            pos = position.get(model, 0)
+            position[model] = pos + 1
+            if leaf.state != CellState.BOUND:
+                unbound.setdefault(model, []).append((pos, leaf))
+        for model, slots in unbound.items():
+            pool = reported.get(model)
             if not pool:
                 continue
-            chip = pool.pop(0)
-            leaf.uuid = chip.uuid
-            leaf.full_memory = chip.memory
-            leaf.free_memory = chip.memory
-            leaf.available = 1.0
-            leaf.available_whole_cell = 1
-            leaf.state = CellState.BOUND
-            self.leaf_cells[chip.uuid] = leaf
-            self._propagate(leaf, 1.0, 1, chip.memory, chip.memory)
-            self._set_health(leaf, True)
-            bound += 1
+            by_pos = {pos: leaf for pos, leaf in slots}
+            leftovers: List[ChipInfo] = []
+            for chip in pool:
+                leaf = by_pos.pop(chip.index, None)
+                if leaf is None:
+                    leftovers.append(chip)
+                    continue
+                self._bind_leaf(leaf, chip)
+                bound += 1
+            for chip, (_, leaf) in zip(
+                leftovers, sorted(by_pos.items())
+            ):
+                self._bind_leaf(leaf, chip)
+                bound += 1
         return bound
+
+    def _bind_leaf(self, leaf: Cell, chip: ChipInfo) -> None:
+        leaf.uuid = chip.uuid
+        leaf.full_memory = chip.memory
+        leaf.free_memory = chip.memory
+        leaf.available = 1.0
+        leaf.available_whole_cell = 1
+        leaf.state = CellState.BOUND
+        self.leaf_cells[chip.uuid] = leaf
+        self._propagate(leaf, 1.0, 1, chip.memory, chip.memory)
+        self._set_health(leaf, True)
 
     def _unbind_leaf(self, leaf: Cell) -> None:
         """Withdraw a vanished chip: capacity and memory leave the tree,
@@ -367,25 +413,31 @@ class CellTree:
             raise ValueError(f"reserve targets leaf cells, got {leaf!r}")
         if leaf.state != CellState.BOUND:
             raise ValueError(f"reserve on unbound leaf {leaf.id}")
+        if request < 0 or memory < 0:
+            raise ValueError(
+                f"negative reservation on {leaf.id}: request={request} mem={memory}"
+            )
         if not fge(leaf.available, request) or leaf.free_memory < memory:
             raise ValueError(
                 f"over-reservation on {leaf.id}: request={request} "
                 f"mem={memory} vs {leaf!r}"
             )
-        was_whole = leaf.is_whole_free and not feq(request, 0.0)
-        cell: Optional[Cell] = leaf
-        while cell is not None:
-            cell.available = max(0.0, cell.available - request)
-            cell.free_memory -= memory
-            if was_whole:
-                cell.available_whole_cell -= 1
-            cell = cell.parent
+        was_whole = leaf.is_whole_free
+        leaf.available = max(0.0, leaf.available - request)
+        leaf.free_memory -= memory
+        whole_delta = int(leaf.is_whole_free) - int(was_whole)
+        leaf.available_whole_cell += whole_delta
+        self._propagate(leaf, -request, whole_delta, -memory, 0)
 
     def reclaim(self, leaf: Cell, request: float, memory: int) -> None:
         if leaf.level != 1:
             raise ValueError(f"reclaim targets leaf cells, got {leaf!r}")
         if leaf.state != CellState.BOUND:
             raise ValueError(f"reclaim on unbound leaf {leaf.id}")
+        if request < 0 or memory < 0:
+            raise ValueError(
+                f"negative reclaim on {leaf.id}: request={request} mem={memory}"
+            )
         if leaf.available + request > 1.0 + _EPS or (
             leaf.free_memory + memory > leaf.full_memory
         ):
@@ -393,14 +445,12 @@ class CellTree:
                 f"over-reclaim on {leaf.id}: request={request} mem={memory} "
                 f"vs {leaf!r}"
             )
-        becomes_whole = feq(leaf.available + request, 1.0) and not feq(request, 0.0)
-        cell: Optional[Cell] = leaf
-        while cell is not None:
-            cell.available += request
-            cell.free_memory += memory
-            if becomes_whole:
-                cell.available_whole_cell += 1
-            cell = cell.parent
+        was_whole = leaf.is_whole_free
+        leaf.available += request
+        leaf.free_memory += memory
+        whole_delta = int(leaf.is_whole_free) - int(was_whole)
+        leaf.available_whole_cell += whole_delta
+        self._propagate(leaf, request, whole_delta, memory, 0)
 
     # -- queries -------------------------------------------------------
 

@@ -1,0 +1,3 @@
+from .api import ClusterAPI, Container, Node, Pod, PodPhase
+
+__all__ = ["ClusterAPI", "Container", "Node", "Pod", "PodPhase"]

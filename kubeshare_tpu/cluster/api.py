@@ -1,0 +1,101 @@
+"""Cluster abstraction the scheduler runs against.
+
+The engine never imports a Kubernetes client directly; it talks to this
+interface. ``cluster.fake.FakeCluster`` implements it hermetically for
+tests and the simulator; a real adapter (kubernetes python client) can
+implement the same surface. This is what lets the Filter/Score/Reserve
+logic be unit-tested without a cluster — the harness the reference
+lacks entirely (SURVEY.md §4: zero ``*_test.go`` files upstream).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Protocol
+
+
+class PodPhase(str, enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class Container:
+    name: str = "main"
+    env: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Pod:
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    node_name: str = ""
+    phase: PodPhase = PodPhase.PENDING
+    scheduler_name: str = ""
+    containers: List[Container] = field(default_factory=lambda: [Container()])
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    @property
+    def is_bound(self) -> bool:
+        return self.node_name != ""
+
+    @property
+    def is_completed(self) -> bool:
+        return self.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+
+
+@dataclass
+class Node:
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    ready: bool = True
+    unschedulable: bool = False
+
+    @property
+    def healthy(self) -> bool:
+        return self.ready and not self.unschedulable
+
+
+class ClusterAPI(Protocol):
+    """Minimal verbs the scheduler needs from the cluster."""
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        ...
+
+    def list_nodes(self) -> List[Node]:
+        ...
+
+    def get_pod(self, key: str) -> Optional[Pod]:
+        ...
+
+    def bind(self, pod_key: str, node_name: str) -> None:
+        """Set spec.nodeName — the proper Bind verb, replacing the
+        reference's delete+recreate shadow-pod hack
+        (pkg/scheduler/scheduler.go:515-528)."""
+        ...
+
+    def patch_pod(
+        self,
+        pod_key: str,
+        annotations: Optional[Dict[str, str]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Merge annotations and per-container env into the pod."""
+        ...
+
+    def on_pod_event(
+        self, add: Callable[[Pod], None], delete: Callable[[Pod], None]
+    ) -> None:
+        ...
+
+    def on_node_event(self, update: Callable[[Node], None]) -> None:
+        ...

@@ -1,0 +1,111 @@
+"""Hermetic in-memory cluster + chip inventory for tests & simulation.
+
+Replaces three process boundaries of the reference with direct calls:
+the kube API (informers), the Prometheus bus (collector -> scheduler),
+and node chip enumeration. The scheduler code is identical either way —
+it only sees ``ClusterAPI`` and an inventory callable.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Dict, List, Optional
+
+from ..cells.cell import ChipInfo
+from .api import Node, Pod, PodPhase
+
+
+class FakeCluster:
+    def __init__(self):
+        self._pods: Dict[str, Pod] = {}
+        self._nodes: Dict[str, Node] = {}
+        self._chips: Dict[str, List[ChipInfo]] = {}
+        self._pod_add_handlers: List[Callable[[Pod], None]] = []
+        self._pod_delete_handlers: List[Callable[[Pod], None]] = []
+        self._node_handlers: List[Callable[[Node], None]] = []
+        self._uid_counter = itertools.count(1)
+
+    # ---- ClusterAPI ------------------------------------------------
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        pods = list(self._pods.values())
+        if namespace is not None:
+            pods = [p for p in pods if p.namespace == namespace]
+        return pods
+
+    def list_nodes(self) -> List[Node]:
+        return list(self._nodes.values())
+
+    def get_pod(self, key: str) -> Optional[Pod]:
+        return self._pods.get(key)
+
+    def bind(self, pod_key: str, node_name: str) -> None:
+        pod = self._pods[pod_key]
+        pod.node_name = node_name
+        pod.phase = PodPhase.RUNNING
+
+    def patch_pod(
+        self,
+        pod_key: str,
+        annotations: Optional[Dict[str, str]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        pod = self._pods[pod_key]
+        if annotations:
+            pod.annotations.update(annotations)
+        if env:
+            for container in pod.containers:
+                container.env.update(env)
+
+    def on_pod_event(self, add, delete) -> None:
+        self._pod_add_handlers.append(add)
+        self._pod_delete_handlers.append(delete)
+
+    def on_node_event(self, update) -> None:
+        self._node_handlers.append(update)
+
+    # ---- test-side verbs -------------------------------------------
+
+    def add_node(
+        self, name: str, chips: Optional[List[ChipInfo]] = None, **node_kwargs
+    ) -> Node:
+        node = Node(name=name, **node_kwargs)
+        self._nodes[name] = node
+        if chips is not None:
+            self._chips[name] = list(chips)
+        for handler in self._node_handlers:
+            handler(node)
+        return node
+
+    def set_node_ready(self, name: str, ready: bool) -> None:
+        node = self._nodes[name]
+        node.ready = ready
+        for handler in self._node_handlers:
+            handler(node)
+
+    def chips_on_node(self, node_name: str) -> List[ChipInfo]:
+        """The inventory source (stands in for the collector scrape)."""
+        return list(self._chips.get(node_name, []))
+
+    def create_pod(self, pod: Pod) -> Pod:
+        if not pod.uid:
+            pod.uid = f"uid-{next(self._uid_counter)}"
+        self._pods[pod.key] = pod
+        for handler in self._pod_add_handlers:
+            handler(pod)
+        return pod
+
+    def delete_pod(self, key: str) -> Optional[Pod]:
+        pod = self._pods.pop(key, None)
+        if pod is not None:
+            for handler in self._pod_delete_handlers:
+                handler(pod)
+        return pod
+
+    def finish_pod(self, key: str, failed: bool = False) -> None:
+        pod = self._pods[key]
+        pod.phase = PodPhase.FAILED if failed else PodPhase.SUCCEEDED
+        # completed pods release resources (reference filterPod -> deletePod,
+        # pkg/scheduler/pod.go:139-153)
+        for handler in self._pod_delete_handlers:
+            handler(pod)

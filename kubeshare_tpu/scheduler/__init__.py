@@ -1,0 +1,22 @@
+from . import constants
+from .labels import GangSpec, LabelError, PodKind, PodRequirements, parse_pod
+from .plugin import Decision, TpuShareScheduler, Unschedulable
+from .podgroup import PodGroupInfo, PodGroupRegistry
+from .state import PodState, PodStatus, PodStatusStore
+
+__all__ = [
+    "constants",
+    "GangSpec",
+    "LabelError",
+    "PodKind",
+    "PodRequirements",
+    "parse_pod",
+    "Decision",
+    "TpuShareScheduler",
+    "Unschedulable",
+    "PodGroupInfo",
+    "PodGroupRegistry",
+    "PodState",
+    "PodStatus",
+    "PodStatusStore",
+]

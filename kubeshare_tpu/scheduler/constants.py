@@ -1,0 +1,58 @@
+"""Label / annotation / env contract of the workload API.
+
+Pods opt in by setting ``spec.schedulerName: kubeshare-tpu-scheduler``
+plus ``sharedtpu/*`` labels, mirroring the reference's ``sharedgpu/*``
+surface (pkg/scheduler/constants.go:3-28, README.md:34-45) with TPU
+naming. Outputs (annotations + env) are injected at Reserve time.
+"""
+
+SCHEDULER_NAME = "kubeshare-tpu-scheduler"
+
+DOMAIN = "sharedtpu/"
+
+# ---- input labels (set by the user) --------------------------------
+LABEL_GROUP_NAME = DOMAIN + "group_name"          # gang name
+LABEL_GROUP_HEADCOUNT = DOMAIN + "group_headcount"  # total pods in gang
+LABEL_GROUP_THRESHOLD = DOMAIN + "group_threshold"  # min fraction to start
+LABEL_PRIORITY = DOMAIN + "priority"              # 1..100 guarantee, 0/unset opportunistic
+LABEL_TPU_LIMIT = DOMAIN + "tpu_request_limit"    # burst ceiling (chip fraction)
+LABEL_TPU_REQUEST = DOMAIN + "tpu_request"        # guaranteed chip fraction
+LABEL_TPU_MEMORY = DOMAIN + "tpu_mem"             # HBM bytes cap
+LABEL_TPU_MODEL = DOMAIN + "tpu_model"            # chip generation pin (e.g. tpu-v5e)
+
+# compat aliases: accept the short names used in docs/examples too
+LABEL_TPU_LIMIT_ALIASES = (LABEL_TPU_LIMIT, DOMAIN + "tpu_limit")
+
+# ---- output annotations (set by the scheduler) ---------------------
+ANNOTATION_CHIP_UUID = DOMAIN + "chip_uuid"
+ANNOTATION_CELL_ID = DOMAIN + "cell_id"
+ANNOTATION_MANAGER_PORT = DOMAIN + "tpu_manager_port"
+ANNOTATION_TPU_MEMORY = DOMAIN + "tpu_mem"
+ANNOTATION_TPU_MODEL = DOMAIN + "tpu_model"
+
+# ---- env injected into every container of a placed pod -------------
+ENV_VISIBLE_CHIPS = "TPU_VISIBLE_CHIPS"        # chip uuid list (comma sep)
+ENV_VISIBLE_DEVICE_IDS = "TPU_VISIBLE_DEVICES"  # chip indices on the node
+ENV_POD_MANAGER_PORT = "KUBESHARE_POD_MANAGER_PORT"
+ENV_POD_NAME = "KUBESHARE_POD_NAME"            # namespace/name
+ENV_HBM_LIMIT = "KUBESHARE_HBM_LIMIT_BYTES"
+ENV_LIBRARY_PATH = "KUBESHARE_LIBRARY_PATH"
+
+# hostPath where the hook library + scheduler IP file live on each node
+LIBRARY_PATH = "/kubeshare/library"
+SCHEDULER_IP_FILE = LIBRARY_PATH + "/schedulerIP.txt"
+LOG_DIR = "/kubeshare/log"
+CONFIG_DIR = "/kubeshare/scheduler/config"
+PORT_DIR = "/kubeshare/scheduler/podmanagerport"
+
+# ---- operating parameters ------------------------------------------
+POD_MANAGER_PORT_START = 50050
+POD_MANAGER_PORT_COUNT = 512
+CHIP_ARBITER_BASE_PORT = 49901
+
+PERMIT_WAIT_BASE_SECONDS = 2        # × group headcount
+POD_GROUP_EXPIRATION_SECONDS = 600
+POD_GROUP_GC_INTERVAL_SECONDS = 30
+
+MAX_NODE_SCORE = 100
+MIN_NODE_SCORE = 0

@@ -1,0 +1,69 @@
+"""Node feasibility checks.
+
+Reads only the cell tree's O(1) aggregates (maintained by reserve/
+reclaim walks) — no I/O on the hot path, unlike the reference which
+issues a Prometheus query inside Filter (node.go:42 via
+scheduler.go:335); inventory sync happens out-of-band in the engine.
+
+Divergence from the reference: its model-agnostic path admits a node
+when capacity *summed across chip models* covers the request
+(scheduler.go:398-404) even if no single chip/node-cell fits, which
+then fails at Reserve. Here a node passes only if some single model
+fits.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..cells.cell import Cell, CellTree, fge
+from .labels import PodKind, PodRequirements
+
+
+def shared_fit(
+    tree: CellTree, node: str, model: str, request: float, memory: int
+) -> bool:
+    """A fractional pod fits if one healthy bound leaf has capacity."""
+    for leaf in tree.leaves_on_node(node, model):
+        if leaf.healthy and fge(leaf.available, request) and leaf.free_memory >= memory:
+            return True
+    return False
+
+
+def _node_level_cells(tree: CellTree, node: str, model: str) -> List[Cell]:
+    cells = {}
+    for leaf in tree.leaves_on_node(node, model):
+        cell: Optional[Cell] = leaf
+        while cell is not None and not cell.is_node:
+            cell = cell.parent
+        if cell is not None:
+            cells[id(cell)] = cell
+    return list(cells.values())
+
+
+def multi_chip_fit(
+    tree: CellTree, node: str, model: str, chips: int, memory: int
+) -> bool:
+    """An integer pod fits if a node-level cell has enough whole free
+    chips (and HBM) under it."""
+    for cell in _node_level_cells(tree, node, model):
+        if cell.healthy and cell.available_whole_cell >= chips and cell.free_memory >= memory:
+            return True
+    return False
+
+
+def node_fits(
+    tree: CellTree, node: str, req: PodRequirements
+) -> Tuple[bool, str]:
+    """Full Filter verdict for one node. Returns (fit, reason)."""
+    models = [req.model] if req.model else tree.models_on_node(node)
+    if req.model and req.model not in tree.models_on_node(node):
+        return False, f"node {node} has no {req.model} chips"
+    for model in models:
+        if req.kind == PodKind.MULTI_CHIP:
+            if multi_chip_fit(tree, node, model, req.chip_count, req.memory):
+                return True, ""
+        else:
+            if shared_fit(tree, node, model, req.request, req.memory):
+                return True, ""
+    return False, f"node {node} cannot fit request={req.request} mem={req.memory}"

@@ -1,0 +1,192 @@
+"""Workload-label parsing and validation.
+
+Implements the reference's admission matrix (pkg/scheduler/pod.go:
+207-327) for TPU labels:
+
+- no ``sharedtpu/tpu_*`` labels        -> regular pod (not ours to place)
+- fractional (limit <= 1.0)            -> 0 <= request <= limit
+- multi-chip (limit > 1.0)             -> integer, request == limit
+- ``tpu_mem`` optional, bytes >= 0 (0 => defaulted at reserve time to
+  ``floor(request * chip HBM)``, pod.go:419-421)
+- ``priority`` 0 (or unset) = opportunistic, 1..100 = guarantee
+  (pod.go:181-205)
+- gang labels: group_name + headcount >= 1 + threshold > 0;
+  ``min_available = floor(headcount * threshold + 0.5)``
+  (pod_group.go:85-116)
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from ..cluster.api import Pod
+from . import constants as C
+
+_EPS = 1e-9
+
+
+class PodKind(enum.Enum):
+    REGULAR = "regular"        # no TPU labels — scored away from TPU nodes
+    SHARED = "shared"          # fractional chip (limit <= 1.0)
+    MULTI_CHIP = "multi_chip"  # integer chips (limit > 1.0)
+
+
+class LabelError(ValueError):
+    pass
+
+
+@dataclass
+class GangSpec:
+    name: str
+    headcount: int
+    threshold: float
+
+    @property
+    def min_available(self) -> int:
+        return int(math.floor(self.headcount * self.threshold + 0.5))
+
+
+@dataclass
+class PodRequirements:
+    kind: PodKind
+    limit: float = 0.0
+    request: float = 0.0
+    memory: int = 0
+    model: str = ""
+    priority: int = 0
+    gang: Optional[GangSpec] = None
+
+    @property
+    def is_guarantee(self) -> bool:
+        return self.priority > 0
+
+    @property
+    def chip_count(self) -> int:
+        """Whole chips for a multi-chip pod."""
+        return int(round(self.request))
+
+
+def _parse_float(pod: Pod, label: str, raw: str) -> float:
+    try:
+        value = float(raw)
+    except ValueError as e:
+        raise LabelError(f"pod {pod.key}: {label}={raw!r} is not a number") from e
+    if value < 0:
+        raise LabelError(f"pod {pod.key}: {label}={raw!r} must be >= 0")
+    return value
+
+
+def parse_priority(pod: Pod) -> int:
+    raw = pod.labels.get(C.LABEL_PRIORITY, "")
+    if raw == "":
+        return 0  # opportunistic by default
+    try:
+        p = int(raw)
+    except ValueError as e:
+        raise LabelError(f"pod {pod.key}: priority={raw!r} is not an integer") from e
+    if not 0 <= p <= 100:
+        raise LabelError(f"pod {pod.key}: priority={p} must be in 0..100")
+    return p
+
+
+def parse_gang(pod: Pod) -> Optional[GangSpec]:
+    name = pod.labels.get(C.LABEL_GROUP_NAME, "")
+    if not name:
+        return None
+    raw_head = pod.labels.get(C.LABEL_GROUP_HEADCOUNT, "")
+    raw_thresh = pod.labels.get(C.LABEL_GROUP_THRESHOLD, "")
+    if not raw_head or not raw_thresh:
+        # incomplete gang labels degrade to a solo pod (reference
+        # getPodGroupLabels returns "" on any missing piece)
+        return None
+    try:
+        headcount = int(raw_head)
+        threshold = float(raw_thresh)
+    except ValueError as e:
+        raise LabelError(
+            f"pod {pod.key}: gang labels headcount={raw_head!r} "
+            f"threshold={raw_thresh!r} malformed"
+        ) from e
+    if headcount < 1:
+        raise LabelError(f"pod {pod.key}: group_headcount={headcount} must be >= 1")
+    if not 0 < threshold <= 1.0:
+        raise LabelError(
+            f"pod {pod.key}: group_threshold={threshold} must be in (0, 1]"
+        )
+    return GangSpec(name=name, headcount=headcount, threshold=threshold)
+
+
+def parse_pod(pod: Pod) -> PodRequirements:
+    """Parse + validate. Raises ``LabelError`` on misconfiguration
+    (maps to Unschedulable in PreFilter); returns kind=REGULAR for pods
+    with no TPU labels."""
+    priority = parse_priority(pod)
+    gang = parse_gang(pod)
+
+    raw_limit = None
+    for label in C.LABEL_TPU_LIMIT_ALIASES:
+        if label in pod.labels:
+            raw_limit = pod.labels[label]
+            break
+    raw_request = pod.labels.get(C.LABEL_TPU_REQUEST)
+    raw_memory = pod.labels.get(C.LABEL_TPU_MEMORY)
+
+    if raw_limit is None and raw_request is None and raw_memory is None:
+        return PodRequirements(kind=PodKind.REGULAR, priority=priority, gang=gang)
+
+    if raw_limit is None:
+        raise LabelError(
+            f"pod {pod.key}: a TPU pod must set {C.LABEL_TPU_LIMIT_ALIASES[1]}"
+        )
+    limit = _parse_float(pod, "tpu_limit", raw_limit)
+    request = (
+        _parse_float(pod, "tpu_request", raw_request)
+        if raw_request is not None
+        else 0.0
+    )
+
+    if limit == 0.0 and request == 0.0:
+        return PodRequirements(kind=PodKind.REGULAR, priority=priority, gang=gang)
+
+    if limit > 1.0 + _EPS:
+        # multi-chip: integers, request == limit
+        if abs(limit - round(limit)) > _EPS:
+            raise LabelError(
+                f"pod {pod.key}: multi-chip limit={limit} must be an integer"
+            )
+        if abs(request - limit) > _EPS:
+            raise LabelError(
+                f"pod {pod.key}: multi-chip pods need request == limit "
+                f"(got {request} != {limit})"
+            )
+        kind = PodKind.MULTI_CHIP
+    else:
+        if request > limit + _EPS:
+            raise LabelError(
+                f"pod {pod.key}: request={request} exceeds limit={limit}"
+            )
+        kind = PodKind.SHARED
+
+    memory = 0
+    if raw_memory is not None:
+        try:
+            memory = int(raw_memory)
+        except ValueError as e:
+            raise LabelError(
+                f"pod {pod.key}: tpu_mem={raw_memory!r} is not an integer"
+            ) from e
+        if memory < 0:
+            raise LabelError(f"pod {pod.key}: tpu_mem={memory} must be >= 0")
+
+    return PodRequirements(
+        kind=kind,
+        limit=limit,
+        request=request,
+        memory=memory,
+        model=pod.labels.get(C.LABEL_TPU_MODEL, ""),
+        priority=priority,
+        gang=gang,
+    )

@@ -1,0 +1,498 @@
+"""The scheduling engine: QueueSort → PreFilter → Filter → Score →
+Reserve → Permit → Bind, plus informer handlers and restart resync.
+
+Hook-for-hook parity with the reference plugin (pkg/scheduler/
+scheduler.go:242-587) with the quirks fixed:
+
+- proper Bind via the cluster API instead of delete+recreate shadow
+  pods (scheduler.go:515-528);
+- no Prometheus round-trip inside Filter — inventory sync is
+  event-driven (node.go:42);
+- reserve-time chip selection anchors gang members to each other in
+  ICI hop space.
+
+State is rebuilt from pod annotations after a restart (pod.go:47-78,
+528-617): the cluster objects are the durable store.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
+
+from ..cells.cell import Cell, CellTree, ChipInfo
+from ..cells.spec import TopologyConfig, load_topology
+from ..cluster.api import ClusterAPI, Node, Pod
+from ..utils.bitmap import RRBitmap
+from ..utils.logger import get_logger
+from . import constants as C
+from .filtering import node_fits
+from .labels import LabelError, PodKind, PodRequirements, parse_pod
+from .podgroup import PodGroupRegistry
+from .scoring import normalize_scores, score_node, select_leaves, _resolved_memory
+from .state import PodState, PodStatus, PodStatusStore
+
+
+class Unschedulable(Exception):
+    pass
+
+
+@dataclass
+class Decision:
+    status: str            # "bound" | "waiting" | "unschedulable"
+    pod_key: str
+    node: str = ""
+    message: str = ""
+    bound_with: List[str] = field(default_factory=list)  # gang members bound together
+
+
+@dataclass
+class _Waiting:
+    pod_key: str
+    node: str
+    deadline: float
+
+
+class TpuShareScheduler:
+    def __init__(
+        self,
+        topology: Union[str, dict, TopologyConfig],
+        cluster: ClusterAPI,
+        inventory: Optional[Callable[[str], List[ChipInfo]]] = None,
+        clock: Callable[[], float] = _time.monotonic,
+        permit_wait_base: float = C.PERMIT_WAIT_BASE_SECONDS,
+        log=None,
+    ):
+        cfg = (
+            topology
+            if isinstance(topology, TopologyConfig)
+            else load_topology(topology)
+        )
+        self.tree = CellTree(cfg)
+        self.cluster = cluster
+        self.inventory = inventory or getattr(cluster, "chips_on_node")
+        self.clock = clock
+        self.permit_wait_base = permit_wait_base
+        self.log = log or get_logger("scheduler", level=0)
+
+        self.status = PodStatusStore()
+        self.groups = PodGroupRegistry(clock=clock)
+        self.ports: Dict[str, RRBitmap] = {}
+        self._waiting: Dict[str, Dict[str, _Waiting]] = {}  # group_key -> pods
+        self._synced_nodes: Set[str] = set()
+        self._bound_queue: Dict[str, List[Pod]] = {}  # node -> pods to resync
+
+        cluster.on_pod_event(self._on_pod_add, self._on_pod_delete)
+        cluster.on_node_event(self._on_node_update)
+        # replay pre-existing cluster state (scheduler restart)
+        for node in cluster.list_nodes():
+            self._on_node_update(node)
+        for pod in cluster.list_pods():
+            self._on_pod_add(pod)
+
+    # ================= informer handlers =============================
+
+    def _on_node_update(self, node: Node) -> None:
+        if not node.healthy:
+            self.tree.set_node_health(node.name, False)
+            return
+        chips = self.inventory(node.name)
+        if chips:
+            self.tree.bind_node(node.name, chips)
+        else:
+            self.tree.set_node_health(node.name, True)
+        self._synced_nodes.add(node.name)
+        self.ports.setdefault(node.name, RRBitmap(C.POD_MANAGER_PORT_COUNT))
+        for pod in self._bound_queue.pop(node.name, []):
+            self._restore_bound_pod(pod)
+
+    def _on_pod_add(self, pod: Pod) -> None:
+        if pod.scheduler_name != C.SCHEDULER_NAME:
+            return
+        if not pod.is_bound or pod.is_completed:
+            return
+        if self.status.get(pod.key) is not None:
+            return
+        if C.ANNOTATION_CHIP_UUID not in pod.annotations:
+            return  # regular pod, nothing to restore
+        if pod.node_name in self._synced_nodes:
+            self._restore_bound_pod(pod)
+        else:
+            self._bound_queue.setdefault(pod.node_name, []).append(pod)
+
+    def _on_pod_delete(self, pod: Pod) -> None:
+        self.groups.forget_pod(pod.key)
+        status = self.status.pop(pod.key)
+        if status is not None:
+            self._release(status)
+            group_waiters = self._waiting.get(status.group_key)
+            if group_waiters is not None:
+                group_waiters.pop(pod.key, None)
+                if not group_waiters:
+                    self._waiting.pop(status.group_key, None)
+        group_key = status.group_key if status else ""
+        if group_key:
+            remaining = self._count_group_pods(
+                pod.namespace, group_key.split("/", 1)[1], exclude=pod.key
+            )
+            if remaining <= 0:
+                self.groups.mark_deleted(group_key)
+
+    def _restore_bound_pod(self, pod: Pod) -> None:
+        """Rebuild reservation state from annotations after a restart."""
+        try:
+            req = parse_pod(pod)
+        except LabelError as e:
+            self.log.error("resync %s: %s", pod.key, e)
+            return
+        uuids = [
+            u for u in pod.annotations.get(C.ANNOTATION_CHIP_UUID, "").split(",") if u
+        ]
+        leaves = [
+            self.tree.leaf_cells[u] for u in uuids if u in self.tree.leaf_cells
+        ]
+        if len(leaves) != len(uuids):
+            self.log.error(
+                "resync %s: %d of %d chips missing from inventory",
+                pod.key, len(uuids) - len(leaves), len(uuids),
+            )
+        group = self.groups.get_or_create(pod, req.gang)
+        status = PodStatus(
+            key=pod.key,
+            uid=pod.uid,
+            requirements=req,
+            group_key=group.key,
+            node_name=pod.node_name,
+            state=PodState.BOUND,
+        )
+        try:
+            memory = int(pod.annotations.get(C.ANNOTATION_TPU_MEMORY, "0"))
+        except ValueError:
+            memory = 0
+        if req.kind == PodKind.MULTI_CHIP:
+            for leaf in leaves:
+                self.tree.reserve(leaf, 1.0, leaf.full_memory)
+            status.memory = sum(l.full_memory for l in leaves)
+        else:
+            if leaves:
+                self.tree.reserve(leaves[0], req.request, memory)
+            status.memory = memory
+            try:
+                port = int(pod.annotations.get(C.ANNOTATION_MANAGER_PORT, "0"))
+            except ValueError:
+                port = 0
+            if (
+                C.POD_MANAGER_PORT_START
+                <= port
+                < C.POD_MANAGER_PORT_START + C.POD_MANAGER_PORT_COUNT
+            ):
+                self.ports.setdefault(
+                    pod.node_name, RRBitmap(C.POD_MANAGER_PORT_COUNT)
+                ).mask(port - C.POD_MANAGER_PORT_START)
+                status.port = port
+            elif port:
+                self.log.error(
+                    "resync %s: manager port %d out of range, ignoring",
+                    pod.key, port,
+                )
+        status.leaves = leaves
+        status.uuids = [l.uuid for l in leaves]
+        self.status.put(status)
+
+    # ================= framework hooks ===============================
+
+    def queue_sort_key(self, pod: Pod):
+        """Priority desc, then group/pod creation time, then key
+        (reference Less, scheduler.go:247-267). Total order is stable
+        across re-sorts; malformed pods sort last (PreFilter will
+        reject them with a real message)."""
+        try:
+            group = self.groups.get_or_create(pod)
+        except LabelError:
+            return (101, 0.0, pod.key)
+        ts = group.timestamp if group.key else self.groups.pod_timestamp(pod.key, self.clock)
+        return (-group.priority, ts, group.key or pod.key)
+
+    def pre_filter(self, pod: Pod) -> PodRequirements:
+        """Label validation + gang sanity. Raises Unschedulable."""
+        try:
+            req = parse_pod(pod)
+        except LabelError as e:
+            raise Unschedulable(str(e)) from e
+        group = self.groups.get_or_create(pod, req.gang)
+        if group.key:
+            if req.gang and req.gang.min_available != group.min_available:
+                raise Unschedulable(
+                    f"pod {pod.key} min_available {req.gang.min_available} != "
+                    f"group {group.key} min_available {group.min_available}"
+                )
+            if req.priority != group.priority:
+                raise Unschedulable(
+                    f"pod {pod.key} priority {req.priority} != group "
+                    f"{group.key} priority {group.priority}"
+                )
+            total = self._count_group_pods(pod.namespace, group.name)
+            if total < group.min_available:
+                raise Unschedulable(
+                    f"group {group.key} has {total} pods < min_available "
+                    f"{group.min_available}"
+                )
+        return req
+
+    def filter(self, pod: Pod, req: PodRequirements, node_name: str):
+        """Per-node feasibility: port pool + cell-tree fit. Returns
+        (fit, reason)."""
+        self._ensure_synced(node_name)
+        if req.kind == PodKind.REGULAR:
+            return True, ""
+        if req.kind == PodKind.SHARED:
+            ports = self.ports.setdefault(
+                node_name, RRBitmap(C.POD_MANAGER_PORT_COUNT)
+            )
+            if ports.find_next_from_current() == -1:
+                return False, f"node {node_name}: pod-manager port pool full"
+        return node_fits(self.tree, node_name, req)
+
+    def score(self, pod: Pod, req: PodRequirements, node_name: str) -> float:
+        anchors = self.status.group_placed_leaves(
+            self.groups.get_or_create(pod, req.gang).key
+        )
+        return score_node(self.tree, node_name, req, anchors)
+
+    def reserve(self, pod: Pod, req: PodRequirements, node_name: str) -> PodStatus:
+        group = self.groups.get_or_create(pod, req.gang)
+        anchors = self.status.group_placed_leaves(group.key)
+        leaves = select_leaves(self.tree, node_name, req, anchors)
+        if not leaves:
+            raise Unschedulable(
+                f"pod {pod.key}: no chips left on {node_name} at reserve time"
+            )
+        status = PodStatus(
+            key=pod.key,
+            uid=pod.uid,
+            requirements=req,
+            group_key=group.key,
+            node_name=node_name,
+            leaves=leaves,
+            uuids=[l.uuid for l in leaves],
+            state=PodState.RESERVED,
+        )
+        annotations: Dict[str, str] = {}
+        env: Dict[str, str] = {}
+        if req.kind == PodKind.MULTI_CHIP:
+            total_memory = 0
+            for leaf in leaves:
+                self.tree.reserve(leaf, 1.0, leaf.full_memory)
+                total_memory += leaf.full_memory
+            status.memory = total_memory
+            annotations[C.ANNOTATION_CELL_ID] = ",".join(l.id for l in leaves)
+            annotations[C.ANNOTATION_CHIP_UUID] = ",".join(l.uuid for l in leaves)
+            annotations[C.ANNOTATION_TPU_MODEL] = leaves[0].leaf_cell_type
+            annotations[C.ANNOTATION_TPU_MEMORY] = str(total_memory)
+            env[C.ENV_VISIBLE_CHIPS] = ",".join(l.uuid for l in leaves)
+        else:
+            leaf = leaves[0]
+            memory = _resolved_memory(leaf, req)
+            port_slot = self.ports.setdefault(
+                node_name, RRBitmap(C.POD_MANAGER_PORT_COUNT)
+            ).find_next_and_set()
+            if port_slot == -1:
+                raise Unschedulable(
+                    f"pod {pod.key}: node {node_name} pod-manager port pool full"
+                )
+            port = port_slot + C.POD_MANAGER_PORT_START
+            self.tree.reserve(leaf, req.request, memory)
+            status.memory = memory
+            status.port = port
+            annotations[C.ANNOTATION_CELL_ID] = leaf.id
+            annotations[C.ANNOTATION_CHIP_UUID] = leaf.uuid
+            annotations[C.ANNOTATION_TPU_MODEL] = leaf.leaf_cell_type
+            annotations[C.ANNOTATION_TPU_MEMORY] = str(memory)
+            annotations[C.ANNOTATION_MANAGER_PORT] = str(port)
+            env[C.ENV_VISIBLE_CHIPS] = leaf.uuid
+            env[C.ENV_POD_MANAGER_PORT] = str(port)
+            env[C.ENV_POD_NAME] = pod.key
+            env[C.ENV_HBM_LIMIT] = str(memory)
+            env[C.ENV_LIBRARY_PATH] = C.LIBRARY_PATH
+        self.cluster.patch_pod(pod.key, annotations=annotations, env=env)
+        self.status.put(status)
+        return status
+
+    def unreserve(self, pod_key: str, reject_group: bool = True) -> List[str]:
+        """Release a reservation; optionally reject all waiting gang
+        members (reference Unreserve, scheduler.go:534-549). Returns
+        the keys of every pod released."""
+        status = self.status.get(pod_key)
+        released = []
+        if status is not None and status.state in (
+            PodState.RESERVED, PodState.WAITING
+        ):
+            self._release(status)
+            self.status.pop(pod_key)
+            released.append(pod_key)
+            if reject_group and status.group_key:
+                for waiting in list(
+                    self._waiting.get(status.group_key, {}).values()
+                ):
+                    if waiting.pod_key != pod_key:
+                        released.extend(
+                            self.unreserve(waiting.pod_key, reject_group=False)
+                        )
+                self._waiting.pop(status.group_key, None)
+        return released
+
+    def permit(self, pod: Pod, status: PodStatus):
+        """Gang barrier. Returns ("allow", [co-bound members]) or
+        ("wait", timeout_seconds)."""
+        group_key = status.group_key
+        if not group_key:
+            return "allow", []
+        group = self.groups.get(group_key)
+        held = [
+            s
+            for s in self.status.in_group(group_key)
+            if s.state in (PodState.RESERVED, PodState.WAITING, PodState.BOUND)
+        ]
+        if len(held) >= group.min_available:
+            members = []
+            for waiting in list(self._waiting.get(group_key, {}).values()):
+                self._bind(waiting.pod_key, waiting.node)
+                members.append(waiting.pod_key)
+            self._waiting.pop(group_key, None)
+            return "allow", members
+        status.state = PodState.WAITING
+        deadline = self.clock() + self.permit_wait_base * group.headcount
+        self._waiting.setdefault(group_key, {})[pod.key] = _Waiting(
+            pod_key=pod.key, node=status.node_name, deadline=deadline
+        )
+        return "wait", self.permit_wait_base * group.headcount
+
+    # ================= cycle driver ==================================
+
+    def schedule_one(self, pod: Pod) -> Decision:
+        """One full scheduling cycle for one pod."""
+        existing = self.status.get(pod.key)
+        if existing is not None and existing.state != PodState.PENDING:
+            # already reserved/waiting/bound — a requeue race must not
+            # double-reserve
+            state = "waiting" if existing.state == PodState.WAITING else "bound"
+            return Decision(state, pod.key, node=existing.node_name,
+                            message="already scheduled")
+        try:
+            req = self.pre_filter(pod)
+        except Unschedulable as e:
+            return Decision("unschedulable", pod.key, message=str(e))
+
+        nodes = [n for n in self.cluster.list_nodes() if n.healthy]
+        feasible: List[str] = []
+        reasons: List[str] = []
+        for node in sorted(nodes, key=lambda n: n.name):
+            fit, reason = self.filter(pod, req, node.name)
+            if fit:
+                feasible.append(node.name)
+            elif reason:
+                reasons.append(reason)
+        if not feasible:
+            return Decision(
+                "unschedulable", pod.key, message="; ".join(reasons) or "no nodes"
+            )
+
+        scores = {name: self.score(pod, req, name) for name in feasible}
+        normalized = normalize_scores(scores)
+        best = max(feasible, key=lambda n: (normalized[n], n))
+
+        if req.kind == PodKind.REGULAR:
+            self._bind_regular(pod, best)
+            return Decision("bound", pod.key, node=best)
+
+        try:
+            status = self.reserve(pod, req, best)
+        except Unschedulable as e:
+            return Decision("unschedulable", pod.key, message=str(e))
+
+        action, extra = self.permit(pod, status)
+        if action == "allow":
+            self._bind(pod.key, best)
+            return Decision("bound", pod.key, node=best, bound_with=extra)
+        return Decision(
+            "waiting", pod.key, node=best,
+            message=f"gang barrier, timeout {extra}s",
+        )
+
+    def tick(self) -> List[str]:
+        """Expire gang barriers. Returns keys of rejected pods (they
+        re-enter the queue)."""
+        now = self.clock()
+        rejected: List[str] = []
+        for group_key, waiters in list(self._waiting.items()):
+            if not waiters:
+                self._waiting.pop(group_key, None)
+                continue
+            if any(w.deadline <= now for w in waiters.values()):
+                first = next(iter(waiters.values()))
+                rejected.extend(self.unreserve(first.pod_key, reject_group=True))
+        self.groups.gc()
+        return rejected
+
+    # ================= internals =====================================
+
+    def _bind(self, pod_key: str, node_name: str) -> None:
+        self.cluster.bind(pod_key, node_name)
+        status = self.status.get(pod_key)
+        if status is not None:
+            status.state = PodState.BOUND
+        group_key = status.group_key if status else ""
+        if group_key and group_key in self._waiting:
+            self._waiting[group_key].pop(pod_key, None)
+
+    def _bind_regular(self, pod: Pod, node_name: str) -> None:
+        self.cluster.bind(pod.key, node_name)
+
+    def _ensure_synced(self, node_name: str) -> None:
+        if node_name not in self._synced_nodes:
+            for node in self.cluster.list_nodes():
+                if node.name == node_name:
+                    self._on_node_update(node)
+                    return
+
+    def _release(self, status: PodStatus) -> None:
+        req = status.requirements
+        for i, leaf in enumerate(status.leaves):
+            expected_uuid = status.uuids[i] if i < len(status.uuids) else leaf.uuid
+            if leaf.uuid != expected_uuid:
+                # the chip vanished (unbound) or was swapped since we
+                # reserved — its reservation left the tree with it
+                self.log.warning(
+                    "release %s: chip %s no longer bound to cell %s, "
+                    "skipping reclaim", status.key, expected_uuid, leaf.id,
+                )
+                continue
+            try:
+                if req.kind == PodKind.MULTI_CHIP:
+                    self.tree.reclaim(leaf, 1.0, leaf.full_memory)
+                else:
+                    self.tree.reclaim(leaf, req.request, status.memory)
+            except ValueError as e:
+                # inventory churn between reserve and release (e.g. chip
+                # rebound fresh): never let accounting noise crash the
+                # delete path
+                self.log.error("release %s: %s", status.key, e)
+        if status.port >= C.POD_MANAGER_PORT_START and status.node_name in self.ports:
+            self.ports[status.node_name].clear(
+                status.port - C.POD_MANAGER_PORT_START
+            )
+        status.leaves = []
+        status.uuids = []
+        status.state = PodState.PENDING
+
+    def _count_group_pods(
+        self, namespace: str, group_name: str, exclude: str = ""
+    ) -> int:
+        count = 0
+        for pod in self.cluster.list_pods(namespace):
+            if pod.key == exclude or pod.is_completed:
+                continue
+            if pod.labels.get(C.LABEL_GROUP_NAME) == group_name:
+                count += 1
+        return count

@@ -1,0 +1,110 @@
+"""Gang (pod-group) registry.
+
+A pod group is identified by ``namespace/group_name``; all members must
+share priority and min_available (PreFilter enforces). Groups are
+resurrected if referenced after being marked deleted, and garbage
+collected after an expiration period (reference pkg/scheduler/
+pod_group.go:12-129, scheduler.go:46).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from ..cluster.api import Pod
+from . import constants as C
+from .labels import GangSpec, parse_gang, parse_priority
+
+
+@dataclass
+class PodGroupInfo:
+    key: str                 # "<namespace>/<group name>" ("" for solo pods)
+    name: str
+    priority: int
+    timestamp: float         # creation time: queue tiebreaker
+    min_available: int
+    headcount: int
+    threshold: float
+    deletion_timestamp: Optional[float] = None
+
+
+class PodGroupRegistry:
+    def __init__(
+        self,
+        clock: Callable[[], float] = _time.monotonic,
+        expiration_seconds: float = C.POD_GROUP_EXPIRATION_SECONDS,
+    ):
+        self._groups: Dict[str, PodGroupInfo] = {}
+        self._solo_timestamps: Dict[str, float] = {}
+        self._clock = clock
+        self._expiration = expiration_seconds
+
+    def get_or_create(self, pod: Pod, gang: Optional[GangSpec] = None) -> PodGroupInfo:
+        """Group info for a pod; solo pods get an unregistered one-off
+        record (key ''). Re-reference resurrects an expired group."""
+        if gang is None:
+            gang = parse_gang(pod)
+        if gang is None or gang.min_available <= 0:
+            return PodGroupInfo(
+                key="",
+                name="",
+                priority=parse_priority(pod),
+                timestamp=self._clock(),
+                min_available=0,
+                headcount=0,
+                threshold=0.0,
+            )
+        key = f"{pod.namespace}/{gang.name}"
+        info = self._groups.get(key)
+        if info is not None:
+            info.deletion_timestamp = None
+            return info
+        info = PodGroupInfo(
+            key=key,
+            name=gang.name,
+            priority=parse_priority(pod),
+            timestamp=self._clock(),
+            min_available=gang.min_available,
+            headcount=gang.headcount,
+            threshold=gang.threshold,
+        )
+        self._groups[key] = info
+        return info
+
+    def get(self, key: str) -> Optional[PodGroupInfo]:
+        return self._groups.get(key)
+
+    def mark_deleted(self, key: str) -> None:
+        info = self._groups.get(key)
+        if info is not None and info.deletion_timestamp is None:
+            info.deletion_timestamp = self._clock()
+
+    def drop(self, key: str) -> None:
+        self._groups.pop(key, None)
+
+    def pod_timestamp(self, pod_key: str, clock=None) -> float:
+        """Stable first-seen timestamp for a solo pod (queue-sort
+        tiebreaker — must not change between re-sorts)."""
+        ts = self._solo_timestamps.get(pod_key)
+        if ts is None:
+            ts = (clock or self._clock)()
+            self._solo_timestamps[pod_key] = ts
+        return ts
+
+    def forget_pod(self, pod_key: str) -> None:
+        self._solo_timestamps.pop(pod_key, None)
+
+    def gc(self) -> int:
+        """Remove groups expired longer than the expiration period."""
+        now = self._clock()
+        expired = [
+            key
+            for key, info in self._groups.items()
+            if info.deletion_timestamp is not None
+            and info.deletion_timestamp + self._expiration < now
+        ]
+        for key in expired:
+            del self._groups[key]
+        return len(expired)

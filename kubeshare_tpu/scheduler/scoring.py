@@ -1,0 +1,185 @@
+"""Node scoring and reserve-time chip selection.
+
+Two policies (reference pkg/scheduler/score.go, rebuilt):
+
+- **Opportunistic** (priority 0): *pack*. Prefer busy, low-priority
+  chips so whole chips stay free for guarantee/multi-chip pods —
+  ``score = (Σ priority + usage·100 − freeLeafFrac·100) / n``.
+- **Guarantee** (priority 1..100): *spread + cluster*. Prefer
+  high-priority models, free chips, and proximity to already-placed
+  gang members — ``score = (Σ priority − usage·100 − locality·λ) / n``
+  with locality measured in real ICI hops (wraparound torus) instead
+  of the reference's digit-wise cell-ID arithmetic.
+
+Regular pods: TPU chips are a rare resource, so chip-less nodes score
+100 and chip-ful nodes 0. (The reference's code does the opposite of
+its own comment — score.go:11-20; the comment's intent wins here.)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+from ..cells.cell import Cell, CellTree, feq, fge
+from ..cells.topology import ici_distance, id_path_distance
+from .labels import PodKind, PodRequirements
+
+# Weight per ICI hop in score points. Same-torus placements cost
+# 10/hop; the cross-fabric fallback distance (>= 100) then costs >= 1000
+# — dominating, as cross-node placement should for a gang.
+LOCALITY_WEIGHT = 10.0
+
+# Placement anchors: live leaf cells, or bare cell-id strings recovered
+# from annotations when the chip itself is gone.
+Anchor = Union[Cell, str]
+
+
+def regular_pod_node_score(tree: CellTree, node: str) -> float:
+    return 0.0 if tree.leaves_on_node(node) else 100.0
+
+
+def _usage_points(leaf: Cell) -> float:
+    """0 for a fully free chip, up to 100 for a fully used one."""
+    return (1.0 - leaf.available) * 100.0
+
+
+def _locality_penalty(leaf: Cell, anchors: Sequence[Anchor]) -> float:
+    if not anchors:
+        return 0.0
+    total = 0.0
+    for anchor in anchors:
+        if isinstance(anchor, str):
+            total += id_path_distance(leaf.id, anchor)
+        else:
+            total += ici_distance(leaf, anchor)
+    return total / len(anchors) * LOCALITY_WEIGHT
+
+
+def opportunistic_node_score(leaves: Sequence[Cell]) -> float:
+    if not leaves:
+        return 0.0
+    n = float(len(leaves))
+    score = 0.0
+    free_leaves = 0.0
+    for leaf in leaves:
+        score += leaf.priority
+        if leaf.is_whole_free:
+            free_leaves += 1.0
+        else:
+            score += _usage_points(leaf)
+    score -= free_leaves / n * 100.0
+    return score / n
+
+
+def guarantee_node_score(
+    leaves: Sequence[Cell], anchors: Sequence[Anchor]
+) -> float:
+    if not leaves:
+        return 0.0
+    n = float(len(leaves))
+    score = 0.0
+    for leaf in leaves:
+        score += leaf.priority - _usage_points(leaf)
+        score -= _locality_penalty(leaf, anchors)
+    return score / n
+
+
+def score_node(
+    tree: CellTree,
+    node: str,
+    req: PodRequirements,
+    anchors: Sequence[Anchor] = (),
+) -> float:
+    if req.kind == PodKind.REGULAR:
+        return regular_pod_node_score(tree, node)
+    leaves = tree.leaves_on_node(node, req.model or None)
+    if req.is_guarantee:
+        return guarantee_node_score(leaves, anchors)
+    return opportunistic_node_score(leaves)
+
+
+def normalize_scores(scores: dict) -> dict:
+    """Shift negatives to zero, then rescale into 0..100 if needed
+    (reference NormalizeScore, scheduler.go:443-487)."""
+    if not scores:
+        return {}
+    values = list(scores.values())
+    lo, hi = min(values), max(values)
+    if lo < 0:
+        scores = {k: v - lo for k, v in scores.items()}
+        hi -= lo
+        lo = 0.0
+    if hi <= 100:
+        return {k: int(v) for k, v in scores.items()}
+    span = (hi - lo) or 100.0
+    return {k: int(100.0 * (v - lo) / span) for k, v in scores.items()}
+
+
+def select_leaves(
+    tree: CellTree,
+    node: str,
+    req: PodRequirements,
+    anchors: Sequence[Anchor] = (),
+) -> List[Cell]:
+    """Reserve-time chip choice on the winning node. Returns the leaf
+    list to reserve ([] if nothing fits — the caller unreserves).
+
+    Fractional pods take the single best-scoring leaf that fits.
+    Multi-chip pods take the N best whole-free leaves; for guarantee
+    pods each subsequent pick is anchored to the picks before it, so a
+    gang's chips land torus-adjacent, not just priority-sorted
+    (divergence: the reference scores picks independently and can
+    scatter a multi-chip pod across the fabric)."""
+    leaves = [
+        l for l in tree.leaves_on_node(node, req.model or None) if l.healthy
+    ]
+    if req.kind == PodKind.MULTI_CHIP:
+        return _select_whole_leaves(leaves, req, anchors)
+    ranked = sorted(
+        leaves, key=lambda l: -_fractional_score(l, req, anchors)
+    )
+    for leaf in ranked:
+        if fge(leaf.available, req.request) and leaf.free_memory >= _resolved_memory(
+            leaf, req
+        ):
+            return [leaf]
+    return []
+
+
+def _fractional_score(
+    leaf: Cell, req: PodRequirements, anchors: Sequence[Anchor]
+) -> float:
+    if req.is_guarantee:
+        return (
+            leaf.priority - _usage_points(leaf) - _locality_penalty(leaf, anchors)
+        )
+    return leaf.priority + _usage_points(leaf)
+
+
+def _select_whole_leaves(
+    leaves: Sequence[Cell], req: PodRequirements, anchors: Sequence[Anchor]
+) -> List[Cell]:
+    count = req.chip_count
+    candidates = [l for l in leaves if l.is_whole_free]
+    if len(candidates) < count:
+        return []
+    picked: List[Cell] = []
+    pool = list(candidates)
+    for _ in range(count):
+        current_anchors: List[Anchor] = list(anchors) + list(picked)
+        if req.is_guarantee:
+            pool.sort(
+                key=lambda l: -(l.priority - _locality_penalty(l, current_anchors))
+            )
+        else:
+            pool.sort(key=lambda l: -float(l.priority))
+        picked.append(pool.pop(0))
+    return picked
+
+
+def _resolved_memory(leaf: Cell, req: PodRequirements) -> int:
+    """HBM cap after defaulting: unset means a proportional slice of
+    the chosen chip (reference pod.go:419-421)."""
+    if req.memory > 0:
+        return req.memory
+    return int(req.request * leaf.full_memory)

@@ -1,0 +1,67 @@
+"""Per-pod scheduling state.
+
+The durable source of truth is the pods' annotations (chip uuid, cell
+id, manager port) — in-memory state is a cache rebuilt from them after
+a scheduler restart (reference pkg/scheduler/pod.go:528-617). ``PodStatus``
+holds the parsed requirements plus the placement once reserved.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..cells.cell import Cell
+from .labels import PodRequirements
+
+
+class PodState(enum.Enum):
+    PENDING = "pending"
+    RESERVED = "reserved"   # resources held, not yet past the gang barrier
+    WAITING = "waiting"     # parked at Permit waiting for gang members
+    BOUND = "bound"
+
+
+@dataclass
+class PodStatus:
+    key: str                       # namespace/name
+    uid: str
+    requirements: PodRequirements
+    group_key: str = ""
+    node_name: str = ""
+    leaves: List[Cell] = field(default_factory=list)
+    uuids: List[str] = field(default_factory=list)  # chips at reserve time
+    memory: int = 0                # resolved HBM bytes (after defaulting)
+    port: int = 0                  # pod-manager port (shared pods only)
+    state: PodState = PodState.PENDING
+
+
+class PodStatusStore:
+    def __init__(self):
+        self._status: Dict[str, PodStatus] = {}
+
+    def get(self, key: str) -> Optional[PodStatus]:
+        return self._status.get(key)
+
+    def put(self, status: PodStatus) -> None:
+        self._status[status.key] = status
+
+    def pop(self, key: str) -> Optional[PodStatus]:
+        return self._status.pop(key, None)
+
+    def in_group(self, group_key: str) -> List[PodStatus]:
+        if not group_key:
+            return []
+        return [s for s in self._status.values() if s.group_key == group_key]
+
+    def group_placed_leaves(self, group_key: str) -> List[Cell]:
+        """Leaf cells already held by members of a gang — the locality
+        anchors for guarantee scoring."""
+        leaves: List[Cell] = []
+        for status in self.in_group(group_key):
+            leaves.extend(status.leaves)
+        return leaves
+
+    def values(self) -> List[PodStatus]:
+        return list(self._status.values())

@@ -50,5 +50,8 @@ class MetricServer:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() waits on an event only serve_forever() sets — guard
+        # against stop() before/without start(), which would hang forever
+        if self._thread.is_alive():
+            self._server.shutdown()
         self._server.server_close()

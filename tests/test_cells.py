@@ -313,3 +313,51 @@ class TestDistance:
         a = tree.leaf_cells["lite-1-chip-0"]
         b = tree.leaf_cells["perf-1-chip-0"]
         assert ici_distance(a, b) >= 100
+
+
+class TestReviewRegressions:
+    def test_cycle_in_cell_types(self):
+        from kubeshare_tpu.cells.cell import build_cell_elements
+        from kubeshare_tpu.cells.spec import CellTypeSpec
+        with pytest.raises(ValueError, match="cycle"):
+            build_cell_elements({
+                "a": CellTypeSpec("b", 2), "b": CellTypeSpec("a", 2),
+            })
+
+    def test_negative_reserve_reclaim_rejected(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        leaf = tree.leaf_cells["node-a-chip-0"]
+        with pytest.raises(ValueError, match="negative"):
+            tree.reserve(leaf, -0.5, 0)
+        with pytest.raises(ValueError, match="negative"):
+            tree.reserve(leaf, 0.5, -1)
+        tree.reserve(leaf, 0.5, 0)
+        with pytest.raises(ValueError, match="negative"):
+            tree.reclaim(leaf, -0.1, 0)
+
+    def test_returning_chip_recovers_its_coordinate(self):
+        tree = CellTree(load_topology(V5E_16))
+        inv = chips("node-a", "tpu-v5e", 8)
+        tree.bind_node("node-a", inv)
+        coord5 = tree.leaf_cells["node-a-chip-5"].coord
+        # chips 2 and 5 vanish
+        tree.bind_node("node-a", [c for c in inv if c.index not in (2, 5)])
+        # chip 5 alone returns: must land back on its own leaf position
+        tree.bind_node("node-a", [c for c in inv if c.index != 2])
+        assert tree.leaf_cells["node-a-chip-5"].coord == coord5
+
+    def test_rebind_updates_memory(self):
+        tree = CellTree(load_topology(V5E_16))
+        tree.bind_node("node-a", chips("node-a", "tpu-v5e", 8))
+        [root] = tree.free_list["tpu-v5e"][4]
+        corrected = chips("node-a", "tpu-v5e", 8, mem=15 << 30)
+        tree.bind_node("node-a", corrected)
+        leaf = tree.leaf_cells["node-a-chip-0"]
+        assert leaf.full_memory == 15 << 30 and leaf.free_memory == 15 << 30
+        assert root.full_memory == 8 * (15 << 30)
+
+    def test_stop_before_start_does_not_hang(self):
+        from kubeshare_tpu.utils.httpserv import MetricServer
+        srv = MetricServer(host="127.0.0.1", port=0)
+        srv.stop()  # must return, not deadlock

@@ -1,0 +1,92 @@
+import pytest
+
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.labels import (
+    LabelError,
+    PodKind,
+    parse_gang,
+    parse_pod,
+    parse_priority,
+)
+
+
+def mk(labels):
+    return Pod(name="p", labels={C.DOMAIN + k: str(v) for k, v in labels.items()})
+
+
+class TestTpuLabels:
+    def test_regular_pod(self):
+        req = parse_pod(Pod(name="p"))
+        assert req.kind == PodKind.REGULAR
+
+    def test_shared_valid(self):
+        req = parse_pod(mk({"tpu_limit": 1.0, "tpu_request": 0.5, "tpu_mem": 1 << 30}))
+        assert req.kind == PodKind.SHARED
+        assert req.limit == 1.0 and req.request == 0.5 and req.memory == 1 << 30
+
+    def test_limit_required(self):
+        with pytest.raises(LabelError, match="must set"):
+            parse_pod(mk({"tpu_request": 0.5}))
+
+    def test_request_over_limit(self):
+        with pytest.raises(LabelError, match="exceeds limit"):
+            parse_pod(mk({"tpu_limit": 0.5, "tpu_request": 1.0}))
+
+    def test_multi_chip_valid(self):
+        req = parse_pod(mk({"tpu_limit": 2.0, "tpu_request": 2.0}))
+        assert req.kind == PodKind.MULTI_CHIP and req.chip_count == 2
+
+    def test_multi_chip_fractional_rejected(self):
+        with pytest.raises(LabelError, match="integer"):
+            parse_pod(mk({"tpu_limit": 1.5, "tpu_request": 1.5}))
+
+    def test_multi_chip_request_must_equal_limit(self):
+        with pytest.raises(LabelError, match="request == limit"):
+            parse_pod(mk({"tpu_limit": 3.0, "tpu_request": 2.0}))
+
+    def test_zero_zero_is_regular(self):
+        req = parse_pod(mk({"tpu_limit": 0.0, "tpu_request": 0.0}))
+        assert req.kind == PodKind.REGULAR
+
+    def test_negative_and_garbage(self):
+        with pytest.raises(LabelError):
+            parse_pod(mk({"tpu_limit": -0.5}))
+        with pytest.raises(LabelError):
+            parse_pod(mk({"tpu_limit": "abc"}))
+        with pytest.raises(LabelError):
+            parse_pod(mk({"tpu_limit": 1.0, "tpu_mem": "lots"}))
+
+
+class TestPriority:
+    def test_default_opportunistic(self):
+        assert parse_priority(Pod(name="p")) == 0
+        assert not parse_pod(mk({"tpu_limit": 0.5})).is_guarantee
+
+    def test_guarantee(self):
+        req = parse_pod(mk({"tpu_limit": 0.5, "priority": 80}))
+        assert req.priority == 80 and req.is_guarantee
+
+    def test_out_of_range(self):
+        with pytest.raises(LabelError):
+            parse_priority(mk({"priority": 101}))
+        with pytest.raises(LabelError):
+            parse_priority(mk({"priority": -2}))
+
+
+class TestGang:
+    def test_min_available_rounding(self):
+        gang = parse_gang(mk({"group_name": "g", "group_headcount": 5, "group_threshold": 0.2}))
+        assert gang.min_available == 1
+        gang = parse_gang(mk({"group_name": "g", "group_headcount": 3, "group_threshold": 0.5}))
+        assert gang.min_available == 2  # floor(1.5 + 0.5)
+
+    def test_incomplete_gang_is_solo(self):
+        assert parse_gang(mk({"group_name": "g"})) is None
+        assert parse_gang(mk({"group_name": "g", "group_headcount": 3})) is None
+
+    def test_invalid_gang(self):
+        with pytest.raises(LabelError):
+            parse_gang(mk({"group_name": "g", "group_headcount": 0, "group_threshold": 0.5}))
+        with pytest.raises(LabelError):
+            parse_gang(mk({"group_name": "g", "group_headcount": 2, "group_threshold": 1.5}))
