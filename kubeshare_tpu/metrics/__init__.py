@@ -1,0 +1,12 @@
+from .collector import Collector, FakeChipBackend, JaxChipBackend
+from .aggregator import Aggregator
+from .scrape import scrape_capacity, scrape_requirements
+
+__all__ = [
+    "Collector",
+    "FakeChipBackend",
+    "JaxChipBackend",
+    "Aggregator",
+    "scrape_capacity",
+    "scrape_requirements",
+]

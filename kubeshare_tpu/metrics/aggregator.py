@@ -1,0 +1,93 @@
+"""Cluster-wide requirement exporter (``tpu_requirement``).
+
+Rebuild of pkg/aggregator (aggregator.go:22-38, pod.go:51-154): exports
+one sample per *placed* shared/multi-chip pod so per-node config
+daemons can derive the isolation runtime's config files. Requirement
+facts are recovered from the scheduler-written annotations (the
+reference digs them out of injected container env, pod.go:130-154 —
+annotations are the cleaner channel and survive env-less containers).
+
+Series contract::
+
+    tpu_requirement{namespace, pod, pod_id, node, group_name,
+                    min_available, limit, request, memory,
+                    cell_id, uuid, port} <timestamp>
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List
+
+from ..cluster.api import ClusterAPI, Pod, PodPhase
+from ..scheduler import constants as C
+from ..scheduler.labels import LabelError, parse_gang, parse_pod, PodKind
+from ..utils import expfmt
+from ..utils.httpserv import MetricServer
+
+REQUIREMENT_METRIC = "tpu_requirement"
+AGGREGATOR_PATH = "/kubeshare-tpu-aggregator"
+AGGREGATOR_PORT = 9005
+
+
+class Aggregator:
+    def __init__(self, cluster: ClusterAPI, clock: Callable[[], float] = time.time):
+        self.cluster = cluster
+        self.clock = clock
+
+    def _pod_sample(self, pod: Pod, now: float):
+        if pod.scheduler_name != C.SCHEDULER_NAME or not pod.is_bound:
+            return None
+        if pod.is_completed:
+            return None
+        uuid = pod.annotations.get(C.ANNOTATION_CHIP_UUID, "")
+        if not uuid:
+            return None  # regular pod or not yet reserved
+        try:
+            req = parse_pod(pod)
+        except LabelError:
+            return None
+        if req.kind == PodKind.REGULAR:
+            return None
+        gang = req.gang
+        return expfmt.Sample(
+            REQUIREMENT_METRIC,
+            {
+                "namespace": pod.namespace,
+                "pod": pod.name,
+                "pod_id": pod.uid,
+                "node": pod.node_name,
+                "group_name": gang.name if gang else "",
+                "min_available": str(gang.min_available if gang else 0),
+                "limit": str(req.limit),
+                "request": str(req.request),
+                "memory": pod.annotations.get(C.ANNOTATION_TPU_MEMORY, "0"),
+                "cell_id": pod.annotations.get(C.ANNOTATION_CELL_ID, ""),
+                "uuid": uuid,
+                "port": pod.annotations.get(C.ANNOTATION_MANAGER_PORT, "0"),
+            },
+            now,
+        )
+
+    def samples(self) -> List[expfmt.Sample]:
+        now = self.clock()
+        out = []
+        for pod in self.cluster.list_pods():
+            sample = self._pod_sample(pod, now)
+            if sample is not None:
+                out.append(sample)
+        return out
+
+    def render(self) -> str:
+        return expfmt.render(
+            self.samples(),
+            help_text={
+                REQUIREMENT_METRIC: "per-pod TPU requirements of placed pods"
+            },
+        )
+
+    def serve(self, host: str = "0.0.0.0", port: int = AGGREGATOR_PORT) -> MetricServer:
+        server = MetricServer(host=host, port=port)
+        server.route(AGGREGATOR_PATH, self.render)
+        server.route("/metrics", self.render)
+        return server.start()

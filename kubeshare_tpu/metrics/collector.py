@@ -1,0 +1,152 @@
+"""Per-node chip-inventory exporter (``tpu_capacity``).
+
+TPU-native replacement for the reference's NVML collector
+(pkg/collector/collector.go:30-58, gpu.go:26-107): chips are enumerated
+through JAX/libtpu instead of NVML, and a fake backend stands in on
+chip-less machines (the reference instead parks NVML-less nodes in a
+``select {}`` sleep — cmd/kubeshare-collector/main.go:42-49; here the
+fake backend keeps the endpoint alive and empty).
+
+Series contract::
+
+    tpu_capacity{node, uuid, model, memory, index} <timestamp>
+
+One sample per chip; ``memory`` is HBM bytes as a label (the value slot
+carries a freshness timestamp, matching the reference's value=now()
+convention so consumers can spot stale scrapes).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..cells.cell import ChipInfo
+from ..utils import expfmt
+from ..utils.httpserv import MetricServer
+
+CAPACITY_METRIC = "tpu_capacity"
+COLLECTOR_PATH = "/kubeshare-tpu-collector"
+COLLECTOR_PORT = 9004
+
+# default HBM per chip generation, used when the runtime doesn't expose
+# memory stats (bytes)
+_HBM_BY_KIND = {
+    "tpu v2": 8 << 30,
+    "tpu v3": 16 << 30,
+    "tpu v4": 32 << 30,
+    "tpu v5": 16 << 30,
+    "tpu v5 lite": 16 << 30,
+    "tpu v5e": 16 << 30,
+    "tpu v5p": 95 << 30,
+    "tpu v6e": 32 << 30,
+}
+
+
+def _normalize_model(device_kind: str) -> str:
+    """'TPU v5 lite' -> 'tpu-v5-lite' (spaces to dashes, lowercase),
+    mirroring the reference's model-name normalization (gpu.go:60)."""
+    return device_kind.strip().lower().replace(" ", "-")
+
+
+class FakeChipBackend:
+    """Deterministic inventory for tests / chip-less dev machines."""
+
+    def __init__(self, chips: Optional[Sequence[ChipInfo]] = None):
+        self._chips = list(chips or [])
+
+    def enumerate(self) -> List[ChipInfo]:
+        return list(self._chips)
+
+    def set_chips(self, chips: Sequence[ChipInfo]) -> None:
+        self._chips = list(chips)
+
+
+class JaxChipBackend:
+    """Enumerate local TPU chips via jax.local_devices().
+
+    uuid: ``<hostname>-<platform>-<device id>`` (stable per boot, like a
+    GPU UUID is per card); model: normalized device_kind; memory: from
+    ``memory_stats()['bytes_limit']`` when libtpu exposes it, else a
+    per-generation default.
+    """
+
+    def __init__(self, node_name: str = ""):
+        import socket
+
+        self.node_name = node_name or socket.gethostname()
+
+    def enumerate(self) -> List[ChipInfo]:
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:  # no runtime / no chips: empty inventory
+            return []
+        chips: List[ChipInfo] = []
+        for device in devices:
+            if device.platform == "cpu":
+                continue  # CPU "devices" are not shareable chips
+            model = _normalize_model(getattr(device, "device_kind", device.platform))
+            memory = 0
+            try:
+                stats = device.memory_stats()
+                memory = int(stats.get("bytes_limit", 0))
+            except Exception:
+                pass
+            if memory <= 0:
+                memory = _HBM_BY_KIND.get(
+                    getattr(device, "device_kind", "").strip().lower(), 16 << 30
+                )
+            chips.append(
+                ChipInfo(
+                    uuid=f"{self.node_name}-{device.platform}-{device.id}",
+                    model=model,
+                    memory=memory,
+                    index=device.id,
+                )
+            )
+        return chips
+
+
+class Collector:
+    def __init__(
+        self,
+        node_name: str,
+        backend,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.node_name = node_name
+        self.backend = backend
+        self.clock = clock
+
+    def samples(self) -> List[expfmt.Sample]:
+        now = self.clock()
+        out = []
+        for chip in self.backend.enumerate():
+            out.append(
+                expfmt.Sample(
+                    CAPACITY_METRIC,
+                    {
+                        "node": self.node_name,
+                        "uuid": chip.uuid,
+                        "model": chip.model,
+                        "memory": str(chip.memory),
+                        "index": str(chip.index),
+                    },
+                    now,
+                )
+            )
+        return out
+
+    def render(self) -> str:
+        return expfmt.render(
+            self.samples(),
+            help_text={CAPACITY_METRIC: "TPU chip inventory of this node"},
+        )
+
+    def serve(self, host: str = "0.0.0.0", port: int = COLLECTOR_PORT) -> MetricServer:
+        server = MetricServer(host=host, port=port)
+        server.route(COLLECTOR_PATH, self.render)
+        server.route("/metrics", self.render)
+        return server.start()

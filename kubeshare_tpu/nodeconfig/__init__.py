@@ -1,0 +1,12 @@
+from .files import ConfigEntry, PortEntry, read_config_file, read_port_file, write_config_file, write_port_file
+from .daemon import NodeConfigDaemon
+
+__all__ = [
+    "ConfigEntry",
+    "PortEntry",
+    "read_config_file",
+    "read_port_file",
+    "write_config_file",
+    "write_port_file",
+    "NodeConfigDaemon",
+]

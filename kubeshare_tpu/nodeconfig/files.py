@@ -1,0 +1,116 @@
+"""The per-chip config-file contract — the isolation runtime's API.
+
+Two text-file sets keyed by chip uuid (kept text-identical in spirit to
+the reference so the C++ runtime and humans can read them —
+pkg/config/query.go:43-105):
+
+``<base>/config/<uuid>``::
+
+    N
+    namespace/name limit request memory
+    ...                               (xN)
+
+``<base>/podmanagerport/<uuid>``::
+
+    N
+    namespace/name port
+    ...                               (xN)
+
+Writes are atomic (tmp + rename) so the launcher's file watcher never
+reads a half-written file — the reference relies on IN_CLOSE_WRITE
+ordering instead (launcher.py:89-98); rename gives the same guarantee
+without inotify-ordering subtleties.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass
+class ConfigEntry:
+    pod: str            # namespace/name
+    limit: float
+    request: float
+    memory: int
+
+
+@dataclass
+class PortEntry:
+    pod: str
+    port: int
+
+
+def _atomic_write(path: str, content: str) -> None:
+    directory = os.path.dirname(path)
+    os.makedirs(directory, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp-")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(content)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_config_file(base: str, uuid: str, entries: List[ConfigEntry]) -> str:
+    path = os.path.join(base, "config", uuid)
+    lines = [str(len(entries))]
+    lines += [
+        f"{e.pod} {e.limit:g} {e.request:g} {e.memory}" for e in entries
+    ]
+    _atomic_write(path, "\n".join(lines) + "\n")
+    return path
+
+
+def write_port_file(base: str, uuid: str, entries: List[PortEntry]) -> str:
+    path = os.path.join(base, "podmanagerport", uuid)
+    lines = [str(len(entries))]
+    lines += [f"{e.pod} {e.port}" for e in entries]
+    _atomic_write(path, "\n".join(lines) + "\n")
+    return path
+
+
+def read_config_file(path: str) -> List[ConfigEntry]:
+    with open(path) as f:
+        lines = [l.strip() for l in f if l.strip()]
+    if not lines:
+        return []
+    n = int(lines[0])
+    entries = []
+    for line in lines[1 : n + 1]:
+        pod, limit, request, memory = line.split()
+        entries.append(
+            ConfigEntry(pod=pod, limit=float(limit), request=float(request),
+                        memory=int(memory))
+        )
+    return entries
+
+
+def read_port_file(path: str) -> List[PortEntry]:
+    with open(path) as f:
+        lines = [l.strip() for l in f if l.strip()]
+    if not lines:
+        return []
+    n = int(lines[0])
+    entries = []
+    for line in lines[1 : n + 1]:
+        pod, port = line.split()
+        entries.append(PortEntry(pod=pod, port=int(port)))
+    return entries
+
+
+def list_chip_files(base: str) -> List[str]:
+    config_dir = os.path.join(base, "config")
+    if not os.path.isdir(config_dir):
+        return []
+    return sorted(
+        f for f in os.listdir(config_dir) if not f.startswith(".")
+    )

@@ -1,0 +1,11 @@
+from .client import TokenClient, NativeTokenClient, load_native_library
+from .hook import SharedChipGate, install_gate, current_gate
+
+__all__ = [
+    "TokenClient",
+    "NativeTokenClient",
+    "load_native_library",
+    "SharedChipGate",
+    "install_gate",
+    "current_gate",
+]

@@ -1,0 +1,183 @@
+"""JAX-side gating: the TPU answer to the reference's CUDA interposer.
+
+On NVIDIA, Gemini LD_PRELOADs ``libgemhook.so.1`` under the app and
+intercepts driver calls. TPUs expose no per-process driver surface to
+interpose, so the gate sits at the *dispatch* layer instead: a wrapped
+step function acquires a compute token before dispatching device work
+and reports measured device time back on release. Because XLA dispatch
+is async, the wrapper calls ``block_until_ready`` on results inside the
+token hold — the device is provably idle when the token is returned,
+which is what makes the accounting honest.
+
+HBM caps are enforced two ways:
+- cooperatively via ``request_memory`` accounting against the arbiter
+  (an over-cap allocation raises ``HbmCapExceeded`` *before* dispatch);
+- preventively at process start: ``apply_hbm_env_cap`` writes the libtpu
+  flags that cap the premapped HBM pool before JAX initializes.
+
+Usage in a pod (env injected by the scheduler)::
+
+    gate = install_gate()          # reads KUBESHARE_* env
+    step = gate.wrap(train_step)   # or: with gate.compute(): ...
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+from ..scheduler import constants as C
+from .client import TokenClient, TokenProtocolError
+
+
+class HbmCapExceeded(MemoryError):
+    pass
+
+
+class SharedChipGate:
+    def __init__(
+        self,
+        client: Optional[TokenClient],
+        hbm_limit_bytes: int = 0,
+        fail_open: bool = True,
+    ):
+        self.client = client
+        self.hbm_limit = hbm_limit_bytes
+        self.fail_open = fail_open
+        self._hbm_used = 0
+        self.tokens_acquired = 0
+        self.compute_ms = 0.0
+
+    # ---- compute gating --------------------------------------------
+
+    @contextmanager
+    def compute(self, est_ms: float = 0.0):
+        """Hold a compute token around a block of device work."""
+        acquired = False
+        if self.client is not None:
+            try:
+                self.client.acquire(est_ms)
+                acquired = True
+                self.tokens_acquired += 1
+            except (TokenProtocolError, OSError):
+                if not self.fail_open:
+                    raise
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            used_ms = (time.perf_counter() - start) * 1e3
+            self.compute_ms += used_ms
+            if acquired:
+                try:
+                    self.client.release(used_ms)
+                except (TokenProtocolError, OSError):
+                    if not self.fail_open:
+                        raise
+
+    def wrap(self, fn: Callable, est_ms: float = 0.0) -> Callable:
+        """Gate a (jitted) step function; blocks on results inside the
+        token hold so released time reflects real device occupancy."""
+
+        @functools.wraps(fn)
+        def gated(*args, **kwargs):
+            with self.compute(est_ms):
+                result = fn(*args, **kwargs)
+                result = _block(result)
+            return result
+
+        return gated
+
+    # ---- HBM accounting --------------------------------------------
+
+    def request_memory(self, delta_bytes: int) -> None:
+        """Account an allocation; raises HbmCapExceeded over the cap."""
+        if self.hbm_limit and self._hbm_used + delta_bytes > self.hbm_limit:
+            raise HbmCapExceeded(
+                f"HBM cap {self.hbm_limit} exceeded: "
+                f"{self._hbm_used} + {delta_bytes}"
+            )
+        if self.client is not None:
+            try:
+                granted, used, cap = self.client.request_memory(delta_bytes)
+            except (TokenProtocolError, OSError):
+                if not self.fail_open:
+                    raise
+                granted = True
+            if not granted:
+                raise HbmCapExceeded(
+                    f"arbiter denied {delta_bytes} bytes (cap {self.hbm_limit})"
+                )
+        self._hbm_used = max(0, self._hbm_used + delta_bytes)
+
+    def track_arrays(self, *arrays) -> None:
+        """Convenience: account the HBM footprint of concrete arrays."""
+        total = 0
+        for a in arrays:
+            nbytes = getattr(a, "nbytes", None)
+            if nbytes:
+                total += int(nbytes)
+        if total:
+            self.request_memory(total)
+
+    def close(self) -> None:
+        if self.client is not None:
+            self.client.close()
+
+
+def _block(result: Any) -> Any:
+    """block_until_ready over an arbitrary pytree of jax arrays."""
+    try:
+        import jax
+
+        return jax.block_until_ready(result)
+    except ImportError:
+        return result
+
+
+def apply_hbm_env_cap(limit_bytes: int, total_hbm: int = 0) -> None:
+    """Cap libtpu's premapped HBM pool before JAX initializes — the
+    hard backstop under the cooperative accounting. Must run before
+    the first jax import touches the backend."""
+    if limit_bytes <= 0:
+        return
+    os.environ.setdefault("TPU_PREMAPPED_BUFFER_SIZE", str(limit_bytes))
+    if total_hbm > 0:
+        fraction = max(0.01, min(1.0, limit_bytes / total_hbm))
+        os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", f"{fraction:.3f}")
+
+
+_GATE: Optional[SharedChipGate] = None
+
+
+def install_gate(
+    host: str = "127.0.0.1",
+    port: Optional[int] = None,
+    hbm_limit: Optional[int] = None,
+    fail_open: bool = True,
+) -> SharedChipGate:
+    """Build the process-wide gate from the env the scheduler injected
+    (ENV_POD_MANAGER_PORT / ENV_HBM_LIMIT / ENV_POD_NAME). Without a
+    manager port (whole-chip or dev run), the gate is a no-op."""
+    global _GATE
+    if port is None:
+        port = int(os.environ.get(C.ENV_POD_MANAGER_PORT, "0") or "0")
+    if hbm_limit is None:
+        hbm_limit = int(os.environ.get(C.ENV_HBM_LIMIT, "0") or "0")
+    client = None
+    if port:
+        try:
+            client = TokenClient(host, port)
+        except OSError:
+            if not fail_open:
+                raise
+    apply_hbm_env_cap(hbm_limit)
+    _GATE = SharedChipGate(client, hbm_limit_bytes=hbm_limit, fail_open=fail_open)
+    return _GATE
+
+
+def current_gate() -> Optional[SharedChipGate]:
+    return _GATE

@@ -1,0 +1,199 @@
+"""Node launcher: per-chip arbiter fanout + pod-manager lifecycle.
+
+Rebuild of the reference's gemini-scheduler glue (launcher.py +
+launcher-multigpus.sh): for every local chip, ensure zeroed config
+files exist, spawn one ``tpu-schd`` (port = base + chip index), and
+reconcile one ``tpu-pmgr`` per entry of the chip's podmanagerport file.
+File changes are detected by polling mtimes (no inotify dependency);
+vanished pods get their manager SIGKILLed (reference launcher.py:58-67).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from ..nodeconfig.files import read_port_file
+from ..scheduler import constants as C
+from ..utils.logger import get_logger
+
+_BUILD_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "..", "runtime_native", "build"
+)
+
+
+def default_binary(name: str) -> str:
+    for candidate in (
+        os.path.join(_BUILD_DIR, name),
+        os.path.join(C.LIBRARY_PATH, name),
+    ):
+        if os.path.exists(candidate):
+            return os.path.abspath(candidate)
+    return name  # rely on PATH
+
+
+@dataclass
+class ChipRuntime:
+    uuid: str
+    port: int
+    scheduler_proc: Optional[subprocess.Popen] = None
+    pod_managers: Dict[str, subprocess.Popen] = field(default_factory=dict)
+    desired: Dict[str, object] = field(default_factory=dict)  # key -> PortEntry
+    port_file_mtime: float = -1.0
+
+
+class NodeLauncher:
+    def __init__(
+        self,
+        base_dir: str,
+        chip_uuids: Sequence[str],
+        schd_binary: str = "",
+        pmgr_binary: str = "",
+        base_port: int = C.CHIP_ARBITER_BASE_PORT,
+        base_quota_ms: float = 300.0,
+        min_quota_ms: float = 20.0,
+        window_ms: float = 10000.0,
+        log=None,
+    ):
+        self.base_dir = base_dir
+        self.schd_binary = schd_binary or default_binary("tpu-schd")
+        self.pmgr_binary = pmgr_binary or default_binary("tpu-pmgr")
+        self.base_quota_ms = base_quota_ms
+        self.min_quota_ms = min_quota_ms
+        self.window_ms = window_ms
+        self.log = log or get_logger("launcher", level=1)
+        self.chips: Dict[str, ChipRuntime] = {
+            uuid: ChipRuntime(uuid=uuid, port=base_port + i)
+            for i, uuid in enumerate(chip_uuids)
+        }
+
+    # ---- arbiter fanout --------------------------------------------
+
+    def start_arbiters(self) -> None:
+        config_dir = os.path.join(self.base_dir, "config")
+        os.makedirs(config_dir, exist_ok=True)
+        for chip in self.chips.values():
+            path = os.path.join(config_dir, chip.uuid)
+            if not os.path.exists(path):
+                with open(path, "w") as f:
+                    f.write("0\n")
+            self._spawn_schd(chip)
+
+    def _spawn_schd(self, chip: ChipRuntime) -> None:
+        config_dir = os.path.join(self.base_dir, "config")
+        chip.scheduler_proc = subprocess.Popen(
+            [
+                self.schd_binary,
+                "-p", config_dir,
+                "-f", chip.uuid,
+                "-P", str(chip.port),
+                "-q", str(self.base_quota_ms),
+                "-m", str(self.min_quota_ms),
+                "-w", str(self.window_ms),
+            ],
+        )
+        self.log.info(
+            "tpu-schd for chip %s on port %d (pid %d)",
+            chip.uuid, chip.port, chip.scheduler_proc.pid,
+        )
+
+    # ---- pod-manager reconciliation --------------------------------
+
+    def reconcile(self) -> None:
+        """One pass: (a) restart any dead child — a crashed arbiter or
+        pod manager must not silently disable isolation; (b) diff every
+        chip's port file against the desired set; spawn new, kill
+        vanished."""
+        for chip in self.chips.values():
+            # (a) liveness, independent of file changes
+            if (
+                chip.scheduler_proc is not None
+                and chip.scheduler_proc.poll() is not None
+            ):
+                self.log.error(
+                    "tpu-schd for chip %s died (rc=%s), restarting",
+                    chip.uuid, chip.scheduler_proc.returncode,
+                )
+                self._spawn_schd(chip)
+            for key, proc in list(chip.pod_managers.items()):
+                if proc.poll() is not None:
+                    self.log.error("pod manager %s died, restarting", key)
+                    del chip.pod_managers[key]
+                    entry = chip.desired.get(key)
+                    if entry is not None:
+                        chip.pod_managers[key] = self._spawn_pmgr(chip, entry)
+
+            # (b) port-file diff
+            path = os.path.join(self.base_dir, "podmanagerport", chip.uuid)
+            try:
+                mtime = os.stat(path).st_mtime
+            except OSError:
+                continue
+            if mtime == chip.port_file_mtime:
+                continue
+            chip.port_file_mtime = mtime
+            try:
+                entries = read_port_file(path)
+            except (OSError, ValueError) as e:
+                self.log.error("bad port file %s: %s", path, e)
+                continue
+            chip.desired = {f"{e.pod}:{e.port}": e for e in entries}
+            for key, proc in list(chip.pod_managers.items()):
+                if key not in chip.desired:
+                    self._kill(proc)
+                    del chip.pod_managers[key]
+                    self.log.info("pod manager %s stopped", key)
+            for key, entry in chip.desired.items():
+                if key not in chip.pod_managers:
+                    chip.pod_managers[key] = self._spawn_pmgr(chip, entry)
+                    self.log.info(
+                        "pod manager %s started on port %d", key, entry.port
+                    )
+
+    def _spawn_pmgr(self, chip: ChipRuntime, entry) -> subprocess.Popen:
+        env = os.environ.copy()
+        env.update(
+            {
+                "SCHEDULER_IP": "127.0.0.1",
+                "SCHEDULER_PORT": str(chip.port),
+                "POD_MANAGER_IP": "0.0.0.0",
+                "POD_MANAGER_PORT": str(entry.port),
+                "POD_NAME": entry.pod,
+            }
+        )
+        return subprocess.Popen(
+            [self.pmgr_binary], env=env, start_new_session=True
+        )
+
+    @staticmethod
+    def _kill(proc: subprocess.Popen) -> None:
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+        proc.wait()
+
+    def run(self, poll_interval: float = 0.5) -> None:
+        self.start_arbiters()
+        try:
+            while True:
+                self.reconcile()
+                time.sleep(poll_interval)
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        for chip in self.chips.values():
+            for proc in chip.pod_managers.values():
+                self._kill(proc)
+            chip.pod_managers.clear()
+            if chip.scheduler_proc is not None:
+                if chip.scheduler_proc.poll() is None:
+                    chip.scheduler_proc.kill()
+                chip.scheduler_proc.wait()
+                chip.scheduler_proc = None

@@ -1,0 +1,101 @@
+// libtpuhook: in-pod client library (C ABI) for the token protocol.
+//
+// The TPU analog of the reference's LD_PRELOAD CUDA interposer
+// (libgemhook.so.1, injected at pkg/scheduler/pod.go:446-449). TPUs
+// have no per-process driver API to interpose, so gating happens at
+// the dispatch layer instead: the Python hook (kubeshare_tpu.runtime.hook)
+// calls these functions around every jitted step, via ctypes. Keeping
+// the client in C keeps the hot path allocation-free and usable from
+// C++ runtimes (PJRT plugins) as well.
+//
+//   h   = tpuhook_connect("127.0.0.1", port)       // pod manager
+//   q   = tpuhook_acquire(h, est_ms)               // blocks; quota ms
+//         ... dispatch up to q ms of device work ...
+//   tpuhook_release(h, used_ms)
+//   ok  = tpuhook_mem(h, delta_bytes)              // HBM accounting
+//   tpuhook_close(h)
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "proto.h"
+
+using namespace tpushare;
+
+namespace {
+
+struct Hook {
+  int fd = -1;
+  std::mutex mu;
+  std::string pod;  // "-" when talking through tpu-pmgr (it pins identity)
+};
+
+bool roundtrip(Hook* h, const std::string& line, std::string* reply) {
+  std::lock_guard<std::mutex> lock(h->mu);
+  if (h->fd < 0) return false;
+  if (!write_all(h->fd, line)) return false;
+  return read_line(h->fd, reply);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tpuhook_connect(const char* host, int port) {
+  int fd = tcp_connect(host, port);
+  if (fd < 0) return nullptr;
+  Hook* h = new Hook;
+  h->fd = fd;
+  const char* pod = std::getenv("KUBESHARE_POD_NAME");
+  h->pod = pod && *pod ? pod : "-";
+  return h;
+}
+
+// Blocks until a compute token is granted. Returns quota in ms, or a
+// negative value on connection failure (caller should fail open —
+// isolation must not take the workload down with it).
+double tpuhook_acquire(void* handle, double est_ms) {
+  Hook* h = static_cast<Hook*>(handle);
+  if (!h) return -1.0;
+  char line[256];
+  std::snprintf(line, sizeof(line), "ACQ %s %.3f", h->pod.c_str(), est_ms);
+  std::string reply;
+  if (!roundtrip(h, line, &reply)) return -1.0;
+  double quota = -1.0;
+  if (std::sscanf(reply.c_str(), "TOK %lf", &quota) != 1) return -1.0;
+  return quota;
+}
+
+int tpuhook_release(void* handle, double used_ms) {
+  Hook* h = static_cast<Hook*>(handle);
+  if (!h) return -1;
+  char line[256];
+  std::snprintf(line, sizeof(line), "REL %s %.3f", h->pod.c_str(), used_ms);
+  std::string reply;
+  return roundtrip(h, line, &reply) && reply == "OK" ? 0 : -1;
+}
+
+// Returns 1 if the delta fits under the pod's HBM cap, 0 if denied,
+// negative on connection failure.
+int tpuhook_mem(void* handle, long long delta_bytes) {
+  Hook* h = static_cast<Hook*>(handle);
+  if (!h) return -1;
+  char line[256];
+  std::snprintf(line, sizeof(line), "MEM %s %lld", h->pod.c_str(),
+                delta_bytes);
+  std::string reply;
+  if (!roundtrip(h, line, &reply)) return -1;
+  return reply.rfind("OK", 0) == 0 ? 1 : 0;
+}
+
+void tpuhook_close(void* handle) {
+  Hook* h = static_cast<Hook*>(handle);
+  if (!h) return;
+  if (h->fd >= 0) ::close(h->fd);
+  delete h;
+}
+
+}  // extern "C"

@@ -1,0 +1,84 @@
+// Line protocol shared by tpu-schd, tpu-pmgr and libtpuhook.
+//
+// TPU-native rebuild of the Gemini runtime contract (reference repo's
+// launcher env contract: docker/kubeshare-gemini-scheduler/launcher.py:13-20;
+// the Gemini sources themselves are an empty submodule there). The wire
+// format is new: newline-delimited ASCII for debuggability (nc/telnet
+// into an arbiter and type STAT).
+//
+//   ACQ <pod> <est_ms>   -> blocks, then "TOK <quota_ms>"
+//   REL <pod> <used_ms>  -> "OK"
+//   MEM <pod> <delta>    -> "OK <used> <cap>" | "DENY <used> <cap>"
+//   STAT                 -> "STAT <n>" + n lines "<pod> <win_ms> <used> <cap>"
+//   PING                 -> "PONG"
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace tpushare {
+
+// Read one '\n'-terminated line (without the newline). Returns false on
+// EOF/error.
+inline bool read_line(int fd, std::string* out) {
+  out->clear();
+  char c;
+  for (;;) {
+    ssize_t n = ::recv(fd, &c, 1, 0);
+    if (n <= 0) return false;
+    if (c == '\n') return true;
+    if (c != '\r') out->push_back(c);
+    if (out->size() > 4096) return false;  // malformed: line way too long
+  }
+}
+
+inline bool write_all(int fd, const std::string& line) {
+  std::string msg = line;
+  if (msg.empty() || msg.back() != '\n') msg.push_back('\n');
+  size_t off = 0;
+  while (off < msg.size()) {
+    ssize_t n = ::send(fd, msg.data() + off, msg.size() - off, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+inline int tcp_listen(const char* host, int port, int backlog = 64) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr = host ? ::inet_addr(host) : INADDR_ANY;
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, backlog) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+inline int tcp_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr = ::inet_addr(host);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+}  // namespace tpushare

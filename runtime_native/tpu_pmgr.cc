@@ -1,0 +1,107 @@
+// tpu-pmgr: per-sharing-pod manager.
+//
+// Bridges the in-pod hook to the per-chip arbiter, pinning the pod
+// identity server-side so a container cannot impersonate another pod's
+// quota. Env contract (identical surface to the reference launcher's,
+// launcher.py:13-20):
+//   SCHEDULER_IP / SCHEDULER_PORT   - the chip's tpu-schd
+//   POD_MANAGER_IP / POD_MANAGER_PORT - where to listen for the hook
+//   POD_NAME                        - namespace/name, forced onto
+//                                     every forwarded command
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <thread>
+
+#include "proto.h"
+
+using namespace tpushare;
+
+static std::string g_sched_ip;
+static int g_sched_port;
+static std::string g_pod_name;
+
+static void serve_hook(int client_fd) {
+  int up = tcp_connect(g_sched_ip.c_str(), g_sched_port);
+  if (up < 0) {
+    write_all(client_fd, "ERR scheduler unreachable");
+    ::close(client_fd);
+    return;
+  }
+  std::string line, reply;
+  while (read_line(client_fd, &line)) {
+    std::istringstream in(line);
+    std::string cmd, pod;
+    in >> cmd;
+    std::string rest;
+    std::getline(in, rest);
+    std::string forwarded;
+    if (cmd == "ACQ" || cmd == "REL" || cmd == "MEM") {
+      // drop the client-supplied pod field, substitute ours
+      std::istringstream r(rest);
+      r >> pod;
+      std::string tail;
+      std::getline(r, tail);
+      forwarded = cmd + " " + g_pod_name + tail;
+    } else {
+      forwarded = line;
+    }
+    if (!write_all(up, forwarded)) break;
+    if (!read_line(up, &reply)) break;
+    if (cmd == "STAT") {
+      // STAT has a multi-line body: relay it
+      std::istringstream head(reply);
+      std::string tag;
+      size_t n = 0;
+      head >> tag >> n;
+      if (!write_all(client_fd, reply)) break;
+      bool failed = false;
+      for (size_t i = 0; i < n; ++i) {
+        std::string body;
+        if (!read_line(up, &body) || !write_all(client_fd, body)) {
+          failed = true;
+          break;
+        }
+      }
+      if (failed) break;
+      continue;
+    }
+    if (!write_all(client_fd, reply)) break;
+  }
+  ::close(up);
+  ::close(client_fd);
+}
+
+int main() {
+  const char* sched_ip = std::getenv("SCHEDULER_IP");
+  const char* sched_port = std::getenv("SCHEDULER_PORT");
+  const char* mgr_ip = std::getenv("POD_MANAGER_IP");
+  const char* mgr_port = std::getenv("POD_MANAGER_PORT");
+  const char* pod_name = std::getenv("POD_NAME");
+  if (!sched_ip || !sched_port || !mgr_port || !pod_name) {
+    std::fprintf(stderr,
+                 "tpu-pmgr: need SCHEDULER_IP, SCHEDULER_PORT, "
+                 "POD_MANAGER_PORT, POD_NAME env\n");
+    return 2;
+  }
+  g_sched_ip = sched_ip;
+  g_sched_port = std::atoi(sched_port);
+  g_pod_name = pod_name;
+
+  int listener = tcp_listen(mgr_ip ? mgr_ip : "0.0.0.0",
+                            std::atoi(mgr_port));
+  if (listener < 0) {
+    std::fprintf(stderr, "tpu-pmgr: cannot listen on port %s\n", mgr_port);
+    return 1;
+  }
+  std::fprintf(stderr, "[tpu-pmgr] pod %s on port %s -> schd %s:%d\n",
+               pod_name, mgr_port, g_sched_ip.c_str(), g_sched_port);
+  for (;;) {
+    int fd = ::accept(listener, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve_hook, fd).detach();
+  }
+}

@@ -1,0 +1,183 @@
+"""Metrics plane + node config daemon: the cross-component data bus."""
+
+import os
+import urllib.request
+
+import pytest
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.metrics.aggregator import Aggregator
+from kubeshare_tpu.metrics.collector import Collector, FakeChipBackend
+from kubeshare_tpu.metrics.scrape import (
+    capacity_from_samples,
+    scrape_capacity,
+    scrape_requirements,
+)
+from kubeshare_tpu.nodeconfig.daemon import NodeConfigDaemon
+from kubeshare_tpu.nodeconfig.files import (
+    read_config_file,
+    read_port_file,
+    write_config_file,
+    ConfigEntry,
+)
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+from kubeshare_tpu.utils import expfmt
+
+from test_scheduler import TOPO, chips, tpu_pod, GIB
+
+
+@pytest.fixture
+def scheduled_cluster():
+    cluster = FakeCluster()
+    cluster.add_node("node-a", chips("node-a"))
+    cluster.add_node("node-b", chips("node-b"))
+    sched = TpuShareScheduler(TOPO, cluster)
+    for name, kw in [
+        ("mnist-1", dict(request=0.5, mem=2 * GIB)),
+        ("mnist-2", dict(request=0.5)),
+        ("big", dict(request=2.0, limit=2.0)),
+    ]:
+        assert sched.schedule_one(cluster.create_pod(tpu_pod(name, **kw))).status == "bound"
+    return cluster, sched
+
+
+class TestCollector:
+    def test_samples_and_http(self):
+        backend = FakeChipBackend(chips("n1", 2))
+        collector = Collector("n1", backend, clock=lambda: 123.0)
+        text = collector.render()
+        parsed = expfmt.parse(text)
+        assert len(parsed) == 2
+        assert parsed[0].labels["model"] == "tpu-v5e"
+        assert parsed[0].value == 123.0
+
+        srv = collector.serve(host="127.0.0.1", port=0)
+        try:
+            inv = scrape_capacity(f"http://127.0.0.1:{srv.port}/metrics")
+        finally:
+            srv.stop()
+        assert [c.uuid for c in inv["n1"]] == ["n1-chip-0", "n1-chip-1"]
+        assert inv["n1"][0].memory == 16 * GIB
+
+    def test_scraped_inventory_feeds_scheduler(self):
+        """Full bus: collector -> scrape -> scheduler inventory."""
+        backend = FakeChipBackend(chips("node-a"))
+        collector = Collector("node-a", backend)
+        srv = collector.serve(host="127.0.0.1", port=0)
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            cluster = FakeCluster()
+            cluster.add_node("node-a")
+            sched = TpuShareScheduler(
+                {"cell_types": TOPO["cell_types"],
+                 "cells": [{"cell_type": "v5e-node", "cell_id": "node-a"}]},
+                cluster,
+                inventory=lambda node: scrape_capacity(url).get(node, []),
+            )
+            d = sched.schedule_one(cluster.create_pod(tpu_pod("p", 0.5)))
+            assert d.status == "bound"
+        finally:
+            srv.stop()
+
+    def test_malformed_capacity_sample_skipped(self):
+        samples = expfmt.parse(
+            'tpu_capacity{node="n1",uuid="u1",model="m",memory="abc"} 1\n'
+            'tpu_capacity{node="n1",uuid="u2",model="m",memory="512"} 1\n'
+        )
+        inv = capacity_from_samples(samples)
+        assert [c.uuid for c in inv["n1"]] == ["u2"]
+
+
+class TestAggregator:
+    def test_requirements_exported(self, scheduled_cluster):
+        cluster, sched = scheduled_cluster
+        agg = Aggregator(cluster)
+        samples = agg.samples()
+        names = sorted(s.labels["pod"] for s in samples)
+        assert names == ["big", "mnist-1", "mnist-2"]
+        mnist1 = next(s for s in samples if s.labels["pod"] == "mnist-1")
+        assert mnist1.labels["request"] == "0.5"
+        assert mnist1.labels["memory"] == str(2 * GIB)
+        assert int(mnist1.labels["port"]) >= 50050
+        big = next(s for s in samples if s.labels["pod"] == "big")
+        assert "," in big.labels["uuid"]  # two chips
+
+    def test_http_roundtrip(self, scheduled_cluster):
+        cluster, _ = scheduled_cluster
+        srv = Aggregator(cluster).serve(host="127.0.0.1", port=0)
+        try:
+            samples = scrape_requirements(
+                f"http://127.0.0.1:{srv.port}/metrics"
+            )
+            assert len(samples) == 3
+            node_a_only = scrape_requirements(
+                f"http://127.0.0.1:{srv.port}/metrics", node="node-a"
+            )
+            assert all(s.labels["node"] == "node-a" for s in node_a_only)
+        finally:
+            srv.stop()
+
+    def test_completed_pods_excluded(self, scheduled_cluster):
+        cluster, _ = scheduled_cluster
+        cluster.finish_pod("default/mnist-1")
+        names = [s.labels["pod"] for s in Aggregator(cluster).samples()]
+        assert "mnist-1" not in names
+
+
+class TestFileContract:
+    def test_roundtrip(self, tmp_path):
+        base = str(tmp_path)
+        entries = [
+            ConfigEntry("default/a", 1.0, 0.5, 2 * GIB),
+            ConfigEntry("default/b", 0.8, 0.3, GIB),
+        ]
+        path = write_config_file(base, "chip-1", entries)
+        raw = open(path).read()
+        assert raw.splitlines()[0] == "2"
+        assert raw.splitlines()[1] == f"default/a 1 0.5 {2 * GIB}"
+        assert read_config_file(path) == entries
+
+    def test_zeroed_file(self, tmp_path):
+        path = write_config_file(str(tmp_path), "chip-1", [])
+        assert open(path).read() == "0\n"
+        assert read_config_file(path) == []
+
+
+class TestNodeConfigDaemon:
+    def test_end_to_end_sync(self, scheduled_cluster, tmp_path):
+        cluster, sched = scheduled_cluster
+        agg = Aggregator(cluster)
+        base = str(tmp_path)
+        daemon_a = NodeConfigDaemon("node-a", base, agg.samples)
+        daemon_b = NodeConfigDaemon("node-b", base, agg.samples)
+        written = daemon_a.sync()
+        written.update(daemon_b.sync())
+        # the two fractional pods share one chip; the multi-chip pod is
+        # excluded from time-slicing config
+        shared_uuids = [u for u, n in written.items() if n > 0]
+        assert len(shared_uuids) == 1
+        [uuid] = shared_uuids
+        entries = read_config_file(os.path.join(base, "config", uuid))
+        assert sorted(e.pod for e in entries) == ["default/mnist-1", "default/mnist-2"]
+        ports = read_port_file(os.path.join(base, "podmanagerport", uuid))
+        assert len({p.port for p in ports}) == 2
+
+    def test_pod_deletion_zeroes_file(self, scheduled_cluster, tmp_path):
+        cluster, sched = scheduled_cluster
+        agg = Aggregator(cluster)
+        base = str(tmp_path)
+        daemon_a = NodeConfigDaemon("node-a", base, agg.samples)
+        daemon_b = NodeConfigDaemon("node-b", base, agg.samples)
+        daemon_a.sync(), daemon_b.sync()
+        cluster.delete_pod("default/mnist-1")
+        cluster.delete_pod("default/mnist-2")
+        daemon_a.sync(), daemon_b.sync()
+        for uuid in os.listdir(os.path.join(base, "config")):
+            assert read_config_file(os.path.join(base, "config", uuid)) == []
+
+    def test_ensure_chip_files(self, tmp_path):
+        daemon = NodeConfigDaemon("n", str(tmp_path), lambda: [])
+        daemon.ensure_chip_files(["c1", "c2"])
+        assert sorted(os.listdir(tmp_path / "config")) == ["c1", "c2"]
+        assert read_config_file(str(tmp_path / "config" / "c1")) == []

@@ -1,0 +1,321 @@
+"""Isolation-runtime integration: real C++ binaries driven over TCP."""
+
+import os
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from kubeshare_tpu.nodeconfig.files import (
+    ConfigEntry,
+    PortEntry,
+    write_config_file,
+    write_port_file,
+)
+from kubeshare_tpu.runtime.client import NativeTokenClient, TokenClient
+from kubeshare_tpu.runtime.hook import HbmCapExceeded, SharedChipGate
+from kubeshare_tpu.runtime.launcher import NodeLauncher, default_binary
+
+BUILD = os.path.join(os.path.dirname(__file__), "..", "runtime_native", "build")
+SCHD = os.path.join(BUILD, "tpu-schd")
+PMGR = os.path.join(BUILD, "tpu-pmgr")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(SCHD), reason="native runtime not built"
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for_port(port, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=0.2).close()
+            return
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"port {port} never came up")
+
+
+@pytest.fixture
+def arbiter(tmp_path):
+    """A tpu-schd on a temp config: pods a (0.6 req) and b (0.2 req)."""
+    base = str(tmp_path)
+    write_config_file(base, "chip-0", [
+        ConfigEntry("default/a", 1.0, 0.6, 1000),
+        ConfigEntry("default/b", 0.5, 0.2, 500),
+    ])
+    port = free_port()
+    proc = subprocess.Popen([
+        SCHD, "-p", os.path.join(base, "config"), "-f", "chip-0",
+        "-P", str(port), "-q", "50", "-m", "5", "-w", "1000",
+        "-H", "127.0.0.1",
+    ])
+    wait_for_port(port)
+    yield port, base
+    proc.kill()
+    proc.wait()
+
+
+class TestArbiter:
+    def test_acquire_release_cycle(self, arbiter):
+        port, _ = arbiter
+        with TokenClient("127.0.0.1", port, pod="default/a") as c:
+            assert c.ping()
+            quota = c.acquire()
+            assert quota > 0
+            c.release(10.0)
+            stats = {s.pod: s for s in c.stats()}
+            assert stats["default/a"].window_usage_ms == pytest.approx(10.0, abs=0.5)
+
+    def test_lease_is_exclusive(self, arbiter):
+        port, _ = arbiter
+        a = TokenClient("127.0.0.1", port, pod="default/a")
+        b = TokenClient("127.0.0.1", port, pod="default/b")
+        a.acquire()
+        got_b = []
+
+        def try_b():
+            b.acquire()
+            got_b.append(time.perf_counter())
+
+        t = threading.Thread(target=try_b)
+        t0 = time.perf_counter()
+        t.start()
+        time.sleep(0.15)
+        assert not got_b  # b blocked while a holds the lease
+        a.release(5.0)
+        t.join(timeout=2)
+        assert got_b and got_b[0] - t0 >= 0.14
+        b.release(5.0)
+        a.close(), b.close()
+
+    def test_guaranteed_pod_served_first(self, arbiter):
+        port, _ = arbiter
+        a = TokenClient("127.0.0.1", port, pod="default/a")   # request 0.6
+        b = TokenClient("127.0.0.1", port, pod="default/b")   # request 0.2
+        hog = TokenClient("127.0.0.1", port, pod="default/hog")  # unknown: burst tier
+        # hog burns time first
+        hog.acquire(); hog.release(300.0)
+        order = []
+        lock = threading.Lock()
+
+        def worker(client, name):
+            client.acquire()
+            with lock:
+                order.append(name)
+            time.sleep(0.01)
+            client.release(5.0)
+
+        holder = TokenClient("127.0.0.1", port, pod="default/b")
+        holder.acquire()  # hold lease so both contenders queue up
+        threads = [
+            threading.Thread(target=worker, args=(hog, "hog")),
+            threading.Thread(target=worker, args=(a, "a")),
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)  # both waiting
+        holder.release(1.0)
+        for t in threads:
+            t.join(timeout=3)
+        # guaranteed pod a (under its request) beats the burst hog
+        assert order[0] == "a"
+        for c in (a, b, hog, holder):
+            c.close()
+
+    def test_limit_throttles(self, arbiter):
+        port, _ = arbiter
+        # pod b has limit 0.5 over a 1000ms window: after using 600ms it
+        # must wait for the window to slide
+        b = TokenClient("127.0.0.1", port, pod="default/b")
+        b.acquire(); b.release(600.0)
+        t0 = time.perf_counter()
+        b.acquire(timeout=5.0)
+        waited = time.perf_counter() - t0
+        b.release(1.0)
+        assert waited > 0.3  # had to wait for window slide-out
+        b.close()
+
+    def test_memory_cap(self, arbiter):
+        port, _ = arbiter
+        with TokenClient("127.0.0.1", port, pod="default/b") as c:
+            ok, used, cap = c.request_memory(400)
+            assert ok and used == 400 and cap == 500
+            ok, used, cap = c.request_memory(200)
+            assert not ok and used == 400
+            ok, used, _ = c.request_memory(-100)
+            assert ok and used == 300
+            ok, used, _ = c.request_memory(200)
+            assert ok and used == 500
+
+    def test_config_reload(self, arbiter):
+        port, base = arbiter
+        with TokenClient("127.0.0.1", port, pod="default/new") as c:
+            stats = {s.pod for s in c.stats()}
+            assert "default/new" not in stats
+            time.sleep(1.1)  # ensure mtime tick
+            write_config_file(base, "chip-0", [
+                ConfigEntry("default/new", 1.0, 0.9, 2000),
+            ])
+            deadline = time.time() + 3
+            while time.time() < deadline:
+                stats = {s.pod for s in c.stats()}
+                if "default/new" in stats:
+                    break
+                time.sleep(0.1)
+            assert "default/new" in stats
+
+
+class TestPodManager:
+    def test_identity_pinning(self, arbiter):
+        port, _ = arbiter
+        mgr_port = free_port()
+        env = os.environ.copy()
+        env.update({
+            "SCHEDULER_IP": "127.0.0.1", "SCHEDULER_PORT": str(port),
+            "POD_MANAGER_IP": "127.0.0.1", "POD_MANAGER_PORT": str(mgr_port),
+            "POD_NAME": "default/b",
+        })
+        proc = subprocess.Popen([PMGR], env=env)
+        try:
+            wait_for_port(mgr_port)
+            # client lies about its identity; pmgr must pin default/b
+            with TokenClient("127.0.0.1", mgr_port, pod="default/a") as c:
+                c.acquire()
+                c.release(42.0)
+                stats = {s.pod: s for s in c.stats()}
+                assert stats["default/b"].window_usage_ms == pytest.approx(42.0, abs=0.5)
+                assert stats["default/a"].window_usage_ms == pytest.approx(0.0, abs=0.5)
+        finally:
+            proc.kill()
+            proc.wait()
+
+
+class TestNativeClient:
+    def test_ctypes_binding(self, arbiter):
+        port, _ = arbiter
+        c = NativeTokenClient("127.0.0.1", port)
+        quota = c.acquire()
+        assert quota > 0
+        c.release(3.0)
+        granted, _, _ = c.request_memory(10)
+        assert granted
+        c.close()
+
+
+class TestGate:
+    def test_gate_wraps_and_accounts(self, arbiter):
+        port, _ = arbiter
+        client = TokenClient("127.0.0.1", port, pod="default/a")
+        gate = SharedChipGate(client, hbm_limit_bytes=1000)
+
+        calls = []
+        step = gate.wrap(lambda x: calls.append(x) or x * 2)
+        assert step(21) == 42
+        assert gate.tokens_acquired == 1
+        gate.request_memory(900)
+        with pytest.raises(HbmCapExceeded):
+            gate.request_memory(200)
+        gate.close()
+
+    def test_gate_fail_open_without_arbiter(self):
+        gate = SharedChipGate(None)
+        assert gate.wrap(lambda: 7)() == 7
+
+
+class TestLauncher:
+    def test_fanout_and_reconcile(self, tmp_path):
+        base = str(tmp_path)
+        write_config_file(base, "chip-0", [ConfigEntry("default/x", 1.0, 0.5, 0)])
+        launcher = NodeLauncher(
+            base, ["chip-0"], base_port=free_port(),
+            base_quota_ms=50, min_quota_ms=5, window_ms=1000,
+        )
+        try:
+            launcher.start_arbiters()
+            chip = launcher.chips["chip-0"]
+            wait_for_port(chip.port)
+            pod_port = free_port()
+            write_port_file(base, "chip-0", [PortEntry("default/x", pod_port)])
+            launcher.reconcile()
+            wait_for_port(pod_port)
+            with TokenClient("127.0.0.1", pod_port, pod="ignored") as c:
+                c.acquire()
+                c.release(1.0)
+                assert {s.pod for s in c.stats()} == {"default/x"}
+            # pod vanishes -> manager killed
+            time.sleep(1.1)
+            write_port_file(base, "chip-0", [])
+            launcher.reconcile()
+            time.sleep(0.2)
+            with pytest.raises(OSError):
+                socket.create_connection(("127.0.0.1", pod_port), timeout=0.3)
+        finally:
+            launcher.shutdown()
+
+
+class TestReviewRegressions:
+    def test_same_second_config_rewrite_reloads(self, arbiter):
+        port, base = arbiter
+        with TokenClient("127.0.0.1", port, pod="default/x") as c:
+            # two rewrites in quick succession (same wall second)
+            write_config_file(base, "chip-0", [ConfigEntry("default/mid", 1.0, 0.5, 0)])
+            write_config_file(base, "chip-0", [ConfigEntry("default/x", 1.0, 0.5, 77)])
+            deadline = time.time() + 3
+            seen = set()
+            while time.time() < deadline:
+                seen = {s.pod for s in c.stats()}
+                if "default/x" in seen:
+                    break
+                time.sleep(0.1)
+            assert "default/x" in seen and "default/mid" not in seen
+
+    def test_lease_discipline(self, arbiter):
+        port, _ = arbiter
+        with TokenClient("127.0.0.1", port, pod="default/a") as c:
+            c.acquire()
+            # second ACQ on same connection rejected
+            with pytest.raises(Exception):
+                c.acquire()
+            # REL by a non-holder identity rejected (direct connection)
+            c.pod = "default/b"
+            with pytest.raises(Exception):
+                c.release(1.0)
+            c.pod = "default/a"
+            c.release(1.0)
+
+    def test_launcher_restarts_dead_children(self, tmp_path):
+        base = str(tmp_path)
+        write_config_file(base, "chip-0", [ConfigEntry("default/x", 1.0, 0.5, 0)])
+        launcher = NodeLauncher(base, ["chip-0"], base_port=free_port(),
+                                base_quota_ms=50, min_quota_ms=5, window_ms=1000)
+        try:
+            launcher.start_arbiters()
+            chip = launcher.chips["chip-0"]
+            wait_for_port(chip.port)
+            pod_port = free_port()
+            write_port_file(base, "chip-0", [PortEntry("default/x", pod_port)])
+            launcher.reconcile()
+            wait_for_port(pod_port)
+            # kill both children; reconcile must bring them back without
+            # any file change
+            chip.scheduler_proc.kill(); chip.scheduler_proc.wait()
+            for proc in chip.pod_managers.values():
+                proc.kill(); proc.wait()
+            launcher.reconcile()
+            wait_for_port(chip.port)
+            wait_for_port(pod_port)
+            with TokenClient("127.0.0.1", pod_port) as c:
+                assert c.ping()
+        finally:
+            launcher.shutdown()
