@@ -1,0 +1,25 @@
+"""JAX workload library.
+
+TPU-native ports of the reference's containerized test workloads
+(test/: PyTorch/TF MNIST, CIFAR-10, LSTM-wiki2, TorchElastic ResNet —
+SURVEY.md §4) plus a Llama-style transformer as the flagship model for
+the BASELINE.json inference config. All models are pure-functional
+(params pytree in, loss/logits out), static-shaped, bfloat16-friendly,
+and built to jit cleanly on TPU.
+"""
+
+from .mnist import MnistConfig, init_mnist, mnist_apply, make_mnist_train_step
+from .cifar import CifarConfig, init_cifar, cifar_apply
+from .lstm import LstmConfig, init_lstm, lstm_apply
+from .resnet import ResNetConfig, init_resnet, resnet_apply
+from .llama import LlamaConfig, init_llama, llama_apply
+from .train import make_train_step, synthetic_batches
+
+__all__ = [
+    "MnistConfig", "init_mnist", "mnist_apply", "make_mnist_train_step",
+    "CifarConfig", "init_cifar", "cifar_apply",
+    "LstmConfig", "init_lstm", "lstm_apply",
+    "ResNetConfig", "init_resnet", "resnet_apply",
+    "LlamaConfig", "init_llama", "llama_apply",
+    "make_train_step", "synthetic_batches",
+]

@@ -1,0 +1,52 @@
+"""CIFAR-10 CNN — the reference's second co-location workload
+(test/cifar10/*, BASELINE.json config 2: two 0.5-chip pods on one v5e).
+VGG-style blocks sized so two instances fit one chip's HBM at 0.5 each.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .common import conv, conv_init, dense, dense_init
+
+
+@dataclass(frozen=True)
+class CifarConfig:
+    widths: tuple = (64, 128, 256)
+    hidden: int = 256
+    num_classes: int = 10
+    image_size: int = 32
+
+
+def init_cifar(rng, cfg: CifarConfig = CifarConfig()) -> Dict:
+    params: Dict = {}
+    keys = jax.random.split(rng, 2 * len(cfg.widths) + 2)
+    in_ch = 3
+    k = 0
+    for i, width in enumerate(cfg.widths):
+        params[f"block{i}_a"] = conv_init(keys[k], 3, 3, in_ch, width); k += 1
+        params[f"block{i}_b"] = conv_init(keys[k], 3, 3, width, width); k += 1
+        in_ch = width
+    spatial = cfg.image_size // (2 ** len(cfg.widths))
+    params["fc"] = dense_init(keys[k], spatial * spatial * in_ch, cfg.hidden)
+    params["out"] = dense_init(keys[k + 1], cfg.hidden, cfg.num_classes)
+    return params
+
+
+def cifar_apply(params: Dict, images: jnp.ndarray,
+                cfg: CifarConfig = CifarConfig()) -> jnp.ndarray:
+    """images [B, 32, 32, 3] -> logits [B, 10]."""
+    x = images
+    for i in range(len(cfg.widths)):
+        x = jax.nn.relu(conv(params[f"block{i}_a"], x))
+        x = jax.nn.relu(conv(params[f"block{i}_b"], x))
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+        )
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(dense(params["fc"], x))
+    return dense(params["out"], x)

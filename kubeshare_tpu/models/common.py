@@ -1,0 +1,94 @@
+"""Shared layer primitives: pure-functional, pytree params.
+
+Conventions: params are nested dicts of jnp arrays; weights are stored
+in float32 and cast to the compute dtype at apply time (bf16 matmuls on
+the MXU with f32 accumulation via ``preferred_element_type``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_init(rng, in_dim: int, out_dim: int, scale: float = 1.0):
+    w_rng, _ = jax.random.split(rng)
+    std = scale / jnp.sqrt(jnp.asarray(in_dim, jnp.float32))
+    return {
+        "w": jax.random.normal(w_rng, (in_dim, out_dim), jnp.float32) * std,
+        "b": jnp.zeros((out_dim,), jnp.float32),
+    }
+
+
+def dense(params, x, dtype=jnp.bfloat16):
+    # uniform in/out dtype keeps AD transpose rules happy; the MXU
+    # accumulates bf16 matmuls in f32 internally regardless
+    w = params["w"].astype(dtype)
+    y = jnp.dot(x.astype(dtype), w)
+    return y + params["b"].astype(dtype)
+
+
+def conv_init(rng, kh: int, kw: int, in_ch: int, out_ch: int):
+    fan_in = kh * kw * in_ch
+    std = jnp.sqrt(2.0 / fan_in)
+    return {
+        "w": jax.random.normal(rng, (kh, kw, in_ch, out_ch), jnp.float32) * std,
+        "b": jnp.zeros((out_ch,), jnp.float32),
+    }
+
+
+def conv(params, x, stride: int = 1, dtype=jnp.bfloat16):
+    """NHWC conv, SAME padding — lowers onto the MXU as implicit GEMM."""
+    y = jax.lax.conv_general_dilated(
+        x.astype(dtype),
+        params["w"].astype(dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + params["b"].astype(dtype)
+
+
+def layernorm_init(dim: int):
+    return {"scale": jnp.ones((dim,), jnp.float32),
+            "bias": jnp.zeros((dim,), jnp.float32)}
+
+
+def layernorm(params, x, eps: float = 1e-5):
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    normed = (x - mean) * jax.lax.rsqrt(var + eps)
+    return normed * params["scale"] + params["bias"]
+
+
+def rmsnorm_init(dim: int):
+    return {"scale": jnp.ones((dim,), jnp.float32)}
+
+
+def rmsnorm(params, x, eps: float = 1e-6):
+    x32 = x.astype(jnp.float32)
+    rms = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * rms * params["scale"]).astype(x.dtype)
+
+
+def embed_init(rng, vocab: int, dim: int):
+    return {"table": jax.random.normal(rng, (vocab, dim), jnp.float32) * 0.02}
+
+
+def embed(params, ids, dtype=jnp.bfloat16):
+    return params["table"].astype(dtype)[ids]
+
+
+def cross_entropy_loss(logits, labels) -> jnp.ndarray:
+    """Mean softmax cross entropy; logits f32 for stability."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def accuracy(logits, labels) -> jnp.ndarray:
+    return jnp.mean(jnp.argmax(logits, axis=-1) == labels)

@@ -1,0 +1,134 @@
+"""Llama-style decoder transformer — the flagship model.
+
+Covers BASELINE.json config 5 ("Llama-3-8B JAX inference, 4 pods x 0.25
+chip"): RMSNorm, rotary embeddings, SwiGLU MLP, grouped-query
+attention. Pure-functional params; attention dispatches to the Pallas
+flash kernel on TPU (ops/attention.py). Tensor-parallel sharding rules
+for the weights live in parallel/sharding.py — the model itself stays
+sharding-agnostic (GSPMD: annotate inputs/params, let XLA insert the
+collectives).
+
+``llama3_8b()`` is the real config; tests and the graft entry use tiny
+configs with the same code path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import mha
+from .common import cross_entropy_loss, embed_init, rmsnorm, rmsnorm_init
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 32000
+    dim: int = 256
+    layers: int = 2
+    num_heads: int = 8
+    num_kv_heads: int = 4
+    mlp_dim: int = 688           # ~8/3 * dim rounded
+    max_seq_len: int = 2048
+    rope_theta: float = 500000.0
+    dtype: str = "bfloat16"
+
+
+def llama3_8b() -> LlamaConfig:
+    return LlamaConfig(
+        vocab=128256, dim=4096, layers=32, num_heads=32, num_kv_heads=8,
+        mlp_dim=14336, max_seq_len=8192,
+    )
+
+
+def _linear_init(rng, in_dim: int, out_dim: int):
+    std = in_dim ** -0.5
+    return jax.random.normal(rng, (in_dim, out_dim), jnp.float32) * std
+
+
+def init_llama(rng, cfg: LlamaConfig = LlamaConfig()) -> Dict:
+    hd = cfg.dim // cfg.num_heads
+    keys = jax.random.split(rng, cfg.layers + 2)
+    params: Dict = {"embed": embed_init(keys[0], cfg.vocab, cfg.dim)}
+    for i in range(cfg.layers):
+        lk = jax.random.split(keys[i + 1], 7)
+        params[f"layer{i}"] = {
+            "attn_norm": rmsnorm_init(cfg.dim),
+            "wq": _linear_init(lk[0], cfg.dim, cfg.num_heads * hd),
+            "wk": _linear_init(lk[1], cfg.dim, cfg.num_kv_heads * hd),
+            "wv": _linear_init(lk[2], cfg.dim, cfg.num_kv_heads * hd),
+            "wo": _linear_init(lk[3], cfg.num_heads * hd, cfg.dim),
+            "mlp_norm": rmsnorm_init(cfg.dim),
+            "w_gate": _linear_init(lk[4], cfg.dim, cfg.mlp_dim),
+            "w_up": _linear_init(lk[5], cfg.dim, cfg.mlp_dim),
+            "w_down": _linear_init(lk[6], cfg.mlp_dim, cfg.dim),
+        }
+    params["final_norm"] = rmsnorm_init(cfg.dim)
+    params["lm_head"] = _linear_init(keys[-1], cfg.dim, cfg.vocab)
+    return params
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
+    """Rotary embedding. x [B, H, T, D], positions [T]."""
+    head_dim = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
+    cos = jnp.cos(angles)[None, None, :, :]
+    sin = jnp.sin(angles)[None, None, :, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _matmul(x, w, dtype):
+    return jnp.dot(
+        x.astype(dtype), w.astype(dtype), preferred_element_type=jnp.float32
+    ).astype(dtype)
+
+
+def llama_apply(
+    params: Dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig = LlamaConfig(),
+    positions: Optional[jnp.ndarray] = None,
+    use_flash: Optional[bool] = None,
+) -> jnp.ndarray:
+    """tokens [B, T] int32 -> logits [B, T, vocab]."""
+    dtype = jnp.dtype(cfg.dtype)
+    batch, seq = tokens.shape
+    hd = cfg.dim // cfg.num_heads
+    if positions is None:
+        positions = jnp.arange(seq)
+    x = params["embed"]["table"].astype(dtype)[tokens]
+    for i in range(cfg.layers):
+        layer = params[f"layer{i}"]
+        h = rmsnorm(layer["attn_norm"], x)
+        q = _matmul(h, layer["wq"], dtype).reshape(batch, seq, cfg.num_heads, hd)
+        k = _matmul(h, layer["wk"], dtype).reshape(batch, seq, cfg.num_kv_heads, hd)
+        v = _matmul(h, layer["wv"], dtype).reshape(batch, seq, cfg.num_kv_heads, hd)
+        q = jnp.swapaxes(q, 1, 2)   # [B, H, T, D]
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        out = mha(q, k, v, causal=True, use_flash=use_flash)
+        out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)
+        x = x + _matmul(out, layer["wo"], dtype)
+
+        h = rmsnorm(layer["mlp_norm"], x)
+        gate = jax.nn.silu(_matmul(h, layer["w_gate"], dtype))
+        up = _matmul(h, layer["w_up"], dtype)
+        x = x + _matmul(gate * up, layer["w_down"], dtype)
+    x = rmsnorm(params["final_norm"], x)
+    return _matmul(x, params["lm_head"], dtype).astype(jnp.float32)
+
+
+def llama_loss(params, tokens, cfg: LlamaConfig) -> jnp.ndarray:
+    """Next-token LM loss on a [B, T] batch."""
+    logits = llama_apply(params, tokens[:, :-1], cfg)
+    return cross_entropy_loss(logits, tokens[:, 1:])

@@ -1,0 +1,67 @@
+"""LSTM language model — the reference's gang-scheduled workload
+(test/job1.yaml: LSTM on wikitext-2 with group_headcount=5,
+threshold=0.2; BASELINE.json config 3). Recurrence via ``lax.scan`` so
+the whole unrolled step is one XLA computation (no Python loop in jit).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import dense, dense_init, embed, embed_init
+
+
+@dataclass(frozen=True)
+class LstmConfig:
+    vocab: int = 8192
+    dim: int = 256
+    hidden: int = 512
+    layers: int = 2
+
+
+def _cell_init(rng, in_dim: int, hidden: int) -> Dict:
+    # one fused kernel for the 4 gates: [in+hidden, 4*hidden]
+    return dense_init(rng, in_dim + hidden, 4 * hidden)
+
+
+def init_lstm(rng, cfg: LstmConfig = LstmConfig()) -> Dict:
+    keys = jax.random.split(rng, cfg.layers + 2)
+    params: Dict = {"embed": embed_init(keys[0], cfg.vocab, cfg.dim)}
+    in_dim = cfg.dim
+    for i in range(cfg.layers):
+        params[f"cell{i}"] = _cell_init(keys[i + 1], in_dim, cfg.hidden)
+        in_dim = cfg.hidden
+    params["out"] = dense_init(keys[-1], cfg.hidden, cfg.vocab)
+    return params
+
+
+def _cell_step(cell_params, carry, x):
+    h, c = carry
+    gates = dense(cell_params, jnp.concatenate([x, h], axis=-1))
+    i, f, g, o = jnp.split(gates.astype(jnp.float32), 4, axis=-1)
+    c = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = (jax.nn.sigmoid(o) * jnp.tanh(c)).astype(x.dtype)
+    return (h, c), h
+
+
+def lstm_apply(params: Dict, tokens: jnp.ndarray,
+               cfg: LstmConfig = LstmConfig()) -> jnp.ndarray:
+    """tokens [B, T] int32 -> logits [B, T, vocab]."""
+    batch = tokens.shape[0]
+    x = embed(params["embed"], tokens)          # [B, T, D]
+    x = jnp.swapaxes(x, 0, 1)                   # [T, B, D] scan over time
+    for layer in range(cfg.layers):
+        cell = params[f"cell{layer}"]
+        h0 = jnp.zeros((batch, cfg.hidden), x.dtype)
+        c0 = jnp.zeros((batch, cfg.hidden), jnp.float32)
+
+        def step(carry, xt, cell=cell):
+            return _cell_step(cell, carry, xt)
+
+        _, x = jax.lax.scan(step, (h0, c0), x)
+    x = jnp.swapaxes(x, 0, 1)                   # [B, T, H]
+    return dense(params["out"], x)

@@ -1,0 +1,78 @@
+"""MNIST MLP/CNN — the reference's canonical fractional-share workload
+(test/mnist/mnist1.yaml: a 0.5-GPU PyTorch MNIST pod), rebuilt as a
+compact JAX model. This is also the co-location benchmark workload
+(BASELINE.json config 1: "PyTorch->JAX MNIST, 1 chip").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import conv, conv_init, cross_entropy_loss, dense, dense_init
+
+
+@dataclass(frozen=True)
+class MnistConfig:
+    arch: str = "cnn"          # "cnn" | "mlp"
+    hidden: int = 128
+    num_classes: int = 10
+    image_size: int = 28
+
+
+def init_mnist(rng, cfg: MnistConfig = MnistConfig()) -> Dict:
+    keys = jax.random.split(rng, 4)
+    if cfg.arch == "mlp":
+        in_dim = cfg.image_size * cfg.image_size
+        return {
+            "fc1": dense_init(keys[0], in_dim, cfg.hidden),
+            "fc2": dense_init(keys[1], cfg.hidden, cfg.hidden),
+            "out": dense_init(keys[2], cfg.hidden, cfg.num_classes),
+        }
+    flat = (cfg.image_size // 4) * (cfg.image_size // 4) * 64
+    return {
+        "conv1": conv_init(keys[0], 3, 3, 1, 32),
+        "conv2": conv_init(keys[1], 3, 3, 32, 64),
+        "fc1": dense_init(keys[2], flat, cfg.hidden),
+        "out": dense_init(keys[3], cfg.hidden, cfg.num_classes),
+    }
+
+
+def mnist_apply(params: Dict, images: jnp.ndarray,
+                cfg: MnistConfig = MnistConfig()) -> jnp.ndarray:
+    """images: [B, 28, 28, 1] (cnn) or [B, 784] (mlp) -> logits [B, 10]."""
+    if cfg.arch == "mlp":
+        x = images.reshape(images.shape[0], -1)
+        x = jax.nn.relu(dense(params["fc1"], x))
+        x = jax.nn.relu(dense(params["fc2"], x))
+        return dense(params["out"], x)
+    x = images
+    x = jax.nn.relu(conv(params["conv1"], x))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+    x = jax.nn.relu(conv(params["conv2"], x))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(dense(params["fc1"], x))
+    return dense(params["out"], x)
+
+
+def make_mnist_train_step(cfg: MnistConfig = MnistConfig(), lr: float = 1e-3):
+    """Jitted SGD step: (params, images, labels) -> (params, loss)."""
+
+    def loss_fn(params, images, labels):
+        return cross_entropy_loss(mnist_apply(params, images, cfg), labels)
+
+    @jax.jit
+    def step(params, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images, labels)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return params, loss
+
+    return step

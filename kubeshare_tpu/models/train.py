@@ -1,0 +1,38 @@
+"""Generic single-chip training utilities."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_train_step(loss_fn: Callable, learning_rate: float = 1e-3,
+                    optimizer: Optional[optax.GradientTransformation] = None):
+    """Jitted optax step: (params, opt_state, *batch) ->
+    (params, opt_state, loss). ``loss_fn(params, *batch) -> scalar``."""
+    opt = optimizer or optax.adam(learning_rate)
+
+    @jax.jit
+    def step(params, opt_state, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return opt, step
+
+
+def synthetic_batches(rng, shape, vocab: Optional[int] = None, count: int = 0):
+    """Deterministic synthetic data stream (int tokens or float images) —
+    the hermetic stand-in for the reference's dataset containers."""
+    i = 0
+    while count == 0 or i < count:
+        rng, key = jax.random.split(rng)
+        if vocab is not None:
+            yield jax.random.randint(key, shape, 0, vocab, dtype=jnp.int32)
+        else:
+            yield jax.random.normal(key, shape, jnp.float32)
+        i += 1

@@ -1,0 +1,3 @@
+from .attention import attention, flash_attention, mha
+
+__all__ = ["attention", "flash_attention", "mha"]

@@ -1,0 +1,201 @@
+"""Attention: reference implementation + Pallas TPU flash kernel.
+
+``attention`` is the plain O(T^2)-memory einsum version (differentiable,
+runs anywhere). ``flash_attention`` is a Pallas kernel that streams K/V
+blocks through VMEM with an online softmax — O(T) memory, MXU-shaped
+block matmuls (guide: /opt/skills/guides/pallas_guide.md). Its backward
+pass is the autodiff of the reference implementation (custom_vjp), so
+it trains correctly while the forward stays flash; a fused backward
+kernel is a later optimization.
+
+On CPU (tests) the kernel runs in interpret mode; on TPU it compiles
+natively. Shapes: q [B, H, Tq, D], k/v [B, Hkv, Tk, D] with H a
+multiple of Hkv (GQA: kv heads are repeated).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k, v, num_heads: int):
+    h_kv = k.shape[1]
+    if h_kv != num_heads:
+        reps = num_heads // h_kv
+        k = jnp.repeat(k, reps, axis=1)
+        v = jnp.repeat(v, reps, axis=1)
+    return k, v
+
+
+def attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """Reference attention. q [B,H,Tq,D], k/v [B,Hkv,Tk,D] -> [B,H,Tq,D]."""
+    *_, num_heads, t_q, head_dim = q.shape
+    k, v = _repeat_kv(k, v, num_heads)
+    t_k = k.shape[2]
+    scale = scale if scale is not None else head_dim ** -0.5
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = jnp.arange(t_q)[:, None] + (t_k - t_q)
+        k_pos = jnp.arange(t_k)[None, :]
+        scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+# ---- Pallas flash forward ------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, out_ref, *, block_k: int,
+                  causal: bool, scale: float):
+    """One (batch*head, q-block) program: stream K/V blocks with online
+    softmax. Refs: q [1, BQ, D], k/v [1, Tk, D], out [1, BQ, D]."""
+    q = q_ref[0].astype(jnp.float32) * scale
+    block_q, head_dim = q.shape
+    t_k = k_ref.shape[1]
+    q_block_idx = pl.program_id(1)
+    q_offset = q_block_idx * block_q
+
+    num_k_blocks = t_k // block_k
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        correction = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * correction + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+
+    if causal:
+        # only k blocks at or before this q block contribute
+        last = jnp.minimum(
+            (q_offset + block_q + block_k - 1) // block_k, num_k_blocks
+        )
+        acc, m, l = jax.lax.fori_loop(0, last, body, (acc0, m0, l0))
+    else:
+        acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+
+    out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+
+
+def flash_shapes_ok(q_shape, k_shape, causal: bool,
+                    block_q: int = 128, block_k: int = 128) -> bool:
+    """Whether the flash kernel's tiling constraints hold."""
+    t_q, t_k = q_shape[-2], k_shape[-2]
+    bq, bk = min(block_q, t_q), min(block_k, t_k)
+    if t_q % bq or t_k % bk:
+        return False
+    if causal and t_q != t_k:
+        return False
+    return True
+
+
+def _flash_forward(q, k, v, causal: bool, scale: float,
+                   block_q: int, block_k: int, interpret: bool):
+    batch, num_heads, t_q, head_dim = q.shape
+    h_kv = k.shape[1]
+    reps = num_heads // h_kv
+    t_k = k.shape[2]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+    assert flash_shapes_ok(q.shape, k.shape, causal, block_q, block_k), (
+        f"flash tiling violated: t_q={t_q} t_k={t_k} blocks=({block_q},"
+        f"{block_k}) causal={causal} — use attention()"
+    )
+    qf = q.reshape(batch * num_heads, t_q, head_dim)
+    # GQA without materializing repeats: K/V stay [B*Hkv, T, D] and the
+    # BlockSpec index map routes each q head to its kv head, so each
+    # K/V shard streams through VMEM once.
+    kf = k.reshape(batch * h_kv, t_k, head_dim)
+    vf = v.reshape(batch * h_kv, t_k, head_dim)
+
+    def kv_index(b, i):
+        del i
+        return (b // num_heads) * h_kv + (b % num_heads) // reps
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(batch * num_heads, t_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t_k, head_dim), lambda b, i: (kv_index(b, i), 0, 0)),
+            pl.BlockSpec((1, t_k, head_dim), lambda b, i: (kv_index(b, i), 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((batch * num_heads, t_q, head_dim), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(batch, num_heads, t_q, head_dim)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: attention(q_, k_, v_, causal, scale),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def mha(q, k, v, causal: bool = True, use_flash: Optional[bool] = None):
+    """Dispatch: flash on TPU when shapes tile, reference otherwise."""
+    if use_flash is None:
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and q.shape[-2] >= 128
+            and flash_shapes_ok(q.shape, k.shape, causal)
+        )
+    if use_flash:
+        return flash_attention(q, k, v, causal)
+    return attention(q, k, v, causal)

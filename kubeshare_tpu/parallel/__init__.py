@@ -1,0 +1,15 @@
+from .mesh import MeshPlan, make_mesh, factorize_devices
+from .sharding import llama_param_spec, shard_params, batch_sharding
+from .ring_attention import ring_attention
+from .train import make_sharded_train_step
+
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "factorize_devices",
+    "llama_param_spec",
+    "shard_params",
+    "batch_sharding",
+    "ring_attention",
+    "make_sharded_train_step",
+]

@@ -1,0 +1,76 @@
+"""Device-mesh construction for dp / fsdp / tp / sp axes.
+
+The scaling recipe (How to Scale Your Model): pick a mesh whose axes
+map onto the ICI topology, annotate array shardings, and let XLA insert
+the collectives. The scheduler side of this framework places gang
+members ICI-close (cells/topology.py); this module is the workload side
+that exploits that placement. ``jax.make_mesh`` orders devices so the
+innermost axes ride the fastest links — tp innermost (all-reduce heavy),
+then sp, fsdp, dp outermost (DCN-tolerant).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.sp, self.tp)
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return ("dp", "fsdp", "sp", "tp")
+
+    @property
+    def total(self) -> int:
+        total = 1
+        for d in self.shape:
+            total *= d
+        return total
+
+
+def factorize_devices(n: int, tp_max: int = 8, fsdp_max: int = 8) -> MeshPlan:
+    """Reasonable default split of n devices: tp up to tp_max (innermost,
+    bandwidth-hungry), then fsdp up to fsdp_max, remainder dp."""
+    tp = 1
+    for candidate in (8, 4, 2, 1):
+        if candidate <= tp_max and n % candidate == 0:
+            tp = candidate
+            break
+    rest = n // tp
+    fsdp = 1
+    for candidate in (8, 4, 2, 1):
+        if candidate <= min(fsdp_max, rest) and rest % candidate == 0:
+            fsdp = candidate
+            break
+    dp = rest // fsdp
+    return MeshPlan(dp=dp, fsdp=fsdp, tp=tp, sp=1)
+
+
+def make_mesh(plan: Optional[MeshPlan] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if plan is None:
+        plan = factorize_devices(len(devices))
+    if plan.total != len(devices):
+        raise ValueError(
+            f"mesh plan {plan.shape} needs {plan.total} devices, "
+            f"have {len(devices)}"
+        )
+    import numpy as np
+
+    # classic (auto-sharding) mesh: GSPMD propagates shardings from the
+    # in/out annotations without requiring explicit-mode mesh contexts
+    return Mesh(np.asarray(devices).reshape(plan.shape), plan.axis_names)

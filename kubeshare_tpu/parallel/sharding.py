@@ -1,0 +1,83 @@
+"""Sharding rules (GSPMD partition specs) for the model families.
+
+Weights: tensor-parallel over ``tp`` (column-parallel up-projections,
+row-parallel down-projections -> one psum per block, inserted by XLA),
+optionally sharded over ``fsdp`` on the other axis (ZeRO-3 style: XLA
+all-gathers weights per layer). Activations/batch: data-parallel over
+``(dp, fsdp)``, sequence over ``sp``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def llama_param_spec(fsdp: bool = True) -> Dict:
+    """PartitionSpec pytree matching init_llama's params structure,
+    keyed by the same names. Patterns (in_dim, out_dim):
+
+    - wq/wk/wv, w_gate/w_up: column-parallel -> P(fsdp?, 'tp')
+    - wo, w_down:            row-parallel    -> P('tp', fsdp?)
+    - embed/lm_head:         vocab-sharded on tp
+    - norms: replicated
+    """
+    f = "fsdp" if fsdp else None
+
+    def layer_spec():
+        return {
+            "attn_norm": {"scale": P()},
+            "wq": P(f, "tp"),
+            "wk": P(f, "tp"),
+            "wv": P(f, "tp"),
+            "wo": P("tp", f),
+            "mlp_norm": {"scale": P()},
+            "w_gate": P(f, "tp"),
+            "w_up": P(f, "tp"),
+            "w_down": P("tp", f),
+        }
+
+    return {
+        "embed": {"table": P("tp", None)},
+        "final_norm": {"scale": P()},
+        "lm_head": P(f, "tp"),
+        "__layers__": layer_spec,
+    }
+
+
+def build_param_specs(params: Dict, fsdp: bool = True) -> Dict:
+    """Full spec pytree for a concrete params dict."""
+    template = llama_param_spec(fsdp)
+    layer_spec = template["__layers__"]
+    specs: Dict = {}
+    for name, value in params.items():
+        if name.startswith("layer"):
+            specs[name] = layer_spec()
+        elif name in template:
+            specs[name] = template[name]
+        else:
+            specs[name] = jax.tree.map(lambda _: P(), value)
+    return specs
+
+
+def apply_specs(params, specs, fn):
+    """Zip a params pytree against a spec pytree (PartitionSpec leaves —
+    which are themselves pytrees, so jax.tree.map cannot zip them)."""
+    if isinstance(specs, P):
+        return fn(params, specs)
+    return {k: apply_specs(params[k], specs[k], fn) for k in params}
+
+
+def shard_params(params: Dict, mesh: Mesh, fsdp: bool = True) -> Dict:
+    specs = build_param_specs(params, fsdp)
+    return apply_specs(
+        params, specs,
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+    )
+
+
+def batch_sharding(mesh: Mesh, seq_axis: Optional[str] = None) -> NamedSharding:
+    """Tokens [B, T]: batch over (dp, fsdp), optionally sequence over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), seq_axis))

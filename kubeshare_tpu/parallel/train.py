@@ -1,0 +1,67 @@
+"""Sharded training step factory (dp x fsdp x tp via GSPMD).
+
+One jitted function carries the whole step — forward, backward, optax
+update — with input/output shardings pinned so XLA lays gradients'
+all-reduces over (dp, fsdp) and tensor-parallel psums over tp onto the
+mesh. This is the TPU-native replacement for the reference's
+TorchElastic + NCCL data-parallel test jobs (test/distribute/**): the
+collective work lives in the compiled program, not a sidecar process
+group.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding
+
+from .sharding import apply_specs, build_param_specs, batch_sharding
+
+
+def make_sharded_train_step(
+    loss_fn: Callable,           # (params, batch) -> scalar loss
+    params: Dict,
+    mesh: Mesh,
+    learning_rate: float = 1e-3,
+    fsdp: bool = True,
+    param_specs: Optional[Dict] = None,
+    batch_spec: Optional[NamedSharding] = None,
+):
+    """Returns (step_fn, sharded_params, opt_state). ``step_fn(params,
+    opt_state, batch) -> (params, opt_state, loss)``; shardings flow
+    from the committed (returned) params/opt_state, and params +
+    opt_state buffers are donated."""
+    if param_specs is None:
+        param_specs = build_param_specs(params, fsdp)
+    if batch_spec is None:
+        batch_spec = batch_sharding(mesh)
+
+    optimizer = optax.adamw(learning_rate)
+    # Shardings bind through the committed inputs: params are placed per
+    # spec here, the optimizer state inherits them through jitted init,
+    # and GSPMD propagates from there. Callers must thread the RETURNED
+    # params/opt_state (donation consumes the old buffers anyway).
+    sharded_params = apply_specs(
+        params, param_specs,
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+    )
+    opt_state = jax.jit(optimizer.init)(sharded_params)
+
+    # donate params+opt_state: the update writes in place, halving peak
+    # HBM — the difference between fitting a model and OOMing at half
+    # its size on 16GB v5e chips
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def run(params, opt_state, batch):
+        batch = jax.device_put(batch, batch_spec)
+        return step(params, opt_state, batch)
+
+    return run, sharded_params, opt_state

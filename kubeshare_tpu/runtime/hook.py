@@ -50,6 +50,9 @@ class SharedChipGate:
         self._hbm_used = 0
         self.tokens_acquired = 0
         self.compute_ms = 0.0
+        self._held = False
+        self._quota_ms = 0.0
+        self._hold_start = 0.0
 
     # ---- compute gating --------------------------------------------
 
@@ -90,6 +93,77 @@ class SharedChipGate:
             return result
 
         return gated
+
+    # ---- amortized token holding -----------------------------------
+    #
+    # Per-call acquire/release costs a TCP round trip (~100us) — fatal
+    # when device steps are that small. Like the reference's CUDA hook
+    # (which gates batches of kernel launches, not single launches), a
+    # held token covers as many dispatches as fit in its quota: steps
+    # run fully async inside the hold, and when the quota's wall-clock
+    # expires the stream is drained (block_until_ready) and the token
+    # returned with the measured hold time.
+
+    def begin(self, est_ms: float = 0.0) -> None:
+        """Ensure a compute token is held (no-op if already holding)."""
+        if self.client is None or self._held:
+            return
+        try:
+            self._quota_ms = self.client.acquire(est_ms)
+            self._held = True
+            self._hold_start = time.perf_counter()
+            self.tokens_acquired += 1
+        except (TokenProtocolError, OSError):
+            if not self.fail_open:
+                raise
+
+    def maybe_release(self, result: Any = None) -> Any:
+        """Call after each dispatched step: if the held quota expired,
+        drain the device stream and return the token."""
+        if self.client is None or not self._held:
+            return result
+        elapsed_ms = (time.perf_counter() - self._hold_start) * 1e3
+        if elapsed_ms >= self._quota_ms:
+            result = _block(result)
+            used_ms = (time.perf_counter() - self._hold_start) * 1e3
+            self.compute_ms += used_ms
+            self._held = False
+            try:
+                self.client.release(used_ms)
+            except (TokenProtocolError, OSError):
+                if not self.fail_open:
+                    raise
+        return result
+
+    def flush(self, result: Any = None) -> Any:
+        """Drain and return the token unconditionally (end of stream)."""
+        if self.client is not None and self._held:
+            result = _block(result)
+            used_ms = (time.perf_counter() - self._hold_start) * 1e3
+            self.compute_ms += used_ms
+            self._held = False
+            try:
+                self.client.release(used_ms)
+            except (TokenProtocolError, OSError):
+                if not self.fail_open:
+                    raise
+        return result
+
+    @contextmanager
+    def burst(self, est_ms: float = 0.0):
+        """Hold one token across a burst of async dispatches, draining
+        and returning the token at burst end. This is the right shape
+        for input-bound loops: the lease is NEVER held across the
+        caller's input stall (holding it there would idle the chip for
+        every co-located pod — exactly what the arbiter exists to
+        prevent). For continuous dispatch loops, call begin()/
+        maybe_release() directly so one token spans many bursts up to
+        its quota."""
+        self.begin(est_ms)
+        try:
+            yield self
+        finally:
+            self.flush()
 
     # ---- HBM accounting --------------------------------------------
 

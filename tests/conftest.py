@@ -2,10 +2,16 @@ import os
 
 # Hermetic TPU-free testing: an 8-device virtual CPU mesh so sharding
 # paths (dp/fsdp/tp, ring attention) compile and run without chips.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS must be set before the CPU backend initializes; the
+# platform override must be applied via jax.config because the site's
+# TPU plugin (axon) force-selects itself at interpreter startup.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
