@@ -1,4 +1,14 @@
 from .bitmap import Bitmap, RRBitmap
+from .containers import LockedSet, Queue, Stack
 from .logger import get_logger
+from .signals import setup_signal_handler
 
-__all__ = ["Bitmap", "RRBitmap", "get_logger"]
+__all__ = [
+    "Bitmap",
+    "RRBitmap",
+    "LockedSet",
+    "Queue",
+    "Stack",
+    "get_logger",
+    "setup_signal_handler",
+]

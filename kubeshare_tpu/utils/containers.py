@@ -1,0 +1,121 @@
+"""Thread-safe container primitives: FIFO queue, LIFO stack, set.
+
+Rebuild of the reference's pkg/lib trio (pkg/lib/queue/queue.go:1-68,
+pkg/lib/stack/stack.go:1-62, pkg/lib/set/set.go:1-61). The reference's
+set has a latent deadlock — ``Contains``/``Items`` take the read lock
+twice instead of unlocking (set.go:29-34, 47-49); here every method
+pairs acquire/release correctly. Unlike the reference's ``interface{}``
+containers, these are duck-typed over any hashable/arbitrary values but
+keep the same surface: the queue backs bound-pod resync and topology
+BFS (reference: pkg/scheduler/pod.go:47-78, config.go:77-120), the
+stack backs cell-tree DFS (pkg/scheduler/stack.go,
+pkg/scheduler/filter.go:32-104).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Iterable, List, Optional
+
+
+class Queue:
+    """Locked FIFO (reference pkg/lib/queue/queue.go:1-68)."""
+
+    def __init__(self, items: Iterable[Any] = ()):
+        self._items = deque(items)
+        self._lock = threading.Lock()
+
+    def enqueue(self, item: Any) -> None:
+        with self._lock:
+            self._items.append(item)
+
+    def dequeue(self) -> Optional[Any]:
+        """Pop the oldest item; None when empty (reference returns nil)."""
+        with self._lock:
+            return self._items.popleft() if self._items else None
+
+    def front(self) -> Optional[Any]:
+        with self._lock:
+            return self._items[0] if self._items else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def empty(self) -> bool:
+        return len(self) == 0
+
+    def items(self) -> List[Any]:
+        """Snapshot copy, oldest first."""
+        with self._lock:
+            return list(self._items)
+
+
+class Stack:
+    """Locked LIFO (reference pkg/lib/stack/stack.go:1-62 and the
+    scheduler's typed copy pkg/scheduler/stack.go:1-62)."""
+
+    def __init__(self, items: Iterable[Any] = ()):
+        self._items = list(items)
+        self._lock = threading.Lock()
+
+    def push(self, item: Any) -> None:
+        with self._lock:
+            self._items.append(item)
+
+    def pop(self) -> Optional[Any]:
+        with self._lock:
+            return self._items.pop() if self._items else None
+
+    def top(self) -> Optional[Any]:
+        with self._lock:
+            return self._items[-1] if self._items else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def empty(self) -> bool:
+        return len(self) == 0
+
+
+class LockedSet:
+    """Locked set (reference pkg/lib/set/set.go:1-61, with the
+    double-RLock bug at set.go:30-31 fixed: every method releases what
+    it acquires)."""
+
+    def __init__(self, items: Iterable[Any] = ()):
+        self._items = set(items)
+        self._lock = threading.Lock()
+
+    def add(self, item: Any) -> bool:
+        """Insert; True if the item was new."""
+        with self._lock:
+            if item in self._items:
+                return False
+            self._items.add(item)
+            return True
+
+    def remove(self, item: Any) -> bool:
+        """Discard; True if the item was present."""
+        with self._lock:
+            if item not in self._items:
+                return False
+            self._items.discard(item)
+            return True
+
+    def contains(self, item: Any) -> bool:
+        with self._lock:
+            return item in self._items
+
+    def __contains__(self, item: Any) -> bool:
+        return self.contains(item)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def items(self) -> List[Any]:
+        with self._lock:
+            return list(self._items)

@@ -1,0 +1,46 @@
+"""Graceful-shutdown signal handling.
+
+Rebuild of pkg/signals (signal.go:26-40, signal_posix.go:23): first
+SIGINT/SIGTERM sets a stop event the daemons poll/wait on; a second
+signal exits the process immediately (the reference calls
+``os.Exit(1)`` on the second delivery).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Iterable
+
+_installed_lock = threading.Lock()
+_installed = False
+
+
+def setup_signal_handler(
+    signums: Iterable[int] = (signal.SIGINT, signal.SIGTERM),
+) -> threading.Event:
+    """Install once-only handlers; returns the stop event. Raises
+    RuntimeError on a second call (the reference panics: signal.go:28)."""
+    global _installed
+    with _installed_lock:
+        if _installed:
+            raise RuntimeError("setup_signal_handler called twice")
+        _installed = True
+
+    stop = threading.Event()
+
+    def _handler(signum, frame):
+        if stop.is_set():
+            os._exit(1)  # second signal: hard exit (reference signal.go:35-38)
+        stop.set()
+
+    for signum in signums:
+        signal.signal(signum, _handler)
+    return stop
+
+
+def _reset_for_tests() -> None:
+    global _installed
+    with _installed_lock:
+        _installed = False

@@ -1,9 +1,11 @@
+import signal
 import threading
 
 import pytest
 
 from kubeshare_tpu.utils.bitmap import Bitmap, RRBitmap
-from kubeshare_tpu.utils import expfmt
+from kubeshare_tpu.utils.containers import LockedSet, Queue, Stack
+from kubeshare_tpu.utils import expfmt, signals
 from kubeshare_tpu.utils.httpserv import MetricServer
 
 
@@ -97,6 +99,85 @@ class TestExpfmt:
         )
         parsed = expfmt.parse(text)
         assert [(s.name, s.value) for s in parsed] == [("good", 2.0), ("ok", 3.0)]
+
+
+class TestQueue:
+    def test_fifo_order(self):
+        q = Queue()
+        assert q.empty() and q.dequeue() is None and q.front() is None
+        for i in range(3):
+            q.enqueue(i)
+        assert q.front() == 0 and len(q) == 3
+        assert [q.dequeue() for _ in range(4)] == [0, 1, 2, None]
+
+    def test_concurrent_drain(self):
+        q = Queue(range(1000))
+        got, lock = [], threading.Lock()
+
+        def worker():
+            while True:
+                item = q.dequeue()
+                if item is None:
+                    return
+                with lock:
+                    got.append(item)
+
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert sorted(got) == list(range(1000))
+
+
+class TestStack:
+    def test_lifo_order(self):
+        s = Stack()
+        assert s.empty() and s.pop() is None and s.top() is None
+        s.push("a"), s.push("b")
+        assert s.top() == "b" and len(s) == 2
+        assert [s.pop(), s.pop(), s.pop()] == ["b", "a", None]
+
+
+class TestLockedSet:
+    def test_add_remove_contains(self):
+        s = LockedSet(["x"])
+        assert "x" in s and s.contains("x")
+        assert not s.add("x")
+        assert s.add("y") and len(s) == 2
+        assert s.remove("x") and not s.remove("x")
+        assert sorted(s.items()) == ["y"]
+
+    def test_no_self_deadlock(self):
+        # The reference's Contains double-RLocks (set.go:30-31); ours
+        # must answer promptly even under mixed load.
+        s = LockedSet(range(100))
+        done = threading.Event()
+
+        def reader():
+            for i in range(2000):
+                s.contains(i % 100)
+                s.items()
+            done.set()
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert done.is_set()
+
+
+class TestSignals:
+    def test_stop_event_and_double_install(self):
+        signals._reset_for_tests()
+        old = signal.getsignal(signal.SIGUSR1)
+        try:
+            stop = signals.setup_signal_handler(signums=(signal.SIGUSR1,))
+            assert not stop.is_set()
+            signal.raise_signal(signal.SIGUSR1)
+            assert stop.wait(timeout=5)
+            with pytest.raises(RuntimeError):
+                signals.setup_signal_handler(signums=(signal.SIGUSR1,))
+        finally:
+            signal.signal(signal.SIGUSR1, old)
+            signals._reset_for_tests()
 
 
 class TestMetricServer:
