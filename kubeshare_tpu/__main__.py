@@ -1,0 +1,28 @@
+"""``python -m kubeshare_tpu <component> [flags]`` dispatcher."""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+from .cmd import COMPONENTS
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        names = ", ".join(sorted(COMPONENTS))
+        print(f"usage: python -m kubeshare_tpu <component> [flags]\n"
+              f"components: {names}")
+        return 0 if argv else 2
+    name = argv[0]
+    if name not in COMPONENTS:
+        print(f"unknown component {name!r}; one of: "
+              + ", ".join(sorted(COMPONENTS)))
+        return 2
+    module = importlib.import_module(COMPONENTS[name])
+    return module.main(argv[1:])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

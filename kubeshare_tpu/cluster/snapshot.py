@@ -1,0 +1,204 @@
+"""File-backed cluster state for offline / simulated operation.
+
+A JSON (or YAML) snapshot file describes nodes, chips, and pods; the
+adapter reloads it when its mtime changes and replays adds/deletes to
+registered handlers — the file is to this adapter what the kube API
+watch stream is to a real one. Lets every daemon CLI (scheduler,
+aggregator) run hermetically, and is the backbone of the trace
+simulator (reference: test/simulator/simulator.py drives a live
+cluster; we can drive a file).
+
+Snapshot schema::
+
+    {
+      "nodes": [{"name": "n1", "ready": true,
+                 "chips": [{"uuid": "c0", "model": "tpu-v5e",
+                            "memory": 17179869184, "index": 0}]}],
+      "pods":  [{"name": "p1", "namespace": "default",
+                 "scheduler_name": "kubeshare-tpu-scheduler",
+                 "labels": {...}, "annotations": {...},
+                 "node_name": "", "phase": "Pending"}]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional
+
+from ..cells.cell import ChipInfo
+from .api import Container, Node, Pod, PodPhase
+
+
+def _load_file(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        return yaml.safe_load(text) or {}
+    return json.loads(text or "{}")
+
+
+def pod_from_dict(raw: dict) -> Pod:
+    pod = Pod(
+        name=raw["name"],
+        namespace=raw.get("namespace", "default"),
+        uid=raw.get("uid", ""),
+        labels=dict(raw.get("labels", {})),
+        annotations=dict(raw.get("annotations", {})),
+        node_name=raw.get("node_name", ""),
+        phase=PodPhase(raw.get("phase", "Pending")),
+        scheduler_name=raw.get("scheduler_name", ""),
+    )
+    for c in raw.get("containers", []):
+        pod.containers.append(
+            Container(name=c.get("name", "main"), env=dict(c.get("env", {})))
+        )
+    if not pod.containers:
+        pod.containers.append(Container())
+    return pod
+
+
+def node_from_dict(raw: dict) -> Node:
+    return Node(
+        name=raw["name"],
+        ready=bool(raw.get("ready", True)),
+        unschedulable=bool(raw.get("unschedulable", False)),
+        labels=dict(raw.get("labels", {})),
+    )
+
+
+def chips_from_dicts(raws: List[dict]) -> List[ChipInfo]:
+    return [
+        ChipInfo(
+            uuid=c["uuid"],
+            model=c.get("model", "tpu-v5e"),
+            memory=int(c.get("memory", 16 << 30)),
+            index=int(c.get("index", i)),
+        )
+        for i, c in enumerate(raws)
+    ]
+
+
+class SnapshotCluster:
+    """ClusterAPI over a snapshot file; ``refresh()`` diffs the file
+    against in-memory state and fires pod add/delete + node handlers."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mtime = -1.0
+        self._pods: Dict[str, Pod] = {}
+        self._completed_notified: set = set()
+        self._nodes: Dict[str, Node] = {}
+        self._chips: Dict[str, List[ChipInfo]] = {}
+        self._pod_add: List[Callable[[Pod], None]] = []
+        self._pod_delete: List[Callable[[Pod], None]] = []
+        self._node_update: List[Callable[[Node], None]] = []
+        self.refresh(force=True)
+
+    # ---- ClusterAPI -------------------------------------------------
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        pods = list(self._pods.values())
+        if namespace is not None:
+            pods = [p for p in pods if p.namespace == namespace]
+        return pods
+
+    def list_nodes(self) -> List[Node]:
+        return list(self._nodes.values())
+
+    def get_pod(self, key: str) -> Optional[Pod]:
+        return self._pods.get(key)
+
+    def bind(self, pod_key: str, node_name: str) -> None:
+        pod = self._pods[pod_key]
+        pod.node_name = node_name
+        pod.phase = PodPhase.RUNNING
+
+    def patch_pod(self, pod_key, annotations=None, env=None) -> None:
+        pod = self._pods[pod_key]
+        if annotations:
+            pod.annotations.update(annotations)
+        if env:
+            for container in pod.containers:
+                container.env.update(env)
+
+    def on_pod_event(self, add, delete) -> None:
+        self._pod_add.append(add)
+        self._pod_delete.append(delete)
+
+    def on_node_event(self, update) -> None:
+        self._node_update.append(update)
+
+    def chips_on_node(self, node_name: str) -> List[ChipInfo]:
+        return list(self._chips.get(node_name, []))
+
+    # ---- file sync --------------------------------------------------
+
+    def refresh(self, force: bool = False) -> bool:
+        """Reload if the file changed. Returns True when state moved.
+
+        In-memory scheduler writes (bind/patch) are preserved for pods
+        whose file record is still Pending — the file is the source of
+        pod *existence*, the scheduler the source of *placement*.
+        """
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return False
+        if not force and mtime == self._mtime:
+            return False
+        self._mtime = mtime
+        raw = _load_file(self.path)
+
+        seen_nodes = set()
+        for raw_node in raw.get("nodes", []):
+            node = node_from_dict(raw_node)
+            seen_nodes.add(node.name)
+            old = self._nodes.get(node.name)
+            self._nodes[node.name] = node
+            self._chips[node.name] = chips_from_dicts(raw_node.get("chips", []))
+            if old is None or (old.ready, old.unschedulable) != (
+                node.ready, node.unschedulable
+            ):
+                for handler in self._node_update:
+                    handler(node)
+        for name in [n for n in self._nodes if n not in seen_nodes]:
+            # node vanished from the file: report it unready (the verb
+            # the ClusterAPI has for node death), then drop it
+            gone = self._nodes.pop(name)
+            self._chips.pop(name, None)
+            gone.ready = False
+            for handler in self._node_update:
+                handler(gone)
+
+        seen = set()
+        for raw_pod in raw.get("pods", []):
+            pod = pod_from_dict(raw_pod)
+            seen.add(pod.key)
+            existing = self._pods.get(pod.key)
+            if existing is None:
+                self._pods[pod.key] = pod
+                if pod.is_completed:
+                    # arrived already finished: nothing was ever
+                    # allocated through us, so no delete event either
+                    self._completed_notified.add(pod.key)
+                for handler in self._pod_add:
+                    handler(pod)
+            elif (
+                pod.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+                and pod.key not in self._completed_notified
+            ):
+                existing.phase = pod.phase
+                self._completed_notified.add(pod.key)
+                for handler in self._pod_delete:
+                    handler(existing)
+        for key in [k for k in self._pods if k not in seen]:
+            pod = self._pods.pop(key)
+            if key not in self._completed_notified:
+                for handler in self._pod_delete:
+                    handler(pod)
+            self._completed_notified.discard(key)
+        return True

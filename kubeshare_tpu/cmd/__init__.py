@@ -1,0 +1,19 @@
+"""Daemon entry points — the ``cmd/`` layer.
+
+One main per control-plane binary, mirroring the reference's cmd/ tree
+(cmd/kubeshare-{scheduler,collector,aggregator,config,query-ip}) plus
+the node launcher that upstream ships as container glue
+(docker/kubeshare-gemini-scheduler/launcher.py). Invoke via
+``python -m kubeshare_tpu <component> [flags]``.
+"""
+
+from __future__ import annotations
+
+COMPONENTS = {
+    "scheduler": "kubeshare_tpu.cmd.scheduler",
+    "collector": "kubeshare_tpu.cmd.collector",
+    "aggregator": "kubeshare_tpu.cmd.aggregator",
+    "nodeconfig": "kubeshare_tpu.cmd.nodeconfig",
+    "launcher": "kubeshare_tpu.cmd.launcher",
+    "query-ip": "kubeshare_tpu.cmd.query_ip",
+}

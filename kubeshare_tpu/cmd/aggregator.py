@@ -1,0 +1,58 @@
+"""Cluster-wide requirement exporter daemon.
+
+Rebuild of cmd/kubeshare-aggregator (main.go:39-64): serve
+``tpu_requirement`` for every placed shared pod on :9005. Cluster state
+comes from a snapshot file (offline/sim) or the kube REST adapter.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+from typing import Optional, Sequence
+
+from ..cluster.snapshot import SnapshotCluster
+from ..metrics.aggregator import AGGREGATOR_PORT, Aggregator
+from ..utils.signals import setup_signal_handler
+from .common import add_common_flags, component_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-aggregator", description=__doc__
+    )
+    add_common_flags(parser)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=AGGREGATOR_PORT)
+    parser.add_argument(
+        "--cluster-state", required=True, metavar="PATH",
+        help="cluster snapshot file (JSON/YAML), reloaded on change",
+    )
+    parser.add_argument(
+        "--refresh-interval", type=float, default=1.0,
+        help="seconds between snapshot mtime checks",
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    log = component_logger("aggregator", args)
+    cluster = SnapshotCluster(args.cluster_state)
+    aggregator = Aggregator(cluster)
+    server = aggregator.serve(host=args.host, port=args.port)
+    log.info("aggregator serving on %s:%d", args.host, server.port)
+    stop = setup_signal_handler()
+
+    def refresher():
+        while not stop.wait(args.refresh_interval):
+            cluster.refresh()
+
+    threading.Thread(target=refresher, daemon=True).start()
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,75 @@
+"""Per-node chip-inventory exporter daemon.
+
+Rebuild of cmd/kubeshare-collector (main.go:35-63): enumerate local TPU
+chips and serve ``tpu_capacity`` on :9004. On chip-less nodes the
+endpoint stays up and empty instead of the reference's hang-forever
+``select {}`` fallback (main.go:42-49).
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+from typing import Optional, Sequence
+
+from ..cells.cell import ChipInfo
+from ..metrics.collector import (
+    COLLECTOR_PORT,
+    Collector,
+    FakeChipBackend,
+    JaxChipBackend,
+)
+from ..utils.signals import setup_signal_handler
+from .common import add_common_flags, component_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-collector", description=__doc__
+    )
+    add_common_flags(parser)
+    parser.add_argument("--node-name", default=socket.gethostname())
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=COLLECTOR_PORT)
+    parser.add_argument(
+        "--fake-chips", type=int, default=-1, metavar="N",
+        help="serve N synthetic v5e chips instead of enumerating "
+             "hardware (dev machines / CI)",
+    )
+    return parser
+
+
+def make_backend(args: argparse.Namespace):
+    if args.fake_chips >= 0:
+        return FakeChipBackend(
+            [
+                ChipInfo(
+                    uuid=f"{args.node_name}-fake-{i}",
+                    model="tpu-v5e",
+                    memory=16 << 30,
+                    index=i,
+                )
+                for i in range(args.fake_chips)
+            ]
+        )
+    return JaxChipBackend(node_name=args.node_name)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    log = component_logger("collector", args)
+    collector = Collector(args.node_name, make_backend(args))
+    server = collector.serve(host=args.host, port=args.port)
+    log.info(
+        "collector for node %s serving on %s:%d (%d chips)",
+        args.node_name, args.host, server.port,
+        len(collector.backend.enumerate()),
+    )
+    stop = setup_signal_handler()
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

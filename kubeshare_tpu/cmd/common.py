@@ -1,0 +1,31 @@
+"""Shared CLI plumbing for every daemon.
+
+Mirrors the flag surface each reference binary exposes (``--level``
+verbosity everywhere, e.g. cmd/kubeshare-config/main.go:32; log files
+under /kubeshare/log, pkg/logger/logger.go:40-57).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+from ..scheduler import constants as C
+from ..utils.logger import get_logger
+
+
+def add_common_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--level", type=int, default=1,
+        help="log verbosity 0..3 (WARNING..DEBUG)",
+    )
+    parser.add_argument(
+        "--log-dir", default="",
+        help=f"also log to <dir>/<component>.log (e.g. {C.LOG_DIR})",
+    )
+
+
+def component_logger(component: str, args: argparse.Namespace):
+    return get_logger(
+        component, level=args.level, log_dir=args.log_dir or None
+    )

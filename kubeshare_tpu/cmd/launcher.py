@@ -1,0 +1,71 @@
+"""Node isolation-runtime launcher daemon.
+
+Rebuild of the reference's gemini-scheduler container glue
+(docker/kubeshare-gemini-scheduler/launcher.py + launcher-multigpus.sh):
+one ``tpu-schd`` arbiter per local chip, one ``tpu-pmgr`` per sharing
+pod, reconciled from the nodeconfig-written port files. Quota knobs
+match the reference defaults (launcher.py:77-80).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from typing import Optional, Sequence
+
+from ..metrics.collector import JaxChipBackend
+from ..runtime.launcher import NodeLauncher
+from ..scheduler import constants as C
+from .common import add_common_flags, component_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-launcher", description=__doc__
+    )
+    add_common_flags(parser)
+    parser.add_argument("--base-dir", default=os.path.dirname(C.CONFIG_DIR))
+    parser.add_argument(
+        "--chips", default="",
+        help="comma-separated chip uuids; default: enumerate local chips",
+    )
+    parser.add_argument("--schd-binary", default="")
+    parser.add_argument("--pmgr-binary", default="")
+    parser.add_argument("--base-port", type=int, default=C.CHIP_ARBITER_BASE_PORT)
+    parser.add_argument("--base-quota-ms", type=float, default=300.0)
+    parser.add_argument("--min-quota-ms", type=float, default=20.0)
+    parser.add_argument("--window-ms", type=float, default=10000.0)
+    parser.add_argument("--poll-interval", type=float, default=0.5)
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    log = component_logger("launcher", args)
+    chips = [u for u in args.chips.split(",") if u]
+    if not chips:
+        backend = JaxChipBackend(node_name=socket.gethostname())
+        chips = [c.uuid for c in backend.enumerate()]
+    if not chips:
+        log.warning("no local chips; launcher idle (chip-less node)")
+    launcher = NodeLauncher(
+        base_dir=args.base_dir,
+        chip_uuids=chips,
+        schd_binary=args.schd_binary,
+        pmgr_binary=args.pmgr_binary,
+        base_port=args.base_port,
+        base_quota_ms=args.base_quota_ms,
+        min_quota_ms=args.min_quota_ms,
+        window_ms=args.window_ms,
+        log=log,
+    )
+    try:
+        launcher.run(poll_interval=args.poll_interval)
+    except KeyboardInterrupt:
+        pass  # run()'s finally already tore the children down
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

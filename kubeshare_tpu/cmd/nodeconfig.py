@@ -1,0 +1,78 @@
+"""Per-node config daemon.
+
+Rebuild of cmd/kubeshare-config (main.go:40-76): poll the aggregator's
+``tpu_requirement`` endpoint for this node's pods and rewrite the
+per-chip config/port files the isolation launcher watches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from typing import Optional, Sequence
+
+from ..metrics.scrape import scrape_requirements
+from ..nodeconfig.daemon import NodeConfigDaemon
+from ..scheduler import constants as C
+from ..utils.signals import setup_signal_handler
+from .common import add_common_flags, component_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-nodeconfig", description=__doc__
+    )
+    add_common_flags(parser)
+    parser.add_argument(
+        "--node-name", default=os.environ.get("NODE_NAME", socket.gethostname()),
+        help="this node's name (downward-API NODE_NAME in-cluster)",
+    )
+    parser.add_argument(
+        "--base-dir", default=os.path.dirname(C.CONFIG_DIR),
+        help="directory holding config/ and podmanagerport/ trees",
+    )
+    parser.add_argument(
+        "--aggregator-url", required=True,
+        help="tpu_requirement endpoint (aggregator or Prometheus federate)",
+    )
+    parser.add_argument("--interval", type=float, default=5.0,
+                        help="seconds between syncs (reference scrape cadence)")
+    parser.add_argument(
+        "--chips", default="",
+        help="comma-separated local chip uuids to pre-create zeroed files for",
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    log = component_logger("nodeconfig", args)
+
+    def source():
+        try:
+            return scrape_requirements(args.aggregator_url, node=args.node_name)
+        except OSError as e:
+            # unreachable aggregator must not zero out live files: return
+            # nothing new, keep last written state (the reference's
+            # fail-safe is zeroed defaults at boot, not mid-flight wipes)
+            log.error("scrape %s failed: %s", args.aggregator_url, e)
+            raise
+
+    daemon = NodeConfigDaemon(args.node_name, args.base_dir, source, log=log)
+    chips = [u for u in args.chips.split(",") if u]
+    if chips:
+        daemon.ensure_chip_files(chips)
+    log.info("nodeconfig for %s -> %s", args.node_name, args.base_dir)
+    stop = setup_signal_handler()
+    while not stop.is_set():
+        try:
+            daemon.sync()
+        except OSError:
+            pass  # already logged; retry next tick
+        stop.wait(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,44 @@
+"""Init-container helper: persist the node daemon's IP for app hooks.
+
+Rebuild of cmd/kubeshare-query-ip (main.go:22-34): write the value of
+``$KUBESHARE_SCHEDULER_IP`` (downward-API pod IP of the node daemon) to
+``<library>/schedulerIP.txt`` so the LD_PRELOAD hook inside app
+containers can find its pod manager's host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional, Sequence
+
+from ..scheduler import constants as C
+
+ENV_SCHEDULER_IP = "KUBESHARE_SCHEDULER_IP"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-query-ip", description=__doc__
+    )
+    parser.add_argument(
+        "--ip", default=os.environ.get(ENV_SCHEDULER_IP, ""),
+        help=f"IP to record (default ${ENV_SCHEDULER_IP})",
+    )
+    parser.add_argument("--out", default=C.SCHEDULER_IP_FILE)
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.ip:
+        print(f"{ENV_SCHEDULER_IP} not set and --ip not given")
+        return 1
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        f.write(args.ip + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,124 @@
+"""Scheduler daemon.
+
+Rebuild of cmd/kubeshare-scheduler (main.go:26-38) — but where the
+reference registers a plugin into the stock kube-scheduler, this daemon
+drives the same hook sequence itself: refresh cluster state, run
+QueueSort over pending pods, schedule each through
+PreFilter→Filter→Score→Reserve→Permit, expire gang barriers, repeat.
+Cluster state comes from a snapshot file (offline/simulation) or the
+kube REST adapter; decisions are applied through the ClusterAPI bind/
+patch verbs and optionally journaled as JSON lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Optional, Sequence
+
+from ..cluster.snapshot import SnapshotCluster
+from ..scheduler import constants as C
+from ..scheduler.plugin import TpuShareScheduler
+from ..utils.signals import setup_signal_handler
+from .common import add_common_flags, component_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-scheduler", description=__doc__
+    )
+    add_common_flags(parser)
+    parser.add_argument(
+        "--topology", required=True,
+        help="cell-topology YAML (celltypes + cells), see deploy/config/",
+    )
+    parser.add_argument(
+        "--cluster-state", required=True, metavar="PATH",
+        help="cluster snapshot file (JSON/YAML), reloaded on change",
+    )
+    parser.add_argument("--interval", type=float, default=1.0,
+                        help="seconds between scheduling passes")
+    parser.add_argument(
+        "--decisions-out", default="-",
+        help="JSONL decision journal ('-' = stdout, '' = off)",
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="one scheduling pass then exit (CI / simulator mode)",
+    )
+    parser.add_argument(
+        "--permit-wait-base", type=float, default=C.PERMIT_WAIT_BASE_SECONDS,
+        help="gang barrier base timeout, multiplied by headcount",
+    )
+    return parser
+
+
+def run_pass(engine: TpuShareScheduler, cluster, journal) -> int:
+    """One queue drain. Returns number of pods scheduled/acted on."""
+    pending = [
+        p
+        for p in cluster.list_pods()
+        if p.scheduler_name == C.SCHEDULER_NAME
+        and not p.is_bound
+        and not p.is_completed
+        and engine.status.get(p.key) is None
+    ]
+    pending.sort(key=engine.queue_sort_key)
+    acted = 0
+    for pod in pending:
+        decision = engine.schedule_one(pod)
+        acted += 1
+        if journal is not None:
+            journal.write(
+                json.dumps(
+                    {
+                        "pod": decision.pod_key,
+                        "status": decision.status,
+                        "node": decision.node,
+                        "message": decision.message,
+                        "bound_with": decision.bound_with,
+                    }
+                )
+                + "\n"
+            )
+            journal.flush()
+    engine.tick()
+    return acted
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    log = component_logger("scheduler", args)
+    cluster = SnapshotCluster(args.cluster_state)
+    engine = TpuShareScheduler(
+        topology=args.topology,
+        cluster=cluster,
+        permit_wait_base=args.permit_wait_base,
+        log=log,
+    )
+    journal = None
+    if args.decisions_out == "-":
+        journal = sys.stdout
+    elif args.decisions_out:
+        journal = open(args.decisions_out, "a")
+
+    if args.once:
+        cluster.refresh()
+        run_pass(engine, cluster, journal)
+        return 0
+
+    stop = setup_signal_handler()
+    log.info("scheduler loop started (interval %.1fs)", args.interval)
+    while not stop.is_set():
+        started = time.monotonic()
+        cluster.refresh()
+        run_pass(engine, cluster, journal)
+        elapsed = time.monotonic() - started
+        stop.wait(max(0.05, args.interval - elapsed))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,230 @@
+"""CLI entry points + snapshot-cluster adapter."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from kubeshare_tpu.__main__ import main as dispatch
+from kubeshare_tpu.cluster.snapshot import SnapshotCluster
+from kubeshare_tpu.cmd import collector as collector_cmd
+from kubeshare_tpu.cmd import query_ip as query_ip_cmd
+from kubeshare_tpu.cmd import scheduler as scheduler_cmd
+from kubeshare_tpu.metrics.aggregator import Aggregator
+from kubeshare_tpu.scheduler import constants as C
+
+TOPO_YAML = """
+cell_types:
+  v5e-tray:
+    child_cell_type: tpu-v5e
+    child_cell_number: 4
+    child_cell_priority: 50
+  v5e-node:
+    child_cell_type: v5e-tray
+    child_cell_number: 1
+    is_node_level: true
+    torus: [2, 2]
+cells:
+  - cell_type: v5e-node
+    cell_id: node-a
+"""
+
+GIB = 1 << 30
+
+
+def snapshot_dict(pods):
+    return {
+        "nodes": [
+            {
+                "name": "node-a",
+                "chips": [
+                    {"uuid": f"node-a-chip-{i}", "model": "tpu-v5e",
+                     "memory": 16 * GIB, "index": i}
+                    for i in range(4)
+                ],
+            }
+        ],
+        "pods": pods,
+    }
+
+
+def shared_pod(name, request="0.5", limit="1.0"):
+    return {
+        "name": name,
+        "scheduler_name": C.SCHEDULER_NAME,
+        "labels": {
+            C.LABEL_TPU_REQUEST: request,
+            C.LABEL_TPU_LIMIT_ALIASES[1]: limit,
+        },
+    }
+
+
+class TestSnapshotCluster:
+    def test_refresh_diffs_pods(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(snapshot_dict([shared_pod("p1")])))
+        cluster = SnapshotCluster(str(path))
+        adds, deletes = [], []
+        cluster.on_pod_event(lambda p: adds.append(p.key),
+                             lambda p: deletes.append(p.key))
+        assert [p.key for p in cluster.list_pods()] == ["default/p1"]
+        assert len(cluster.chips_on_node("node-a")) == 4
+
+        # unchanged mtime -> no-op
+        assert cluster.refresh() is False
+
+        path.write_text(json.dumps(snapshot_dict([shared_pod("p2")])))
+        os.utime(path, (1e9, 1e9))
+        assert cluster.refresh() is True
+        assert adds == ["default/p2"]
+        assert deletes == ["default/p1"]
+
+    def test_node_removal_reported_unready(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(snapshot_dict([])))
+        cluster = SnapshotCluster(str(path))
+        events = []
+        cluster.on_node_event(lambda n: events.append((n.name, n.ready)))
+        path.write_text(json.dumps({"nodes": [], "pods": []}))
+        os.utime(path, (1e9, 1e9))
+        cluster.refresh()
+        assert events == [("node-a", False)]
+        assert cluster.list_nodes() == []
+        assert cluster.chips_on_node("node-a") == []
+
+    def test_completed_pod_delete_fires_once(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(snapshot_dict([shared_pod("p1")])))
+        cluster = SnapshotCluster(str(path))
+        deletes = []
+        cluster.on_pod_event(lambda p: None, lambda p: deletes.append(p.key))
+        done = shared_pod("p1")
+        done["phase"] = "Succeeded"
+        path.write_text(json.dumps(snapshot_dict([done])))
+        os.utime(path, (1e9, 1e9))
+        cluster.refresh()
+        assert deletes == ["default/p1"]
+        # later unrelated change must not re-fire p1's delete
+        path.write_text(json.dumps(snapshot_dict([done, shared_pod("p2")])))
+        os.utime(path, (2e9, 2e9))
+        cluster.refresh()
+        assert deletes == ["default/p1"]
+        # removal from the file after completion: still no second event
+        path.write_text(json.dumps(snapshot_dict([shared_pod("p2")])))
+        os.utime(path, (3e9, 3e9))
+        cluster.refresh()
+        assert deletes == ["default/p1"]
+
+    def test_scheduler_writes_survive_refresh(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(snapshot_dict([shared_pod("p1")])))
+        cluster = SnapshotCluster(str(path))
+        cluster.patch_pod("default/p1", annotations={"a": "1"})
+        cluster.bind("default/p1", "node-a")
+        os.utime(path, (1e9, 1e9))
+        cluster.refresh()
+        pod = cluster.get_pod("default/p1")
+        assert pod.node_name == "node-a" and pod.annotations["a"] == "1"
+
+
+class TestSchedulerCli:
+    def test_once_schedules_and_journals(self, tmp_path, capsys):
+        topo = tmp_path / "topo.yaml"
+        topo.write_text(TOPO_YAML)
+        state = tmp_path / "state.json"
+        state.write_text(
+            json.dumps(snapshot_dict([shared_pod("p1"), shared_pod("p2")]))
+        )
+        out = tmp_path / "decisions.jsonl"
+        rc = scheduler_cmd.main([
+            "--topology", str(topo),
+            "--cluster-state", str(state),
+            "--decisions-out", str(out),
+            "--once",
+        ])
+        assert rc == 0
+        decisions = [json.loads(l) for l in out.read_text().splitlines()]
+        assert {d["pod"] for d in decisions} == {"default/p1", "default/p2"}
+        assert all(d["status"] == "bound" for d in decisions)
+        assert all(d["node"] == "node-a" for d in decisions)
+
+    def test_unschedulable_reported(self, tmp_path):
+        topo = tmp_path / "topo.yaml"
+        topo.write_text(TOPO_YAML)
+        state = tmp_path / "state.json"
+        state.write_text(json.dumps(snapshot_dict(
+            [shared_pod("big", request="9.0", limit="9.0")]
+        )))
+        out = tmp_path / "decisions.jsonl"
+        rc = scheduler_cmd.main([
+            "--topology", str(topo), "--cluster-state", str(state),
+            "--decisions-out", str(out), "--once",
+        ])
+        assert rc == 0
+        [d] = [json.loads(l) for l in out.read_text().splitlines()]
+        assert d["status"] == "unschedulable"
+
+
+class TestCollectorCli:
+    def test_fake_backend_serves_capacity(self):
+        args = collector_cmd.build_parser().parse_args(
+            ["--node-name", "dev", "--fake-chips", "3"]
+        )
+        backend = collector_cmd.make_backend(args)
+        assert len(backend.enumerate()) == 3
+        from kubeshare_tpu.metrics.collector import Collector
+
+        collector = Collector("dev", backend)
+        server = collector.serve(host="127.0.0.1", port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics"
+            ).read().decode()
+            assert body.count("tpu_capacity{") == 3
+            assert 'model="tpu-v5e"' in body
+        finally:
+            server.stop()
+
+
+class TestAggregatorOverSnapshot:
+    def test_placed_pod_exported(self, tmp_path):
+        pod = shared_pod("p1")
+        pod["node_name"] = "node-a"
+        pod["phase"] = "Running"
+        pod["annotations"] = {
+            C.ANNOTATION_CHIP_UUID: "node-a-chip-0",
+            C.ANNOTATION_TPU_MEMORY: str(8 * GIB),
+            C.ANNOTATION_CELL_ID: "node-a/1/1",
+            C.ANNOTATION_MANAGER_PORT: "50050",
+        }
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(snapshot_dict([pod])))
+        aggregator = Aggregator(SnapshotCluster(str(path)))
+        [sample] = aggregator.samples()
+        assert sample.labels["uuid"] == "node-a-chip-0"
+        assert sample.labels["port"] == "50050"
+
+
+class TestQueryIp:
+    def test_writes_ip_file(self, tmp_path):
+        out = tmp_path / "schedulerIP.txt"
+        assert query_ip_cmd.main(["--ip", "10.0.0.7", "--out", str(out)]) == 0
+        assert out.read_text() == "10.0.0.7\n"
+
+    def test_missing_ip_errors(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(query_ip_cmd.ENV_SCHEDULER_IP, raising=False)
+        assert query_ip_cmd.main(["--out", str(tmp_path / "x")]) == 1
+
+
+class TestDispatch:
+    def test_help_and_unknown(self, capsys):
+        assert dispatch([]) == 2
+        assert dispatch(["--help"]) == 0
+        assert dispatch(["nope"]) == 2
+        assert "collector" in capsys.readouterr().out
+
+    def test_dispatch_runs_component(self, tmp_path):
+        out = tmp_path / "ip.txt"
+        assert dispatch(["query-ip", "--ip", "1.2.3.4", "--out", str(out)]) == 0
+        assert out.read_text().strip() == "1.2.3.4"
