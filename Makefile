@@ -1,0 +1,28 @@
+# Top-level build/test/bench driver (reference: Makefile building the 5
+# Go binaries + docker images; here: the native isolation runtime + the
+# Python control plane, exercised by the test suite).
+PYTHON ?= /opt/venv/bin/python
+
+all: native
+
+native:
+	$(MAKE) -C runtime_native
+
+test: native
+	$(PYTHON) -m pytest tests/ -x -q
+
+bench: native
+	$(PYTHON) bench.py
+
+dryrun:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+images:
+	docker build -f docker/scheduler/Dockerfile -t kubeshare-tpu/scheduler:latest .
+	docker build -f docker/node/Dockerfile -t kubeshare-tpu/node:latest .
+
+clean:
+	$(MAKE) -C runtime_native clean
+
+.PHONY: all native test bench dryrun images clean

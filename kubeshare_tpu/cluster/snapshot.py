@@ -88,7 +88,7 @@ class SnapshotCluster:
 
     def __init__(self, path: str):
         self.path = path
-        self._mtime = -1.0
+        self._stamp = (-1, -1)  # (st_mtime_ns, st_size) of last good load
         self._pods: Dict[str, Pod] = {}
         self._completed_notified: set = set()
         self._nodes: Dict[str, Node] = {}
@@ -145,13 +145,26 @@ class SnapshotCluster:
         pod *existence*, the scheduler the source of *placement*.
         """
         try:
-            mtime = os.stat(self.path).st_mtime
+            st = os.stat(self.path)
         except OSError:
             return False
-        if not force and mtime == self._mtime:
+        stamp = (st.st_mtime_ns, st.st_size)
+        if not force and stamp == self._stamp:
             return False
-        self._mtime = mtime
-        raw = _load_file(self.path)
+        try:
+            raw = _load_file(self.path)
+        except (OSError, ValueError) as e:
+            # mid-write snapshot (non-atomic writer): keep the last good
+            # state and retry next poll — the stamp is only recorded on
+            # a successful parse
+            if force:
+                raise
+            import sys
+
+            print(f"snapshot {self.path}: transient load error: {e}",
+                  file=sys.stderr)
+            return False
+        self._stamp = stamp
 
         seen_nodes = set()
         for raw_node in raw.get("nodes", []):
@@ -179,6 +192,19 @@ class SnapshotCluster:
             pod = pod_from_dict(raw_pod)
             seen.add(pod.key)
             existing = self._pods.get(pod.key)
+            if existing is not None and (
+                (pod.uid and existing.uid and pod.uid != existing.uid)
+                or (existing.is_completed and not pod.is_completed)
+            ):
+                # same name, new incarnation (uid changed, or a fresh
+                # Pending pod reusing a completed pod's name): retire the
+                # old record, then fall through to the add path
+                if pod.key not in self._completed_notified:
+                    for handler in self._pod_delete:
+                        handler(existing)
+                self._completed_notified.discard(pod.key)
+                self._pods.pop(pod.key)
+                existing = None
             if existing is None:
                 self._pods[pod.key] = pod
                 if pod.is_completed:

@@ -17,6 +17,7 @@ from typing import Optional, Sequence
 from ..metrics.collector import JaxChipBackend
 from ..runtime.launcher import NodeLauncher
 from ..scheduler import constants as C
+from ..utils.signals import setup_signal_handler
 from .common import add_common_flags, component_logger
 
 
@@ -60,8 +61,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         window_ms=args.window_ms,
         log=log,
     )
+    stop = setup_signal_handler()
     try:
-        launcher.run(poll_interval=args.poll_interval)
+        launcher.run(poll_interval=args.poll_interval, stop=stop)
     except KeyboardInterrupt:
         pass  # run()'s finally already tore the children down
     return 0

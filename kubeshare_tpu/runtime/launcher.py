@@ -178,12 +178,17 @@ class NodeLauncher:
                 proc.kill()
         proc.wait()
 
-    def run(self, poll_interval: float = 0.5) -> None:
+    def run(self, poll_interval: float = 0.5, stop=None) -> None:
+        """Reconcile until ``stop`` (a threading.Event) is set — or
+        forever if none given. Children are always torn down on exit."""
         self.start_arbiters()
         try:
-            while True:
+            while stop is None or not stop.is_set():
                 self.reconcile()
-                time.sleep(poll_interval)
+                if stop is None:
+                    time.sleep(poll_interval)
+                elif stop.wait(poll_interval):
+                    break
         finally:
             self.shutdown()
 

@@ -116,6 +116,39 @@ class TestSnapshotCluster:
         cluster.refresh()
         assert deletes == ["default/p1"]
 
+    def test_partial_write_retried(self, tmp_path):
+        path = tmp_path / "state.json"
+        path.write_text(json.dumps(snapshot_dict([shared_pod("p1")])))
+        cluster = SnapshotCluster(str(path))
+        path.write_text('{"nodes": [, truncated')  # writer mid-flight
+        os.utime(path, (1e9, 1e9))
+        assert cluster.refresh() is False  # stale but alive
+        assert [p.key for p in cluster.list_pods()] == ["default/p1"]
+        path.write_text(json.dumps(snapshot_dict([shared_pod("p2")])))
+        os.utime(path, (1e9, 1e9))  # same mtime, different size: still seen
+        assert cluster.refresh() is True
+        assert [p.key for p in cluster.list_pods()] == ["default/p2"]
+
+    def test_name_reuse_new_incarnation(self, tmp_path):
+        path = tmp_path / "state.json"
+        done = shared_pod("p1")
+        done["uid"] = "uid-old"
+        done["phase"] = "Succeeded"
+        path.write_text(json.dumps(snapshot_dict([done])))
+        cluster = SnapshotCluster(str(path))
+        adds, deletes = [], []
+        cluster.on_pod_event(lambda p: adds.append(p.uid),
+                             lambda p: deletes.append(p.uid))
+        fresh = shared_pod("p1")
+        fresh["uid"] = "uid-new"
+        path.write_text(json.dumps(snapshot_dict([fresh])))
+        os.utime(path, (1e9, 1e9))
+        cluster.refresh()
+        assert adds == ["uid-new"]
+        assert deletes == []  # completed incarnation was already retired
+        pod = cluster.get_pod("default/p1")
+        assert pod.uid == "uid-new" and not pod.is_completed
+
     def test_scheduler_writes_survive_refresh(self, tmp_path):
         path = tmp_path / "state.json"
         path.write_text(json.dumps(snapshot_dict([shared_pod("p1")])))

@@ -1,0 +1,77 @@
+"""Deploy artifacts: manifests parse, topology examples build trees."""
+
+import glob
+import os
+
+import yaml
+
+from kubeshare_tpu.cells.cell import CellTree
+from kubeshare_tpu.cells.spec import load_topology
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTopologyExamples:
+    def test_all_examples_build(self):
+        paths = sorted(glob.glob(os.path.join(REPO, "deploy", "config", "*.yaml")))
+        assert len(paths) >= 4
+        for path in paths:
+            cfg = load_topology(path)
+            tree = CellTree(cfg)
+            assert tree.nodes(), path  # every example roots at >= node level
+
+    def test_slice16_locality_structure(self):
+        tree = CellTree(
+            load_topology(os.path.join(REPO, "deploy", "config", "v5e-slice-16.yaml"))
+        )
+        # 4 hosts under one slice cell; node names are the admin-given
+        # last id segments
+        assert tree.nodes() == [
+            "tpu-host-0", "tpu-host-1", "tpu-host-2", "tpu-host-3",
+        ]
+        # all 16 leaves share the slice-wide 4x4 torus domain
+        domains = {
+            leaf.torus_domain
+            for root in tree.roots
+            for leaf in root.iter_leaves()
+        }
+        assert len(domains) == 1
+        dims = {
+            tuple(leaf.torus_dims)
+            for root in tree.roots
+            for leaf in root.iter_leaves()
+        }
+        assert dims == {(4, 4)}
+
+    def test_heterogeneous_priorities(self):
+        cfg = load_topology(
+            os.path.join(REPO, "deploy", "config", "heterogeneous.yaml")
+        )
+        tree = CellTree(cfg)
+        prio = tree.chip_priority
+        assert prio["tpu-v5p"] > prio["tpu-v5e"] > prio["tpu-v4"]
+
+
+class TestManifests:
+    def test_manifests_parse_and_reference_components(self):
+        for name in ("scheduler", "collector", "aggregator", "node-daemon"):
+            path = os.path.join(REPO, "deploy", f"{name}.yaml")
+            docs = [d for d in yaml.safe_load_all(open(path)) if d]
+            assert docs, path
+            kinds = {d["kind"] for d in docs}
+            assert kinds & {
+                "Deployment", "DaemonSet", "Service", "ServiceAccount",
+                "ClusterRole", "ClusterRoleBinding", "ConfigMap",
+                "ServiceMonitor",
+            }, path
+
+    def test_scheduler_rbac_not_wildcard(self):
+        # the reference ships a wildcard ClusterRole
+        # (deploy/scheduler.yaml:12-17); ours must stay scoped
+        path = os.path.join(REPO, "deploy", "scheduler.yaml")
+        for doc in yaml.safe_load_all(open(path)):
+            if doc and doc["kind"] == "ClusterRole":
+                for rule in doc["rules"]:
+                    assert rule["apiGroups"] != ["*"]
+                    assert rule["resources"] != ["*"]
+                    assert rule["verbs"] != ["*"]
