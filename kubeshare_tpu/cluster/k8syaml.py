@@ -1,0 +1,77 @@
+"""Load Kubernetes Pod/Job manifests into ClusterAPI Pod objects.
+
+The workload corpus (workloads/*.yaml) is written as ordinary k8s
+manifests — the same user surface the reference exercises with its
+test/ YAML corpus (labeled Pods, gang Jobs). This loader understands
+just enough of the PodSpec/JobSpec schema to turn them into scheduler
+inputs: metadata (name/namespace/labels/annotations), schedulerName,
+container env, and Job parallelism fan-out.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import yaml
+
+from .api import Container, Pod, PodPhase
+
+
+def _pod_from_manifest(meta: dict, spec: dict, name_suffix: str = "") -> Pod:
+    containers = [
+        Container(
+            name=c.get("name", "main"),
+            env={
+                e["name"]: str(e.get("value", ""))
+                for e in c.get("env", []) or []
+                if "name" in e
+            },
+        )
+        for c in spec.get("containers", []) or []
+    ] or [Container()]
+    return Pod(
+        name=meta.get("name", "unnamed") + name_suffix,
+        namespace=meta.get("namespace", "default"),
+        labels=dict(meta.get("labels", {}) or {}),
+        annotations=dict(meta.get("annotations", {}) or {}),
+        node_name=spec.get("nodeName", ""),
+        phase=PodPhase.PENDING,
+        scheduler_name=spec.get("schedulerName", ""),
+        containers=containers,
+    )
+
+
+def pods_from_manifest(doc: dict) -> List[Pod]:
+    """One manifest document -> pods. Jobs fan out to ``parallelism``
+    pods named ``<job>-<i>`` (the reference gang example is a Job with
+    parallelism == group_headcount, README.md:70-105)."""
+    kind = (doc or {}).get("kind", "")
+    meta = (doc or {}).get("metadata", {}) or {}
+    if kind == "Pod":
+        return [_pod_from_manifest(meta, doc.get("spec", {}) or {})]
+    if kind == "Job":
+        job_spec = doc.get("spec", {}) or {}
+        parallelism = int(job_spec.get("parallelism", 1) or 1)
+        template = job_spec.get("template", {}) or {}
+        tmeta = dict(template.get("metadata", {}) or {})
+        # pod labels = job labels overlaid with template labels
+        labels = dict(meta.get("labels", {}) or {})
+        labels.update(tmeta.get("labels", {}) or {})
+        tmeta["labels"] = labels
+        tmeta.setdefault("name", meta.get("name", "job"))
+        tmeta.setdefault("namespace", meta.get("namespace", "default"))
+        return [
+            _pod_from_manifest(tmeta, template.get("spec", {}) or {}, f"-{i}")
+            for i in range(parallelism)
+        ]
+    return []
+
+
+def load_pods(path: str) -> List[Pod]:
+    """All pods described by a (possibly multi-document) manifest file."""
+    with open(path) as f:
+        docs = list(yaml.safe_load_all(f))
+    pods: List[Pod] = []
+    for doc in docs:
+        pods.extend(pods_from_manifest(doc))
+    return pods

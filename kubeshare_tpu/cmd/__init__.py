@@ -16,4 +16,6 @@ COMPONENTS = {
     "nodeconfig": "kubeshare_tpu.cmd.nodeconfig",
     "launcher": "kubeshare_tpu.cmd.launcher",
     "query-ip": "kubeshare_tpu.cmd.query_ip",
+    "workload": "kubeshare_tpu.cmd.workload",
+    "simulate": "kubeshare_tpu.cmd.simulate",
 }

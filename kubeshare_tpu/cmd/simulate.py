@@ -1,0 +1,69 @@
+"""Trace-replay simulator CLI.
+
+Rebuild of test/simulator/simulator.py's role as the scheduler soak
+harness: replay a trace (or a generated synthetic one) against a
+topology on a virtual clock and print the scheduling report as JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional, Sequence
+
+from ..sim.simulator import Simulator
+from ..sim.trace import generate_trace, load_trace
+from .common import add_common_flags, component_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-simulate", description=__doc__
+    )
+    add_common_flags(parser)
+    parser.add_argument("--topology", required=True)
+    parser.add_argument(
+        "--nodes", required=True,
+        help="comma-separated node=chips pairs, e.g. node-a=4,node-b=4 "
+             "(node names must match the topology's node cells)",
+    )
+    parser.add_argument("--trace", default="",
+                        help="trace file; omit to generate synthetically")
+    parser.add_argument("--count", type=int, default=1000,
+                        help="synthetic trace length")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--priority-ratio", type=float, default=0.5,
+                        help="share of pods given a guarantee priority")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    component_logger("simulate", args)
+    nodes = {}
+    for pair in args.nodes.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        name, sep, chips = pair.partition("=")
+        if not sep or not name.strip() or not chips.strip():
+            raise SystemExit(
+                f"--nodes: expected name=chips, got {pair!r}"
+            )
+        nodes[name.strip()] = int(chips)
+    events = (
+        load_trace(args.trace)
+        if args.trace
+        else generate_trace(count=args.count, seed=args.seed)
+    )
+    sim = Simulator(
+        args.topology, nodes,
+        priority_ratio=args.priority_ratio, seed=args.seed,
+    )
+    report = sim.run(events)
+    print(json.dumps(report.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,169 @@
+"""Containerized training workload entrypoint.
+
+The TPU analog of the reference's test images (test/: PyTorch MNIST /
+CIFAR / LSTM / TorchElastic ResNet containers): train a named model on
+synthetic data under the shared-chip gate. The gate reads the
+scheduler-injected env (KUBESHARE_POD_MANAGER_PORT / KUBESHARE_HBM_
+LIMIT_BYTES) and amortizes token holds across dispatches; on a
+whole-chip or dev run it is a transparent no-op. Prints one JSON line
+of throughput stats at exit — the bench harness consumes it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import Optional, Sequence
+
+from .common import add_common_flags, component_logger
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-workload", description=__doc__
+    )
+    add_common_flags(parser)
+    parser.add_argument(
+        "--model", default="mnist",
+        choices=["mnist", "cifar", "lstm", "resnet", "llama"],
+    )
+    parser.add_argument("--batch", type=int, default=256)
+    parser.add_argument("--steps", type=int, default=0,
+                        help="step budget (0 = until --duration)")
+    parser.add_argument("--duration", type=float, default=0.0,
+                        help="wall-clock budget in seconds (0 = until --steps)")
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser
+
+
+def _build(model: str, batch: int, rng):
+    """(params, loss_fn, batch_maker): model-specific pieces."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from .. import models as M
+
+    if model == "llama":
+        cfg = M.LlamaConfig(vocab=2048, dim=256, layers=4, num_heads=8,
+                            num_kv_heads=4, mlp_dim=512, max_seq_len=256)
+        params = M.init_llama(rng, cfg)
+        from ..models.llama import llama_loss
+
+        def loss_fn(p, tokens):
+            return llama_loss(p, tokens, cfg)
+
+        def make_batch(key):
+            return (jax.random.randint(key, (batch, 256), 0, cfg.vocab,
+                                       dtype=jnp.int32),)
+
+        return params, loss_fn, make_batch
+
+    if model == "lstm":
+        cfg = M.LstmConfig()
+        params = M.init_lstm(rng, cfg)
+
+        def loss_fn(p, tokens):
+            logits = M.lstm_apply(p, tokens, cfg)
+            targets = jnp.roll(tokens, -1, axis=1)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], targets[:, :-1]
+            ).mean()
+
+        def make_batch(key):
+            return (jax.random.randint(key, (batch, 64), 0, cfg.vocab,
+                                       dtype=jnp.int32),)
+
+        return params, loss_fn, make_batch
+
+    shapes = {
+        "mnist": ((batch, 28, 28, 1), M.MnistConfig, M.init_mnist,
+                  M.mnist_apply, 10),
+        "cifar": ((batch, 32, 32, 3), M.CifarConfig, M.init_cifar,
+                  M.cifar_apply, 10),
+        "resnet": ((batch, 32, 32, 3), M.ResNetConfig, M.init_resnet,
+                   M.resnet_apply, 10),
+    }
+    shape, cfg_cls, init, apply, classes = shapes[model]
+    cfg = cfg_cls()
+    params = init(rng, cfg)
+
+    def loss_fn(p, images, labels):
+        logits = apply(p, images, cfg)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+
+    def make_batch(key):
+        import jax
+
+        k1, k2 = jax.random.split(key)
+        return (
+            jax.random.normal(k1, shape, jnp.float32),
+            jax.random.randint(k2, (batch,), 0, classes, dtype=jnp.int32),
+        )
+
+    return params, loss_fn, make_batch
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    log = component_logger("workload", args)
+    if not args.steps and not args.duration:
+        args.steps = 100
+
+    from ..runtime.hook import install_gate  # before heavy jax init
+
+    gate = install_gate()
+
+    import jax
+
+    from ..models.train import make_train_step
+
+    rng = jax.random.PRNGKey(args.seed)
+    params, loss_fn, make_batch = _build(args.model, args.batch, rng)
+    opt, step = make_train_step(loss_fn, learning_rate=args.lr)
+    opt_state = opt.init(params)
+
+    # warmup compile outside the gated loop
+    key = jax.random.PRNGKey(args.seed + 1)
+    batch = make_batch(key)
+    params, opt_state, loss = step(params, opt_state, *batch)
+    jax.block_until_ready(loss)
+
+    log.info("workload %s batch=%d starting", args.model, args.batch)
+    started = time.perf_counter()
+    steps_done = 0
+    result = None
+    while True:
+        if args.steps and steps_done >= args.steps:
+            break
+        if args.duration and time.perf_counter() - started >= args.duration:
+            break
+        key, sub = jax.random.split(key)
+        batch = make_batch(sub)
+        gate.begin()
+        params, opt_state, loss = step(params, opt_state, *batch)
+        result = gate.maybe_release(loss)
+        steps_done += 1
+    gate.flush(result)
+    jax.block_until_ready(loss)  # async dispatch must not inflate throughput
+    elapsed = time.perf_counter() - started
+    gate.close()
+    print(json.dumps({
+        "model": args.model,
+        "steps": steps_done,
+        "batch": args.batch,
+        "seconds": round(elapsed, 3),
+        "samples_per_s": round(steps_done * args.batch / max(elapsed, 1e-9), 1),
+        "final_loss": float(loss),
+        "tokens_acquired": gate.tokens_acquired,
+        "compute_ms": round(gate.compute_ms, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,7 +35,13 @@ from .state import PodState, PodStatus, PodStatusStore
 
 
 class Unschedulable(Exception):
-    pass
+    """retryable=False marks specs that can never schedule (malformed
+    labels, inconsistent gang declarations); True marks transient
+    capacity/membership shortfalls a requeue may resolve."""
+
+    def __init__(self, message: str, retryable: bool = True):
+        super().__init__(message)
+        self.retryable = retryable
 
 
 @dataclass
@@ -45,6 +51,9 @@ class Decision:
     node: str = ""
     message: str = ""
     bound_with: List[str] = field(default_factory=list)  # gang members bound together
+    # unschedulable only: True = transient (capacity; requeue may succeed),
+    # False = permanent (malformed labels / gang spec can never fit)
+    retryable: bool = True
 
 
 @dataclass
@@ -219,18 +228,20 @@ class TpuShareScheduler:
         try:
             req = parse_pod(pod)
         except LabelError as e:
-            raise Unschedulable(str(e)) from e
+            raise Unschedulable(str(e), retryable=False) from e
         group = self.groups.get_or_create(pod, req.gang)
         if group.key:
             if req.gang and req.gang.min_available != group.min_available:
                 raise Unschedulable(
                     f"pod {pod.key} min_available {req.gang.min_available} != "
-                    f"group {group.key} min_available {group.min_available}"
+                    f"group {group.key} min_available {group.min_available}",
+                    retryable=False,
                 )
             if req.priority != group.priority:
                 raise Unschedulable(
                     f"pod {pod.key} priority {req.priority} != group "
-                    f"{group.key} priority {group.priority}"
+                    f"{group.key} priority {group.priority}",
+                    retryable=False,
                 )
             total = self._count_group_pods(pod.namespace, group.name)
             if total < group.min_available:
@@ -382,7 +393,8 @@ class TpuShareScheduler:
         try:
             req = self.pre_filter(pod)
         except Unschedulable as e:
-            return Decision("unschedulable", pod.key, message=str(e))
+            return Decision("unschedulable", pod.key, message=str(e),
+                            retryable=e.retryable)
 
         nodes = [n for n in self.cluster.list_nodes() if n.healthy]
         feasible: List[str] = []
@@ -409,7 +421,8 @@ class TpuShareScheduler:
         try:
             status = self.reserve(pod, req, best)
         except Unschedulable as e:
-            return Decision("unschedulable", pod.key, message=str(e))
+            return Decision("unschedulable", pod.key, message=str(e),
+                            retryable=e.retryable)
 
         action, extra = self.permit(pod, status)
         if action == "allow":

@@ -1,0 +1,11 @@
+from .trace import TraceEvent, generate_trace, load_trace, save_trace
+from .simulator import SimReport, Simulator
+
+__all__ = [
+    "TraceEvent",
+    "generate_trace",
+    "load_trace",
+    "save_trace",
+    "SimReport",
+    "Simulator",
+]

@@ -1,0 +1,77 @@
+"""Workload traces: ``start_offset<TAB>chips<TAB>runtime`` lines.
+
+Same 3-column file shape as the reference's trace corpus
+(test/simulator/trace.txt: 989 arrival rows driven by
+test/simulator/simulator.py). Sharing semantics differ deliberately:
+the reference derives random fractional requests from rows asking >2
+GPUs (simulator.py:64-69); our rows carry the request directly —
+``chips < 1.0`` is a fractional sharing pod, integers are whole-chip
+pods — so a trace states exactly what load it replays.
+``generate_trace`` produces deterministic synthetic traces for tests
+and soaks (no RNG state leaks: explicit seed).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import List
+
+
+@dataclass(frozen=True)
+class TraceEvent:
+    start: float       # seconds from trace start
+    chips: float       # requested chips (fractional < 1.0 => sharing)
+    runtime: float     # seconds of work
+
+    @property
+    def is_fractional(self) -> bool:
+        return self.chips < 1.0
+
+
+def load_trace(path: str) -> List[TraceEvent]:
+    events: List[TraceEvent] = []
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) != 3:
+                raise ValueError(f"{path}:{line_no}: expected 3 columns")
+            events.append(
+                TraceEvent(float(parts[0]), float(parts[1]), float(parts[2]))
+            )
+    events.sort(key=lambda e: e.start)
+    return events
+
+
+def save_trace(path: str, events: List[TraceEvent]) -> None:
+    with open(path, "w") as f:
+        f.write("# start_offset\tchips\truntime\n")
+        for e in events:
+            f.write(f"{e.start:g}\t{e.chips:g}\t{e.runtime:g}\n")
+
+
+def generate_trace(
+    count: int = 1000,
+    seed: int = 0,
+    mean_interarrival: float = 2.0,
+    mean_runtime: float = 60.0,
+    fractional_ratio: float = 0.6,
+    multi_chip_max: int = 4,
+) -> List[TraceEvent]:
+    """Poisson arrivals; a ``fractional_ratio`` share of jobs request
+    0.1..0.9 of a chip, the rest 1..multi_chip_max whole chips."""
+    rng = random.Random(seed)
+    events: List[TraceEvent] = []
+    t = 0.0
+    for _ in range(count):
+        t += rng.expovariate(1.0 / mean_interarrival)
+        if rng.random() < fractional_ratio:
+            chips = round(rng.uniform(0.1, 0.9), 2)
+        else:
+            chips = float(rng.randint(1, multi_chip_max))
+        runtime = max(1.0, rng.expovariate(1.0 / mean_runtime))
+        events.append(TraceEvent(round(t, 3), chips, round(runtime, 1)))
+    return events

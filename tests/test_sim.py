@@ -1,0 +1,194 @@
+"""Workload corpus + trace simulator."""
+
+import glob
+import os
+
+import pytest
+
+from kubeshare_tpu.cluster.k8syaml import load_pods
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.labels import LabelError, PodKind, parse_pod
+from kubeshare_tpu.sim.simulator import Simulator
+from kubeshare_tpu.sim.trace import TraceEvent, generate_trace, load_trace, save_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKLOADS = os.path.join(REPO, "workloads")
+
+TOPO = {
+    "cell_types": {
+        "v5e-tray": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 50,
+        },
+        "v5e-node": {
+            "child_cell_type": "v5e-tray",
+            "child_cell_number": 1,
+            "is_node_level": True,
+            "torus": [2, 2],
+        },
+    },
+    "cells": [
+        {"cell_type": "v5e-node", "cell_id": "node-a"},
+        {"cell_type": "v5e-node", "cell_id": "node-b"},
+    ],
+}
+
+
+class TestWorkloadCorpus:
+    def test_corpus_parses(self):
+        paths = glob.glob(os.path.join(WORKLOADS, "**", "*.yaml"), recursive=True)
+        assert len(paths) >= 10
+        for path in paths:
+            assert load_pods(path), path
+
+    def test_valid_specs_accepted_invalid_rejected(self):
+        expectations = {
+            "mnist/mnist-half.yaml": PodKind.SHARED,
+            "mnist/mnist-mem.yaml": PodKind.SHARED,
+            "mnist/mnist-bad-pair.yaml": LabelError,
+            "multichip/pod-2chip.yaml": PodKind.MULTI_CHIP,
+            "multichip/pod-bad-frac.yaml": LabelError,
+            "opportunistic/pod-opportunistic.yaml": PodKind.SHARED,
+            "guarantee/pod-priority.yaml": PodKind.SHARED,
+            "regular/pod-regular.yaml": PodKind.REGULAR,
+            "pinned/pod-v5e.yaml": PodKind.SHARED,
+        }
+        for rel, expected in expectations.items():
+            [pod] = load_pods(os.path.join(WORKLOADS, rel))
+            if expected is LabelError:
+                with pytest.raises(LabelError):
+                    parse_pod(pod)
+            else:
+                assert parse_pod(pod).kind == expected, rel
+
+    def test_gang_job_fans_out(self):
+        pods = load_pods(os.path.join(WORKLOADS, "gang", "gang-job.yaml"))
+        assert len(pods) == 4
+        assert {p.name for p in pods} == {
+            "gang-train-0", "gang-train-1", "gang-train-2", "gang-train-3",
+        }
+        for pod in pods:
+            req = parse_pod(pod)
+            assert req.gang is not None
+            assert req.gang.min_available == 3  # floor(4*0.75 + 0.5)
+
+    def test_pinned_model_label(self):
+        [pod] = load_pods(os.path.join(WORKLOADS, "pinned", "pod-v5e.yaml"))
+        assert parse_pod(pod).model == "tpu-v5e"
+
+
+class TestTrace:
+    def test_roundtrip(self, tmp_path):
+        events = generate_trace(count=50, seed=7)
+        path = tmp_path / "t.txt"
+        save_trace(str(path), events)
+        back = load_trace(str(path))
+        assert back == sorted(events, key=lambda e: e.start)
+
+    def test_committed_trace_loads(self):
+        events = load_trace(os.path.join(WORKLOADS, "trace.txt"))
+        assert len(events) == 989
+        assert any(e.is_fractional for e in events)
+        assert any(not e.is_fractional for e in events)
+
+    def test_deterministic(self):
+        assert generate_trace(count=20, seed=3) == generate_trace(count=20, seed=3)
+
+    def test_malformed_rejected(self, tmp_path):
+        path = tmp_path / "bad.txt"
+        path.write_text("1.0 2.0\n")
+        with pytest.raises(ValueError):
+            load_trace(str(path))
+
+
+class TestSimulator:
+    def test_small_trace_all_complete(self):
+        sim = Simulator(TOPO, {"node-a": 4, "node-b": 4}, seed=1)
+        events = [
+            TraceEvent(0.0, 0.5, 10.0),
+            TraceEvent(0.0, 0.5, 10.0),
+            TraceEvent(1.0, 1.0, 5.0),
+            TraceEvent(2.0, 2.0, 5.0),
+        ]
+        report = sim.run(events)
+        assert report.submitted == 4
+        assert report.bound == 4
+        assert report.completed == 4
+        assert report.unschedulable == 0
+        assert report.utilization > 0
+
+    def test_oversubscription_queues_then_drains(self):
+        # 8 chips; 16 whole-chip jobs of 10s arriving at once: half wait
+        sim = Simulator(TOPO, {"node-a": 4, "node-b": 4}, seed=2)
+        events = [TraceEvent(0.0, 1.0, 10.0) for _ in range(16)]
+        report = sim.run(events)
+        assert report.bound == 16
+        assert report.completed == 16
+        assert report.peak_pending >= 8
+        # the second wave waited ~10s
+        assert 4.0 < report.mean_wait < 11.0
+
+    def test_too_big_job_rejected_at_end(self):
+        sim = Simulator(TOPO, {"node-a": 4, "node-b": 4}, seed=3)
+        report = sim.run([TraceEvent(0.0, 9.0, 5.0)])
+        assert report.submitted == 1
+        assert report.bound == 0
+        assert report.unschedulable == 1
+
+    def test_malformed_pod_rejected_permanently(self):
+        from kubeshare_tpu.cluster.api import Pod
+
+        sim = Simulator(TOPO, {"node-a": 4}, seed=5)
+        bad = Pod(
+            name="bad",
+            labels={
+                C.LABEL_TPU_REQUEST: "0.8",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "0.5",
+            },
+            scheduler_name=C.SCHEDULER_NAME,
+        )
+        sim.cluster.create_pod(bad)
+        decision = sim.engine.schedule_one(bad)
+        assert decision.status == "unschedulable"
+        assert decision.retryable is False
+        # capacity shortfalls stay retryable
+        big = Pod(
+            name="big",
+            labels={
+                C.LABEL_TPU_REQUEST: "4.0",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "4.0",
+            },
+            scheduler_name=C.SCHEDULER_NAME,
+        )
+        sim.cluster.create_pod(big)
+        half = Pod(
+            name="half",
+            labels={
+                C.LABEL_TPU_REQUEST: "0.5",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+            },
+            scheduler_name=C.SCHEDULER_NAME,
+        )
+        sim.cluster.create_pod(half)
+        assert sim.engine.schedule_one(half).status == "bound"
+        blocked = sim.engine.schedule_one(big)
+        assert blocked.status == "unschedulable"
+        assert blocked.retryable is True
+
+    def test_horizon_caps_run_and_utilization(self):
+        sim = Simulator(TOPO, {"node-a": 4, "node-b": 4}, seed=6)
+        events = [TraceEvent(0.0, 1.0, 1000.0), TraceEvent(500.0, 1.0, 10.0)]
+        report = sim.run(events, horizon=100.0)
+        assert report.submitted == 1      # the t=500 arrival is past horizon
+        assert report.bound == 1
+        assert 0 < report.utilization <= 1.0
+
+    def test_replays_committed_trace_prefix(self):
+        sim = Simulator(TOPO, {"node-a": 4, "node-b": 4}, seed=4)
+        events = load_trace(os.path.join(WORKLOADS, "trace.txt"))[:120]
+        report = sim.run(events)
+        assert report.submitted == 120
+        assert report.bound + report.unschedulable == 120
+        assert report.completed == report.bound
+        assert 0 < report.utilization <= 1.0
