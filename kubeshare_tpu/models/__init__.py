@@ -13,6 +13,7 @@ from .cifar import CifarConfig, init_cifar, cifar_apply
 from .lstm import LstmConfig, init_lstm, lstm_apply
 from .resnet import ResNetConfig, init_resnet, resnet_apply
 from .llama import LlamaConfig, init_llama, llama_apply
+from .moe import MoeConfig, init_moe_ffn, moe_ffn_apply, moe_param_spec
 from .train import make_train_step, synthetic_batches
 
 __all__ = [
@@ -21,5 +22,6 @@ __all__ = [
     "LstmConfig", "init_lstm", "lstm_apply",
     "ResNetConfig", "init_resnet", "resnet_apply",
     "LlamaConfig", "init_llama", "llama_apply",
+    "MoeConfig", "init_moe_ffn", "moe_ffn_apply", "moe_param_spec",
     "make_train_step", "synthetic_batches",
 ]

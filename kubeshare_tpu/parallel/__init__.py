@@ -1,6 +1,11 @@
 from .mesh import MeshPlan, make_mesh, factorize_devices
 from .sharding import llama_param_spec, shard_params, batch_sharding
 from .ring_attention import ring_attention
+from .pipeline import (
+    pipeline_apply,
+    shard_stacked_params,
+    stack_stage_params,
+)
 from .train import make_sharded_train_step
 
 __all__ = [
@@ -11,5 +16,8 @@ __all__ = [
     "shard_params",
     "batch_sharding",
     "ring_attention",
+    "pipeline_apply",
+    "shard_stacked_params",
+    "stack_stage_params",
     "make_sharded_train_step",
 ]

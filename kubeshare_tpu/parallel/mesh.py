@@ -1,12 +1,13 @@
-"""Device-mesh construction for dp / fsdp / tp / sp axes.
+"""Device-mesh construction for dp / pp / fsdp / sp / tp / ep axes.
 
 The scaling recipe (How to Scale Your Model): pick a mesh whose axes
 map onto the ICI topology, annotate array shardings, and let XLA insert
 the collectives. The scheduler side of this framework places gang
 members ICI-close (cells/topology.py); this module is the workload side
-that exploits that placement. ``jax.make_mesh`` orders devices so the
-innermost axes ride the fastest links — tp innermost (all-reduce heavy),
-then sp, fsdp, dp outermost (DCN-tolerant).
+that exploits that placement. Axis order puts the bandwidth-hungriest
+axes innermost (fastest links): ep (all-to-all dispatch) then tp
+(all-reduce heavy), then sp, fsdp; pp (neighbor ppermute only) and dp
+(gradient sync) sit outermost, where DCN hops are tolerable.
 """
 
 from __future__ import annotations
@@ -24,14 +25,16 @@ class MeshPlan:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    pp: int = 1    # pipeline stages (neighbor ppermute; DCN-tolerant -> outer)
+    ep: int = 1    # expert parallel (all-to-all heavy -> inner, near tp)
 
     @property
     def shape(self) -> Tuple[int, ...]:
-        return (self.dp, self.fsdp, self.sp, self.tp)
+        return (self.dp, self.pp, self.fsdp, self.sp, self.tp, self.ep)
 
     @property
     def axis_names(self) -> Tuple[str, ...]:
-        return ("dp", "fsdp", "sp", "tp")
+        return ("dp", "pp", "fsdp", "sp", "tp", "ep")
 
     @property
     def total(self) -> int:

@@ -1,0 +1,135 @@
+"""Pipeline parallelism: GPipe microbatch schedule over a ``pp`` axis.
+
+TPU-native design: one ``lax.scan`` inside ``shard_map``, activations
+hopping stage-to-stage with ``lax.ppermute`` each step — the collective
+rides neighbor ICI links, and XLA overlaps the permute with the next
+microbatch's compute. No per-stage Python processes, no send/recv
+runtime: the whole schedule is one compiled program (contrast with the
+reference's process-level gang scheduling of torch workers,
+test/distribute/**; sharding recipe per the scaling-book pipelining
+chapter).
+
+Constraints: every stage maps activations ``[mb, ...] -> [mb, ...]`` of
+identical shape/dtype (true for stacked transformer blocks), and the
+number of microbatches M amortizes the P-1 bubble (efficiency =
+M / (M + P - 1)).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(per_stage: Sequence) -> object:
+    """Stack per-stage param pytrees along a new leading axis (the pp
+    axis): P pytrees of leaves [...]-> one pytree of leaves [P, ...]."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *per_stage)
+
+
+def _local_pipeline(stage_fn: Callable, params_local, x_mb):
+    """Runs inside shard_map: this device is stage ``i`` of ``P``.
+
+    params_local leaves: [1, ...] (this stage's slice); x_mb: [M, mb, ...]
+    (every device sees the full microbatch stream; only stage 0 feeds it).
+    Returns [M, mb, ...] (valid on every device after the final psum).
+    """
+    num_stages = lax.axis_size("pp")
+    stage_idx = lax.axis_index("pp")
+    params_here = jax.tree.map(lambda leaf: leaf[0], params_local)
+    num_mb = x_mb.shape[0]
+    steps = num_mb + num_stages - 1
+
+    def body(carry, t):
+        incoming, outputs = carry
+        # stage 0 consumes microbatch t (clamped; masked past the end),
+        # later stages consume what the previous stage sent last step
+        feed = x_mb[jnp.clip(t, 0, num_mb - 1)]
+        x_in = jnp.where(stage_idx == 0, feed, incoming)
+        y = stage_fn(params_here, x_in)
+        # the last stage emits microbatch t-(P-1)'s result
+        out_idx = t - (num_stages - 1)
+        write = jnp.logical_and(stage_idx == num_stages - 1, out_idx >= 0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(write, y, lax.dynamic_index_in_dim(
+                outputs, jnp.clip(out_idx, 0, num_mb - 1), 0, keepdims=False
+            )),
+            jnp.clip(out_idx, 0, num_mb - 1),
+            axis=0,
+        )
+        # hop activations one stage forward (ring permute; the wrap link
+        # carries garbage that stage 0 overwrites with its feed)
+        incoming = lax.ppermute(
+            y, "pp",
+            [(j, (j + 1) % num_stages) for j in range(num_stages)],
+        )
+        return (incoming, outputs), None
+
+    init = (
+        jnp.zeros_like(x_mb[0]),
+        jnp.zeros((num_mb,) + x_mb.shape[1:], x_mb.dtype),
+    )
+    (_, outputs), _ = lax.scan(body, init, jnp.arange(steps))
+    # only the last stage holds real outputs; share them with every stage
+    outputs = jnp.where(stage_idx == num_stages - 1, outputs, 0.0)
+    return lax.psum(outputs, "pp")
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stacked_params,
+    x: jnp.ndarray,
+    num_microbatches: int,
+    mesh: Mesh,
+):
+    """Run ``stage_fn`` as a P-stage pipeline over mesh axis ``pp``.
+
+    stacked_params: leaves [P, ...] (see stack_stage_params), sharded on
+    the pp axis. x: [B, ...] with B divisible by num_microbatches.
+    Returns [B, ...].
+    """
+    num_stages = mesh.shape["pp"]
+    leading = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}
+    if leading != {num_stages}:
+        raise ValueError(
+            f"stacked params have leading dims {sorted(leading)}, "
+            f"mesh pp axis is {num_stages} — each leaf must stack exactly "
+            "one slice per stage"
+        )
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible into {num_microbatches} microbatches"
+        )
+    mb = batch // num_microbatches
+    x_mb = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda leaf: P("pp", *(None,) * (leaf.ndim - 1)), stacked_params
+    )
+    fn = jax.shard_map(
+        partial(_local_pipeline, stage_fn),
+        mesh=mesh,
+        in_specs=(param_specs, P()),      # params split by stage, x replicated
+        out_specs=P(),
+        check_vma=False,
+    )
+    y_mb = fn(stacked_params, x_mb)
+    return y_mb.reshape((batch,) + y_mb.shape[2:])
+
+
+def shard_stacked_params(stacked_params, mesh: Mesh):
+    """Place stacked stage params so stage i's slice lives on pp=i."""
+    return jax.tree.map(
+        lambda leaf: jax.device_put(
+            leaf,
+            NamedSharding(mesh, P("pp", *(None,) * (leaf.ndim - 1))),
+        ),
+        stacked_params,
+    )

@@ -37,7 +37,9 @@ class TestMesh:
     @needs_8_devices
     def test_make_mesh(self):
         mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
-        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+        assert dict(mesh.shape) == {
+            "dp": 2, "pp": 1, "fsdp": 2, "sp": 1, "tp": 2, "ep": 1,
+        }
         with pytest.raises(ValueError, match="devices"):
             make_mesh(MeshPlan(dp=16))
 
