@@ -20,6 +20,14 @@ class PodPhase(str, enum.Enum):
     RUNNING = "Running"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    # kubelet unreachable: the pod may still be running and holding its
+    # chips, so Unknown is NOT completed
+    UNKNOWN = "Unknown"
+
+    @classmethod
+    def _missing_(cls, value):
+        # future/novel apiserver phases must not crash the sync loop
+        return cls.UNKNOWN
 
 
 @dataclass

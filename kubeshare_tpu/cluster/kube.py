@@ -1,0 +1,276 @@
+"""ClusterAPI over the Kubernetes REST API — no client library needed.
+
+The real-cluster counterpart of ``fake.FakeCluster`` / ``snapshot.
+SnapshotCluster``: plain HTTPS against the apiserver with the
+in-cluster service-account token (or any bearer token / insecure local
+proxy). Implements exactly the verbs the engine uses:
+
+- ``list_pods`` / ``list_nodes`` — GET collections;
+- ``bind`` — POST ``pods/<name>/binding`` (the proper Bind subresource,
+  replacing the reference's delete+recreate shadow pods,
+  scheduler.go:515-528);
+- ``patch_pod`` — strategic-merge PATCH of annotations (env cannot be
+  patched on a running pod; the runtime contract is carried by
+  annotations, which the aggregator reads — aggregator.py);
+- ``poll`` — full list + uid/phase diff against the local cache,
+  driving the same add/delete handlers the informer-style adapters
+  fire (O(cluster) per tick; a watch-stream upgrade can slot in behind
+  the same handler contract).
+
+Chip inventory comes from the collector scrape, not this adapter
+(``scrape.scrape_capacity``), mirroring the reference's
+Prometheus-backed ``getGPUByNode`` (pkg/scheduler/gpu.go:22-53).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from .api import Container, Node, Pod, PodPhase
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeError(RuntimeError):
+    pass
+
+
+def pod_from_k8s(obj: dict) -> Pod:
+    meta = obj.get("metadata", {}) or {}
+    spec = obj.get("spec", {}) or {}
+    status = obj.get("status", {}) or {}
+    containers = [
+        Container(
+            name=c.get("name", "main"),
+            env={
+                e["name"]: str(e.get("value", ""))
+                for e in (c.get("env") or [])
+                if "name" in e
+            },
+        )
+        for c in (spec.get("containers") or [])
+    ] or [Container()]
+    return Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        labels=dict(meta.get("labels") or {}),
+        annotations=dict(meta.get("annotations") or {}),
+        node_name=spec.get("nodeName", "") or "",
+        phase=PodPhase(status.get("phase", "Pending")),
+        scheduler_name=spec.get("schedulerName", "") or "",
+        containers=containers,
+    )
+
+
+def node_from_k8s(obj: dict) -> Node:
+    meta = obj.get("metadata", {}) or {}
+    spec = obj.get("spec", {}) or {}
+    conditions = (obj.get("status", {}) or {}).get("conditions") or []
+    ready = any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in conditions
+    )
+    return Node(
+        name=meta.get("name", ""),
+        labels=dict(meta.get("labels") or {}),
+        ready=ready,
+        unschedulable=bool(spec.get("unschedulable", False)),
+    )
+
+
+class KubeCluster:
+    """ClusterAPI against a live apiserver.
+
+    ``poll()`` must be called periodically (the scheduler loop's tick);
+    it diffs pod/node state and fires the registered handlers, the same
+    contract the hermetic adapters implement with file mtimes.
+    """
+
+    def __init__(
+        self,
+        api_server: str = "",
+        token: str = "",
+        ca_file: str = "",
+        namespace_selector: str = "",
+        timeout: float = 10.0,
+    ):
+        if not api_server:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise KubeError(
+                    "api_server not given and not running in-cluster"
+                )
+            api_server = f"https://{host}:{port}"
+        self.base = api_server.rstrip("/")
+        token_file = os.path.join(SA_DIR, "token")
+        if not token and os.path.exists(token_file):
+            with open(token_file) as f:
+                token = f.read().strip()
+        self.token = token
+        ca = ca_file or os.path.join(SA_DIR, "ca.crt")
+        if self.base.startswith("https"):
+            if os.path.exists(ca):
+                self._ctx: Optional[ssl.SSLContext] = (
+                    ssl.create_default_context(cafile=ca)
+                )
+            else:
+                self._ctx = ssl.create_default_context()
+        else:
+            self._ctx = None
+        self.timeout = timeout
+        self.ns_selector = namespace_selector
+        self._pods: Dict[str, Pod] = {}
+        self._nodes: Dict[str, Node] = {}
+        self._pod_add: List[Callable[[Pod], None]] = []
+        self._pod_delete: List[Callable[[Pod], None]] = []
+        self._node_update: List[Callable[[Node], None]] = []
+
+    # ---- HTTP plumbing ---------------------------------------------
+
+    def _request(
+        self, method: str, path: str, body: Optional[dict] = None,
+        content_type: str = "application/json",
+    ) -> dict:
+        url = self.base + path
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self._ctx
+            ) as resp:
+                payload = resp.read().decode()
+        except urllib.error.HTTPError as e:
+            raise KubeError(
+                f"{method} {path}: HTTP {e.code} {e.read().decode()[:300]}"
+            ) from e
+        except (urllib.error.URLError, OSError) as e:
+            raise KubeError(f"{method} {path}: {e}") from e
+        return json.loads(payload) if payload else {}
+
+    # ---- ClusterAPI ------------------------------------------------
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        if namespace:
+            path = f"/api/v1/namespaces/{namespace}/pods"
+        else:
+            path = "/api/v1/pods"
+        items = self._request("GET", path).get("items", [])
+        return [pod_from_k8s(o) for o in items]
+
+    def list_nodes(self) -> List[Node]:
+        items = self._request("GET", "/api/v1/nodes").get("items", [])
+        return [node_from_k8s(o) for o in items]
+
+    def get_pod(self, key: str) -> Optional[Pod]:
+        namespace, _, name = key.partition("/")
+        try:
+            return pod_from_k8s(
+                self._request(
+                    "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"
+                )
+            )
+        except KubeError:
+            return None
+
+    def bind(self, pod_key: str, node_name: str) -> None:
+        namespace, _, name = pod_key.partition("/")
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+            body={
+                "apiVersion": "v1",
+                "kind": "Binding",
+                "metadata": {"name": name, "namespace": namespace},
+                "target": {
+                    "apiVersion": "v1", "kind": "Node", "name": node_name,
+                },
+            },
+        )
+        cached = self._pods.get(pod_key)
+        if cached is not None:
+            cached.node_name = node_name
+
+    def patch_pod(
+        self,
+        pod_key: str,
+        annotations: Optional[Dict[str, str]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        namespace, _, name = pod_key.partition("/")
+        patch: Dict = {}
+        if annotations:
+            patch["metadata"] = {"annotations": annotations}
+        # env on live pods is immutable in Kubernetes; the runtime
+        # contract rides annotations (consumed by the aggregator), and
+        # is also mirrored here for anything reading the patch
+        if env:
+            patch.setdefault("metadata", {}).setdefault("annotations", {})
+            for key, value in env.items():
+                patch["metadata"]["annotations"][f"env.sharedtpu/{key}"] = value
+        if not patch:
+            return
+        self._request(
+            "PATCH",
+            f"/api/v1/namespaces/{namespace}/pods/{name}",
+            body=patch,
+            content_type="application/strategic-merge-patch+json",
+        )
+        cached = self._pods.get(pod_key)
+        if cached is not None and annotations:
+            cached.annotations.update(annotations)
+
+    def on_pod_event(self, add, delete) -> None:
+        self._pod_add.append(add)
+        self._pod_delete.append(delete)
+
+    def on_node_event(self, update) -> None:
+        self._node_update.append(update)
+
+    # ---- polling sync ----------------------------------------------
+
+    def poll(self) -> None:
+        """One list+diff pass over nodes and pods, firing handlers."""
+        nodes = {n.name: n for n in self.list_nodes()}
+        for name, node in nodes.items():
+            old = self._nodes.get(name)
+            if old is None or (old.ready, old.unschedulable) != (
+                node.ready, node.unschedulable
+            ):
+                for handler in self._node_update:
+                    handler(node)
+        for name in [n for n in self._nodes if n not in nodes]:
+            gone = self._nodes.pop(name)
+            gone.ready = False
+            for handler in self._node_update:
+                handler(gone)
+        self._nodes = nodes
+
+        pods = {p.key: p for p in self.list_pods(self.ns_selector or None)}
+        for key, pod in pods.items():
+            old = self._pods.get(key)
+            if old is None or old.uid != pod.uid:
+                if old is not None:  # name reuse: retire old incarnation
+                    for handler in self._pod_delete:
+                        handler(old)
+                for handler in self._pod_add:
+                    handler(pod)
+            elif pod.is_completed and not old.is_completed:
+                for handler in self._pod_delete:
+                    handler(pod)
+        for key in [k for k in self._pods if k not in pods]:
+            gone = self._pods.pop(key)
+            if not gone.is_completed:
+                for handler in self._pod_delete:
+                    handler(gone)
+        self._pods = pods

@@ -24,9 +24,19 @@ def build_parser() -> argparse.ArgumentParser:
     add_common_flags(parser)
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=AGGREGATOR_PORT)
-    parser.add_argument(
-        "--cluster-state", required=True, metavar="PATH",
+    source = parser.add_mutually_exclusive_group(required=True)
+    source.add_argument(
+        "--cluster-state", default="", metavar="PATH",
         help="cluster snapshot file (JSON/YAML), reloaded on change",
+    )
+    source.add_argument(
+        "--kube", action="store_true",
+        help="talk to the Kubernetes API (in-cluster service account, "
+             "or --api-server)",
+    )
+    parser.add_argument(
+        "--api-server", default="",
+        help="apiserver URL for --kube (default: in-cluster env)",
     )
     parser.add_argument(
         "--refresh-interval", type=float, default=1.0,
@@ -38,17 +48,30 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     log = component_logger("aggregator", args)
-    cluster = SnapshotCluster(args.cluster_state)
+    if args.kube:
+        from ..cluster.kube import KubeCluster
+
+        # KubeCluster.list_pods always lists live, so every scrape is
+        # already fresh — no refresher thread needed
+        cluster = KubeCluster(api_server=args.api_server)
+        sync = None
+    else:
+        cluster = SnapshotCluster(args.cluster_state)
+        sync = cluster.refresh
     aggregator = Aggregator(cluster)
     server = aggregator.serve(host=args.host, port=args.port)
     log.info("aggregator serving on %s:%d", args.host, server.port)
     stop = setup_signal_handler()
 
-    def refresher():
-        while not stop.wait(args.refresh_interval):
-            cluster.refresh()
+    if sync is not None:
+        def refresher():
+            while not stop.wait(args.refresh_interval):
+                try:
+                    sync()
+                except Exception as e:  # transient blip: keep serving stale
+                    log.error("cluster sync failed: %s", e)
 
-    threading.Thread(target=refresher, daemon=True).start()
+        threading.Thread(target=refresher, daemon=True).start()
     stop.wait()
     server.stop()
     return 0

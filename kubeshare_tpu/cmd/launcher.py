@@ -37,6 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--base-quota-ms", type=float, default=300.0)
     parser.add_argument("--min-quota-ms", type=float, default=20.0)
     parser.add_argument("--window-ms", type=float, default=10000.0)
+    parser.add_argument(
+        "--lease-slots", type=int, default=2,
+        help="concurrent compute leases per chip (1 = strict reference "
+             "semantics; 2 hides per-hold drain latency)",
+    )
     parser.add_argument("--poll-interval", type=float, default=0.5)
     return parser
 
@@ -59,6 +64,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         base_quota_ms=args.base_quota_ms,
         min_quota_ms=args.min_quota_ms,
         window_ms=args.window_ms,
+        lease_slots=args.lease_slots,
         log=log,
     )
     stop = setup_signal_handler()

@@ -34,9 +34,24 @@ def build_parser() -> argparse.ArgumentParser:
         "--topology", required=True,
         help="cell-topology YAML (celltypes + cells), see deploy/config/",
     )
-    parser.add_argument(
-        "--cluster-state", required=True, metavar="PATH",
+    source = parser.add_mutually_exclusive_group(required=True)
+    source.add_argument(
+        "--cluster-state", default="", metavar="PATH",
         help="cluster snapshot file (JSON/YAML), reloaded on change",
+    )
+    source.add_argument(
+        "--kube", action="store_true",
+        help="talk to the Kubernetes API (in-cluster service account, "
+             "or --api-server)",
+    )
+    parser.add_argument(
+        "--api-server", default="",
+        help="apiserver URL for --kube (default: in-cluster env)",
+    )
+    parser.add_argument(
+        "--capacity-url", default="",
+        help="tpu_capacity endpoint for chip inventory in --kube mode "
+             "(collector service or Prometheus federate)",
     )
     parser.add_argument("--interval", type=float, default=1.0,
                         help="seconds between scheduling passes")
@@ -91,10 +106,28 @@ def run_pass(engine: TpuShareScheduler, cluster, journal) -> int:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     log = component_logger("scheduler", args)
-    cluster = SnapshotCluster(args.cluster_state)
+    if args.kube:
+        from ..cluster.kube import KubeCluster
+
+        if not args.capacity_url:
+            raise SystemExit(
+                "--kube requires --capacity-url (chip inventory source)"
+            )
+        cluster = KubeCluster(api_server=args.api_server)
+        from ..metrics.scrape import scrape_capacity
+
+        def inventory(node_name, _url=args.capacity_url):
+            # a failed scrape must RAISE, not return [] — an empty list
+            # means "node has no chips" and would mark the node synced
+            # with zero inventory, never retried
+            return scrape_capacity(_url).get(node_name, [])
+    else:
+        cluster = SnapshotCluster(args.cluster_state)
+        inventory = None
     engine = TpuShareScheduler(
         topology=args.topology,
         cluster=cluster,
+        inventory=inventory,
         permit_wait_base=args.permit_wait_base,
         log=log,
     )
@@ -104,8 +137,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     elif args.decisions_out:
         journal = open(args.decisions_out, "a")
 
+    # snapshot adapters expose refresh(); the kube adapter poll()
+    sync = getattr(cluster, "refresh", None) or cluster.poll
+
     if args.once:
-        cluster.refresh()
+        sync()
         run_pass(engine, cluster, journal)
         return 0
 
@@ -113,8 +149,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     log.info("scheduler loop started (interval %.1fs)", args.interval)
     while not stop.is_set():
         started = time.monotonic()
-        cluster.refresh()
-        run_pass(engine, cluster, journal)
+        try:
+            sync()
+            run_pass(engine, cluster, journal)
+        except Exception as e:  # apiserver blips must not kill the loop
+            log.error("scheduling pass failed: %s", e)
         elapsed = time.monotonic() - started
         stop.wait(max(0.05, args.interval - elapsed))
     return 0

@@ -57,6 +57,7 @@ class NodeLauncher:
         base_quota_ms: float = 300.0,
         min_quota_ms: float = 20.0,
         window_ms: float = 10000.0,
+        lease_slots: int = 2,
         log=None,
     ):
         self.base_dir = base_dir
@@ -65,6 +66,7 @@ class NodeLauncher:
         self.base_quota_ms = base_quota_ms
         self.min_quota_ms = min_quota_ms
         self.window_ms = window_ms
+        self.lease_slots = lease_slots
         self.log = log or get_logger("launcher", level=1)
         self.chips: Dict[str, ChipRuntime] = {
             uuid: ChipRuntime(uuid=uuid, port=base_port + i)
@@ -94,6 +96,7 @@ class NodeLauncher:
                 "-q", str(self.base_quota_ms),
                 "-m", str(self.min_quota_ms),
                 "-w", str(self.window_ms),
+                "-c", str(self.lease_slots),
             ],
         )
         self.log.info(

@@ -3,11 +3,18 @@
 // Semantics reproduced from the reference's published contract (the
 // gem-schd CLI surface: -q base_quota=300ms -m min_quota=20ms
 // -w window=10000ms, per-pod "limit request memory" tuples from the
-// config file — SURVEY.md §2.5): a client must hold the (single)
-// compute lease to dispatch work; lease quotas are sized base_quota,
-// shrinking toward min_quota under contention; usage is accounted over
-// a sliding window; a pod under request*window is *guaranteed* (served
-// first), a pod past limit*window is throttled until the window slides.
+// config file — SURVEY.md §2.5): a client must hold a compute lease to
+// dispatch work; lease quotas are sized base_quota, shrinking toward
+// min_quota under contention; usage is accounted over a sliding window;
+// a pod under request*window is *guaranteed* (served first), a pod past
+// limit*window is throttled until the window slides.
+//
+// TPU-native extension over the reference's single token: up to
+// `slots` leases may be outstanding at once (default 1 = reference
+// semantics). XLA dispatch is async and each hold includes a drain
+// round trip, so slots=2 lets one pod's drain latency hide under
+// another's compute — work conservation without weakening the window
+// accounting that enforces request/limit fairness.
 #pragma once
 
 #include <algorithm>
@@ -29,10 +36,12 @@ struct PodQuota {
 
 class TokenArbiter {
  public:
-  TokenArbiter(double base_quota_ms, double min_quota_ms, double window_ms)
+  TokenArbiter(double base_quota_ms, double min_quota_ms, double window_ms,
+               int slots = 1)
       : base_quota_ms_(base_quota_ms),
         min_quota_ms_(min_quota_ms),
-        window_ms_(window_ms) {}
+        window_ms_(window_ms),
+        slots_(slots < 1 ? 1 : slots) {}
 
   void set_quotas(const std::map<std::string, PodQuota>& quotas) {
     std::lock_guard<std::mutex> lock(mu_);
@@ -47,13 +56,12 @@ class TokenArbiter {
     waiting_.push_back(pod);
     for (;;) {
       expire_usage(now_ms());
-      if (!lease_held_ && eligible(pod) && next_in_line(pod)) break;
+      if (active_ < slots_ && eligible(pod) && next_in_line(pod)) break;
       cv_.wait_for(lock, std::chrono::milliseconds(5));
     }
     auto it = std::find(waiting_.begin(), waiting_.end(), pod);
     if (it != waiting_.end()) waiting_.erase(it);
-    lease_held_ = true;
-    lease_pod_ = pod;
+    ++active_;
     double quota = base_quota_ms_;
     int contenders = static_cast<int>(waiting_.size()) + 1;
     if (contenders > 1) quota = base_quota_ms_ / contenders;
@@ -62,10 +70,7 @@ class TokenArbiter {
 
   void release(const std::string& pod, double used_ms) {
     std::lock_guard<std::mutex> lock(mu_);
-    if (lease_held_ && lease_pod_ == pod) {
-      lease_held_ = false;
-      lease_pod_.clear();
-    }
+    if (active_ > 0) --active_;
     usage_[pod].push_back({now_ms(), std::max(0.0, used_ms)});
     cv_.notify_all();
   }
@@ -189,6 +194,7 @@ class TokenArbiter {
   const double base_quota_ms_;
   const double min_quota_ms_;
   const double window_ms_;
+  const int slots_;
 
   mutable std::mutex mu_;
   std::condition_variable cv_;
@@ -196,8 +202,7 @@ class TokenArbiter {
   std::map<std::string, std::deque<Usage>> usage_;
   std::map<std::string, long long> mem_used_;
   std::vector<std::string> waiting_;
-  bool lease_held_ = false;
-  std::string lease_pod_;
+  int active_ = 0;
 };
 
 }  // namespace tpushare

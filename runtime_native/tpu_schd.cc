@@ -8,6 +8,7 @@
 //
 // Usage: tpu-schd -p <config dir> -f <file (chip uuid)> -P <port>
 //                 [-q base_quota_ms] [-m min_quota_ms] [-w window_ms]
+//                 [-c lease_slots]
 
 #include <atomic>
 #include <cstdio>
@@ -139,6 +140,7 @@ static void serve_client(int fd, TokenArbiter* arbiter) {
 int main(int argc, char** argv) {
   std::string dir = ".", file, host = "0.0.0.0";
   int port = 49901;
+  int slots = 1;
   double base_quota = 300.0, min_quota = 20.0, window = 10000.0;
   for (int i = 1; i < argc - 1; ++i) {
     std::string a = argv[i];
@@ -148,6 +150,7 @@ int main(int argc, char** argv) {
     else if (a == "-q") base_quota = std::atof(argv[++i]);
     else if (a == "-m") min_quota = std::atof(argv[++i]);
     else if (a == "-w") window = std::atof(argv[++i]);
+    else if (a == "-c") slots = std::atoi(argv[++i]);
     else if (a == "-H") host = argv[++i];
   }
   if (file.empty()) {
@@ -155,7 +158,7 @@ int main(int argc, char** argv) {
                          "[-q base] [-m min] [-w window]\n");
     return 2;
   }
-  TokenArbiter arbiter(base_quota, min_quota, window);
+  TokenArbiter arbiter(base_quota, min_quota, window, slots);
   std::string path = dir + "/" + file;
   arbiter.set_quotas(load_config(path));
   std::atomic<bool> stop{false};
@@ -167,9 +170,10 @@ int main(int argc, char** argv) {
                  port);
     return 1;
   }
-  std::fprintf(stderr, "[tpu-schd] chip %s serving on %s:%d (q=%g m=%g w=%g)\n",
+  std::fprintf(stderr,
+               "[tpu-schd] chip %s serving on %s:%d (q=%g m=%g w=%g c=%d)\n",
                file.c_str(), host.c_str(), port, base_quota, min_quota,
-               window);
+               window, slots);
   for (;;) {
     int fd = ::accept(listener, nullptr, nullptr);
     if (fd < 0) continue;

@@ -1,0 +1,272 @@
+"""KubeCluster adapter against a stub apiserver (plain HTTP)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubeshare_tpu.cluster.kube import KubeCluster, KubeError
+
+
+class StubApiServer:
+    """Minimal /api/v1 pods+nodes apiserver recording writes."""
+
+    def __init__(self):
+        self.pods = {}    # (ns, name) -> k8s object dict
+        self.nodes = {}   # name -> k8s object dict
+        self.bindings = []
+        self.patches = []
+        self.auth_headers = []
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                stub.auth_headers.append(self.headers.get("Authorization"))
+                parts = [p for p in self.path.split("/") if p]
+                if self.path == "/api/v1/nodes":
+                    self._send({"items": list(stub.nodes.values())})
+                elif self.path == "/api/v1/pods":
+                    self._send({"items": list(stub.pods.values())})
+                elif len(parts) == 5 and parts[2] == "namespaces":
+                    # /api/v1/namespaces/<ns>/pods
+                    ns = parts[3]
+                    self._send({"items": [
+                        o for (n, _), o in stub.pods.items() if n == ns
+                    ]})
+                elif len(parts) == 6:
+                    obj = stub.pods.get((parts[3], parts[5]))
+                    if obj is None:
+                        self._send({"message": "not found"}, code=404)
+                    else:
+                        self._send(obj)
+                else:
+                    self._send({"message": "bad path"}, code=404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path.endswith("/binding"):
+                    parts = [p for p in self.path.split("/") if p]
+                    if (parts[3], parts[5]) not in stub.pods:
+                        self._send({"message": "not found"}, code=404)
+                        return
+                    stub.bindings.append((self.path, body))
+                    self._send({}, code=201)
+                else:
+                    self._send({"message": "bad path"}, code=404)
+
+            def do_PATCH(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                stub.patches.append(
+                    (self.path, self.headers.get("Content-Type"), body)
+                )
+                self._send({})
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    # -- fixture helpers --
+
+    def add_pod(self, name, ns="default", uid="u1", phase="Pending",
+                labels=None, node=""):
+        self.pods[(ns, name)] = {
+            "metadata": {"name": name, "namespace": ns, "uid": uid,
+                         "labels": labels or {}, "annotations": {}},
+            "spec": {"schedulerName": "kubeshare-tpu-scheduler",
+                     "nodeName": node,
+                     "containers": [{"name": "main", "env": []}]},
+            "status": {"phase": phase},
+        }
+
+    def add_node(self, name, ready=True):
+        self.nodes[name] = {
+            "metadata": {"name": name, "labels": {"SharedTPU": "true"}},
+            "spec": {},
+            "status": {"conditions": [
+                {"type": "Ready", "status": "True" if ready else "False"}
+            ]},
+        }
+
+
+@pytest.fixture
+def stub():
+    server = StubApiServer()
+    yield server
+    server.stop()
+
+
+def make_cluster(stub_server):
+    return KubeCluster(
+        api_server=f"http://127.0.0.1:{stub_server.port}", token="test-token"
+    )
+
+
+class TestKubeCluster:
+    def test_list_and_auth(self, stub):
+        stub.add_node("node-a")
+        stub.add_pod("p1")
+        cluster = make_cluster(stub)
+        [node] = cluster.list_nodes()
+        assert node.name == "node-a" and node.healthy
+        [pod] = cluster.list_pods()
+        assert pod.key == "default/p1"
+        assert pod.scheduler_name == "kubeshare-tpu-scheduler"
+        assert stub.auth_headers[-1] == "Bearer test-token"
+
+    def test_get_pod_and_missing(self, stub):
+        stub.add_pod("p1")
+        cluster = make_cluster(stub)
+        assert cluster.get_pod("default/p1").name == "p1"
+        assert cluster.get_pod("default/nope") is None
+
+    def test_bind_posts_binding_subresource(self, stub):
+        stub.add_pod("p1")
+        cluster = make_cluster(stub)
+        cluster.bind("default/p1", "node-a")
+        [(path, body)] = stub.bindings
+        assert path == "/api/v1/namespaces/default/pods/p1/binding"
+        assert body["target"]["name"] == "node-a"
+        assert body["kind"] == "Binding"
+
+    def test_patch_annotations_and_env_mirror(self, stub):
+        stub.add_pod("p1")
+        cluster = make_cluster(stub)
+        cluster.patch_pod(
+            "default/p1",
+            annotations={"sharedtpu/chip_uuid": "c0"},
+            env={"KUBESHARE_POD_MANAGER_PORT": "50050"},
+        )
+        [(path, ctype, body)] = stub.patches
+        assert path == "/api/v1/namespaces/default/pods/p1"
+        assert ctype == "application/strategic-merge-patch+json"
+        anns = body["metadata"]["annotations"]
+        assert anns["sharedtpu/chip_uuid"] == "c0"
+        assert anns["env.sharedtpu/KUBESHARE_POD_MANAGER_PORT"] == "50050"
+
+    def test_poll_fires_informer_style_events(self, stub):
+        stub.add_node("node-a")
+        stub.add_pod("p1", uid="u1")
+        cluster = make_cluster(stub)
+        adds, deletes, nodes = [], [], []
+        cluster.on_pod_event(lambda p: adds.append(p.uid),
+                             lambda p: deletes.append(p.uid))
+        cluster.on_node_event(lambda n: nodes.append((n.name, n.ready)))
+        cluster.poll()
+        assert adds == ["u1"] and nodes == [("node-a", True)]
+
+        # completion fires delete once
+        stub.add_pod("p1", uid="u1", phase="Succeeded")
+        cluster.poll()
+        cluster.poll()
+        assert deletes == ["u1"]
+
+        # name reuse with a new uid retires old and adds new
+        stub.add_pod("p1", uid="u2")
+        cluster.poll()
+        assert adds == ["u1", "u2"]
+        assert deletes == ["u1", "u1"]  # retire event for the old record
+
+        # node vanishes -> reported unready
+        del stub.nodes["node-a"]
+        cluster.poll()
+        assert nodes[-1] == ("node-a", False)
+
+    def test_http_error_wrapped(self, stub):
+        cluster = make_cluster(stub)
+        with pytest.raises(KubeError):
+            cluster.bind("default/ghost", "node-a")
+
+    def test_unknown_phase_tolerated(self, stub):
+        from kubeshare_tpu.cluster.api import PodPhase
+
+        stub.add_pod("p1", phase="Unknown")
+        stub.add_pod("p2", phase="SomeFuturePhase")
+        cluster = make_cluster(stub)
+        pods = {p.name: p for p in cluster.list_pods()}
+        assert pods["p1"].phase == PodPhase.UNKNOWN
+        assert pods["p2"].phase == PodPhase.UNKNOWN
+        # Unknown pods may still hold chips: not completed
+        assert not pods["p1"].is_completed
+
+    def test_out_of_cluster_requires_server(self, monkeypatch):
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+        with pytest.raises(KubeError, match="in-cluster"):
+            KubeCluster()
+
+
+TOPO_YAML = """
+cell_types:
+  v5e-tray:
+    child_cell_type: tpu-v5e
+    child_cell_number: 4
+    child_cell_priority: 50
+  v5e-node:
+    child_cell_type: v5e-tray
+    child_cell_number: 1
+    is_node_level: true
+    torus: [2, 2]
+cells:
+  - cell_type: v5e-node
+    cell_id: node-a
+"""
+
+
+class TestSchedulerKubeMode:
+    def test_schedules_via_stub_apiserver(self, stub, tmp_path):
+        from kubeshare_tpu.cmd import scheduler as scheduler_cmd
+        from kubeshare_tpu.metrics.collector import Collector, FakeChipBackend
+        from kubeshare_tpu.cells.cell import ChipInfo
+
+        stub.add_node("node-a")
+        stub.add_pod("p1", labels={
+            "sharedtpu/tpu_request": "0.5", "sharedtpu/tpu_limit": "1.0",
+        })
+        chips = [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 << 30, i)
+                 for i in range(4)]
+        collector = Collector("node-a", FakeChipBackend(chips))
+        server = collector.serve(host="127.0.0.1", port=0)
+        topo = tmp_path / "topo.yaml"
+        topo.write_text(TOPO_YAML)
+        out = tmp_path / "decisions.jsonl"
+        try:
+            rc = scheduler_cmd.main([
+                "--topology", str(topo),
+                "--kube",
+                "--api-server", f"http://127.0.0.1:{stub.port}",
+                "--capacity-url",
+                f"http://127.0.0.1:{server.port}/metrics",
+                "--decisions-out", str(out),
+                "--once",
+            ])
+        finally:
+            server.stop()
+        assert rc == 0
+        [decision] = [json.loads(l) for l in out.read_text().splitlines()]
+        assert decision == {
+            "pod": "default/p1", "status": "bound", "node": "node-a",
+            "message": "", "bound_with": [],
+        }
+        # the bind went through the binding subresource and annotations
+        # were patched onto the pod
+        assert stub.bindings
+        [(_, _, patch)] = stub.patches
+        assert "sharedtpu/chip_uuid" in patch["metadata"]["annotations"]

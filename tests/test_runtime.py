@@ -77,6 +77,48 @@ class TestArbiter:
             stats = {s.pod: s for s in c.stats()}
             assert stats["default/a"].window_usage_ms == pytest.approx(10.0, abs=0.5)
 
+    def test_two_slots_allow_concurrent_holds(self, tmp_path):
+        base = str(tmp_path)
+        write_config_file(base, "chip-0", [
+            ConfigEntry("default/a", 1.0, 0.5, 0),
+            ConfigEntry("default/b", 1.0, 0.5, 0),
+            ConfigEntry("default/c", 1.0, 0.0, 0),
+        ])
+        port = free_port()
+        proc = subprocess.Popen([
+            SCHD, "-p", os.path.join(base, "config"), "-f", "chip-0",
+            "-P", str(port), "-q", "50", "-m", "5", "-w", "1000",
+            "-c", "2", "-H", "127.0.0.1",
+        ])
+        try:
+            wait_for_port(port)
+            a = TokenClient("127.0.0.1", port, pod="default/a")
+            b = TokenClient("127.0.0.1", port, pod="default/b")
+            c = TokenClient("127.0.0.1", port, pod="default/c")
+            a.acquire()
+            got_b, got_c = [], []
+
+            def try_(client, sink):
+                client.acquire()
+                sink.append(time.perf_counter())
+
+            tb = threading.Thread(target=try_, args=(b, got_b))
+            tc = threading.Thread(target=try_, args=(c, got_c))
+            tb.start()
+            tb.join(timeout=2)
+            assert got_b  # second slot granted while a still holds
+            tc.start()
+            time.sleep(0.15)
+            assert not got_c  # third hold must wait
+            a.release(5.0)
+            tc.join(timeout=2)
+            assert got_c
+            b.release(5.0), c.release(5.0)
+            a.close(), b.close(), c.close()
+        finally:
+            proc.kill()
+            proc.wait()
+
     def test_lease_is_exclusive(self, arbiter):
         port, _ = arbiter
         a = TokenClient("127.0.0.1", port, pod="default/a")
