@@ -57,12 +57,15 @@ def log(msg: str) -> None:
 
 
 def run_stream(step, params, images, labels, seconds, stall_s, gate=None,
-               burst_steps=STEPS_PER_BURST):
+               burst_steps=STEPS_PER_BURST, latencies=None):
     """One input-bound pod: dispatch a burst of steps async, drain, then
-    block on the input pipeline (I/O stall) before the next burst."""
+    block on the input pipeline (I/O stall) before the next burst.
+    ``latencies`` (optional list) collects per-step wall latency
+    (burst wall time / steps, including any arbiter wait)."""
     deadline = time.perf_counter() + seconds
     steps = 0
     while time.perf_counter() < deadline:
+        t0 = time.perf_counter()
         if gate is not None:
             gate.begin()
         loss = None
@@ -72,6 +75,8 @@ def run_stream(step, params, images, labels, seconds, stall_s, gate=None,
             gate.flush(loss)
         else:
             loss.block_until_ready()
+        if latencies is not None:
+            latencies.append((time.perf_counter() - t0) / burst_steps)
         steps += burst_steps
         time.sleep(stall_s)      # blocking input wait (releases the GIL)
     return steps
@@ -108,11 +113,13 @@ def run_colocated(step, params_per_pod, data, stall_s, gates, seconds,
                   burst_steps=STEPS_PER_BURST):
     images, labels = data
     results = [0] * PODS
+    latencies = [[] for _ in range(PODS)]
 
     def worker(i):
         results[i] = run_stream(step, params_per_pod[i], images, labels,
                                 seconds, stall_s, gate=gates[i],
-                                burst_steps=burst_steps)
+                                burst_steps=burst_steps,
+                                latencies=latencies[i])
 
     threads = [threading.Thread(target=worker, args=(i,)) for i in range(PODS)]
     t0 = time.perf_counter()
@@ -121,7 +128,14 @@ def run_colocated(step, params_per_pod, data, stall_s, gates, seconds,
     for t in threads:
         t.join()
     elapsed = time.perf_counter() - t0
-    return sum(results) * BATCH / elapsed, results, elapsed
+    return sum(results) * BATCH / elapsed, results, elapsed, latencies
+
+
+def p99(values):
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
 
 
 def main() -> None:
@@ -189,18 +203,18 @@ def main() -> None:
                            PHASE_SECONDS, stall_s,
                            burst_steps=burst_steps)
         solo_r = steps * BATCH / PHASE_SECONDS
-        raw_r, _, _ = run_colocated(
+        raw_r, _, _, _ = run_colocated(
             step, params_per_pod, (images, labels), stall_s,
             [None] * PODS, PHASE_SECONDS, burst_steps=burst_steps,
         )
-        gated_r, results, elapsed = run_colocated(
+        gated_r, results, elapsed, lats = run_colocated(
             step, params_per_pod, (images, labels), stall_s, gates,
             PHASE_SECONDS, burst_steps=burst_steps,
         )
         rounds.append({
             "solo": solo_r, "ungated": raw_r, "gated": gated_r,
             "ratio": gated_r / solo_r,
-            "results": results, "elapsed": elapsed,
+            "results": results, "elapsed": elapsed, "lats": lats,
         })
         log(f"round {r}: solo {solo_r:,.0f} | ungated {raw_r:,.0f} | "
             f"gated {gated_r:,.0f} samples/s ({gated_r / solo_r:.2f}x)")
@@ -216,6 +230,10 @@ def main() -> None:
         f"samples/s ({aggregate / solo:.2f}x vs whole-chip); per-pod "
         f"{min(per_pod):,.0f}..{max(per_pod):,.0f}; isolation overhead "
         f"{overhead:.1%}")
+    pod_p99s = [p99(l) * 1e3 for l in mid["lats"] if l]
+    if pod_p99s:
+        log(f"per-pod p99 step latency (ms, incl. arbiter wait): "
+            f"min {min(pod_p99s):.2f} max {max(pod_p99s):.2f}")
 
     if arbiter is not None:
         with TokenClient("127.0.0.1", ARBITER_PORT, pod="probe") as c:

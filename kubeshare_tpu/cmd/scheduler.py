@@ -67,11 +67,99 @@ def build_parser() -> argparse.ArgumentParser:
         "--permit-wait-base", type=float, default=C.PERMIT_WAIT_BASE_SECONDS,
         help="gang barrier base timeout, multiplied by headcount",
     )
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve scheduler self-metrics (tpu_scheduler_*) on this "
+             "port (0 = off)",
+    )
     return parser
 
 
-def run_pass(engine: TpuShareScheduler, cluster, journal) -> int:
+class CapacityInventory:
+    """Chip-inventory source over a tpu_capacity endpoint, with a short
+    scrape cache (one HTTP fetch per scheduling pass, not per node).
+
+    Returns ``None`` — "inventory unavailable, retry later" — both when
+    the scrape fails and when a node is missing from a successful
+    scrape (its collector may be down); the engine keeps such nodes
+    unsynced instead of treating them as chip-less
+    (plugin._on_node_update)."""
+
+    def __init__(self, url: str, ttl: float = 2.0, log=None,
+                 clock=time.monotonic):
+        self.url = url
+        self.ttl = ttl
+        self.log = log
+        self.clock = clock
+        self._cache = None
+        self._fetched_at = -1e18
+
+    def __call__(self, node_name: str):
+        now = self.clock()
+        if self._cache is None or now - self._fetched_at > self.ttl:
+            from ..metrics.scrape import scrape_capacity
+
+            try:
+                self._cache = scrape_capacity(self.url)
+                self._fetched_at = now
+            except (OSError, ValueError) as e:
+                if self.log:
+                    self.log.error("capacity scrape %s: %s", self.url, e)
+                self._cache = None
+                return None
+        return self._cache.get(node_name)
+
+
+class SchedulerMetrics:
+    """Decision counters + pass timing, served Prometheus-style — the
+    observability layer the reference only has as log lines
+    (scheduler.go [Filter]/[Score]/[Reserve] Infof)."""
+
+    def __init__(self, clock=time.time):
+        self.clock = clock
+        self.decisions = {"bound": 0, "waiting": 0, "unschedulable": 0}
+        self.passes = 0
+        self.last_pass_seconds = 0.0
+        self.last_pass_pods = 0
+
+    def record(self, decision) -> None:
+        if decision.status in self.decisions:
+            self.decisions[decision.status] += 1
+
+    def record_pass(self, seconds: float, pods: int) -> None:
+        self.passes += 1
+        self.last_pass_seconds = seconds
+        self.last_pass_pods = pods
+
+    def render(self) -> str:
+        from ..utils import expfmt
+
+        now = self.clock()
+        samples = [
+            expfmt.Sample(
+                "tpu_scheduler_decisions_total", {"status": status}, count
+            )
+            for status, count in sorted(self.decisions.items())
+        ]
+        samples += [
+            expfmt.Sample("tpu_scheduler_passes_total", {}, self.passes),
+            expfmt.Sample(
+                "tpu_scheduler_last_pass_seconds", {}, self.last_pass_seconds
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_last_pass_pods", {}, self.last_pass_pods
+            ),
+            expfmt.Sample("tpu_scheduler_up", {}, 1),
+            expfmt.Sample(
+                "tpu_scheduler_last_render_timestamp_seconds", {}, now
+            ),
+        ]
+        return expfmt.render(samples)
+
+
+def run_pass(engine: TpuShareScheduler, cluster, journal, metrics=None) -> int:
     """One queue drain. Returns number of pods scheduled/acted on."""
+    started = time.monotonic()
     pending = [
         p
         for p in cluster.list_pods()
@@ -85,6 +173,8 @@ def run_pass(engine: TpuShareScheduler, cluster, journal) -> int:
     for pod in pending:
         decision = engine.schedule_one(pod)
         acted += 1
+        if metrics is not None:
+            metrics.record(decision)
         if journal is not None:
             journal.write(
                 json.dumps(
@@ -100,6 +190,8 @@ def run_pass(engine: TpuShareScheduler, cluster, journal) -> int:
             )
             journal.flush()
     engine.tick()
+    if metrics is not None:
+        metrics.record_pass(time.monotonic() - started, acted)
     return acted
 
 
@@ -114,13 +206,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 "--kube requires --capacity-url (chip inventory source)"
             )
         cluster = KubeCluster(api_server=args.api_server)
-        from ..metrics.scrape import scrape_capacity
-
-        def inventory(node_name, _url=args.capacity_url):
-            # a failed scrape must RAISE, not return [] — an empty list
-            # means "node has no chips" and would mark the node synced
-            # with zero inventory, never retried
-            return scrape_capacity(_url).get(node_name, [])
+        inventory = CapacityInventory(args.capacity_url, log=log)
     else:
         cluster = SnapshotCluster(args.cluster_state)
         inventory = None
@@ -140,9 +226,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # snapshot adapters expose refresh(); the kube adapter poll()
     sync = getattr(cluster, "refresh", None) or cluster.poll
 
+    metrics = SchedulerMetrics()
+    metrics_server = None
+    if args.metrics_port:
+        from ..utils.httpserv import MetricServer
+
+        metrics_server = MetricServer(port=args.metrics_port)
+        metrics_server.route("/metrics", metrics.render)
+        metrics_server.start()
+        log.info("self-metrics on :%d/metrics", metrics_server.port)
+
     if args.once:
         sync()
-        run_pass(engine, cluster, journal)
+        run_pass(engine, cluster, journal, metrics)
         return 0
 
     stop = setup_signal_handler()
@@ -151,11 +247,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         started = time.monotonic()
         try:
             sync()
-            run_pass(engine, cluster, journal)
+            run_pass(engine, cluster, journal, metrics)
         except Exception as e:  # apiserver blips must not kill the loop
             log.error("scheduling pass failed: %s", e)
         elapsed = time.monotonic() - started
         stop.wait(max(0.05, args.interval - elapsed))
+    if metrics_server is not None:
+        metrics_server.stop()
     return 0
 
 

@@ -35,6 +35,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="wall-clock budget in seconds (0 = until --steps)")
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="save/resume (params, opt_state, step) here")
+    parser.add_argument("--checkpoint-every", type=int, default=50,
+                        help="steps between checkpoints")
     return parser
 
 
@@ -127,6 +131,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     opt, step = make_train_step(loss_fn, learning_rate=args.lr)
     opt_state = opt.init(params)
 
+    start_step = 0
+    if args.checkpoint_dir:
+        from ..models.checkpoint import restore_checkpoint, save_checkpoint
+
+        restored = restore_checkpoint(args.checkpoint_dir, params, opt_state)
+        if restored is not None:
+            start_step, params, opt_state = restored
+            log.info("resumed from step %d", start_step)
+
     # warmup compile outside the gated loop
     key = jax.random.PRNGKey(args.seed + 1)
     batch = make_batch(key)
@@ -148,7 +161,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         params, opt_state, loss = step(params, opt_state, *batch)
         result = gate.maybe_release(loss)
         steps_done += 1
+        if (
+            args.checkpoint_dir
+            and steps_done % max(1, args.checkpoint_every) == 0
+        ):
+            # return the lease BEFORE the drain + disk write: holding it
+            # would starve co-located pods and bill checkpoint I/O as
+            # device time
+            result = gate.flush(result)
+            jax.block_until_ready(loss)
+            save_checkpoint(
+                args.checkpoint_dir, start_step + steps_done, params, opt_state
+            )
     gate.flush(result)
+    if args.checkpoint_dir and steps_done:
+        jax.block_until_ready(loss)
+        save_checkpoint(
+            args.checkpoint_dir, start_step + steps_done, params, opt_state
+        )
     jax.block_until_ready(loss)  # async dispatch must not inflate throughput
     elapsed = time.perf_counter() - started
     gate.close()

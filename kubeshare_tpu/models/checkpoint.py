@@ -1,0 +1,99 @@
+"""Workload checkpoint/resume (orbax-backed).
+
+The reference leaves checkpointing entirely to the app containers
+(SURVEY.md §5: TorchElastic inside test/distribute; the framework only
+reconstructs *scheduler* state from annotations). A TPU-shared cluster
+makes workload checkpointing first-class: fractional pods are the first
+to be preempted/rescheduled, so every model in models/ can save and
+resume its (params, opt_state, step) triple with two calls.
+
+Orbax handles atomic writes and sharded arrays (a pytree saved under a
+Mesh restores with its shardings), so the same API serves single-chip
+workloads and dp/fsdp/tp training.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(directory: str, step: int, params: Any,
+                    opt_state: Any = None, keep: int = 3) -> str:
+    """Write ``<directory>/step_<n>`` atomically; prune to ``keep``
+    newest. Returns the checkpoint path."""
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"step_{step:010d}")
+    payload: Dict[str, Any] = {"step": step, "params": params}
+    if opt_state is not None:
+        payload["opt_state"] = opt_state
+    _checkpointer().save(path, payload, force=True)
+    for stale in sorted(_list_steps(directory))[:-keep]:
+        _rmtree(os.path.join(directory, f"step_{stale:010d}"))
+    return path
+
+
+def latest_checkpoint(directory: str) -> Optional[int]:
+    steps = _list_steps(directory)
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(
+    directory: str,
+    params_template: Any = None,
+    opt_state_template: Any = None,
+    step: Optional[int] = None,
+) -> Optional[Tuple[int, Any, Any]]:
+    """Restore (step, params, opt_state) from ``step`` (default:
+    newest). None if the directory holds no checkpoint. Templates
+    (matching pytrees of arrays/ShapeDtypeStructs, possibly sharded)
+    guide dtype/sharding-correct restoration when given."""
+    directory = os.path.abspath(directory)
+    if step is None:
+        step = latest_checkpoint(directory)
+        if step is None:
+            return None
+    path = os.path.join(directory, f"step_{step:010d}")
+    if not os.path.isdir(path):
+        return None  # asked-for step was pruned or never written
+    target = None
+    if params_template is not None:
+        target = {"step": step, "params": params_template}
+        if opt_state_template is not None:
+            target["opt_state"] = opt_state_template
+    payload = _checkpointer().restore(path, item=target)
+    return (
+        int(payload["step"]),
+        payload["params"],
+        payload.get("opt_state"),
+    )
+
+
+def _list_steps(directory: str):
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    steps = []
+    for name in names:
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[5:]))
+            except ValueError:
+                continue
+    return steps
+
+
+def _rmtree(path: str) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)

@@ -132,3 +132,138 @@ def llama_loss(params, tokens, cfg: LlamaConfig) -> jnp.ndarray:
     """Next-token LM loss on a [B, T] batch."""
     logits = llama_apply(params, tokens[:, :-1], cfg)
     return cross_entropy_loss(logits, tokens[:, 1:])
+
+
+# ---- KV-cache inference (BASELINE config 5: fractional-chip serving) ----
+#
+# Static-shaped cache so the decode step compiles once: [layers, B, KvH,
+# max_seq, head_dim] k/v buffers plus a scalar length. Prefill writes the
+# prompt's keys/values in one batched pass; decode_step appends one
+# position via dynamic_update_slice and masks attention to cache[:len].
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, dtype=None):
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    hd = cfg.dim // cfg.num_heads
+    shape = (cfg.layers, batch, cfg.num_kv_heads, cfg.max_seq_len, hd)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        "length": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attend_cached(q, k_cache, v_cache, length, num_heads, num_kv_heads):
+    """q [B, H, Tq, D] against cache [B, KvH, S, D] masked to < length
+    (+ causal within the new Tq block)."""
+    groups = num_heads // num_kv_heads
+    batch, _, tq, hd = q.shape
+    max_s = k_cache.shape[2]
+    qg = q.reshape(batch, num_kv_heads, groups, tq, hd)
+    scores = jnp.einsum(
+        "bkgtd,bksd->bkgts", qg.astype(jnp.float32),
+        k_cache.astype(jnp.float32),
+    ) / (hd ** 0.5)
+    # position s is visible to query t (absolute pos length-tq+t) iff
+    # s <= that absolute position and s < length
+    positions = jnp.arange(max_s)[None, None, None, None, :]
+    q_abs = (length - tq + jnp.arange(tq))[None, None, None, :, None]
+    mask = positions <= q_abs
+    scores = jnp.where(mask, scores, -1e30)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,bksd->bkgtd", weights, v_cache.astype(jnp.float32)
+    )
+    return out.reshape(batch, num_heads, tq, hd)
+
+
+def llama_apply_cached(
+    params: Dict,
+    tokens: jnp.ndarray,
+    cache: Dict,
+    cfg: LlamaConfig = LlamaConfig(),
+) -> Tuple[jnp.ndarray, Dict]:
+    """Run [B, T] new tokens against (and update) the KV cache.
+
+    T == prompt length for prefill, T == 1 for decode; returns
+    (logits [B, T, vocab], updated cache). Compiles to a fixed shape
+    per T, so the serving loop is prefill once + decode-step jit.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    batch, seq = tokens.shape
+    hd = cfg.dim // cfg.num_heads
+    start = cache["length"]
+    positions = start + jnp.arange(seq)
+    x = params["embed"]["table"].astype(dtype)[tokens]
+    new_k, new_v = [], []
+    for i in range(cfg.layers):
+        layer = params[f"layer{i}"]
+        h = rmsnorm(layer["attn_norm"], x)
+        q = _matmul(h, layer["wq"], dtype).reshape(batch, seq, cfg.num_heads, hd)
+        k = _matmul(h, layer["wk"], dtype).reshape(batch, seq, cfg.num_kv_heads, hd)
+        v = _matmul(h, layer["wv"], dtype).reshape(batch, seq, cfg.num_kv_heads, hd)
+        q = jnp.swapaxes(q, 1, 2)
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(
+            cache["k"][i], k.astype(cache["k"].dtype), (0, 0, start, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            cache["v"][i], v.astype(cache["v"].dtype), (0, 0, start, 0)
+        )
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        out = _attend_cached(
+            q, k_cache, v_cache, start + seq, cfg.num_heads, cfg.num_kv_heads
+        ).astype(dtype)
+        out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)
+        x = x + _matmul(out, layer["wo"], dtype)
+
+        h = rmsnorm(layer["mlp_norm"], x)
+        gate = jax.nn.silu(_matmul(h, layer["w_gate"], dtype))
+        up = _matmul(h, layer["w_up"], dtype)
+        x = x + _matmul(gate * up, layer["w_down"], dtype)
+    x = rmsnorm(params["final_norm"], x)
+    logits = _matmul(x, params["lm_head"], dtype).astype(jnp.float32)
+    updated = {
+        "k": jnp.stack(new_k, axis=0),
+        "v": jnp.stack(new_v, axis=0),
+        "length": start + seq,
+    }
+    return logits, updated
+
+
+def llama_generate(
+    params: Dict,
+    prompt: jnp.ndarray,
+    steps: int,
+    cfg: LlamaConfig = LlamaConfig(),
+) -> jnp.ndarray:
+    """Greedy decode ``steps`` tokens after [B, T] prompt (one compiled
+    prefill + one compiled decode step iterated via lax.scan)."""
+    batch, prompt_len = prompt.shape
+    if prompt_len + steps > cfg.max_seq_len:
+        raise ValueError(
+            f"prompt {prompt_len} + steps {steps} exceeds max_seq_len "
+            f"{cfg.max_seq_len}"
+        )
+    if steps <= 0:
+        return jnp.zeros((batch, 0), prompt.dtype)
+    cache = init_kv_cache(cfg, batch)
+    logits, cache = llama_apply_cached(params, prompt, cache, cfg)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+    if steps == 1:
+        return first[:, None]
+
+    def body(carry, _):
+        token, cache = carry
+        logits, cache = llama_apply_cached(params, token[:, None], cache, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(token.dtype)
+        return (nxt, cache), nxt
+
+    (_, _), generated = jax.lax.scan(body, (first, cache), None,
+                                     length=steps - 1)
+    out = jnp.concatenate([first[None], generated], axis=0)
+    return jnp.swapaxes(out, 0, 1)  # [B, steps]

@@ -106,7 +106,16 @@ class TpuShareScheduler:
         if not node.healthy:
             self.tree.set_node_health(node.name, False)
             return
-        chips = self.inventory(node.name)
+        try:
+            chips = self.inventory(node.name)
+        except (OSError, ValueError) as e:
+            self.log.error("inventory for %s unavailable: %s", node.name, e)
+            chips = None
+        if chips is None:
+            # inventory source unreachable or not yet reporting this
+            # node: do NOT mark synced — _ensure_synced retries on the
+            # next Filter touching this node
+            return
         if chips:
             self.tree.bind_node(node.name, chips)
         else:

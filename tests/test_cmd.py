@@ -182,6 +182,30 @@ class TestSchedulerCli:
         assert all(d["status"] == "bound" for d in decisions)
         assert all(d["node"] == "node-a" for d in decisions)
 
+    def test_self_metrics_counters(self, tmp_path):
+        from kubeshare_tpu.cmd.scheduler import SchedulerMetrics
+        from kubeshare_tpu.cluster.snapshot import SnapshotCluster
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+        from kubeshare_tpu.cmd.scheduler import run_pass
+        import yaml as _yaml
+
+        state = tmp_path / "state.json"
+        state.write_text(json.dumps(snapshot_dict(
+            [shared_pod("p1"), shared_pod("big", request="9.0", limit="9.0")]
+        )))
+        cluster = SnapshotCluster(str(state))
+        engine = TpuShareScheduler(
+            _yaml.safe_load(TOPO_YAML), cluster
+        )
+        metrics = SchedulerMetrics()
+        run_pass(engine, cluster, None, metrics)
+        assert metrics.decisions["bound"] == 1
+        assert metrics.decisions["unschedulable"] == 1
+        assert metrics.passes == 1 and metrics.last_pass_pods == 2
+        text = metrics.render()
+        assert 'tpu_scheduler_decisions_total{status="bound"} 1' in text
+        assert "tpu_scheduler_passes_total 1" in text
+
     def test_unschedulable_reported(self, tmp_path):
         topo = tmp_path / "topo.yaml"
         topo.write_text(TOPO_YAML)
