@@ -96,18 +96,19 @@ class CapacityInventory:
 
     def __call__(self, node_name: str):
         now = self.clock()
-        if self._cache is None or now - self._fetched_at > self.ttl:
+        if now - self._fetched_at > self.ttl:
             from ..metrics.scrape import scrape_capacity
 
             try:
                 self._cache = scrape_capacity(self.url)
-                self._fetched_at = now
             except (OSError, ValueError) as e:
                 if self.log:
                     self.log.error("capacity scrape %s: %s", self.url, e)
+                # negative-cache the failure: without this, every node
+                # touched in the pass re-blocks on the connect timeout
                 self._cache = None
-                return None
-        return self._cache.get(node_name)
+            self._fetched_at = now
+        return None if self._cache is None else self._cache.get(node_name)
 
 
 class SchedulerMetrics:

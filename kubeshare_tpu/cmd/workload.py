@@ -140,15 +140,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             start_step, params, opt_state = restored
             log.info("resumed from step %d", start_step)
 
-    # warmup compile outside the gated loop
+    # warmup compile outside the gated loop; outputs are discarded so a
+    # restored (params, opt_state) enters the loop exactly as saved —
+    # keeping them would apply a phantom update the step counter never
+    # records, making resumed runs diverge from uninterrupted ones
     key = jax.random.PRNGKey(args.seed + 1)
     batch = make_batch(key)
-    params, opt_state, loss = step(params, opt_state, *batch)
+    _warm_params, _warm_opt, loss = step(params, opt_state, *batch)
     jax.block_until_ready(loss)
+    del _warm_params, _warm_opt
 
     log.info("workload %s batch=%d starting", args.model, args.batch)
     started = time.perf_counter()
     steps_done = 0
+    last_saved = -1
     result = None
     while True:
         if args.steps and steps_done >= args.steps:
@@ -173,8 +178,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             save_checkpoint(
                 args.checkpoint_dir, start_step + steps_done, params, opt_state
             )
+            last_saved = start_step + steps_done
     gate.flush(result)
-    if args.checkpoint_dir and steps_done:
+    if (
+        args.checkpoint_dir
+        and steps_done
+        and last_saved != start_step + steps_done
+    ):
         jax.block_until_ready(loss)
         save_checkpoint(
             args.checkpoint_dir, start_step + steps_done, params, opt_state

@@ -1,5 +1,6 @@
 from .client import TokenClient, NativeTokenClient, load_native_library
 from .hook import SharedChipGate, install_gate, current_gate
+from .interposer import enable as enable_pjrt_interposer
 
 __all__ = [
     "TokenClient",
@@ -8,4 +9,5 @@ __all__ = [
     "SharedChipGate",
     "install_gate",
     "current_gate",
+    "enable_pjrt_interposer",
 ]

@@ -1,0 +1,89 @@
+"""PJRT interposer wiring: point a workload's JAX at the shim plugin.
+
+The native half lives in ``runtime_native/pjrt_interposer.cc``:
+a shim PJRT plugin that dlopens the real libtpu (env
+``KUBESHARE_PJRT_REAL``), forwards the whole function table, and wraps
+Execute (compute-token lease) and buffer creation/destruction (HBM
+accounting) — the TPU analog of the reference's LD_PRELOAD CUDA hook
+(libgemhook.so.1, injected at pkg/scheduler/pod.go:446-449), sitting at
+the narrow waist all frameworks share instead of the CUDA driver API.
+
+This module is the in-pod glue: call :func:`enable` before the first
+``import jax`` (or rely on the injected env from the scheduler) and JAX
+loads the shim as its TPU plugin, unmodified.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional
+
+from ..scheduler import constants as C
+
+_BUILD_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "..", "runtime_native", "build"
+)
+
+
+def find_interposer() -> Optional[str]:
+    """Locate libpjrt_interposer.so (node hostPath first, then the
+    in-repo build for dev runs)."""
+    for candidate in (
+        os.path.join(C.LIBRARY_PATH, "libpjrt_interposer.so"),
+        os.path.join(_BUILD_DIR, "libpjrt_interposer.so"),
+    ):
+        if os.path.exists(candidate):
+            return os.path.abspath(candidate)
+    return None
+
+
+def find_real_libtpu() -> Optional[str]:
+    """Locate the real libtpu.so the shim should forward to."""
+    explicit = os.environ.get("KUBESHARE_PJRT_REAL")
+    if explicit and os.path.exists(explicit):
+        return explicit
+    try:
+        import libtpu
+
+        path = os.path.join(os.path.dirname(libtpu.__file__), "libtpu.so")
+        if os.path.exists(path):
+            return path
+    except ImportError:
+        pass
+    for pattern in (
+        "/usr/lib/python3*/site-packages/libtpu/libtpu.so",
+        "/opt/*/lib/python3*/site-packages/libtpu/libtpu.so",
+    ):
+        matches = glob.glob(pattern)
+        if matches:
+            return matches[0]
+    return None
+
+
+def enable(interposer_path: str = "", real_plugin: str = "") -> bool:
+    """Route JAX's TPU plugin loading through the interposer.
+
+    Must run before JAX initializes its backend. Sets:
+
+    - ``KUBESHARE_PJRT_REAL`` — the real libtpu for the shim to dlopen;
+    - ``TPU_LIBRARY_PATH`` — what JAX dlopens as "libtpu" (the shim).
+
+    Returns False (and changes nothing) when either library is missing,
+    so callers can fail open — a pod without the shim still runs, just
+    without driver-level isolation (the cooperative Python gate and the
+    premapped-HBM cap still apply).
+    """
+    shim = interposer_path or find_interposer()
+    real = real_plugin or find_real_libtpu()
+    if not shim or not real:
+        return False
+    os.environ["KUBESHARE_PJRT_REAL"] = real
+    os.environ["TPU_LIBRARY_PATH"] = shim
+    return True
+
+
+def enabled() -> bool:
+    return os.environ.get("TPU_LIBRARY_PATH", "").endswith(
+        "libpjrt_interposer.so"
+    )

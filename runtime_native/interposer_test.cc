@@ -1,0 +1,259 @@
+// Hermetic test for the PJRT interposer: dlopens the interposer with
+// KUBESHARE_PJRT_REAL pointed at the mock plugin and an in-process
+// token arbiter on a loopback port, then checks table passthrough,
+// Execute lease gating (acquire on first dispatch, drain + re-acquire
+// after quota expiry), and HBM accounting incl. RESOURCE_EXHAUSTED
+// denial and refund on Buffer_Destroy. Exits 0 on success.
+//
+// Usage: interposer_test <path/to/libpjrt_interposer.so> <path/to/libmock_pjrt.so>
+
+#include <dlfcn.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <sstream>
+#include <string>
+#include <thread>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+#include "arbiter.h"
+#include "proto.h"
+
+using namespace tpushare;
+
+#define CHECK(cond)                                                       \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+      std::exit(1);                                                       \
+    }                                                                     \
+  } while (0)
+
+namespace {
+
+std::atomic<int> g_acq{0};
+std::atomic<int> g_rel{0};
+std::atomic<int> g_mem{0};
+
+void serve_client(TokenArbiter* arb, int fd) {
+  std::string line;
+  while (read_line(fd, &line)) {
+    std::istringstream in(line);
+    std::string cmd, pod;
+    in >> cmd >> pod;
+    if (cmd == "ACQ") {
+      double quota = arb->acquire(pod);
+      g_acq++;
+      char out[64];
+      std::snprintf(out, sizeof(out), "TOK %.3f", quota);
+      if (!write_all(fd, out)) break;
+    } else if (cmd == "REL") {
+      double used = 0;
+      in >> used;
+      arb->release(pod, used);
+      g_rel++;
+      if (!write_all(fd, "OK")) break;
+    } else if (cmd == "MEM") {
+      long long delta = 0, used = 0, cap = 0;
+      in >> delta;
+      bool ok = arb->mem(pod, delta, &used, &cap);
+      g_mem++;
+      char out[96];
+      std::snprintf(out, sizeof(out), "%s %lld %lld", ok ? "OK" : "DENY",
+                    used, cap);
+      if (!write_all(fd, out)) break;
+    } else {
+      if (!write_all(fd, "ERR")) break;
+    }
+  }
+  ::close(fd);
+}
+
+PJRT_Error* call_execute(const PJRT_Api* api, PJRT_Event** events,
+                         size_t num_devices = 1) {
+  PJRT_LoadedExecutable_Execute_Args args{};
+  args.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  args.executable = nullptr;  // mock ignores it
+  args.num_devices = num_devices;
+  args.num_args = 0;
+  args.device_complete_events = events;
+  return api->PJRT_LoadedExecutable_Execute(&args);
+}
+
+PJRT_Error* alloc_buffer(const PJRT_Api* api, int64_t n_floats,
+                         PJRT_Buffer** out) {
+  static int64_t dims[1];
+  dims[0] = n_floats;
+  PJRT_Client_BufferFromHostBuffer_Args args{};
+  args.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  args.type = PJRT_Buffer_Type_F32;
+  args.dims = dims;
+  args.num_dims = 1;
+  PJRT_Error* err = api->PJRT_Client_BufferFromHostBuffer(&args);
+  if (err == nullptr) {
+    *out = args.buffer;
+    // mirror real callers: release the done_with_host_buffer event
+    PJRT_Event_Destroy_Args ed{};
+    ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    ed.event = args.done_with_host_buffer;
+    api->PJRT_Event_Destroy(&ed);
+  }
+  return err;
+}
+
+void destroy_buffer(const PJRT_Api* api, PJRT_Buffer* buf) {
+  PJRT_Buffer_Destroy_Args args{};
+  args.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  args.buffer = buf;
+  CHECK(api->PJRT_Buffer_Destroy(&args) == nullptr);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    std::fprintf(stderr, "usage: %s <interposer.so> <mock.so>\n", argv[0]);
+    return 2;
+  }
+
+  // ---- in-process token server on an ephemeral port ----------------
+  // quota 30ms leases over a 1s window; pod capped at 4096 HBM bytes
+  TokenArbiter arbiter(/*base_quota_ms=*/30, /*min_quota_ms=*/5,
+                       /*window_ms=*/1000);
+  std::map<std::string, PodQuota> quotas;
+  quotas["test/p1"] = PodQuota{1.0, 0.5, 4096};
+  arbiter.set_quotas(quotas);
+
+  int listener = tcp_listen("127.0.0.1", 0);
+  CHECK(listener >= 0);
+  sockaddr_in addr{};
+  socklen_t alen = sizeof(addr);
+  CHECK(::getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &alen) ==
+        0);
+  int port = ntohs(addr.sin_port);
+  std::thread([&arbiter, listener] {
+    for (;;) {
+      int fd = ::accept(listener, nullptr, nullptr);
+      if (fd < 0) return;
+      std::thread(serve_client, &arbiter, fd).detach();
+    }
+  }).detach();
+
+  // ---- load the interposer over the mock ---------------------------
+  setenv("KUBESHARE_PJRT_REAL", argv[2], 1);
+  setenv("KUBESHARE_POD_MANAGER_PORT", std::to_string(port).c_str(), 1);
+  setenv("KUBESHARE_POD_NAME", "test/p1", 1);
+  setenv("MOCK_PJRT_EXEC_MS", "2", 1);
+
+  void* handle = dlopen(argv[1], RTLD_NOW | RTLD_LOCAL);
+  if (!handle) {
+    std::fprintf(stderr, "dlopen(%s): %s\n", argv[1], dlerror());
+    return 2;
+  }
+  using GetApiFn = const PJRT_Api* (*)();
+  GetApiFn get_api = reinterpret_cast<GetApiFn>(dlsym(handle, "GetPjrtApi"));
+  CHECK(get_api != nullptr);
+  const PJRT_Api* api = get_api();
+  CHECK(api != nullptr);
+
+  void* mock_handle = dlopen(argv[2], RTLD_NOW | RTLD_LOCAL);
+  CHECK(mock_handle != nullptr);
+  auto mock_execute_count =
+      reinterpret_cast<int (*)()>(dlsym(mock_handle, "mock_execute_count"));
+  auto mock_buffer_count =
+      reinterpret_cast<int (*)()>(dlsym(mock_handle, "mock_buffer_count"));
+  CHECK(mock_execute_count != nullptr && mock_buffer_count != nullptr);
+
+  // ---- passthrough of unwrapped entries ----------------------------
+  CHECK(api->pjrt_api_version.major_version == PJRT_API_MAJOR);
+  {
+    PJRT_Client_PlatformName_Args args{};
+    args.struct_size = PJRT_Client_PlatformName_Args_STRUCT_SIZE;
+    CHECK(api->PJRT_Client_PlatformName(&args) == nullptr);
+    CHECK(std::string(args.platform_name, args.platform_name_size) == "mock");
+  }
+
+  // ---- Execute gating: one lease covers a burst --------------------
+  for (int i = 0; i < 5; ++i) {
+    CHECK(call_execute(api, nullptr) == nullptr);
+  }
+  CHECK(mock_execute_count() == 5);
+  CHECK(g_acq.load() == 1);  // amortized: one lease for the whole burst
+  CHECK(g_rel.load() == 0);
+
+  // quota expiry: next Execute drains in-flight work, releases with
+  // measured usage, and re-acquires
+  std::this_thread::sleep_for(std::chrono::milliseconds(40));
+  CHECK(call_execute(api, nullptr) == nullptr);
+  CHECK(g_rel.load() == 1);
+  CHECK(g_acq.load() == 2);
+  CHECK(arbiter.stats().at(0).window_usage_ms > 0.0);
+
+  // ---- caller-provided completion events pass through --------------
+  {
+    PJRT_Event* events[1] = {nullptr};
+    CHECK(call_execute(api, events) == nullptr);
+    CHECK(events[0] != nullptr);
+    std::atomic<bool> fired{false};
+    PJRT_Event_OnReady_Args oa{};
+    oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+    oa.event = events[0];
+    oa.user_arg = &fired;
+    oa.callback = [](PJRT_Error* err, void* arg) {
+      CHECK(err == nullptr);
+      static_cast<std::atomic<bool>*>(arg)->store(true);
+    };
+    CHECK(api->PJRT_Event_OnReady(&oa) == nullptr);
+    for (int i = 0; i < 200 && !fired.load(); ++i) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+    CHECK(fired.load());
+    PJRT_Event_Destroy_Args ed{};
+    ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    ed.event = events[0];
+    CHECK(api->PJRT_Event_Destroy(&ed) == nullptr);
+  }
+
+  // ---- HBM accounting ----------------------------------------------
+  PJRT_Buffer *b1 = nullptr, *b2 = nullptr, *b3 = nullptr;
+  CHECK(alloc_buffer(api, 512, &b1) == nullptr);  // 2048 bytes
+  CHECK(alloc_buffer(api, 512, &b2) == nullptr);  // 4096 total == cap
+  PJRT_Error* deny = alloc_buffer(api, 512, &b3);
+  CHECK(deny != nullptr);  // over cap
+  {
+    PJRT_Error_GetCode_Args gc{};
+    gc.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+    gc.error = deny;
+    CHECK(api->PJRT_Error_GetCode(&gc) == nullptr);
+    CHECK(gc.code == PJRT_Error_Code_RESOURCE_EXHAUSTED);
+    PJRT_Error_Message_Args msg{};
+    msg.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    msg.error = deny;
+    api->PJRT_Error_Message(&msg);
+    CHECK(std::string(msg.message, msg.message_size).find("HBM cap") !=
+          std::string::npos);
+    PJRT_Error_Destroy_Args ed{};
+    ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    ed.error = deny;
+    api->PJRT_Error_Destroy(&ed);
+  }
+  // freeing refunds the accounting; the next allocation fits again
+  destroy_buffer(api, b1);
+  CHECK(alloc_buffer(api, 512, &b3) == nullptr);
+  destroy_buffer(api, b2);
+  destroy_buffer(api, b3);
+  CHECK(mock_buffer_count() == 0);
+
+  // ---- final drain: lease returns cleanly --------------------------
+  std::this_thread::sleep_for(std::chrono::milliseconds(40));
+  CHECK(call_execute(api, nullptr) == nullptr);
+  std::this_thread::sleep_for(std::chrono::milliseconds(10));
+
+  std::printf("interposer_test: all checks passed (acq=%d rel=%d mem=%d)\n",
+              g_acq.load(), g_rel.load(), g_mem.load());
+  return 0;
+}

@@ -1,0 +1,199 @@
+// Mock PJRT plugin: hermetic test double for the interposer.
+//
+// Implements just enough of the PJRT C API for interposer_test to
+// exercise the wrapped entry points without a device: Execute completes
+// its device_complete_events asynchronously on a worker thread after a
+// configurable delay (MOCK_PJRT_EXEC_MS env, default 2), so the
+// interposer's in-flight tracking and drain-on-quota-expiry paths run
+// for real. Counters are exported with C linkage so the test can
+// observe passthrough (mock_execute_count) across the dlopened
+// boundary.
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct MockError {
+  PJRT_Error_Code code;
+  std::string message;
+};
+
+struct MockEvent {
+  std::mutex mu;
+  bool ready = false;
+  std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> callbacks;
+};
+
+struct MockBuffer {
+  size_t bytes;
+};
+
+std::atomic<int> g_execute_count{0};
+std::atomic<int> g_buffer_count{0};
+std::atomic<int> g_live_events{0};
+
+void complete_event(MockEvent* ev) {
+  std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> cbs;
+  {
+    std::lock_guard<std::mutex> lock(ev->mu);
+    ev->ready = true;
+    cbs.swap(ev->callbacks);
+  }
+  for (auto& cb : cbs) cb.first(nullptr, cb.second);
+}
+
+MockEvent* make_ready_event() {
+  MockEvent* ev = new MockEvent;
+  ev->ready = true;
+  g_live_events++;
+  return ev;
+}
+
+void Mock_Error_Destroy(PJRT_Error_Destroy_Args* args) {
+  delete reinterpret_cast<MockError*>(args->error);
+}
+
+void Mock_Error_Message(PJRT_Error_Message_Args* args) {
+  MockError* e =
+      reinterpret_cast<MockError*>(const_cast<PJRT_Error*>(args->error));
+  args->message = e->message.c_str();
+  args->message_size = e->message.size();
+}
+
+PJRT_Error* Mock_Error_GetCode(PJRT_Error_GetCode_Args* args) {
+  args->code =
+      reinterpret_cast<MockError*>(const_cast<PJRT_Error*>(args->error))
+          ->code;
+  return nullptr;
+}
+
+PJRT_Error* Mock_Plugin_Initialize(PJRT_Plugin_Initialize_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* Mock_Event_Destroy(PJRT_Event_Destroy_Args* args) {
+  delete reinterpret_cast<MockEvent*>(args->event);
+  g_live_events--;
+  return nullptr;
+}
+
+PJRT_Error* Mock_Event_IsReady(PJRT_Event_IsReady_Args* args) {
+  MockEvent* ev = reinterpret_cast<MockEvent*>(args->event);
+  std::lock_guard<std::mutex> lock(ev->mu);
+  args->is_ready = ev->ready;
+  return nullptr;
+}
+
+PJRT_Error* Mock_Event_OnReady(PJRT_Event_OnReady_Args* args) {
+  MockEvent* ev = reinterpret_cast<MockEvent*>(args->event);
+  bool run_now = false;
+  {
+    std::lock_guard<std::mutex> lock(ev->mu);
+    if (ev->ready) {
+      run_now = true;
+    } else {
+      ev->callbacks.emplace_back(args->callback, args->user_arg);
+    }
+  }
+  if (run_now) args->callback(nullptr, args->user_arg);
+  return nullptr;
+}
+
+PJRT_Error* Mock_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  g_execute_count++;
+  int delay_ms = 2;
+  if (const char* d = std::getenv("MOCK_PJRT_EXEC_MS")) {
+    delay_ms = std::atoi(d);
+  }
+  if (args->device_complete_events != nullptr) {
+    for (size_t i = 0; i < args->num_devices; ++i) {
+      MockEvent* ev = new MockEvent;
+      g_live_events++;
+      args->device_complete_events[i] = reinterpret_cast<PJRT_Event*>(ev);
+      std::thread([ev, delay_ms] {
+        std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+        complete_event(ev);
+      }).detach();
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* Mock_BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  size_t bytes = 4;  // mock dtypes are all 4 bytes wide
+  for (size_t i = 0; i < args->num_dims; ++i) {
+    bytes *= static_cast<size_t>(args->dims[i]);
+  }
+  MockBuffer* buf = new MockBuffer{bytes};
+  g_buffer_count++;
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(buf);
+  args->done_with_host_buffer =
+      reinterpret_cast<PJRT_Event*>(make_ready_event());
+  return nullptr;
+}
+
+PJRT_Error* Mock_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
+  if (args->buffer != nullptr) {
+    delete reinterpret_cast<MockBuffer*>(args->buffer);
+    g_buffer_count--;
+  }
+  return nullptr;
+}
+
+PJRT_Error* Mock_Buffer_OnDeviceSizeInBytes(
+    PJRT_Buffer_OnDeviceSizeInBytes_Args* args) {
+  args->on_device_size_in_bytes =
+      reinterpret_cast<MockBuffer*>(args->buffer)->bytes;
+  return nullptr;
+}
+
+PJRT_Error* Mock_Client_PlatformName(PJRT_Client_PlatformName_Args* args) {
+  static const char kName[] = "mock";
+  args->platform_name = kName;
+  args->platform_name_size = sizeof(kName) - 1;
+  return nullptr;
+}
+
+PJRT_Api g_api = [] {
+  PJRT_Api api{};
+  api.struct_size = sizeof(PJRT_Api);
+  api.pjrt_api_version.struct_size = sizeof(PJRT_Api_Version);
+  api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  api.PJRT_Error_Destroy = Mock_Error_Destroy;
+  api.PJRT_Error_Message = Mock_Error_Message;
+  api.PJRT_Error_GetCode = Mock_Error_GetCode;
+  api.PJRT_Plugin_Initialize = Mock_Plugin_Initialize;
+  api.PJRT_Event_Destroy = Mock_Event_Destroy;
+  api.PJRT_Event_IsReady = Mock_Event_IsReady;
+  api.PJRT_Event_OnReady = Mock_Event_OnReady;
+  api.PJRT_LoadedExecutable_Execute = Mock_Execute;
+  api.PJRT_Client_BufferFromHostBuffer = Mock_BufferFromHostBuffer;
+  api.PJRT_Buffer_Destroy = Mock_Buffer_Destroy;
+  api.PJRT_Buffer_OnDeviceSizeInBytes = Mock_Buffer_OnDeviceSizeInBytes;
+  api.PJRT_Client_PlatformName = Mock_Client_PlatformName;
+  return api;
+}();
+
+}  // namespace
+
+extern "C" {
+
+const PJRT_Api* GetPjrtApi() { return &g_api; }
+
+int mock_execute_count() { return g_execute_count.load(); }
+int mock_buffer_count() { return g_buffer_count.load(); }
+int mock_live_events() { return g_live_events.load(); }
+
+}  // extern "C"

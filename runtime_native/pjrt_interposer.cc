@@ -1,0 +1,530 @@
+// PJRT C-API interposer: driver-level isolation for shared TPU chips.
+//
+// The reference enforces sharing by LD_PRELOADing a CUDA interposer
+// (libgemhook.so.1) under unmodified apps (SURVEY.md §2.5). The TPU
+// equivalent of "the narrow waist every framework calls" is the PJRT
+// C API: JAX, PyTorch/XLA and TF all drive libtpu through one
+// GetPjrtApi() function table. This library is a shim PJRT plugin —
+// point the framework at it instead of libtpu
+// (PJRT_NAMES_AND_LIBRARY_PATHS / TPU_LIBRARY_PATH) and it dlopens the
+// real plugin (env KUBESHARE_PJRT_REAL), forwards the full table, and
+// wraps exactly four entry points:
+//
+//   PJRT_LoadedExecutable_Execute    - compute-token gating (amortized
+//                                      lease; see below)
+//   PJRT_Client_BufferFromHostBuffer - HBM accounting (+bytes)
+//   PJRT_Buffer_Destroy              - HBM accounting (-bytes)
+//   PJRT_Error_{Message,GetCode,Destroy} - so fabricated
+//                                      RESOURCE_EXHAUSTED errors from a
+//                                      denied allocation round-trip
+//                                      through caller error handling
+//
+// Lease semantics match the Python gate (kubeshare_tpu/runtime/hook.py)
+// so either layer can enforce the same contract: a token is acquired on
+// first dispatch and covers every Execute until its quota's wall-clock
+// expires; at expiry the gate drains in-flight executions (tracked via
+// device_complete_events completion callbacks — real device occupancy,
+// not host time) before releasing, so released usage is honest and XLA
+// pipelining inside a quota window is untouched. Unlike the Python
+// gate this works under ANY PJRT framework with no app cooperation.
+//
+// Token server: tpu-pmgr at KUBESHARE_POD_MANAGER_PORT (same ACQ/REL/
+// MEM line protocol, proto.h). No server / no env -> transparent
+// passthrough (fail open: isolation must never take the workload down).
+//
+// HBM caps: allocations past the arbiter's per-pod cap are denied with
+// a fabricated RESOURCE_EXHAUSTED PJRT_Error (the reference's memory
+// cap likewise surfaces as a failed cudaMalloc). Set
+// KUBESHARE_HBM_SOFT=1 to log-and-allow instead. Execute scratch/output
+// allocations are not tracked here; the premapped-pool cap applied by
+// apply_hbm_env_cap() remains the hard backstop.
+
+#include <dlfcn.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdarg>
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+#include "proto.h"
+
+namespace {
+
+using tpushare::read_line;
+using tpushare::tcp_connect;
+using tpushare::write_all;
+
+double now_ms() {
+  using namespace std::chrono;
+  return duration<double, std::milli>(steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void logf(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  std::fprintf(stderr, "[pjrt-interposer] ");
+  std::vfprintf(stderr, fmt, ap);
+  std::fprintf(stderr, "\n");
+  va_end(ap);
+}
+
+// ---- fabricated errors ------------------------------------------------
+//
+// PJRT_Error is opaque to callers; every call that consumes one
+// (Message/GetCode/Destroy) goes through the table we control, so we
+// can mint our own, tagged with a magic cookie, and forward everything
+// else to the real plugin.
+
+constexpr uint64_t kErrMagic = 0x6b756265734e5250ULL;  // "kubesNRP"
+
+struct FabError {
+  uint64_t magic = kErrMagic;
+  PJRT_Error_Code code;
+  std::string message;
+};
+
+FabError* as_fab(PJRT_Error* e) {
+  if (e == nullptr) return nullptr;
+  FabError* f = reinterpret_cast<FabError*>(e);
+  // Reading 8 bytes from a real plugin error is safe: every real
+  // PJRT_Error is a heap object at least a pointer wide; the magic
+  // makes a false positive astronomically unlikely.
+  return f->magic == kErrMagic ? f : nullptr;
+}
+
+PJRT_Error* make_error(PJRT_Error_Code code, std::string msg) {
+  FabError* f = new FabError;
+  f->code = code;
+  f->message = std::move(msg);
+  return reinterpret_cast<PJRT_Error*>(f);
+}
+
+// ---- gate state -------------------------------------------------------
+
+struct Gate {
+  const PJRT_Api* real = nullptr;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  int fd = -1;              // token server connection (-1 = passthrough)
+  bool warned = false;
+  std::string pod = "-";
+
+  bool held = false;        // compute lease
+  double lease_start = 0.0;
+  double quota_ms = 0.0;
+  int inflight = 0;         // executions dispatched under the lease
+  double last_complete = 0.0;
+
+  bool hbm_soft = false;
+  // bytes the server actually accepted per buffer — refunds on destroy
+  // must never exceed what was charged, or a denied-but-kept (soft
+  // mode) buffer would erase another buffer's legitimate accounting
+  std::unordered_map<PJRT_Buffer*, long long> charged_bytes;
+  std::vector<PJRT_Event*> event_graveyard;  // deferred Event_Destroy
+
+  bool roundtrip(const std::string& line, std::string* reply) {
+    if (fd < 0) return false;
+    if (write_all(fd, line) && read_line(fd, reply)) return true;
+    ::close(fd);
+    fd = -1;
+    if (!warned) {
+      warned = true;
+      logf("token server lost; failing open (no isolation)");
+    }
+    return false;
+  }
+};
+
+Gate g;
+
+void connect_token_server() {
+  const char* port = std::getenv("KUBESHARE_POD_MANAGER_PORT");
+  if (!port || !*port || std::atoi(port) == 0) return;
+  const char* host = std::getenv("KUBESHARE_POD_MANAGER_IP");
+  g.fd = tcp_connect(host && *host ? host : "127.0.0.1", std::atoi(port));
+  if (g.fd < 0) {
+    logf("cannot reach token server on port %s; failing open", port);
+    return;
+  }
+  const char* pod = std::getenv("KUBESHARE_POD_NAME");
+  g.pod = pod && *pod ? pod : "-";
+  const char* soft = std::getenv("KUBESHARE_HBM_SOFT");
+  g.hbm_soft = soft && *soft && std::strcmp(soft, "0") != 0;
+}
+
+// Drain the event graveyard. Caller holds g.mu.
+void reap_events_locked() {
+  for (PJRT_Event* ev : g.event_graveyard) {
+    PJRT_Event_Destroy_Args d{};
+    d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+    d.event = ev;
+    if (PJRT_Error* e = g.real->PJRT_Event_Destroy(&d)) {
+      PJRT_Error_Destroy_Args ed{};
+      ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      ed.error = e;
+      g.real->PJRT_Error_Destroy(&ed);
+    }
+  }
+  g.event_graveyard.clear();
+}
+
+// Release the lease if its quota has expired, draining in-flight work
+// first so reported usage covers real device occupancy. Caller holds
+// the lock via `lock`.
+void maybe_release_locked(std::unique_lock<std::mutex>& lock) {
+  if (!g.held || now_ms() - g.lease_start < g.quota_ms) return;
+  g.cv.wait(lock, [] { return g.inflight == 0; });
+  double used = std::max(g.last_complete, g.lease_start) - g.lease_start;
+  g.held = false;
+  std::string reply;
+  char line[256];
+  std::snprintf(line, sizeof(line), "REL %s %.3f", g.pod.c_str(), used);
+  g.roundtrip(line, &reply);
+}
+
+void acquire_locked() {
+  if (g.held || g.fd < 0) return;
+  char line[256];
+  std::snprintf(line, sizeof(line), "ACQ %s 0", g.pod.c_str());
+  std::string reply;
+  if (!g.roundtrip(line, &reply)) return;  // fail open
+  double quota = 0.0;
+  if (std::sscanf(reply.c_str(), "TOK %lf", &quota) != 1) return;
+  g.held = true;
+  g.quota_ms = quota;
+  g.lease_start = now_ms();
+  g.last_complete = g.lease_start;
+}
+
+// ---- completion tracking ---------------------------------------------
+
+struct CompletionCtx {
+  PJRT_Event* event;
+  bool owned;  // we created the array slot; destroy the event when done
+};
+
+void on_execute_complete(PJRT_Error* error, void* user_arg) {
+  CompletionCtx* ctx = static_cast<CompletionCtx*>(user_arg);
+  if (error != nullptr) {
+    PJRT_Error_Destroy_Args ed{};
+    ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    ed.error = error;
+    g.real->PJRT_Error_Destroy(&ed);
+  }
+  std::lock_guard<std::mutex> lock(g.mu);
+  g.inflight--;
+  g.last_complete = now_ms();
+  if (ctx->owned) {
+    // Destroying an event from inside its own OnReady callback is
+    // implementation-defined; defer to the next Execute entry.
+    g.event_graveyard.push_back(ctx->event);
+  }
+  g.cv.notify_all();
+  delete ctx;
+}
+
+// ---- wrapped entry points --------------------------------------------
+
+PJRT_Error* Wrapped_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  bool gating = false;
+  {
+    std::unique_lock<std::mutex> lock(g.mu);
+    reap_events_locked();
+    maybe_release_locked(lock);
+    acquire_locked();
+    // Capture the gating decision under the lock (fd can drop to -1 if
+    // the server connection dies mid-acquire) and count the execution
+    // in-flight BEFORE dispatching: a concurrent thread hitting quota
+    // expiry must drain this execution, not release the lease while
+    // our work occupies the device.
+    gating = g.held;
+    if (gating) g.inflight += static_cast<int>(args->num_devices);
+  }
+
+  bool caller_events = args->device_complete_events != nullptr;
+  std::vector<PJRT_Event*> our_events;
+  if (!caller_events && gating && args->num_devices > 0) {
+    our_events.resize(args->num_devices, nullptr);
+    args->device_complete_events = our_events.data();
+  }
+
+  PJRT_Error* err = g.real->PJRT_LoadedExecutable_Execute(args);
+
+  if (gating && (err != nullptr || args->device_complete_events == nullptr)) {
+    // dispatch failed (or produced no completion signal): nothing will
+    // fire callbacks, so un-count what we pre-counted
+    std::lock_guard<std::mutex> lock(g.mu);
+    g.inflight -= static_cast<int>(args->num_devices);
+    g.cv.notify_all();
+  } else if (gating && err == nullptr) {
+    for (size_t i = 0; i < args->num_devices; ++i) {
+      PJRT_Event* ev = args->device_complete_events[i];
+      if (ev == nullptr) {
+        std::lock_guard<std::mutex> lock(g.mu);
+        g.inflight--;
+        g.cv.notify_all();
+        continue;
+      }
+      CompletionCtx* ctx = new CompletionCtx{ev, !caller_events};
+      PJRT_Event_OnReady_Args oa{};
+      oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+      oa.event = ev;
+      oa.callback = on_execute_complete;
+      oa.user_arg = ctx;
+      if (PJRT_Error* oe = g.real->PJRT_Event_OnReady(&oa)) {
+        PJRT_Error_Destroy_Args ed{};
+        ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+        ed.error = oe;
+        g.real->PJRT_Error_Destroy(&ed);
+        std::lock_guard<std::mutex> lock(g.mu);
+        g.inflight--;
+        g.cv.notify_all();
+        delete ctx;
+      }
+    }
+  }
+  if (!caller_events) args->device_complete_events = nullptr;
+  return err;
+}
+
+size_t dtype_bytes(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+    case PJRT_Buffer_Type_F8E5M2:
+    case PJRT_Buffer_Type_F8E4M3FN:
+      return 1;
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_BF16:
+      return 2;
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_C64:
+      return 8;
+    case PJRT_Buffer_Type_C128:
+      return 16;
+    default:
+      return 4;  // S32/U32/F32 and a conservative default
+  }
+}
+
+// Charge `delta` to the server. Returns +delta if accepted, 0 if the
+// server denied (the arbiter does NOT record denied deltas) or the
+// connection is down. Caller holds g.mu.
+long long charge_locked(long long delta) {
+  if (g.fd < 0 || delta == 0) return 0;
+  char line[256];
+  std::snprintf(line, sizeof(line), "MEM %s %lld", g.pod.c_str(), delta);
+  std::string reply;
+  if (!g.roundtrip(line, &reply)) return 0;
+  if (reply.rfind("DENY", 0) == 0) return 0;
+  return delta;
+}
+
+PJRT_Error* Wrapped_BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  {
+    // passthrough mode: no server, no accounting, no extra size query
+    std::lock_guard<std::mutex> fast(g.mu);
+    if (g.fd < 0) {
+      return g.real->PJRT_Client_BufferFromHostBuffer(args);
+    }
+  }
+  long long host_bytes = static_cast<long long>(dtype_bytes(args->type));
+  for (size_t i = 0; i < args->num_dims; ++i) host_bytes *= args->dims[i];
+
+  long long charged = 0;
+  {
+    std::unique_lock<std::mutex> lock(g.mu);
+    if (g.fd >= 0 && host_bytes > 0) {
+      charged = charge_locked(host_bytes);
+      if (charged == 0 && g.fd >= 0) {  // denied (not a dead connection)
+        if (!g.hbm_soft) {
+          return make_error(
+              PJRT_Error_Code_RESOURCE_EXHAUSTED,
+              "kubeshare: HBM cap exceeded for pod " + g.pod + " (+" +
+                  std::to_string(host_bytes) + " bytes requested)");
+        }
+        logf("HBM cap exceeded (soft mode): pod %s +%lld bytes",
+             g.pod.c_str(), host_bytes);
+      }
+    }
+  }
+
+  PJRT_Error* err = g.real->PJRT_Client_BufferFromHostBuffer(args);
+  std::unique_lock<std::mutex> lock(g.mu);
+  if (err == nullptr && args->buffer != nullptr && charged > 0) {
+    // On-device size can differ from the host size (padding/tiling);
+    // charge the difference when the plugin reports one.
+    PJRT_Buffer_OnDeviceSizeInBytes_Args sa{};
+    sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+    sa.buffer = args->buffer;
+    long long device_bytes = host_bytes;
+    if (PJRT_Error* se = g.real->PJRT_Buffer_OnDeviceSizeInBytes(&sa)) {
+      PJRT_Error_Destroy_Args ed{};
+      ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      ed.error = se;
+      g.real->PJRT_Error_Destroy(&ed);
+    } else if (sa.on_device_size_in_bytes > 0) {
+      device_bytes = static_cast<long long>(sa.on_device_size_in_bytes);
+    }
+    if (charged > 0 && device_bytes > host_bytes) {
+      long long extra = charge_locked(device_bytes - host_bytes);
+      if (extra == 0 && g.fd >= 0 && !g.hbm_soft) {
+        // padding pushed the buffer over the cap: enforce it — undo
+        // the allocation and refund what we did charge
+        charge_locked(-charged);
+        PJRT_Buffer* buf = args->buffer;
+        args->buffer = nullptr;
+        lock.unlock();
+        PJRT_Buffer_Destroy_Args bd{};
+        bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+        bd.buffer = buf;
+        if (PJRT_Error* de = g.real->PJRT_Buffer_Destroy(&bd)) {
+          PJRT_Error_Destroy_Args ed{};
+          ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+          ed.error = de;
+          g.real->PJRT_Error_Destroy(&ed);
+        }
+        return make_error(
+            PJRT_Error_Code_RESOURCE_EXHAUSTED,
+            "kubeshare: HBM cap exceeded for pod " + g.pod +
+                " (on-device size " + std::to_string(device_bytes) + ")");
+      }
+      charged += extra;
+    }
+    g.charged_bytes[args->buffer] = charged;
+  } else if (charged > 0) {
+    // allocation failed downstream: refund the accounting
+    charge_locked(-charged);
+  }
+  return err;
+}
+
+PJRT_Error* Wrapped_BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
+  {
+    std::unique_lock<std::mutex> lock(g.mu);
+    auto it = g.charged_bytes.find(args->buffer);
+    if (it != g.charged_bytes.end()) {
+      // refund exactly what the server accepted, never the raw size
+      charge_locked(-it->second);
+      g.charged_bytes.erase(it);
+    }
+  }
+  return g.real->PJRT_Buffer_Destroy(args);
+}
+
+void Wrapped_ErrorDestroy(PJRT_Error_Destroy_Args* args) {
+  if (FabError* f = as_fab(args->error)) {
+    delete f;
+    args->error = nullptr;
+    return;
+  }
+  g.real->PJRT_Error_Destroy(args);
+}
+
+void Wrapped_ErrorMessage(PJRT_Error_Message_Args* args) {
+  if (FabError* f = as_fab(const_cast<PJRT_Error*>(args->error))) {
+    args->message = f->message.c_str();
+    args->message_size = f->message.size();
+    return;
+  }
+  g.real->PJRT_Error_Message(args);
+}
+
+PJRT_Error* Wrapped_ErrorGetCode(PJRT_Error_GetCode_Args* args) {
+  if (FabError* f = as_fab(const_cast<PJRT_Error*>(args->error))) {
+    args->code = f->code;
+    return nullptr;
+  }
+  return g.real->PJRT_Error_GetCode(args);
+}
+
+// ---- table assembly ---------------------------------------------------
+
+// The wrapped table lives in a byte buffer sized to the REAL plugin's
+// struct_size: a plugin newer than our compiled header keeps its extra
+// trailing entries intact (we forward them untouched), and field
+// offsets for the entries we override are ABI-stable (PJRT never
+// reorders or removes fields).
+std::vector<char> wrapped_storage;
+
+template <typename F>
+void override_field(F* field_in_copy, F replacement) {
+  size_t offset = reinterpret_cast<char*>(field_in_copy) -
+                  reinterpret_cast<char*>(wrapped_storage.data());
+  if (offset + sizeof(F) <= wrapped_storage.size()) {
+    *field_in_copy = replacement;
+  }
+}
+
+const PJRT_Api* build_wrapped(const PJRT_Api* real) {
+  g.real = real;
+  size_t size = real->struct_size;
+  wrapped_storage.assign(reinterpret_cast<const char*>(real),
+                         reinterpret_cast<const char*>(real) + size);
+  PJRT_Api* w = reinterpret_cast<PJRT_Api*>(wrapped_storage.data());
+  override_field(&w->PJRT_LoadedExecutable_Execute, &Wrapped_Execute);
+  override_field(&w->PJRT_Client_BufferFromHostBuffer,
+                 &Wrapped_BufferFromHostBuffer);
+  override_field(&w->PJRT_Buffer_Destroy, &Wrapped_BufferDestroy);
+  override_field(&w->PJRT_Error_Destroy, &Wrapped_ErrorDestroy);
+  override_field(&w->PJRT_Error_Message, &Wrapped_ErrorMessage);
+  override_field(&w->PJRT_Error_GetCode, &Wrapped_ErrorGetCode);
+  return w;
+}
+
+}  // namespace
+
+extern "C" {
+
+const PJRT_Api* GetPjrtApi() {
+  static const PJRT_Api* cached = []() -> const PJRT_Api* {
+    const char* real_path = std::getenv("KUBESHARE_PJRT_REAL");
+    if (!real_path || !*real_path) {
+      logf("KUBESHARE_PJRT_REAL not set; cannot load real PJRT plugin");
+      return nullptr;
+    }
+    void* handle = dlopen(real_path, RTLD_NOW | RTLD_GLOBAL);
+    if (!handle) {
+      logf("dlopen(%s) failed: %s", real_path, dlerror());
+      return nullptr;
+    }
+    using GetApiFn = const PJRT_Api* (*)();
+    GetApiFn get_api =
+        reinterpret_cast<GetApiFn>(dlsym(handle, "GetPjrtApi"));
+    if (!get_api) {
+      logf("dlsym(GetPjrtApi) failed: %s", dlerror());
+      return nullptr;
+    }
+    const PJRT_Api* real = get_api();
+    if (!real) {
+      logf("real plugin returned null api");
+      return nullptr;
+    }
+    connect_token_server();
+    logf("wrapping %s (pjrt api v%d.%d)%s", real_path,
+         real->pjrt_api_version.major_version,
+         real->pjrt_api_version.minor_version,
+         g.fd >= 0 ? "" : " [passthrough: no token server]");
+    return build_wrapped(real);
+  }();
+  return cached;
+}
+
+}  // extern "C"
