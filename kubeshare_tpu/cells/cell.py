@@ -42,12 +42,18 @@ class CellState(enum.Enum):
 
 @dataclass
 class ChipInfo:
-    """One physical chip as reported by the collector."""
+    """One schedulable device as reported by the collector.
+
+    Normally a whole physical chip; in subcore mode (the TPU analog of
+    the reference's MIG branch, pkg/collector/gpu.go:69-103) one row per
+    TensorCore with ``parent`` naming the enclosing chip's uuid.
+    """
 
     uuid: str
     model: str
     memory: int  # HBM bytes
     index: int = 0
+    parent: str = ""  # enclosing chip uuid when this row is a subcore
 
 
 @dataclass

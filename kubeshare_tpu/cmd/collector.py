@@ -18,6 +18,7 @@ from ..metrics.collector import (
     Collector,
     FakeChipBackend,
     JaxChipBackend,
+    SubcoreBackend,
 )
 from ..utils.signals import setup_signal_handler
 from .common import add_common_flags, component_logger
@@ -36,23 +37,39 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve N synthetic v5e chips instead of enumerating "
              "hardware (dev machines / CI)",
     )
+    parser.add_argument(
+        "--fake-model", default="tpu-v5e",
+        help="chip model for --fake-chips inventories",
+    )
+    parser.add_argument(
+        "--subcores", default="off", metavar="off|auto|N",
+        help="enumerate per-TensorCore rows instead of whole chips "
+             "(the MIG analog): 'auto' uses the per-generation core "
+             "count, an integer forces a split factor",
+    )
     return parser
 
 
 def make_backend(args: argparse.Namespace):
     if args.fake_chips >= 0:
-        return FakeChipBackend(
+        backend = FakeChipBackend(
             [
                 ChipInfo(
                     uuid=f"{args.node_name}-fake-{i}",
-                    model="tpu-v5e",
+                    model=args.fake_model,
                     memory=16 << 30,
                     index=i,
                 )
                 for i in range(args.fake_chips)
             ]
         )
-    return JaxChipBackend(node_name=args.node_name)
+    else:
+        backend = JaxChipBackend(node_name=args.node_name)
+    subcores = getattr(args, "subcores", "off")
+    if subcores != "off":
+        cores = "auto" if subcores == "auto" else int(subcores)
+        backend = SubcoreBackend(backend, cores)
+    return backend
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -49,6 +49,70 @@ def _normalize_model(device_kind: str) -> str:
     return device_kind.strip().lower().replace(" ", "-")
 
 
+# TensorCores per chip by generation: the granularity the subcore mode
+# splits to. v2/v3/v4 expose two cores per chip; v5e/v6e are single-core
+# (v4's two cores are usually fused as megacore, but can run split).
+_CORES_BY_KIND = {
+    "tpu v2": 2,
+    "tpu v3": 2,
+    "tpu v4": 2,
+    "tpu v5": 1,
+    "tpu v5 lite": 1,
+    "tpu v5e": 1,
+    "tpu v5p": 2,
+    "tpu v6e": 1,
+}
+
+
+def split_subcores(
+    chips: Sequence[ChipInfo], cores: int | str = "auto"
+) -> List[ChipInfo]:
+    """Explode whole-chip rows into per-TensorCore rows.
+
+    TPU analog of the reference's MIG branch (pkg/collector/gpu.go:69-103),
+    which enumerates MIG sub-devices *instead of* the parent GPU: each
+    subcore becomes an ordinary, smaller leaf for the scheduler — uuid
+    ``<chip>-c<k>``, HBM split evenly, ``parent`` pointing back at the
+    chip. ``cores="auto"`` looks the count up per model and leaves
+    single-core generations untouched.
+    """
+    out: List[ChipInfo] = []
+    for chip in chips:
+        if isinstance(cores, int):
+            n = cores
+        else:
+            kind = chip.model.replace("-", " ")
+            n = _CORES_BY_KIND.get(kind, 1)
+        if n <= 1:
+            out.append(chip)
+            continue
+        for k in range(n):
+            out.append(
+                ChipInfo(
+                    uuid=f"{chip.uuid}-c{k}",
+                    model=chip.model,
+                    memory=chip.memory // n,
+                    parent=chip.uuid,
+                )
+            )
+    # Re-index in enumeration order (the reference's MIG rows take the
+    # walk index, gpu.go:69-103) so mixed core counts stay collision-free.
+    for i, chip in enumerate(out):
+        chip.index = i
+    return out
+
+
+class SubcoreBackend:
+    """Wrap any chip backend to enumerate at subcore granularity."""
+
+    def __init__(self, inner, cores: int | str = "auto"):
+        self.inner = inner
+        self.cores = cores
+
+    def enumerate(self) -> List[ChipInfo]:
+        return split_subcores(self.inner.enumerate(), self.cores)
+
+
 class FakeChipBackend:
     """Deterministic inventory for tests / chip-less dev machines."""
 
@@ -124,19 +188,16 @@ class Collector:
         now = self.clock()
         out = []
         for chip in self.backend.enumerate():
-            out.append(
-                expfmt.Sample(
-                    CAPACITY_METRIC,
-                    {
-                        "node": self.node_name,
-                        "uuid": chip.uuid,
-                        "model": chip.model,
-                        "memory": str(chip.memory),
-                        "index": str(chip.index),
-                    },
-                    now,
-                )
-            )
+            labels = {
+                "node": self.node_name,
+                "uuid": chip.uuid,
+                "model": chip.model,
+                "memory": str(chip.memory),
+                "index": str(chip.index),
+            }
+            if chip.parent:
+                labels["parent"] = chip.parent
+            out.append(expfmt.Sample(CAPACITY_METRIC, labels, now))
         return out
 
     def render(self) -> str:

@@ -34,6 +34,7 @@ def capacity_from_samples(
                 model=labels["model"],
                 memory=int(labels["memory"]),
                 index=int(labels.get("index", "0")),
+                parent=labels.get("parent", ""),
             )
         except (KeyError, ValueError):
             continue

@@ -8,7 +8,12 @@ import pytest
 from kubeshare_tpu.cells.cell import ChipInfo
 from kubeshare_tpu.cluster.fake import FakeCluster
 from kubeshare_tpu.metrics.aggregator import Aggregator
-from kubeshare_tpu.metrics.collector import Collector, FakeChipBackend
+from kubeshare_tpu.metrics.collector import (
+    Collector,
+    FakeChipBackend,
+    SubcoreBackend,
+    split_subcores,
+)
 from kubeshare_tpu.metrics.scrape import (
     capacity_from_samples,
     scrape_capacity,
@@ -87,6 +92,57 @@ class TestCollector:
         )
         inv = capacity_from_samples(samples)
         assert [c.uuid for c in inv["n1"]] == ["u2"]
+
+
+class TestSubcores:
+    """MIG-analog per-TensorCore enumeration (reference gpu.go:69-103)."""
+
+    def test_auto_split_multi_core_generations(self):
+        whole = [
+            ChipInfo("n1-chip-0", "tpu-v4", 32 * GIB, 0),
+            ChipInfo("n1-chip-1", "tpu-v5e", 16 * GIB, 1),
+        ]
+        rows = split_subcores(whole, "auto")
+        # v4 chip splits into two cores, v5e stays whole
+        assert [c.uuid for c in rows] == [
+            "n1-chip-0-c0", "n1-chip-0-c1", "n1-chip-1"
+        ]
+        assert rows[0].parent == "n1-chip-0" and rows[2].parent == ""
+        assert rows[0].memory == 16 * GIB
+        assert len({c.index for c in rows}) == 3  # indices stay unique
+
+    def test_forced_split_and_scrape_roundtrip(self):
+        backend = SubcoreBackend(FakeChipBackend(chips("n1", 1)), cores=2)
+        collector = Collector("n1", backend)
+        srv = collector.serve(host="127.0.0.1", port=0)
+        try:
+            inv = scrape_capacity(f"http://127.0.0.1:{srv.port}/metrics")
+        finally:
+            srv.stop()
+        assert [c.uuid for c in inv["n1"]] == ["n1-chip-0-c0", "n1-chip-0-c1"]
+        assert all(c.parent == "n1-chip-0" for c in inv["n1"])
+        assert all(c.memory == 8 * GIB for c in inv["n1"])
+
+    def test_subcore_rows_schedule_as_leaves(self):
+        """Subcore rows are ordinary smaller leaves: two 0.5 pods land
+        on different cores of the same chip."""
+        cores = split_subcores([ChipInfo("node-a-chip-0", "tpu-v5e", 16 * GIB, 0)], 2)
+        cluster = FakeCluster()
+        cluster.add_node("node-a", cores)
+        sched = TpuShareScheduler(
+            {"cell_types": {"v5e-node": {"child_cell_type": "tpu-v5e",
+                                         "child_cell_number": 2,
+                                         "child_cell_priority": 1,
+                                         "is_node_level": True}},
+             "cells": [{"cell_type": "v5e-node", "cell_id": "node-a"}]},
+            cluster,
+        )
+        uuids = set()
+        for name in ("p1", "p2"):
+            d = sched.schedule_one(cluster.create_pod(tpu_pod(name, 1.0, limit=1.0)))
+            assert d.status == "bound"
+            uuids.add(cluster.get_pod(f"default/{name}").annotations["sharedtpu/chip_uuid"])
+        assert uuids == {"node-a-chip-0-c0", "node-a-chip-0-c1"}
 
 
 class TestAggregator:
