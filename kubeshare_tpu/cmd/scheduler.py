@@ -158,6 +158,46 @@ class SchedulerMetrics:
         return expfmt.render(samples)
 
 
+class TopologyWatcher:
+    """mtime-poll the topology file and hot-reload the engine on change.
+
+    Replaces the reference's viper/fsnotify watcher whose handler is
+    ``os.Exit(0)`` (pkg/scheduler/config.go:122-136): a bad edit here
+    logs and keeps the previous topology instead of crash-looping the
+    scheduler."""
+
+    def __init__(self, path: str, engine: TpuShareScheduler, log):
+        self.path = path
+        self.engine = engine
+        self.log = log
+        self._mtime = self._stat()
+
+    def _stat(self):
+        try:
+            import os
+
+            return os.stat(self.path).st_mtime_ns
+        except OSError:
+            return None
+
+    def poll(self) -> bool:
+        """Returns True if a reload happened."""
+        mtime = self._stat()
+        if mtime is None or mtime == self._mtime:
+            return False
+        self._mtime = mtime
+        try:
+            self.engine.reload_topology(self.path)
+        except Exception as e:
+            self.log.error(
+                "topology %s changed but failed to load, keeping old: %s",
+                self.path, e,
+            )
+            return False
+        self.log.info("topology %s reloaded", self.path)
+        return True
+
+
 def run_pass(engine: TpuShareScheduler, cluster, journal, metrics=None) -> int:
     """One queue drain. Returns number of pods scheduled/acted on."""
     started = time.monotonic()
@@ -242,11 +282,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         run_pass(engine, cluster, journal, metrics)
         return 0
 
+    # Topology hot-reload: the reference watches its cell file and
+    # exits the process on change (config.go:122-136); we rebuild the
+    # tree in place and keep the old one on a bad edit.
+    watcher = TopologyWatcher(args.topology, engine, log)
+
     stop = setup_signal_handler()
     log.info("scheduler loop started (interval %.1fs)", args.interval)
     while not stop.is_set():
         started = time.monotonic()
         try:
+            watcher.poll()
             sync()
             run_pass(engine, cluster, journal, metrics)
         except Exception as e:  # apiserver blips must not kill the loop

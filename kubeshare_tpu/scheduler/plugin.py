@@ -100,6 +100,37 @@ class TpuShareScheduler:
         for pod in cluster.list_pods():
             self._on_pod_add(pod)
 
+    def reload_topology(self, topology: Union[str, dict, TopologyConfig]) -> None:
+        """Swap in a new cell topology without restarting the process.
+
+        The reference instead kills itself on a topology-file change and
+        lets Kubernetes restart it (pkg/scheduler/config.go:122-136,
+        ``os.Exit`` at 133) — a SURVEY.md §7 "quirk NOT to replicate".
+        Here we rebuild the tree and replay cluster state through the
+        same path a restart would take (_on_node_update /
+        _restore_bound_pod): bound pods keep their reservations,
+        undecided/waiting pods are simply rescheduled on the next pass.
+        Raises (and leaves the old topology live) if the new config is
+        invalid.
+        """
+        cfg = (
+            topology
+            if isinstance(topology, TopologyConfig)
+            else load_topology(topology)
+        )
+        tree = CellTree(cfg)  # validate before touching live state
+        self.tree = tree
+        self.status = PodStatusStore()
+        self.groups = PodGroupRegistry(clock=self.clock)
+        self.ports = {}
+        self._waiting = {}
+        self._synced_nodes = set()
+        self._bound_queue = {}
+        for node in self.cluster.list_nodes():
+            self._on_node_update(node)
+        for pod in self.cluster.list_pods():
+            self._on_pod_add(pod)
+
     # ================= informer handlers =============================
 
     def _on_node_update(self, node: Node) -> None:

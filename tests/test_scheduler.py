@@ -312,6 +312,83 @@ class TestLifecycle:
         assert sched2.status.get("default/p9").port not in old_ports
 
 
+class TestTopologyReload:
+    def test_reload_keeps_bound_reservations(self, env):
+        cluster, sched, _ = env
+        for i in range(2):
+            sched.schedule_one(cluster.create_pod(tpu_pod(f"p{i}", 0.5, mem=GIB)))
+        old_avail = sum(c.available for c in sched.tree.roots)
+        old_port = sched.status.get("default/p0").port
+
+        sched.reload_topology(TOPO)
+        assert sum(c.available for c in sched.tree.roots) == pytest.approx(old_avail)
+        s = sched.status.get("default/p0")
+        assert s.state == PodState.BOUND and s.port == old_port
+        # engine still schedules after the swap
+        d = sched.schedule_one(cluster.create_pod(tpu_pod("p9", 0.5)))
+        assert d.status == "bound"
+
+    def test_reload_to_grown_topology(self, env):
+        """Adding a node to the cell file makes its chips placeable
+        without restarting (the reference would os.Exit instead)."""
+        cluster, sched, _ = env
+        # fill both existing nodes completely
+        for i in range(8):
+            assert sched.schedule_one(
+                cluster.create_pod(tpu_pod(f"fill{i}", 1.0, limit=1.0))
+            ).status == "bound"
+        assert sched.schedule_one(
+            cluster.create_pod(tpu_pod("extra", 1.0, limit=1.0))
+        ).status == "unschedulable"
+
+        grown = {
+            "cell_types": TOPO["cell_types"],
+            "cells": TOPO["cells"] + [{"cell_type": "v5e-node", "cell_id": "node-c"}],
+        }
+        cluster.add_node("node-c", chips("node-c"))
+        sched.reload_topology(grown)
+        d = sched.schedule_one(cluster.create_pod(tpu_pod("extra2", 1.0, limit=1.0)))
+        assert d.status == "bound" and d.node == "node-c"
+
+    def test_bad_reload_keeps_old_tree(self, env):
+        cluster, sched, _ = env
+        sched.schedule_one(cluster.create_pod(tpu_pod("p1", 0.5)))
+        tree_before = sched.tree
+        with pytest.raises(Exception):
+            sched.reload_topology({"cell_types": {}, "cells": [{"cell_type": "nope"}]})
+        assert sched.tree is tree_before
+        assert sched.status.get("default/p1") is not None
+
+    def test_watcher_reloads_on_mtime_change(self, env, tmp_path):
+        import yaml
+        from kubeshare_tpu.cmd.scheduler import TopologyWatcher
+        from kubeshare_tpu.utils.logger import get_logger
+
+        cluster, sched, _ = env
+        path = tmp_path / "topo.yaml"
+        path.write_text(yaml.safe_dump(TOPO))
+        watcher = TopologyWatcher(str(path), sched, get_logger("t", level=0))
+        assert watcher.poll() is False  # unchanged
+
+        grown = {
+            "cell_types": TOPO["cell_types"],
+            "cells": TOPO["cells"] + [{"cell_type": "v5e-node", "cell_id": "node-c"}],
+        }
+        path.write_text(yaml.safe_dump(grown))
+        import os
+        os.utime(path, ns=(1, 10**18))  # force a distinct mtime
+        cluster.add_node("node-c", chips("node-c"))
+        assert watcher.poll() is True
+        assert any(c.id == "node-c" for c in sched.tree.roots)
+
+        # corrupt file: poll logs and keeps the old tree
+        path.write_text(":::not yaml {")
+        os.utime(path, ns=(2, 2 * 10**18 // 1))
+        tree_before = sched.tree
+        assert watcher.poll() is False
+        assert sched.tree is tree_before
+
+
 class TestRequeueRace:
     def test_double_schedule_is_noop(self, env):
         cluster, sched, _ = env
