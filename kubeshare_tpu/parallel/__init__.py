@@ -1,6 +1,7 @@
 from .mesh import MeshPlan, make_mesh, factorize_devices
 from .sharding import llama_param_spec, shard_params, batch_sharding
 from .ring_attention import ring_attention
+from .ulysses import ulysses_attention
 from .pipeline import (
     pipeline_apply,
     shard_stacked_params,
@@ -16,6 +17,7 @@ __all__ = [
     "shard_params",
     "batch_sharding",
     "ring_attention",
+    "ulysses_attention",
     "pipeline_apply",
     "shard_stacked_params",
     "stack_stage_params",

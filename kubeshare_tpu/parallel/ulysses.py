@@ -1,0 +1,96 @@
+"""Ulysses-style all-to-all sequence parallelism over the ``sp`` axis.
+
+The complementary long-context strategy to ring attention
+(``ring_attention.py``): instead of rotating K/V shards around a ring
+(n-1 ``ppermute`` hops, O(T/n * T/n) blocks), two ``all_to_all``
+collectives re-shard [B, H, T/n, D] inputs into [B, H/n, T, D] — each
+device then holds the *full* sequence for a slice of heads and runs
+ordinary dense attention locally, and a second all_to_all restores
+sequence sharding on the output. On a TPU torus both all_to_alls ride
+ICI; the trade-off vs the ring is one bulk shuffle and full-T working
+memory per head slice instead of n pipelined block steps.
+
+Requires ``num_heads % sp == 0``. Exact (same math as dense attention),
+so it is the drop-in to prefer when heads are plentiful and T/n blocks
+would be too small to keep the MXU busy.
+
+``ulysses_attention`` runs *inside* ``shard_map``;
+``make_ulysses_attention`` builds the shard_mapped callable. No
+reference analog (the reference has no sequence dimension at all —
+SURVEY.md §5 "long-context"); included because long-context SP is
+first-class in the TPU rebuild.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _dense_attention(q, k, v, causal: bool, scale: float):
+    """fp32-accumulated softmax attention on full-sequence shards."""
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
+        k.astype(jnp.float32), preferred_element_type=jnp.float32,
+    )
+    if causal:
+        t = q.shape[2]
+        mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                      scale: Optional[float] = None):
+    """Per-shard bodies: q/k/v [B, H, T_local, D] (sharded on T).
+
+    Must be called inside shard_map over ``axis_name``; H must divide
+    evenly by the axis size.
+    """
+    heads = q.shape[1]
+    head_dim = q.shape[3]
+    n = jax.lax.psum(1, axis_name)
+    if scale is None:
+        scale = head_dim ** -0.5
+
+    def seq_to_heads(x):
+        # [B, H, T/n, D] -> [B, H/n, T, D]
+        return jax.lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = _dense_attention(qh, kh, vh, causal=causal, scale=scale)
+    # [B, H/n, T, D] -> [B, H, T/n, D]
+    del heads, n
+    return jax.lax.all_to_all(
+        out, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
+                           causal: bool = True):
+    """Shard_mapped Ulysses attention over full arrays [B, H, T, D] with
+    T sharded on ``axis_name``."""
+    spec = P(None, None, axis_name, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def sharded(q, k, v):
+        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+
+    return sharded
