@@ -26,7 +26,7 @@ def build_parser() -> argparse.ArgumentParser:
     add_common_flags(parser)
     parser.add_argument(
         "--model", default="mnist",
-        choices=["mnist", "cifar", "lstm", "resnet", "llama"],
+        choices=["mnist", "cifar", "lstm", "resnet", "vgg", "llama"],
     )
     parser.add_argument("--batch", type=int, default=256)
     parser.add_argument("--steps", type=int, default=0,
@@ -89,6 +89,14 @@ def _build(model: str, batch: int, rng):
                   M.cifar_apply, 10),
         "resnet": ((batch, 32, 32, 3), M.ResNetConfig, M.init_resnet,
                    M.resnet_apply, 10),
+        # CIFAR-scale VGG (the reference's vgg16 ElasticJobs run CIFAR,
+        # test/distribute/mixed/vgg16/)
+        "vgg": ((batch, 32, 32, 3),
+                lambda: M.VggConfig(
+                    layers=(32, "M", 64, "M", 128, "M", 256, "M", 256, "M"),
+                    num_classes=10, classifier_width=256, image_size=32,
+                ),
+                M.init_vgg, M.vgg_apply, 10),
     }
     shape, cfg_cls, init, apply, classes = shapes[model]
     cfg = cfg_cls()

@@ -1,0 +1,76 @@
+"""VGG — the reference's second data-parallel workload family
+(test/distribute/vgg16_2.yaml, test/distribute/mixed/vgg16/: TorchElastic
+VGG-16 ElasticJobs gang-scheduled as pod groups). Plain conv stacks with
+max-pool downsampling; ``vgg16`` matches the reference workload's model.
+
+Kept batch-norm-free (the classic VGG formulation) so the step function
+is pure and mesh-shardable with no cross-device stat syncs; convs and
+the classifier run in bfloat16 on the MXU via ``common.conv``/``dense``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import conv, conv_init, dense, dense_init
+
+
+@dataclass(frozen=True)
+class VggConfig:
+    # channels per conv layer; 'M' = 2x2 max pool
+    layers: Tuple = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M",
+                     512, 512, "M")  # vgg11
+    num_classes: int = 1000
+    classifier_width: int = 4096
+    image_size: int = 224
+
+
+def vgg16() -> VggConfig:
+    return VggConfig(
+        layers=(64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                512, 512, 512, "M", 512, 512, 512, "M"),
+    )
+
+
+def _pool(x):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def init_vgg(rng, cfg: VggConfig = VggConfig()) -> Dict:
+    convs = [c for c in cfg.layers if c != "M"]
+    keys = jax.random.split(rng, len(convs) + 3)
+    params: Dict = {}
+    in_ch, k = 3, 0
+    for i, c in enumerate(cfg.layers):
+        if c == "M":
+            continue
+        params[f"conv{i}"] = conv_init(keys[k], 3, 3, in_ch, c)
+        in_ch, k = c, k + 1
+    feat = in_ch * (cfg.image_size // 32) ** 2
+    params["fc1"] = dense_init(keys[k], feat, cfg.classifier_width)
+    params["fc2"] = dense_init(keys[k + 1], cfg.classifier_width,
+                               cfg.classifier_width)
+    params["head"] = dense_init(keys[k + 2], cfg.classifier_width,
+                                cfg.num_classes)
+    return params
+
+
+def vgg_apply(params: Dict, images: jnp.ndarray,
+              cfg: VggConfig = VggConfig()) -> jnp.ndarray:
+    """images [B, S, S, 3] (S = cfg.image_size) -> logits [B, classes]."""
+    x = images
+    for i, c in enumerate(cfg.layers):
+        if c == "M":
+            x = _pool(x)
+        else:
+            x = jax.nn.relu(conv(params[f"conv{i}"], x))
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(dense(params["fc1"], x))
+    x = jax.nn.relu(dense(params["fc2"], x))
+    return dense(params["head"], x)

@@ -8,6 +8,7 @@ from .pipeline import (
     stack_stage_params,
 )
 from .train import make_sharded_train_step
+from .elastic import ElasticTrainer
 
 __all__ = [
     "MeshPlan",
@@ -22,4 +23,5 @@ __all__ = [
     "shard_stacked_params",
     "stack_stage_params",
     "make_sharded_train_step",
+    "ElasticTrainer",
 ]

@@ -65,6 +65,35 @@ class TestModels:
         logits = resnet_apply(params, jax.random.normal(RNG, (2, 16, 16, 3)), cfg)
         assert logits.shape == (2, 4)
 
+    def test_vgg_shape_and_train(self):
+        from kubeshare_tpu.models import VggConfig, init_vgg, vgg_apply
+        from kubeshare_tpu.models.common import cross_entropy_loss
+
+        cfg = VggConfig(layers=(8, "M", 16, "M", 16, "M", 32, "M", 32, "M"),
+                        num_classes=10, classifier_width=32, image_size=32)
+        params = init_vgg(RNG, cfg)
+        images = jax.random.normal(RNG, (4, 32, 32, 3))
+        logits = vgg_apply(params, images, cfg)
+        assert logits.shape == (4, 10)
+
+        labels = jnp.arange(4) % 10
+        opt, step = make_train_step(
+            lambda p, x, y: cross_entropy_loss(vgg_apply(p, x, cfg), y),
+            learning_rate=0.01,
+        )
+        opt_state = opt.init(params)
+        losses = []
+        for _ in range(4):
+            params, opt_state, loss = step(params, opt_state, images, labels)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_vgg16_preset_matches_reference_depth(self):
+        from kubeshare_tpu.models.vgg import vgg16
+
+        cfg = vgg16()
+        assert sum(1 for c in cfg.layers if c != "M") == 13  # 13 conv + 3 fc
+
     def test_llama_forward_and_loss(self):
         cfg = LlamaConfig(vocab=128, dim=32, layers=2, num_heads=4,
                           num_kv_heads=2, mlp_dim=64, max_seq_len=64)
