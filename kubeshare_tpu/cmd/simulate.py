@@ -34,7 +34,33 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--priority-ratio", type=float, default=0.5,
                         help="share of pods given a guarantee priority")
+    parser.add_argument(
+        "--faults", default="",
+        help="fault-injection file: lines 'time kind [target]' with kind "
+             "in node_down|node_up|pod_kill (# comments allowed)",
+    )
     return parser
+
+
+def load_faults(path: str):
+    from ..sim.simulator import FaultEvent
+
+    faults = []
+    with open(path) as f:
+        for line_no, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            if len(parts) not in (2, 3):
+                raise SystemExit(
+                    f"{path}:{line_no}: expected 'time kind [target]'"
+                )
+            faults.append(FaultEvent(
+                time=float(parts[0]), kind=parts[1],
+                target=parts[2] if len(parts) == 3 else "",
+            ))
+    return faults
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -60,7 +86,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         args.topology, nodes,
         priority_ratio=args.priority_ratio, seed=args.seed,
     )
-    report = sim.run(events)
+    report = sim.run(
+        events, faults=load_faults(args.faults) if args.faults else None
+    )
     print(json.dumps(report.to_dict()))
     return 0
 

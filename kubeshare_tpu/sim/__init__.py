@@ -1,5 +1,5 @@
 from .trace import TraceEvent, generate_trace, load_trace, save_trace
-from .simulator import SimReport, Simulator
+from .simulator import FaultEvent, SimReport, Simulator
 
 __all__ = [
     "TraceEvent",
@@ -8,4 +8,5 @@ __all__ = [
     "save_trace",
     "SimReport",
     "Simulator",
+    "FaultEvent",
 ]

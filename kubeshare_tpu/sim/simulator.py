@@ -25,6 +25,25 @@ from ..scheduler.plugin import TpuShareScheduler
 from .trace import TraceEvent
 
 
+@dataclass(frozen=True)
+class FaultEvent:
+    """An injected failure on the virtual clock.
+
+    The reference has no fault-injection tooling (SURVEY.md §5); this
+    fills that gap so the failure-detection paths the reference only
+    exercises in live clusters — unhealthy-cell marking
+    (node.go:109-124), reschedule after pod loss — are assertable in CI.
+
+    kinds: ``node_down`` / ``node_up`` (target = node name; down kills
+    and resubmits that node's running sim pods), ``pod_kill`` (target =
+    pod key, or "" for the longest-running bound pod).
+    """
+
+    time: float
+    kind: str         # node_down | node_up | pod_kill
+    target: str = ""
+
+
 @dataclass
 class SimReport:
     submitted: int = 0
@@ -35,6 +54,9 @@ class SimReport:
     chip_seconds_used: float = 0.0
     chip_seconds_capacity: float = 0.0
     peak_pending: int = 0
+    killed: int = 0            # pods lost to injected faults
+    resubmitted: int = 0       # fault-killed pods requeued
+    faults: int = 0            # fault events applied
 
     @property
     def mean_wait(self) -> float:
@@ -61,6 +83,9 @@ class SimReport:
             "mean_wait_s": round(self.mean_wait, 2),
             "utilization": round(self.utilization, 4),
             "peak_pending": self.peak_pending,
+            "faults": self.faults,
+            "killed": self.killed,
+            "resubmitted": self.resubmitted,
         }
 
 
@@ -121,24 +146,79 @@ class Simulator:
             scheduler_name=C.SCHEDULER_NAME,
         )
 
-    def run(self, events: List[TraceEvent], horizon: float = 0.0) -> SimReport:
+    def _kill_job(self, job: _Job, jobs: Dict[str, "_Job"],
+                  pending: List["_Job"], report: SimReport) -> None:
+        """Delete a fault-killed pod and resubmit it as a fresh arrival
+        (a Job controller recreating its pod)."""
+        jobs.pop(job.pod.key, None)
+        self.cluster.delete_pod(job.pod.key)
+        report.killed += 1
+        self._resubmits += 1
+        clone = Pod(
+            name=f"{job.pod.name}-r{self._resubmits}",
+            labels=dict(job.pod.labels),
+            scheduler_name=C.SCHEDULER_NAME,
+        )
+        self.cluster.create_pod(clone)
+        requeued = _Job(pod=clone, event=job.event,
+                        submitted_at=self.clock_now)
+        jobs[clone.key] = requeued
+        pending.append(requeued)
+        report.resubmitted += 1
+        report.submitted += 1
+
+    def _apply_fault(self, fault: FaultEvent, jobs: Dict[str, "_Job"],
+                     pending: List["_Job"], report: SimReport) -> None:
+        report.faults += 1
+        if fault.kind == "node_up":
+            self.cluster.set_node_ready(fault.target, True)
+            return
+        if fault.kind == "node_down":
+            self.cluster.set_node_ready(fault.target, False)
+            doomed = [
+                j for j in list(jobs.values())
+                if j.bound_at is not None
+                and self.cluster.get_pod(j.pod.key) is not None
+                and self.cluster.get_pod(j.pod.key).node_name == fault.target
+            ]
+            for job in doomed:
+                self._kill_job(job, jobs, pending, report)
+            return
+        if fault.kind == "pod_kill":
+            if fault.target:
+                job = jobs.get(fault.target)
+            else:  # longest-running bound pod
+                bound = [j for j in jobs.values() if j.bound_at is not None]
+                job = min(bound, key=lambda j: j.bound_at) if bound else None
+            if job is not None and job.bound_at is not None:
+                self._kill_job(job, jobs, pending, report)
+            return
+        raise ValueError(f"unknown fault kind {fault.kind!r}")
+
+    def run(self, events: List[TraceEvent], horizon: float = 0.0,
+            faults: Optional[List[FaultEvent]] = None) -> SimReport:
         report = SimReport()
         pending: List[_Job] = []
         finishes: List = []  # heap of (finish_time, key)
         jobs: Dict[str, _Job] = {}
+        self._resubmits = 0
+        fault_queue = sorted(faults or [], key=lambda f: f.time)
+        fi = 0
 
         arrivals = sorted(events, key=lambda e: e.start)
         # default: run until the queue fully drains; an explicit horizon
         # caps runaway replays
         end = horizon or float("inf")
         i = 0
-        while i < len(arrivals) or pending or finishes:
-            # next event time: arrival or finish
+        while i < len(arrivals) or pending or finishes or fi < len(fault_queue):
+            # next event time: arrival, finish, or injected fault
             candidates = []
             if i < len(arrivals):
                 candidates.append(arrivals[i].start)
             if finishes:
                 candidates.append(finishes[0][0])
+            if fi < len(fault_queue):
+                candidates.append(fault_queue[fi].time)
             if not candidates:
                 break
             next_t = max(self.clock_now, min(candidates))
@@ -153,6 +233,11 @@ class Simulator:
                 if job is not None:
                     self.cluster.finish_pod(key)
                     report.completed += 1
+
+            # injected faults at this tick
+            while fi < len(fault_queue) and fault_queue[fi].time <= self.clock_now:
+                self._apply_fault(fault_queue[fi], jobs, pending, report)
+                fi += 1
 
             # arrivals at this tick
             while i < len(arrivals) and arrivals[i].start <= self.clock_now:
@@ -194,7 +279,8 @@ class Simulator:
             report.peak_pending = max(report.peak_pending, len(pending))
             self.engine.tick()
 
-            if i >= len(arrivals) and not finishes and pending:
+            if (i >= len(arrivals) and not finishes and pending
+                    and fi >= len(fault_queue)):
                 # nothing will ever free capacity for these
                 for job in pending:
                     report.unschedulable += 1

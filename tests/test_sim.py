@@ -192,3 +192,69 @@ class TestSimulator:
         assert report.bound + report.unschedulable == 120
         assert report.completed == report.bound
         assert 0 < report.utilization <= 1.0
+
+
+class TestFaultInjection:
+    def test_node_down_kills_and_reschedules(self):
+        from kubeshare_tpu.sim.simulator import FaultEvent
+
+        sim = Simulator(TOPO, {"node-a": 4, "node-b": 4}, seed=7,
+                        priority_ratio=0.0)
+        # 8 whole-chip jobs fill both nodes; node-a dies mid-run
+        events = [TraceEvent(0.0, 1.0, 100.0) for _ in range(8)]
+        faults = [FaultEvent(50.0, "node_down", "node-a")]
+        report = sim.run(events, faults=faults)
+        assert report.faults == 1
+        assert report.killed == 4          # node-a's four pods died
+        assert report.resubmitted == 4
+        # the 4 survivors + the 4 resubmitted clones all complete on
+        # node-b after it frees (killed originals never complete)
+        assert report.completed == 8
+        assert report.bound == 12          # 8 originals + 4 clones
+        assert report.unschedulable == 0
+        # clones waited for node-b to free at t=100
+        assert sorted(report.wait_times)[-1] >= 50.0
+
+    def test_node_down_then_up_restores_capacity(self):
+        from kubeshare_tpu.sim.simulator import FaultEvent
+
+        sim = Simulator(TOPO, {"node-a": 4}, seed=8, priority_ratio=0.0)
+        # node dies before the arrival, recovers later: the job waits
+        # for node_up instead of being rejected
+        events = [TraceEvent(10.0, 1.0, 5.0)]
+        faults = [
+            FaultEvent(0.0, "node_down", "node-a"),
+            FaultEvent(60.0, "node_up", "node-a"),
+        ]
+        report = sim.run(events, faults=faults)
+        assert report.bound == 1 and report.completed == 1
+        assert report.wait_times[0] >= 50.0   # waited for recovery
+
+    def test_pod_kill_targets_longest_running(self):
+        from kubeshare_tpu.sim.simulator import FaultEvent
+
+        sim = Simulator(TOPO, {"node-a": 4}, seed=9, priority_ratio=0.0)
+        events = [TraceEvent(0.0, 1.0, 100.0), TraceEvent(5.0, 1.0, 100.0)]
+        report = sim.run(events, faults=[FaultEvent(20.0, "pod_kill")])
+        assert report.killed == 1 and report.resubmitted == 1
+        assert report.completed == 2   # the survivor + the retry clone
+        assert report.bound == 3
+
+    def test_unknown_fault_kind_raises(self):
+        from kubeshare_tpu.sim.simulator import FaultEvent
+
+        sim = Simulator(TOPO, {"node-a": 4}, seed=10)
+        with pytest.raises(ValueError):
+            sim.run([TraceEvent(0.0, 0.5, 1.0)],
+                    faults=[FaultEvent(0.0, "meteor", "node-a")])
+
+    def test_faults_cli_file_format(self, tmp_path):
+        from kubeshare_tpu.cmd.simulate import load_faults
+
+        p = tmp_path / "faults.txt"
+        p.write_text("# comment\n10 node_down node-a\n20 node_up node-a\n"
+                     "30 pod_kill\n")
+        faults = load_faults(str(p))
+        assert len(faults) == 3
+        assert faults[0].kind == "node_down" and faults[0].target == "node-a"
+        assert faults[2].target == ""
