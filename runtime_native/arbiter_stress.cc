@@ -1,0 +1,158 @@
+// Concurrency stress for TokenArbiter: N client threads hammer
+// acquire/release while config reloads, memory traffic, and stats
+// polling run concurrently — the interleavings a real node sees when
+// tpu-schd serves many pod managers while the config daemon rewrites
+// quota files. Build and run under -fsanitize=thread (make tsan) to get
+// the race detection the reference never had (SURVEY.md §5: no -race,
+// known double-RLock bug in pkg/lib/set).
+//
+// Exits non-zero if any invariant breaks:
+//   - at most `slots` leases outstanding at any instant
+//   - per-pod memory accounting never exceeds its cap
+//   - every thread keeps making progress (no deadlock/livelock)
+//
+// Usage: arbiter_stress [threads=8] [seconds=2] [slots=2]
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "arbiter.h"
+
+namespace {
+
+using tpushare::PodQuota;
+using tpushare::TokenArbiter;
+
+std::atomic<bool> stop{false};
+std::atomic<int> outstanding{0};
+std::atomic<int> max_outstanding{0};
+std::atomic<long long> grants{0};
+std::atomic<long long> mem_denials{0};
+std::atomic<bool> failed{false};
+
+void fail(const char* msg) {
+  std::fprintf(stderr, "STRESS FAIL: %s\n", msg);
+  failed.store(true);
+  stop.store(true);
+}
+
+void client(TokenArbiter* arb, std::string pod, int slots) {
+  while (!stop.load(std::memory_order_relaxed)) {
+    double quota = arb->acquire(pod);
+    int now = outstanding.fetch_add(1) + 1;
+    if (now > slots) fail("more leases outstanding than slots");
+    int prev = max_outstanding.load();
+    while (now > prev && !max_outstanding.compare_exchange_weak(prev, now)) {
+    }
+    if (quota <= 0) fail("non-positive quota granted");
+    // a short "compute burst": long enough to overlap with other
+    // threads' acquire attempts, short enough to spin many rounds
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+    outstanding.fetch_sub(1);
+    arb->release(pod, 0.2);
+    grants.fetch_add(1);
+  }
+}
+
+void mem_hammer(TokenArbiter* arb, std::string pod, long long cap) {
+  long long held = 0;
+  while (!stop.load(std::memory_order_relaxed)) {
+    long long used = 0, got_cap = 0;
+    if (arb->mem(pod, 1 << 20, &used, &got_cap)) {
+      held += 1 << 20;
+      if (got_cap > 0 && used > got_cap) fail("mem_used exceeds cap");
+    } else {
+      mem_denials.fetch_add(1);
+      if (held > 0) {
+        arb->mem(pod, -held, &used, &got_cap);
+        held = 0;
+      }
+    }
+    if (cap > 0 && held > cap) fail("client held more than cap");
+  }
+  long long used = 0, got_cap = 0;
+  if (held > 0) arb->mem(pod, -held, &used, &got_cap);
+}
+
+void config_flipper(TokenArbiter* arb, int pods) {
+  int round = 0;
+  while (!stop.load(std::memory_order_relaxed)) {
+    std::map<std::string, PodQuota> quotas;
+    for (int i = 0; i < pods; ++i) {
+      PodQuota q;
+      // alternate between guaranteed-heavy and burst-only layouts,
+      // like the node config daemon rewriting files as pods churn
+      q.request = (round % 2 == 0) ? 1.0 / pods : 0.0;
+      q.limit = 1.0;
+      q.mem_cap = 64 << 20;
+      quotas["pod-" + std::to_string(i)] = q;
+    }
+    arb->set_quotas(quotas);
+    ++round;
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
+  }
+}
+
+void stats_poller(TokenArbiter* arb) {
+  while (!stop.load(std::memory_order_relaxed)) {
+    for (const auto& s : arb->stats()) {
+      if (s.window_usage_ms < 0) fail("negative window usage");
+      if (s.mem_cap > 0 && s.mem_used > s.mem_cap) {
+        fail("stats shows mem over cap");
+      }
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int threads = argc > 1 ? std::atoi(argv[1]) : 8;
+  int seconds = argc > 2 ? std::atoi(argv[2]) : 2;
+  int slots = argc > 3 ? std::atoi(argv[3]) : 2;
+
+  TokenArbiter arb(20.0, 2.0, 1000.0, slots);
+  {
+    std::map<std::string, PodQuota> quotas;
+    for (int i = 0; i < threads; ++i) {
+      PodQuota q;
+      q.request = 1.0 / threads;
+      q.limit = 1.0;
+      q.mem_cap = 64 << 20;
+      quotas["pod-" + std::to_string(i)] = q;
+    }
+    arb.set_quotas(quotas);
+  }
+
+  std::vector<std::thread> workers;
+  for (int i = 0; i < threads; ++i) {
+    workers.emplace_back(client, &arb, "pod-" + std::to_string(i), slots);
+  }
+  workers.emplace_back(mem_hammer, &arb, "pod-0", 64 << 20);
+  workers.emplace_back(mem_hammer, &arb, "pod-1", 64 << 20);
+  workers.emplace_back(config_flipper, &arb, threads);
+  workers.emplace_back(stats_poller, &arb);
+
+  std::this_thread::sleep_for(std::chrono::seconds(seconds));
+  stop.store(true);
+  for (auto& t : workers) t.join();
+
+  long long total = grants.load();
+  std::printf(
+      "arbiter_stress: %lld grants, max %d concurrent (slots=%d), "
+      "%lld mem denials, %s\n",
+      total, max_outstanding.load(), slots, mem_denials.load(),
+      failed.load() ? "FAILED" : "ok");
+  if (total < threads) {
+    std::fprintf(stderr, "STRESS FAIL: starvation (only %lld grants)\n",
+                 total);
+    return 1;
+  }
+  return failed.load() ? 1 : 0;
+}

@@ -38,6 +38,21 @@ class TestNativeHarness:
         )
         assert "all checks passed" in result.stdout
 
+    def test_arbiter_stress_invariants_hold(self):
+        """Multi-threaded arbiter hammer: lease slots never
+        oversubscribed, memory caps never breached, no starvation
+        (1-second run; `make tsan`/`make asan` run the same binary
+        under sanitizers)."""
+        stress = _built("arbiter_stress")
+        result = subprocess.run(
+            [stress, "8", "1", "2"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert result.returncode == 0, (
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+        assert "ok" in result.stdout and "FAIL" not in result.stdout
+
     def test_shim_fails_closed_without_real_plugin(self):
         # GetPjrtApi must return null (not crash) when the real plugin
         # is missing — the framework then reports a load error instead
