@@ -1,0 +1,207 @@
+"""Mutating admission webhook: inject the isolation-runtime plumbing at
+pod *creation* instead of the reference's delete+recreate trick.
+
+The reference's Reserve deletes the scheduled pod and recreates a copy
+with injected env/mounts and ``spec.nodeName`` set
+(pkg/scheduler/scheduler.go:515-528, pod.go:402-476) — losing controller
+ownership and racing Job controllers (SURVEY.md §7 "quirks NOT to
+replicate"). The TPU rebuild splits that injection in two:
+
+- **admission time** (this webhook): the placement-independent pieces —
+  the ``/kubeshare/library`` hostPath mount, the PJRT-interposer env
+  (``TPU_LIBRARY_PATH`` pointing JAX at the shim), and the library-path
+  env — patched into every fractional shared-TPU pod as it is created;
+- **bind time** (scheduler engine): the placement-dependent pieces —
+  chip uuid, manager port, HBM cap — patched when the pod is bound.
+
+Implements the ``admission.k8s.io/v1`` AdmissionReview protocol with
+JSONPatch responses. TLS (required by kube-apiserver for webhooks) is
+terminated via ``--tls-cert/--tls-key``; tests post plain HTTP.
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..scheduler import constants as C
+from ..scheduler.labels import LabelError, PodKind, parse_pod
+from .api import Pod
+
+VOLUME_NAME = "kubeshare-tpu-library"
+SHIM_PATH = C.LIBRARY_PATH + "/libpjrt_interposer.so"
+
+
+def _is_fractional_shared(labels: Dict[str, str]) -> bool:
+    """True for pods the isolation runtime must wrap: fractional
+    requests only — whole-chip pods get exclusive chips and no hook,
+    matching the reference's multi-GPU path (pod.go:348-400)."""
+    if C.LABEL_TPU_REQUEST not in labels:
+        return False
+    try:
+        req = parse_pod(Pod(name="admission", labels=dict(labels)))
+    except LabelError:
+        return False  # PreFilter will reject it with a real message
+    return req.kind == PodKind.SHARED
+
+
+def mutate_pod(pod: Dict) -> List[Dict]:
+    """Compute the JSONPatch for one pod object (or [] if not ours)."""
+    meta = pod.get("metadata", {})
+    labels = meta.get("labels", {}) or {}
+    spec = pod.get("spec", {}) or {}
+    if spec.get("schedulerName") != C.SCHEDULER_NAME:
+        return []
+    if not _is_fractional_shared(labels):
+        return []
+
+    patches: List[Dict] = []
+    volumes = spec.get("volumes") or []
+    if not any(v.get("name") == VOLUME_NAME for v in volumes):
+        volume = {
+            "name": VOLUME_NAME,
+            "hostPath": {"path": C.LIBRARY_PATH,
+                         "type": "DirectoryOrCreate"},
+        }
+        if "volumes" in spec:
+            patches.append({"op": "add", "path": "/spec/volumes/-",
+                            "value": volume})
+        else:
+            patches.append({"op": "add", "path": "/spec/volumes",
+                            "value": [volume]})
+
+    inject_env = {
+        C.ENV_LIBRARY_PATH: C.LIBRARY_PATH,
+        "TPU_LIBRARY_PATH": SHIM_PATH,
+    }
+    for i, container in enumerate(spec.get("containers", [])):
+        mounts = container.get("volumeMounts") or []
+        if not any(m.get("name") == VOLUME_NAME for m in mounts):
+            mount = {"name": VOLUME_NAME, "mountPath": C.LIBRARY_PATH,
+                     "readOnly": True}
+            if "volumeMounts" in container:
+                patches.append({
+                    "op": "add",
+                    "path": f"/spec/containers/{i}/volumeMounts/-",
+                    "value": mount,
+                })
+            else:
+                patches.append({
+                    "op": "add",
+                    "path": f"/spec/containers/{i}/volumeMounts",
+                    "value": [mount],
+                })
+        env = container.get("env") or []
+        present = {e.get("name") for e in env}
+        additions = [
+            {"name": name, "value": value}
+            for name, value in inject_env.items()
+            if name not in present
+        ]
+        if not additions:
+            continue
+        if "env" in container:
+            for add in additions:
+                patches.append({
+                    "op": "add",
+                    "path": f"/spec/containers/{i}/env/-",
+                    "value": add,
+                })
+        else:
+            patches.append({
+                "op": "add",
+                "path": f"/spec/containers/{i}/env",
+                "value": additions,
+            })
+    return patches
+
+
+def review_response(review: Dict) -> Dict:
+    """AdmissionReview in -> AdmissionReview out (always allowed; we
+    only mutate)."""
+    request = review.get("request", {}) or {}
+    uid = request.get("uid", "")
+    response: Dict = {"uid": uid, "allowed": True}
+    pod = request.get("object") or {}
+    if request.get("kind", {}).get("kind") == "Pod":
+        patches = mutate_pod(copy.deepcopy(pod))
+        if patches:
+            response["patchType"] = "JSONPatch"
+            response["patch"] = base64.b64encode(
+                json.dumps(patches).encode()
+            ).decode()
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "response": response,
+    }
+
+
+class WebhookServer:
+    """Minimal HTTPS/HTTP server for the mutate endpoint."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 tls_cert: str = "", tls_key: str = ""):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/mutate"):
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    review = json.loads(self.rfile.read(length) or b"{}")
+                    body = json.dumps(review_response(review)).encode()
+                except (ValueError, KeyError) as e:
+                    self.send_error(400, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                # health endpoint for the Deployment's readinessProbe
+                body = b"ok"
+                self.send_response(200 if self.path == "/healthz" else 404)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.path == "/healthz":
+                    self.wfile.write(body)
+
+            def log_message(self, *args):
+                del args
+
+        del outer
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        if tls_cert and tls_key:
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key)
+            self._server.socket = ctx.wrap_socket(
+                self._server.socket, server_side=True
+            )
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "WebhookServer":
+        import threading
+
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

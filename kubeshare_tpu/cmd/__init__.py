@@ -18,4 +18,5 @@ COMPONENTS = {
     "query-ip": "kubeshare_tpu.cmd.query_ip",
     "workload": "kubeshare_tpu.cmd.workload",
     "simulate": "kubeshare_tpu.cmd.simulate",
+    "webhook": "kubeshare_tpu.cmd.webhook",
 }

@@ -1,0 +1,177 @@
+"""Admission webhook: JSONPatch mutation + AdmissionReview protocol."""
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from kubeshare_tpu.cluster.webhook import (
+    SHIM_PATH,
+    VOLUME_NAME,
+    WebhookServer,
+    mutate_pod,
+    review_response,
+)
+from kubeshare_tpu.scheduler import constants as C
+
+
+def shared_pod(labels=None, containers=None, volumes=None):
+    pod = {
+        "metadata": {
+            "name": "p1",
+            "labels": labels if labels is not None else {
+                C.LABEL_TPU_REQUEST: "0.5",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+            },
+        },
+        "spec": {
+            "schedulerName": C.SCHEDULER_NAME,
+            "containers": containers or [{"name": "main", "image": "x"}],
+        },
+    }
+    if volumes is not None:
+        pod["spec"]["volumes"] = volumes
+    return pod
+
+
+def apply_patch(pod, patches):
+    """Minimal JSONPatch 'add' applier for assertions."""
+    for p in patches:
+        assert p["op"] == "add"
+        parts = [s for s in p["path"].split("/") if s]
+        target = pod
+        for key in parts[:-1]:
+            target = target[int(key)] if isinstance(target, list) else target[key]
+        last = parts[-1]
+        if last == "-":
+            target.append(p["value"])
+        elif isinstance(target, list):
+            target.insert(int(last), p["value"])
+        else:
+            target[last] = p["value"]
+    return pod
+
+
+class TestMutatePod:
+    def test_injects_volume_mount_env(self):
+        pod = shared_pod()
+        patches = mutate_pod(pod)
+        mutated = apply_patch(json.loads(json.dumps(pod)), patches)
+        spec = mutated["spec"]
+        assert spec["volumes"][0]["name"] == VOLUME_NAME
+        assert spec["volumes"][0]["hostPath"]["path"] == C.LIBRARY_PATH
+        c = spec["containers"][0]
+        assert c["volumeMounts"][0]["mountPath"] == C.LIBRARY_PATH
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["TPU_LIBRARY_PATH"] == SHIM_PATH
+        assert env[C.ENV_LIBRARY_PATH] == C.LIBRARY_PATH
+
+    def test_idempotent_on_already_injected(self):
+        pod = shared_pod()
+        mutated = apply_patch(pod, mutate_pod(pod))
+        assert mutate_pod(mutated) == []
+
+    def test_skips_other_schedulers(self):
+        pod = shared_pod()
+        pod["spec"]["schedulerName"] = "default-scheduler"
+        assert mutate_pod(pod) == []
+
+    def test_skips_whole_chip_and_regular_pods(self):
+        multi = shared_pod(labels={
+            C.LABEL_TPU_REQUEST: "2.0",
+            C.LABEL_TPU_LIMIT_ALIASES[1]: "2.0",
+        })
+        assert mutate_pod(multi) == []  # no hook for exclusive chips
+        regular = shared_pod(labels={})
+        assert mutate_pod(regular) == []
+
+    def test_malformed_labels_left_for_prefilter(self):
+        bad = shared_pod(labels={
+            C.LABEL_TPU_REQUEST: "0.8",
+            C.LABEL_TPU_LIMIT_ALIASES[1]: "0.5",  # request > limit
+        })
+        assert mutate_pod(bad) == []
+
+    def test_multi_container_and_existing_env(self):
+        pod = shared_pod(containers=[
+            {"name": "a", "image": "x",
+             "env": [{"name": "TPU_LIBRARY_PATH", "value": "/custom.so"}]},
+            {"name": "b", "image": "y"},
+        ])
+        mutated = apply_patch(pod, mutate_pod(pod))
+        a, b = mutated["spec"]["containers"]
+        # explicit user value wins; only the missing var is added
+        env_a = {e["name"]: e["value"] for e in a["env"]}
+        assert env_a["TPU_LIBRARY_PATH"] == "/custom.so"
+        assert env_a[C.ENV_LIBRARY_PATH] == C.LIBRARY_PATH
+        env_b = {e["name"]: e["value"] for e in b["env"]}
+        assert env_b["TPU_LIBRARY_PATH"] == SHIM_PATH
+        assert all(m["name"] == VOLUME_NAME for c in (a, b)
+                   for m in c["volumeMounts"])
+
+
+class TestAdmissionReview:
+    def make_review(self, pod):
+        return {
+            "apiVersion": "admission.k8s.io/v1",
+            "kind": "AdmissionReview",
+            "request": {
+                "uid": "u-123",
+                "kind": {"group": "", "version": "v1", "kind": "Pod"},
+                "object": pod,
+            },
+        }
+
+    def test_response_carries_patch(self):
+        out = review_response(self.make_review(shared_pod()))
+        resp = out["response"]
+        assert resp["uid"] == "u-123" and resp["allowed"] is True
+        patches = json.loads(base64.b64decode(resp["patch"]))
+        assert any(p["path"] == "/spec/volumes" for p in patches)
+        assert resp["patchType"] == "JSONPatch"
+
+    def test_response_without_patch_for_foreign_pod(self):
+        pod = shared_pod()
+        pod["spec"]["schedulerName"] = "default-scheduler"
+        resp = review_response(self.make_review(pod))["response"]
+        assert resp["allowed"] is True and "patch" not in resp
+
+    def test_non_pod_request_allowed_untouched(self):
+        review = self.make_review(shared_pod())
+        review["request"]["kind"]["kind"] = "Deployment"
+        resp = review_response(review)["response"]
+        assert resp["allowed"] is True and "patch" not in resp
+
+    def test_http_roundtrip(self):
+        server = WebhookServer(host="127.0.0.1", port=0).start()
+        try:
+            body = json.dumps(self.make_review(shared_pod())).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/mutate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                out = json.loads(resp.read())
+            assert out["response"]["uid"] == "u-123"
+            assert out["response"]["patch"]
+            # health endpoint
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz", timeout=5
+            ) as resp:
+                assert resp.read() == b"ok"
+        finally:
+            server.stop()
+
+    def test_bad_body_is_400(self):
+        server = WebhookServer(host="127.0.0.1", port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/mutate", data=b"not json",
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=5)
+            assert err.value.code == 400
+        finally:
+            server.stop()
