@@ -9,6 +9,7 @@ from .pipeline import (
 )
 from .train import make_sharded_train_step
 from .elastic import ElasticTrainer
+from .multihost import DistSpec, hybrid_mesh, maybe_initialize, spec_from_env
 
 __all__ = [
     "MeshPlan",
@@ -24,4 +25,8 @@ __all__ = [
     "stack_stage_params",
     "make_sharded_train_step",
     "ElasticTrainer",
+    "DistSpec",
+    "hybrid_mesh",
+    "maybe_initialize",
+    "spec_from_env",
 ]

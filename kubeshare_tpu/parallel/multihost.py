@@ -1,0 +1,139 @@
+"""Multi-host distributed init: gang pod env -> jax.distributed -> mesh.
+
+The reference's distributed workloads bootstrap through a TorchElastic
+etcd rendezvous plus NCCL env passthrough
+(test/distribute/default/2gpu/resnet50_1.yaml: ``rdzvEndpoint:
+etcd-service:2379``, ``NCCL_IB_DISABLE`` — SURVEY.md §2.8). The
+TPU-native bootstrap needs neither: ``jax.distributed.initialize`` runs
+its own coordinator, and the collectives ride ICI within a host/slice
+and DCN across hosts once shardings are annotated.
+
+This module derives the initialize() triple from what a gang-scheduled
+pod already has:
+
+- coordinator: ``JAX_COORDINATOR_ADDRESS`` (set in the workload spec,
+  e.g. the gang's headless-service DNS of member 0);
+- world size: ``KUBESHARE_NUM_PROCESSES``, falling back to the gang
+  headcount label value exposed via the downward API;
+- process id: ``KUBESHARE_PROCESS_ID``, falling back to the Indexed-Job
+  ``JOB_COMPLETION_INDEX``, falling back to a trailing ``-<n>`` ordinal
+  in the pod hostname (StatefulSet/Indexed-Job naming).
+
+``hybrid_mesh`` then builds the device mesh with the data-parallel axis
+spanning DCN (slowest links, gradient all-reduce tolerates it) and all
+other axes within a host's ICI domain — the Scaling-Book layering.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from .mesh import MeshPlan, make_mesh
+
+ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "KUBESHARE_NUM_PROCESSES"
+ENV_PROCESS_ID = "KUBESHARE_PROCESS_ID"
+ENV_GANG_HEADCOUNT = "KUBESHARE_GROUP_HEADCOUNT"
+
+
+@dataclass(frozen=True)
+class DistSpec:
+    coordinator: str
+    num_processes: int
+    process_id: int
+
+
+def _ordinal_from_hostname(hostname: str) -> Optional[int]:
+    match = re.search(r"-(\d+)$", hostname)
+    return int(match.group(1)) if match else None
+
+
+def spec_from_env(
+    environ: Optional[Mapping[str, str]] = None,
+    hostname: str = "",
+) -> Optional[DistSpec]:
+    """Returns None when this pod is not part of a multi-host gang."""
+    env = os.environ if environ is None else environ
+    coordinator = env.get(ENV_COORDINATOR, "")
+    if not coordinator:
+        return None
+    raw_n = env.get(ENV_NUM_PROCESSES) or env.get(ENV_GANG_HEADCOUNT) or ""
+    try:
+        num_processes = int(raw_n)
+    except ValueError:
+        return None
+    if num_processes < 2:
+        return None  # single process: nothing to initialize
+
+    raw_id = env.get(ENV_PROCESS_ID) or env.get("JOB_COMPLETION_INDEX") or ""
+    if raw_id:
+        try:
+            process_id = int(raw_id)
+        except ValueError:
+            return None
+    else:
+        if not hostname:
+            import socket
+
+            hostname = socket.gethostname()
+        ordinal = _ordinal_from_hostname(hostname)
+        if ordinal is None:
+            return None
+        process_id = ordinal
+    if not 0 <= process_id < num_processes:
+        return None
+    return DistSpec(coordinator, num_processes, process_id)
+
+
+def maybe_initialize(
+    environ: Optional[Mapping[str, str]] = None,
+    hostname: str = "",
+) -> Optional[DistSpec]:
+    """Call before the first jax backend touch. No-op (returns None)
+    outside a gang; otherwise runs jax.distributed.initialize and
+    returns the spec used."""
+    spec = spec_from_env(environ, hostname)
+    if spec is None:
+        return None
+    jax.distributed.initialize(
+        coordinator_address=spec.coordinator,
+        num_processes=spec.num_processes,
+        process_id=spec.process_id,
+    )
+    return spec
+
+
+def hybrid_mesh(plan: Optional[MeshPlan] = None) -> Mesh:
+    """Mesh whose dp axis spans hosts (DCN) and whose remaining axes
+    stay within each host's ICI domain.
+
+    ``plan`` describes the PER-HOST layout (dp = per-host data
+    parallelism, usually 1); the host count multiplies into dp. With one
+    process this is exactly ``make_mesh(plan)``.
+    """
+    n_local = jax.local_device_count()
+    n_hosts = jax.process_count()
+    if plan is None:
+        plan = MeshPlan(tp=n_local) if n_local > 1 else MeshPlan()
+    if plan.total != n_local:
+        raise ValueError(
+            f"per-host plan {plan.shape} needs {plan.total} devices, "
+            f"host has {n_local}"
+        )
+    if n_hosts == 1:
+        return make_mesh(plan)
+
+    from jax.experimental import mesh_utils
+
+    ici_shape = plan.shape
+    dcn_shape = (n_hosts,) + (1,) * (len(ici_shape) - 1)  # dp is axis 0
+    devices = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_mesh_shape=dcn_shape, devices=jax.devices()
+    )
+    return Mesh(devices, plan.axis_names)

@@ -211,3 +211,74 @@ class TestUlyssesAttention:
         uly = make_ulysses_attention(mesh)
         out = jax.jit(uly)(q, q, q)
         assert out.sharding.spec == P(None, None, "sp", None)
+
+
+class TestMultihost:
+    def test_spec_from_env_explicit(self):
+        from kubeshare_tpu.parallel.multihost import spec_from_env
+
+        env = {
+            "JAX_COORDINATOR_ADDRESS": "gang-0.svc:8476",
+            "KUBESHARE_NUM_PROCESSES": "4",
+            "KUBESHARE_PROCESS_ID": "2",
+        }
+        spec = spec_from_env(env)
+        assert spec.coordinator == "gang-0.svc:8476"
+        assert spec.num_processes == 4 and spec.process_id == 2
+
+    def test_spec_from_gang_headcount_and_job_index(self):
+        from kubeshare_tpu.parallel.multihost import spec_from_env
+
+        env = {
+            "JAX_COORDINATOR_ADDRESS": "gang-0.svc:8476",
+            "KUBESHARE_GROUP_HEADCOUNT": "8",
+            "JOB_COMPLETION_INDEX": "5",
+        }
+        spec = spec_from_env(env)
+        assert spec.num_processes == 8 and spec.process_id == 5
+
+    def test_spec_from_hostname_ordinal(self):
+        from kubeshare_tpu.parallel.multihost import spec_from_env
+
+        env = {
+            "JAX_COORDINATOR_ADDRESS": "gang-0.svc:8476",
+            "KUBESHARE_NUM_PROCESSES": "2",
+        }
+        spec = spec_from_env(env, hostname="dp-resnet-1")
+        assert spec.process_id == 1
+        assert spec_from_env(env, hostname="nonumber") is None
+
+    def test_no_gang_means_none(self):
+        from kubeshare_tpu.parallel.multihost import (
+            maybe_initialize, spec_from_env,
+        )
+
+        assert spec_from_env({}) is None
+        # single-member gang: nothing to initialize
+        assert spec_from_env({
+            "JAX_COORDINATOR_ADDRESS": "x:1",
+            "KUBESHARE_NUM_PROCESSES": "1",
+        }) is None
+        # out-of-range id rejected rather than crashing initialize
+        assert spec_from_env({
+            "JAX_COORDINATOR_ADDRESS": "x:1",
+            "KUBESHARE_NUM_PROCESSES": "2",
+            "KUBESHARE_PROCESS_ID": "7",
+        }) is None
+        assert maybe_initialize({}) is None
+
+
+@needs_8_devices
+class TestHybridMesh:
+    def test_single_process_equals_make_mesh(self):
+        from kubeshare_tpu.parallel.multihost import hybrid_mesh
+
+        mesh = hybrid_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
+        assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+        assert mesh.devices.size == 8
+
+    def test_plan_device_mismatch_raises(self):
+        from kubeshare_tpu.parallel.multihost import hybrid_mesh
+
+        with pytest.raises(ValueError):
+            hybrid_mesh(MeshPlan(dp=3))
