@@ -72,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="serve scheduler self-metrics (tpu_scheduler_*) on this "
              "port (0 = off)",
     )
+    parser.add_argument(
+        "--trace-out", default="", metavar="PATH",
+        help="write a Chrome/Perfetto trace of scheduling phases here "
+             "on exit (and refresh it every 100 passes)",
+    )
     return parser
 
 
@@ -116,8 +121,10 @@ class SchedulerMetrics:
     observability layer the reference only has as log lines
     (scheduler.go [Filter]/[Score]/[Reserve] Infof)."""
 
-    def __init__(self, clock=time.time):
+    def __init__(self, clock=time.time, tracer=None, engine=None):
         self.clock = clock
+        self.tracer = tracer
+        self.engine = engine
         self.decisions = {"bound": 0, "waiting": 0, "unschedulable": 0}
         self.passes = 0
         self.last_pass_seconds = 0.0
@@ -155,6 +162,10 @@ class SchedulerMetrics:
                 "tpu_scheduler_last_render_timestamp_seconds", {}, now
             ),
         ]
+        if self.engine is not None:
+            samples += self.engine.utilization_samples()
+        if self.tracer is not None:
+            samples += self.tracer.metric_samples("tpu_scheduler_phase")
         return expfmt.render(samples)
 
 
@@ -200,7 +211,14 @@ class TopologyWatcher:
 
 def run_pass(engine: TpuShareScheduler, cluster, journal, metrics=None) -> int:
     """One queue drain. Returns number of pods scheduled/acted on."""
+    from ..utils.trace import maybe_span
+
     started = time.monotonic()
+    with maybe_span(engine.tracer, "pass"):
+        return _run_pass_inner(engine, cluster, journal, metrics, started)
+
+
+def _run_pass_inner(engine, cluster, journal, metrics, started) -> int:
     pending = [
         p
         for p in cluster.list_pods()
@@ -251,12 +269,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         cluster = SnapshotCluster(args.cluster_state)
         inventory = None
+    tracer = None
+    if args.trace_out or args.metrics_port:
+        from ..utils.trace import Tracer
+
+        # events only matter when a trace file is requested; metrics
+        # alone just needs the histograms
+        tracer = Tracer(keep_events=bool(args.trace_out))
     engine = TpuShareScheduler(
         topology=args.topology,
         cluster=cluster,
         inventory=inventory,
         permit_wait_base=args.permit_wait_base,
         log=log,
+        tracer=tracer,
     )
     journal = None
     if args.decisions_out == "-":
@@ -267,7 +293,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # snapshot adapters expose refresh(); the kube adapter poll()
     sync = getattr(cluster, "refresh", None) or cluster.poll
 
-    metrics = SchedulerMetrics()
+    metrics = SchedulerMetrics(tracer=tracer, engine=engine)
     metrics_server = None
     if args.metrics_port:
         from ..utils.httpserv import MetricServer
@@ -280,6 +306,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.once:
         sync()
         run_pass(engine, cluster, journal, metrics)
+        if args.trace_out:
+            tracer.write_chrome_trace(args.trace_out)
         return 0
 
     # Topology hot-reload: the reference watches its cell file and
@@ -297,8 +325,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             run_pass(engine, cluster, journal, metrics)
         except Exception as e:  # apiserver blips must not kill the loop
             log.error("scheduling pass failed: %s", e)
+        if args.trace_out and metrics.passes % 100 == 0:
+            tracer.write_chrome_trace(args.trace_out)
         elapsed = time.monotonic() - started
         stop.wait(max(0.05, args.interval - elapsed))
+    if args.trace_out:
+        tracer.write_chrome_trace(args.trace_out)
     if metrics_server is not None:
         metrics_server.stop()
     return 0

@@ -24,8 +24,10 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 from ..cells.cell import Cell, CellTree, ChipInfo
 from ..cells.spec import TopologyConfig, load_topology
 from ..cluster.api import ClusterAPI, Node, Pod
+from ..utils import expfmt
 from ..utils.bitmap import RRBitmap
 from ..utils.logger import get_logger
+from ..utils.trace import Tracer, maybe_span
 from . import constants as C
 from .filtering import node_fits
 from .labels import LabelError, PodKind, PodRequirements, parse_pod
@@ -72,6 +74,7 @@ class TpuShareScheduler:
         clock: Callable[[], float] = _time.monotonic,
         permit_wait_base: float = C.PERMIT_WAIT_BASE_SECONDS,
         log=None,
+        tracer: Optional[Tracer] = None,
     ):
         cfg = (
             topology
@@ -84,6 +87,7 @@ class TpuShareScheduler:
         self.clock = clock
         self.permit_wait_base = permit_wait_base
         self.log = log or get_logger("scheduler", level=0)
+        self.tracer = tracer
 
         self.status = PodStatusStore()
         self.groups = PodGroupRegistry(clock=clock)
@@ -431,7 +435,8 @@ class TpuShareScheduler:
             return Decision(state, pod.key, node=existing.node_name,
                             message="already scheduled")
         try:
-            req = self.pre_filter(pod)
+            with maybe_span(self.tracer, "prefilter", pod=pod.key):
+                req = self.pre_filter(pod)
         except Unschedulable as e:
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
@@ -439,32 +444,36 @@ class TpuShareScheduler:
         nodes = [n for n in self.cluster.list_nodes() if n.healthy]
         feasible: List[str] = []
         reasons: List[str] = []
-        for node in sorted(nodes, key=lambda n: n.name):
-            fit, reason = self.filter(pod, req, node.name)
-            if fit:
-                feasible.append(node.name)
-            elif reason:
-                reasons.append(reason)
+        with maybe_span(self.tracer, "filter", pod=pod.key):
+            for node in sorted(nodes, key=lambda n: n.name):
+                fit, reason = self.filter(pod, req, node.name)
+                if fit:
+                    feasible.append(node.name)
+                elif reason:
+                    reasons.append(reason)
         if not feasible:
             return Decision(
                 "unschedulable", pod.key, message="; ".join(reasons) or "no nodes"
             )
 
-        scores = {name: self.score(pod, req, name) for name in feasible}
-        normalized = normalize_scores(scores)
-        best = max(feasible, key=lambda n: (normalized[n], n))
+        with maybe_span(self.tracer, "score", pod=pod.key):
+            scores = {name: self.score(pod, req, name) for name in feasible}
+            normalized = normalize_scores(scores)
+            best = max(feasible, key=lambda n: (normalized[n], n))
 
         if req.kind == PodKind.REGULAR:
             self._bind_regular(pod, best)
             return Decision("bound", pod.key, node=best)
 
         try:
-            status = self.reserve(pod, req, best)
+            with maybe_span(self.tracer, "reserve", pod=pod.key, node=best):
+                status = self.reserve(pod, req, best)
         except Unschedulable as e:
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
 
-        action, extra = self.permit(pod, status)
+        with maybe_span(self.tracer, "permit", pod=pod.key):
+            action, extra = self.permit(pod, status)
         if action == "allow":
             self._bind(pod.key, best)
             return Decision("bound", pod.key, node=best, bound_with=extra)
@@ -487,6 +496,47 @@ class TpuShareScheduler:
                 rejected.extend(self.unreserve(first.pod_key, reject_group=True))
         self.groups.gc()
         return rejected
+
+    def utilization_samples(self) -> List["expfmt.Sample"]:
+        """Per-node occupancy gauges for the scheduler's /metrics:
+        free capacity fraction, free HBM, whole-free chip count, and
+        the pod-manager port pool headroom. The reference exposes no
+        view of its cell tree at all — fragmentation was only
+        observable by reading scheduler logs."""
+        samples: List[expfmt.Sample] = []
+        for node, leaves in sorted(self.tree._leaves_by_node.items()):
+            bound = [l for l in leaves if l.uuid]
+            if not bound:
+                continue
+            free = sum(l.available for l in bound)
+            whole = sum(1 for l in bound if l.is_whole_free)
+            free_mem = sum(l.free_memory for l in bound)
+            full_mem = sum(l.full_memory for l in bound)
+            labels = {"node": node}
+            samples += [
+                expfmt.Sample("tpu_scheduler_node_chips", labels, len(bound)),
+                expfmt.Sample(
+                    "tpu_scheduler_node_free_fraction",
+                    labels, free / len(bound),
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_node_whole_free_chips", labels, whole
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_node_free_memory_bytes", labels, free_mem
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_node_full_memory_bytes", labels, full_mem
+                ),
+            ]
+            ports = self.ports.get(node)
+            if ports is not None:
+                samples.append(
+                    expfmt.Sample(
+                        "tpu_scheduler_node_ports_used", labels, ports.count()
+                    )
+                )
+        return samples
 
     # ================= internals =====================================
 

@@ -1,0 +1,237 @@
+"""Span tracing + latency histograms for the framework's own hot paths.
+
+The reference's observability is log lines per scheduling phase
+([Filter]/[Score]/[Reserve] Infof, pkg/scheduler/scheduler.go:338,416,
+490) and two Prometheus metric families; there is no tracing or timing
+anywhere (SURVEY.md §5). This module is the rebuild's upgrade:
+
+- ``Tracer.span("filter", pod=key)`` times a phase and feeds a
+  fixed-bucket :class:`Histogram` keyed by span name;
+- histograms render as Prometheus-convention ``_bucket``/``_sum``/
+  ``_count`` samples, served from the scheduler's ``/metrics``;
+- the bounded event ring exports Chrome ``chrome://tracing`` /
+  Perfetto JSON (``trace_event`` format) for offline inspection of a
+  scheduling pass — the tool every profiler on the planet can read.
+
+Pure stdlib, thread-safe, and cheap enough to leave on: a disabled
+tracer is a few ns per span (one branch), an enabled one two
+``perf_counter`` calls plus a deque append under a lock.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Mapping, Optional, Tuple
+
+from . import expfmt
+
+# Log-spaced seconds buckets covering 10us .. 10s — a scheduling phase
+# sits in the us..ms range, a full pass over a big cluster in ms..s.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1, 1.0, 3.0, 10.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (Prometheus semantics)."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.sum += value
+        self.count += 1
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float:
+        """Upper bound of the bucket holding the q-quantile (0..1)."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        acc = 0
+        for i, le in enumerate(self.buckets):
+            acc += self.counts[i]
+            if acc >= target:
+                return le
+        return float("inf")
+
+    def samples(
+        self, name: str, labels: Optional[Mapping[str, str]] = None
+    ) -> List[expfmt.Sample]:
+        """``<name>_bucket{le=...}`` (cumulative) + ``_sum`` + ``_count``."""
+        base = dict(labels or {})
+        out: List[expfmt.Sample] = []
+        acc = 0
+        for i, le in enumerate(self.buckets):
+            acc += self.counts[i]
+            out.append(
+                expfmt.Sample(f"{name}_bucket", {**base, "le": repr(le)}, acc)
+            )
+        out.append(
+            expfmt.Sample(
+                f"{name}_bucket", {**base, "le": "+Inf"}, self.count
+            )
+        )
+        out.append(expfmt.Sample(f"{name}_sum", base, self.sum))
+        out.append(expfmt.Sample(f"{name}_count", base, self.count))
+        return out
+
+
+@dataclass
+class SpanEvent:
+    name: str
+    start: float          # seconds, tracer clock
+    duration: float       # seconds
+    thread: int = 0
+    args: Dict[str, str] = field(default_factory=dict)
+
+
+class Tracer:
+    """Bounded span recorder + per-name latency histograms.
+
+    ``enabled=False`` keeps the histogram accounting (metrics stay
+    live) but skips the event ring; ``None`` tracers are handled by
+    callers via :func:`maybe_span`.
+    """
+
+    def __init__(
+        self,
+        max_events: int = 65536,
+        clock: Callable[[], float] = time.perf_counter,
+        keep_events: bool = True,
+        buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        self.clock = clock
+        self.keep_events = keep_events
+        self.max_events = max_events
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._events: List[SpanEvent] = []
+        self._dropped = 0
+        self.histograms: Dict[str, Histogram] = {}
+        self._epoch = clock()
+
+    # -- recording ----------------------------------------------------
+
+    @contextmanager
+    def span(self, name: str, **args: str) -> Iterator[None]:
+        start = self.clock()
+        try:
+            yield
+        finally:
+            self.record(name, start, self.clock() - start, args)
+
+    def record(
+        self,
+        name: str,
+        start: float,
+        duration: float,
+        args: Optional[Mapping[str, str]] = None,
+    ) -> None:
+        with self._lock:
+            hist = self.histograms.get(name)
+            if hist is None:
+                hist = self.histograms[name] = Histogram(self.buckets)
+            hist.observe(duration)
+            if not self.keep_events:
+                return
+            if len(self._events) >= self.max_events:
+                # drop oldest half in one go: O(1) amortized, and a
+                # trace with a hole beats silently losing the tail
+                drop = self.max_events // 2
+                del self._events[:drop]
+                self._dropped += drop
+            self._events.append(
+                SpanEvent(
+                    name=name,
+                    start=start,
+                    duration=duration,
+                    thread=threading.get_ident(),
+                    args={k: str(v) for k, v in (args or {}).items()},
+                )
+            )
+
+    def counter(self, name: str, value: float = 1.0) -> None:
+        """Histogram-free scalar accumulation (rendered as ``_sum``)."""
+        self.record(name, self.clock(), value)
+
+    # -- export -------------------------------------------------------
+
+    def events(self) -> List[SpanEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    def chrome_trace(self, process_name: str = "kubeshare-tpu") -> dict:
+        """``trace_event``-format dict, loadable by chrome://tracing
+        and Perfetto. Timestamps are relative to tracer creation, in
+        microseconds (the format's unit)."""
+        events: List[dict] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": 0,
+                "args": {"name": process_name},
+            }
+        ]
+        for ev in self.events():
+            events.append(
+                {
+                    "name": ev.name,
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": ev.thread % 1_000_000,
+                    "ts": (ev.start - self._epoch) * 1e6,
+                    "dur": ev.duration * 1e6,
+                    "args": ev.args,
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(
+        self, path: str, process_name: str = "kubeshare-tpu"
+    ) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.chrome_trace(process_name), f)
+        import os
+
+        os.replace(tmp, path)
+
+    def metric_samples(self, prefix: str = "tpu_trace") -> List[expfmt.Sample]:
+        """All histograms as ``<prefix>_<span>_seconds`` families."""
+        out: List[expfmt.Sample] = []
+        with self._lock:
+            items = sorted(self.histograms.items())
+        for name, hist in items:
+            metric = f"{prefix}_{name.replace('.', '_')}_seconds"
+            out.extend(hist.samples(metric))
+        return out
+
+
+@contextmanager
+def maybe_span(tracer: Optional[Tracer], name: str, **args: str):
+    """``tracer.span`` if a tracer is wired, else a no-op."""
+    if tracer is None:
+        yield
+    else:
+        with tracer.span(name, **args):
+            yield

@@ -206,6 +206,39 @@ class TestSchedulerCli:
         assert 'tpu_scheduler_decisions_total{status="bound"} 1' in text
         assert "tpu_scheduler_passes_total 1" in text
 
+    def test_trace_out_and_enriched_metrics(self, tmp_path):
+        from kubeshare_tpu.cmd.scheduler import SchedulerMetrics, run_pass
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+        from kubeshare_tpu.utils.trace import Tracer
+        import yaml as _yaml
+
+        topo = tmp_path / "topo.yaml"
+        topo.write_text(TOPO_YAML)
+        state = tmp_path / "state.json"
+        state.write_text(json.dumps(snapshot_dict([shared_pod("p1")])))
+        trace_out = tmp_path / "trace.json"
+        rc = scheduler_cmd.main([
+            "--topology", str(topo), "--cluster-state", str(state),
+            "--decisions-out", "", "--once", "--trace-out", str(trace_out),
+        ])
+        assert rc == 0
+        spans = [e["name"] for e in
+                 json.loads(trace_out.read_text())["traceEvents"]
+                 if e["ph"] == "X"]
+        assert "pass" in spans and "reserve" in spans
+
+        # metrics render includes phase histograms + node utilization
+        cluster = SnapshotCluster(str(state))
+        tracer = Tracer()
+        engine = TpuShareScheduler(
+            _yaml.safe_load(TOPO_YAML), cluster, tracer=tracer
+        )
+        metrics = SchedulerMetrics(tracer=tracer, engine=engine)
+        run_pass(engine, cluster, None, metrics)
+        text = metrics.render()
+        assert "tpu_scheduler_phase_filter_seconds_count" in text
+        assert 'tpu_scheduler_node_free_fraction{node="node-a"}' in text
+
     def test_unschedulable_reported(self, tmp_path):
         topo = tmp_path / "topo.yaml"
         topo.write_text(TOPO_YAML)

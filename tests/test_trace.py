@@ -1,0 +1,190 @@
+"""Tracing subsystem: histograms, span ring, chrome export, and the
+scheduler-engine integration (phase spans + utilization gauges)."""
+
+import json
+import threading
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+from kubeshare_tpu.utils import expfmt
+from kubeshare_tpu.utils.trace import (
+    DEFAULT_BUCKETS, Histogram, Tracer, maybe_span,
+)
+
+GIB = 1 << 30
+
+TOPO = {
+    "cell_types": {
+        "v5e-node": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 50,
+            "is_node_level": True,
+        },
+    },
+    "cells": [{"cell_type": "v5e-node", "cell_id": "node-a"}],
+}
+
+
+def tpu_pod(name, request=0.5):
+    return Pod(
+        name=name, namespace="default",
+        labels={
+            C.LABEL_TPU_REQUEST: str(request),
+            C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+        },
+        scheduler_name=C.SCHEDULER_NAME,
+    )
+
+
+class TestHistogram:
+    def test_buckets_cumulative(self):
+        h = Histogram(buckets=(0.001, 0.01, 0.1))
+        for v in (0.0005, 0.005, 0.005, 0.05, 5.0):
+            h.observe(v)
+        samples = h.samples("lat_seconds")
+        by_le = {s.labels["le"]: s.value for s in samples
+                 if s.name == "lat_seconds_bucket"}
+        assert by_le[repr(0.001)] == 1
+        assert by_le[repr(0.01)] == 3
+        assert by_le[repr(0.1)] == 4
+        assert by_le["+Inf"] == 5
+        sums = {s.name: s.value for s in samples}
+        assert sums["lat_seconds_count"] == 5
+        assert abs(sums["lat_seconds_sum"] - 5.0605) < 1e-9
+
+    def test_quantile_upper_bound(self):
+        h = Histogram(buckets=(0.001, 0.01, 0.1))
+        for _ in range(99):
+            h.observe(0.005)
+        h.observe(0.05)
+        assert h.quantile(0.5) == 0.01
+        assert h.quantile(0.999) == 0.1
+        assert Histogram().quantile(0.5) == 0.0
+
+    def test_overflow_goes_to_inf(self):
+        h = Histogram(buckets=(1.0,))
+        h.observe(100.0)
+        assert h.quantile(0.5) == float("inf")
+
+
+class TestTracer:
+    def test_span_records_event_and_histogram(self):
+        t = Tracer()
+        with t.span("phase_x", pod="default/p"):
+            pass
+        events = t.events()
+        assert len(events) == 1
+        assert events[0].name == "phase_x"
+        assert events[0].args == {"pod": "default/p"}
+        assert t.histograms["phase_x"].count == 1
+
+    def test_ring_drops_oldest_half(self):
+        t = Tracer(max_events=10)
+        for i in range(25):
+            t.record("e", 0.0, 0.001, {"i": i})
+        events = t.events()
+        assert len(events) <= 10
+        # the newest event always survives
+        assert events[-1].args["i"] == "24"
+        # histogram accounting never drops
+        assert t.histograms["e"].count == 25
+
+    def test_keep_events_false_still_counts(self):
+        t = Tracer(keep_events=False)
+        with t.span("x"):
+            pass
+        assert t.events() == []
+        assert t.histograms["x"].count == 1
+
+    def test_chrome_trace_format(self, tmp_path):
+        t = Tracer()
+        with t.span("filter", pod="a"):
+            pass
+        path = str(tmp_path / "trace.json")
+        t.write_chrome_trace(path, process_name="sched")
+        doc = json.loads(open(path).read())
+        events = doc["traceEvents"]
+        meta = [e for e in events if e["ph"] == "M"]
+        spans = [e for e in events if e["ph"] == "X"]
+        assert meta[0]["args"]["name"] == "sched"
+        assert spans[0]["name"] == "filter"
+        assert spans[0]["dur"] >= 0
+        assert spans[0]["args"] == {"pod": "a"}
+
+    def test_metric_samples_prefix(self):
+        t = Tracer()
+        with t.span("reserve"):
+            pass
+        names = {s.name for s in t.metric_samples("tpu_scheduler_phase")}
+        assert "tpu_scheduler_phase_reserve_seconds_bucket" in names
+        assert "tpu_scheduler_phase_reserve_seconds_count" in names
+        # render+parse round trip through the exposition format
+        text = expfmt.render(t.metric_samples())
+        parsed = expfmt.parse(text)
+        count = expfmt.select(parsed, "tpu_trace_reserve_seconds_count")
+        assert count and count[0].value == 1
+
+    def test_thread_safety(self):
+        t = Tracer(max_events=128)
+
+        def work():
+            for _ in range(500):
+                with t.span("s"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert t.histograms["s"].count == 2000
+
+    def test_maybe_span_none(self):
+        with maybe_span(None, "x"):
+            pass  # no tracer, no error
+
+    def test_default_buckets_sorted(self):
+        assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+
+
+class TestSchedulerIntegration:
+    def _env(self, tracer):
+        cluster = FakeCluster()
+        cluster.add_node(
+            "node-a",
+            [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 * GIB, i)
+             for i in range(4)],
+        )
+        sched = TpuShareScheduler(TOPO, cluster, tracer=tracer)
+        return cluster, sched
+
+    def test_phases_traced(self):
+        tracer = Tracer()
+        cluster, sched = self._env(tracer)
+        d = sched.schedule_one(cluster.create_pod(tpu_pod("p1")))
+        assert d.status == "bound"
+        names = {e.name for e in tracer.events()}
+        assert {"prefilter", "filter", "score", "reserve", "permit"} <= names
+
+    def test_utilization_samples(self):
+        cluster, sched = self._env(None)
+        sched.schedule_one(cluster.create_pod(tpu_pod("p1", 0.5)))
+        samples = sched.utilization_samples()
+        get = lambda n: expfmt.select(samples, n, node="node-a")[0].value
+        assert get("tpu_scheduler_node_chips") == 4
+        assert abs(get("tpu_scheduler_node_free_fraction") - 3.5 / 4) < 1e-9
+        assert get("tpu_scheduler_node_whole_free_chips") == 3
+        assert get("tpu_scheduler_node_ports_used") == 1
+        full = get("tpu_scheduler_node_full_memory_bytes")
+        free = get("tpu_scheduler_node_free_memory_bytes")
+        assert full == 64 * GIB and free == full - 8 * GIB
+
+    def test_untraced_engine_unaffected(self):
+        cluster, sched = self._env(None)
+        assert sched.schedule_one(
+            cluster.create_pod(tpu_pod("p1"))
+        ).status == "bound"
