@@ -317,6 +317,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     stop = setup_signal_handler()
     log.info("scheduler loop started (interval %.1fs)", args.interval)
+    trace_written_at = 0
     while not stop.is_set():
         started = time.monotonic()
         try:
@@ -325,8 +326,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             run_pass(engine, cluster, journal, metrics)
         except Exception as e:  # apiserver blips must not kill the loop
             log.error("scheduling pass failed: %s", e)
-        if args.trace_out and metrics.passes % 100 == 0:
+        if args.trace_out and metrics.passes - trace_written_at >= 100:
             tracer.write_chrome_trace(args.trace_out)
+            trace_written_at = metrics.passes
         elapsed = time.monotonic() - started
         stop.wait(max(0.05, args.interval - elapsed))
     if args.trace_out:

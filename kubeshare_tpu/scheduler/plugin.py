@@ -504,8 +504,8 @@ class TpuShareScheduler:
         view of its cell tree at all — fragmentation was only
         observable by reading scheduler logs."""
         samples: List[expfmt.Sample] = []
-        for node, leaves in sorted(self.tree._leaves_by_node.items()):
-            bound = [l for l in leaves if l.uuid]
+        for node in self.tree.nodes():
+            bound = self.tree.leaves_on_node(node)
             if not bound:
                 continue
             free = sum(l.available for l in bound)

@@ -164,10 +164,6 @@ class Tracer:
                 )
             )
 
-    def counter(self, name: str, value: float = 1.0) -> None:
-        """Histogram-free scalar accumulation (rendered as ``_sum``)."""
-        self.record(name, self.clock(), value)
-
     # -- export -------------------------------------------------------
 
     def events(self) -> List[SpanEvent]:
@@ -183,6 +179,8 @@ class Tracer:
         """``trace_event``-format dict, loadable by chrome://tracing
         and Perfetto. Timestamps are relative to tracer creation, in
         microseconds (the format's unit)."""
+        with self._lock:
+            dropped = self._dropped
         events: List[dict] = [
             {
                 "name": "process_name",
@@ -192,6 +190,18 @@ class Tracer:
                 "args": {"name": process_name},
             }
         ]
+        if dropped:
+            # mark the hole: the ring evicted this many oldest spans
+            events.append(
+                {
+                    "name": f"[{dropped} earlier spans dropped]",
+                    "ph": "i",
+                    "s": "g",
+                    "pid": 1,
+                    "tid": 0,
+                    "ts": 0,
+                }
+            )
         for ev in self.events():
             events.append(
                 {
@@ -209,11 +219,13 @@ class Tracer:
     def write_chrome_trace(
         self, path: str, process_name: str = "kubeshare-tpu"
     ) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.chrome_trace(process_name), f)
         import os
 
+        # pid-unique tmp: two daemons mistakenly pointed at the same
+        # --trace-out must each still land a well-formed file
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.chrome_trace(process_name), f)
         os.replace(tmp, path)
 
     def metric_samples(self, prefix: str = "tpu_trace") -> List[expfmt.Sample]:
@@ -221,9 +233,13 @@ class Tracer:
         out: List[expfmt.Sample] = []
         with self._lock:
             items = sorted(self.histograms.items())
+            dropped = self._dropped
         for name, hist in items:
             metric = f"{prefix}_{name.replace('.', '_')}_seconds"
             out.extend(hist.samples(metric))
+        out.append(
+            expfmt.Sample(f"{prefix}_events_dropped_total", {}, dropped)
+        )
         return out
 
 

@@ -93,6 +93,18 @@ class TestTracer:
         # histogram accounting never drops
         assert t.histograms["e"].count == 25
 
+    def test_dropped_events_surface_in_exports(self):
+        t = Tracer(max_events=10)
+        for i in range(25):
+            t.record("e", 0.0, 0.001)
+        doc = t.chrome_trace()
+        markers = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert markers and "dropped" in markers[0]["name"]
+        [dropped] = expfmt.select(
+            t.metric_samples(), "tpu_trace_events_dropped_total"
+        )
+        assert dropped.value > 0
+
     def test_keep_events_false_still_counts(self):
         t = Tracer(keep_events=False)
         with t.span("x"):
