@@ -220,6 +220,11 @@ class CellTree:
         self.free_list: Dict[str, Dict[int, List[Cell]]] = {}
         self.leaf_cells: Dict[str, Cell] = {}  # chip uuid -> leaf
         self._leaves_by_node: Dict[str, List[Cell]] = {}
+        # node -> (bound leaves in tree order, {model: bound leaves});
+        # invalidated on bind/unbind. leaves_on_node sits in the
+        # filter/score per-(pod,node) hot loop — recomputing the state
+        # filter there dominates large-cluster scheduling profiles.
+        self._bound_cache: Dict[str, Tuple[List[Cell], Dict[str, List[Cell]]]] = {}
         self.roots: List[Cell] = []
         for spec in cfg.cells:
             root = self._build_tree(spec)
@@ -362,6 +367,9 @@ class CellTree:
         self.leaf_cells[chip.uuid] = leaf
         self._propagate(leaf, 1.0, 1, chip.memory, chip.memory)
         self._set_health(leaf, True)
+        # invalidate only after the state flip: a recompute racing this
+        # bind must never cache the pre-bind leaf set
+        self._bound_cache.pop(leaf.node, None)
 
     def _unbind_leaf(self, leaf: Cell) -> None:
         """Withdraw a vanished chip: capacity and memory leave the tree,
@@ -382,6 +390,7 @@ class CellTree:
         leaf.full_memory = 0
         leaf.state = CellState.FREE
         self._set_health(leaf, False)
+        self._bound_cache.pop(leaf.node, None)
 
     def _propagate(
         self, leaf: Cell, avail: float, whole: int, free_mem: int, full_mem: int
@@ -460,18 +469,39 @@ class CellTree:
 
     # -- queries -------------------------------------------------------
 
+    def _bound_on_node(self, node: str) -> Tuple[List[Cell], Dict[str, List[Cell]]]:
+        cached = self._bound_cache.get(node)
+        if cached is None:
+            bound = [
+                l
+                for l in self._leaves_by_node.get(node, [])
+                if l.state == CellState.BOUND
+            ]
+            by_model: Dict[str, List[Cell]] = {}
+            for l in bound:
+                by_model.setdefault(l.leaf_cell_type, []).append(l)
+            cached = self._bound_cache[node] = (bound, by_model)
+        return cached
+
     def leaves_on_node(self, node: str, model: Optional[str] = None) -> List[Cell]:
-        leaves = [
+        bound, by_model = self._bound_on_node(node)
+        if model is not None:
+            return list(by_model.get(model, ()))
+        return list(bound)
+
+    def scan_bound_leaves(self, node: str) -> List[Cell]:
+        """Non-caching bound-leaf read for observer threads (the
+        scheduler's /metrics handler): never writes ``_bound_cache``,
+        so only the scheduling thread mutates it. Values read off the
+        leaves may still be torn mid-update — fine for gauges."""
+        return [
             l
             for l in self._leaves_by_node.get(node, [])
             if l.state == CellState.BOUND
         ]
-        if model is not None:
-            leaves = [l for l in leaves if l.leaf_cell_type == model]
-        return leaves
 
     def nodes(self) -> List[str]:
         return sorted(n for n in self._leaves_by_node if n)
 
     def models_on_node(self, node: str) -> List[str]:
-        return sorted({l.leaf_cell_type for l in self.leaves_on_node(node)})
+        return sorted(self._bound_on_node(node)[1])

@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="fault-injection file: lines 'time kind [target]' with kind "
              "in node_down|node_up|pod_kill (# comments allowed)",
     )
+    parser.add_argument(
+        "--bench", action="store_true",
+        help="add wall-clock engine performance to the report: schedule "
+             "attempts/sec, placements/sec, and per-phase p50/p99 latency",
+    )
     return parser
 
 
@@ -82,14 +87,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         if args.trace
         else generate_trace(count=args.count, seed=args.seed)
     )
+    tracer = None
+    if args.bench:
+        from ..utils.trace import Tracer
+
+        tracer = Tracer(keep_events=False)
     sim = Simulator(
         args.topology, nodes,
-        priority_ratio=args.priority_ratio, seed=args.seed,
+        priority_ratio=args.priority_ratio, seed=args.seed, tracer=tracer,
     )
+    import time as _time
+
+    wall0 = _time.perf_counter()
     report = sim.run(
         events, faults=load_faults(args.faults) if args.faults else None
     )
-    print(json.dumps(report.to_dict()))
+    wall = _time.perf_counter() - wall0
+    doc = report.to_dict()
+    if args.bench:
+        decisions = tracer.histograms.get("prefilter")
+        phases = {}
+        for name, hist in sorted(tracer.histograms.items()):
+            phases[name] = {
+                "count": hist.count,
+                "mean_us": round(hist.sum / hist.count * 1e6, 1),
+                "p50_us": round(hist.quantile(0.5) * 1e6, 1),
+                "p99_us": round(hist.quantile(0.99) * 1e6, 1),
+            }
+        doc["bench"] = {
+            "wall_seconds": round(wall, 3),
+            # every schedule_one entry, including retries/unschedulable
+            "schedule_attempts_per_sec": round(
+                (decisions.count if decisions else 0) / wall, 1
+            ),
+            "placements_per_sec": round(report.bound / wall, 1),
+            "phases": phases,
+        }
+    print(json.dumps(doc))
     return 0
 
 

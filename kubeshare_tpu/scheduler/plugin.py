@@ -156,7 +156,7 @@ class TpuShareScheduler:
         else:
             self.tree.set_node_health(node.name, True)
         self._synced_nodes.add(node.name)
-        self.ports.setdefault(node.name, RRBitmap(C.POD_MANAGER_PORT_COUNT))
+        self._node_ports(node.name)
         for pod in self._bound_queue.pop(node.name, []):
             self._restore_bound_pod(pod)
 
@@ -240,9 +240,9 @@ class TpuShareScheduler:
                 <= port
                 < C.POD_MANAGER_PORT_START + C.POD_MANAGER_PORT_COUNT
             ):
-                self.ports.setdefault(
-                    pod.node_name, RRBitmap(C.POD_MANAGER_PORT_COUNT)
-                ).mask(port - C.POD_MANAGER_PORT_START)
+                self._node_ports(pod.node_name).mask(
+                    port - C.POD_MANAGER_PORT_START
+                )
                 status.port = port
             elif port:
                 self.log.error(
@@ -302,17 +302,23 @@ class TpuShareScheduler:
         if req.kind == PodKind.REGULAR:
             return True, ""
         if req.kind == PodKind.SHARED:
-            ports = self.ports.setdefault(
-                node_name, RRBitmap(C.POD_MANAGER_PORT_COUNT)
-            )
-            if ports.find_next_from_current() == -1:
+            if self._node_ports(node_name).find_next_from_current() == -1:
                 return False, f"node {node_name}: pod-manager port pool full"
         return node_fits(self.tree, node_name, req)
 
-    def score(self, pod: Pod, req: PodRequirements, node_name: str) -> float:
-        anchors = self.status.group_placed_leaves(
-            self.groups.get_or_create(pod, req.gang).key
-        )
+    def score(
+        self,
+        pod: Pod,
+        req: PodRequirements,
+        node_name: str,
+        anchors: Optional[List[Cell]] = None,
+    ) -> float:
+        """``anchors`` — the gang's already-placed leaves — may be
+        passed in to amortize the group lookup over a many-node loop."""
+        if anchors is None:
+            anchors = self.status.group_placed_leaves(
+                self.groups.get_or_create(pod, req.gang).key
+            )
         return score_node(self.tree, node_name, req, anchors)
 
     def reserve(self, pod: Pod, req: PodRequirements, node_name: str) -> PodStatus:
@@ -349,9 +355,7 @@ class TpuShareScheduler:
         else:
             leaf = leaves[0]
             memory = _resolved_memory(leaf, req)
-            port_slot = self.ports.setdefault(
-                node_name, RRBitmap(C.POD_MANAGER_PORT_COUNT)
-            ).find_next_and_set()
+            port_slot = self._node_ports(node_name).find_next_and_set()
             if port_slot == -1:
                 raise Unschedulable(
                     f"pod {pod.key}: node {node_name} pod-manager port pool full"
@@ -457,7 +461,12 @@ class TpuShareScheduler:
             )
 
         with maybe_span(self.tracer, "score", pod=pod.key):
-            scores = {name: self.score(pod, req, name) for name in feasible}
+            anchors = self.status.group_placed_leaves(
+                self.groups.get_or_create(pod, req.gang).key
+            )
+            scores = {
+                name: self.score(pod, req, name, anchors) for name in feasible
+            }
             normalized = normalize_scores(scores)
             best = max(feasible, key=lambda n: (normalized[n], n))
 
@@ -505,7 +514,9 @@ class TpuShareScheduler:
         observable by reading scheduler logs."""
         samples: List[expfmt.Sample] = []
         for node in self.tree.nodes():
-            bound = self.tree.leaves_on_node(node)
+            # non-caching read: this runs on the metrics HTTP thread,
+            # which must not write the scheduling thread's leaf cache
+            bound = self.tree.scan_bound_leaves(node)
             if not bound:
                 continue
             free = sum(l.available for l in bound)
@@ -539,6 +550,12 @@ class TpuShareScheduler:
         return samples
 
     # ================= internals =====================================
+
+    def _node_ports(self, node_name: str) -> RRBitmap:
+        ports = self.ports.get(node_name)
+        if ports is None:
+            ports = self.ports[node_name] = RRBitmap(C.POD_MANAGER_PORT_COUNT)
+        return ports
 
     def _bind(self, pod_key: str, node_name: str) -> None:
         self.cluster.bind(pod_key, node_name)

@@ -109,6 +109,7 @@ class Simulator:
         chip_memory: int = 16 << 30,
         priority_ratio: float = 0.5,
         seed: int = 0,
+        tracer=None,
     ):
         import random
 
@@ -123,7 +124,8 @@ class Simulator:
             )
         self.clock_now = 0.0
         self.engine = TpuShareScheduler(
-            topology, self.cluster, clock=lambda: self.clock_now
+            topology, self.cluster, clock=lambda: self.clock_now,
+            tracer=tracer,
         )
         self.total_chips = sum(nodes.values())
         self.priority_ratio = priority_ratio

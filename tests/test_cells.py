@@ -357,6 +357,24 @@ class TestReviewRegressions:
         assert leaf.full_memory == 15 << 30 and leaf.free_memory == 15 << 30
         assert root.full_memory == 8 * (15 << 30)
 
+    def test_leaves_on_node_cache_tracks_bind_unbind(self):
+        # leaves_on_node is cached per node (hot in filter/score); the
+        # cache must invalidate on every bind AND unbind
+        tree = CellTree(load_topology(V5E_16))
+        inv = chips("node-a", "tpu-v5e", 8)
+        tree.bind_node("node-a", inv)
+        assert len(tree.leaves_on_node("node-a")) == 8
+        assert tree.models_on_node("node-a") == ["tpu-v5e"]
+        tree.bind_node("node-a", inv[:3])  # 5 chips vanish
+        assert len(tree.leaves_on_node("node-a")) == 3
+        assert len(tree.leaves_on_node("node-a", "tpu-v5e")) == 3
+        tree.bind_node("node-a", inv)  # all return
+        assert len(tree.leaves_on_node("node-a")) == 8
+        # callers must not be able to corrupt the cache via the
+        # returned list
+        tree.leaves_on_node("node-a").clear()
+        assert len(tree.leaves_on_node("node-a")) == 8
+
     def test_stop_before_start_does_not_hang(self):
         from kubeshare_tpu.utils.httpserv import MetricServer
         srv = MetricServer(host="127.0.0.1", port=0)
