@@ -2,10 +2,11 @@
 
 The workload corpus (workloads/*.yaml) is written as ordinary k8s
 manifests — the same user surface the reference exercises with its
-test/ YAML corpus (labeled Pods, gang Jobs). This loader understands
-just enough of the PodSpec/JobSpec schema to turn them into scheduler
-inputs: metadata (name/namespace/labels/annotations), schedulerName,
-container env, and Job parallelism fan-out.
+test/ YAML corpus (labeled Pods, gang Jobs, Deployments). This loader
+understands just enough of the PodSpec/JobSpec/DeploymentSpec schema to
+turn them into scheduler inputs: template metadata (name/namespace/
+labels/annotations), schedulerName, container env, and Job
+``parallelism`` / Deployment ``replicas`` fan-out.
 """
 
 from __future__ import annotations
@@ -44,20 +45,26 @@ def _pod_from_manifest(meta: dict, spec: dict, name_suffix: str = "") -> Pod:
 def pods_from_manifest(doc: dict) -> List[Pod]:
     """One manifest document -> pods. Jobs fan out to ``parallelism``
     pods named ``<job>-<i>`` (the reference gang example is a Job with
-    parallelism == group_headcount, README.md:70-105)."""
+    parallelism == group_headcount, README.md:70-105); Deployments fan
+    out by ``replicas`` (the reference corpus schedules labeled
+    Deployments the same way)."""
     kind = (doc or {}).get("kind", "")
     meta = (doc or {}).get("metadata", {}) or {}
     if kind == "Pod":
         return [_pod_from_manifest(meta, doc.get("spec", {}) or {})]
-    if kind == "Job":
+    if kind in ("Job", "Deployment"):
         job_spec = doc.get("spec", {}) or {}
-        parallelism = int(job_spec.get("parallelism", 1) or 1)
+        # Jobs fan out by parallelism, Deployments by replicas; an
+        # explicit 0 (scaled-to-zero) produces no pods, only a missing
+        # key defaults to 1
+        key = "parallelism" if kind == "Job" else "replicas"
+        raw = job_spec.get(key)
+        parallelism = 1 if raw is None else int(raw)
         template = job_spec.get("template", {}) or {}
         tmeta = dict(template.get("metadata", {}) or {})
-        # pod labels = job labels overlaid with template labels
-        labels = dict(meta.get("labels", {}) or {})
-        labels.update(tmeta.get("labels", {}) or {})
-        tmeta["labels"] = labels
+        # pods carry the TEMPLATE's labels only, as in real Kubernetes
+        # (controller-level metadata.labels never reach the pods)
+        tmeta["labels"] = dict(tmeta.get("labels", {}) or {})
         tmeta.setdefault("name", meta.get("name", "job"))
         tmeta.setdefault("namespace", meta.get("namespace", "default"))
         return [

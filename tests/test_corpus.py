@@ -1,0 +1,173 @@
+"""Acceptance corpus: every workloads/*.yaml goes through the manifest
+loader, label validation, and a full scheduling cycle.
+
+The reference's test/ corpus (76 YAMLs) is its validation matrix
+(SURVEY.md §4); this is ours. Convention: files whose first line starts
+with ``# INVALID`` must be *permanently* rejected (label error,
+retryable=False); every other file must parse cleanly and either bind,
+wait on a gang barrier, or park as transiently unschedulable.
+"""
+
+import glob
+import os
+
+import pytest
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.cluster.k8syaml import load_pods
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.labels import LabelError, parse_pod
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKLOADS = os.path.join(REPO, "workloads")
+GIB = 1 << 30
+
+TOPO = {
+    "cell_types": {
+        "v5e-node": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 50,
+            "is_node_level": True,
+        },
+    },
+    "cells": [
+        {"cell_type": "v5e-node", "cell_id": "node-a"},
+        {"cell_type": "v5e-node", "cell_id": "node-b"},
+    ],
+}
+
+CORPUS = sorted(
+    glob.glob(os.path.join(WORKLOADS, "**", "*.yaml"), recursive=True)
+)
+
+
+def is_invalid(path):
+    with open(path) as f:
+        return f.readline().startswith("# INVALID")
+
+
+def rel(path):
+    return os.path.relpath(path, WORKLOADS)
+
+
+def make_env():
+    cluster = FakeCluster()
+    for node in ("node-a", "node-b"):
+        cluster.add_node(
+            node,
+            [ChipInfo(f"{node}-chip-{i}", "tpu-v5e", 16 * GIB, i)
+             for i in range(4)],
+        )
+    return cluster, TpuShareScheduler(TOPO, cluster)
+
+
+class TestCorpus:
+    def test_corpus_is_nontrivial(self):
+        assert len(CORPUS) >= 20
+        assert sum(1 for p in CORPUS if is_invalid(p)) >= 6
+
+    @pytest.mark.parametrize("path", CORPUS, ids=rel)
+    def test_loads_as_pods(self, path):
+        pods = load_pods(path)
+        assert pods, f"{rel(path)} produced no pods"
+        for pod in pods:
+            assert pod.scheduler_name == C.SCHEDULER_NAME
+
+    @pytest.mark.parametrize(
+        "path", [p for p in CORPUS if is_invalid(p)], ids=rel
+    )
+    def test_invalid_files_rejected(self, path):
+        for pod in load_pods(path):
+            with pytest.raises(LabelError):
+                parse_pod(pod)
+        # and the engine reports them permanently unschedulable
+        cluster, sched = make_env()
+        for pod in load_pods(path):
+            decision = sched.schedule_one(cluster.create_pod(pod))
+            assert decision.status == "unschedulable"
+            assert not decision.retryable
+
+    @pytest.mark.parametrize(
+        "path", [p for p in CORPUS if not is_invalid(p)], ids=rel
+    )
+    def test_valid_files_schedule(self, path):
+        for pod in load_pods(path):
+            parse_pod(pod)  # must not raise
+        cluster, sched = make_env()
+        for pod in load_pods(path):
+            decision = sched.schedule_one(cluster.create_pod(pod))
+            assert decision.status in ("bound", "waiting", "unschedulable")
+            if decision.status == "unschedulable":
+                # valid-label files may only park transiently (e.g.
+                # pinned to a model this cluster lacks)
+                assert decision.retryable, (
+                    f"{rel(path)}: {decision.message}"
+                )
+
+    def test_scaled_to_zero_deployment_yields_no_pods(self):
+        from kubeshare_tpu.cluster.k8syaml import pods_from_manifest
+
+        doc = {
+            "kind": "Deployment",
+            "metadata": {"name": "zero"},
+            "spec": {"replicas": 0, "template": {"spec": {}}},
+        }
+        assert pods_from_manifest(doc) == []
+        # missing key still defaults to 1
+        doc["spec"].pop("replicas")
+        assert len(pods_from_manifest(doc)) == 1
+
+    def test_controller_labels_do_not_reach_pods(self):
+        from kubeshare_tpu.cluster.k8syaml import pods_from_manifest
+
+        doc = {
+            "kind": "Deployment",
+            "metadata": {
+                "name": "d", "labels": {C.LABEL_GROUP_NAME: "leaky"},
+            },
+            "spec": {
+                "replicas": 1,
+                "template": {
+                    "metadata": {"labels": {"app": "x"}},
+                    "spec": {},
+                },
+            },
+        }
+        [pod] = pods_from_manifest(doc)
+        # real k8s puts only template labels on pods
+        assert pod.labels == {"app": "x"}
+
+    def test_gang_job_binds_together(self):
+        # the Job controller creates all members before any schedules
+        cluster, sched = make_env()
+        pods = [
+            cluster.create_pod(p)
+            for p in load_pods(os.path.join(WORKLOADS, "gang", "gang-job.yaml"))
+        ]
+        decisions = [sched.schedule_one(p) for p in pods]
+        assert decisions[-1].status == "bound"
+        assert all(
+            d.status in ("bound", "waiting") for d in decisions
+        )
+
+    def test_gang_deployment_fans_out_and_binds(self):
+        cluster, sched = make_env()
+        pods = load_pods(
+            os.path.join(WORKLOADS, "gang", "gang-deployment.yaml")
+        )
+        assert len(pods) == 4
+        assert {p.name for p in pods} == {
+            f"gang-deploy-{i}" for i in range(4)
+        }
+        pods = [cluster.create_pod(p) for p in pods]
+        decisions = [sched.schedule_one(p) for p in pods]
+        # threshold 0.75 of 4 -> barrier lifts at the 3rd member
+        assert decisions[0].status == decisions[1].status == "waiting"
+        assert decisions[2].status == "bound"
+        assert set(decisions[2].bound_with) == {
+            "default/gang-deploy-0", "default/gang-deploy-1"
+        }
+        assert decisions[3].status == "bound"
