@@ -375,3 +375,116 @@ class TestBurstGate:
         with b.burst():
             assert time.perf_counter() - t0 < 1.0
         a.close(), b.close()
+
+
+class TestNodePlaneIntegration:
+    """The whole node plane chained end-to-end, reference data flow
+    (SURVEY.md §1): scheduler places pods -> aggregator exports
+    tpu_requirement -> config daemon writes per-chip files -> launcher
+    spawns the real arbiter + pod managers -> an app-side client is
+    time-token gated -> pod deletion tears its manager down."""
+
+    @staticmethod
+    def _free_port_pair():
+        """A base with base and base+1 both bindable — the scheduler
+        hands out POD_MANAGER_PORT_START + slot, and the default base
+        (50050/50051, gRPC territory) may be taken on a shared host."""
+        for _ in range(50):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+            s.close()
+            try:
+                probe = socket.socket()
+                probe.bind(("127.0.0.1", base + 1))
+                probe.close()
+                return base
+            except OSError:
+                continue
+        raise RuntimeError("no adjacent free port pair found")
+
+    def test_scheduler_to_gated_client(self, tmp_path, monkeypatch):
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.metrics.aggregator import Aggregator
+        from kubeshare_tpu.nodeconfig.daemon import NodeConfigDaemon
+        from kubeshare_tpu.scheduler import constants as C
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        monkeypatch.setattr(
+            C, "POD_MANAGER_PORT_START", self._free_port_pair()
+        )
+        GIB = 1 << 30
+        base = str(tmp_path)
+        uuid = "node-a-chip-0"
+        cluster = FakeCluster()
+        cluster.add_node("node-a", [ChipInfo(uuid, "tpu-v5e", 16 * GIB, 0)])
+        topo = {
+            "cell_types": {
+                "v5e-node": {
+                    "child_cell_type": "tpu-v5e",
+                    "child_cell_number": 1,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+            },
+            "cells": [{"cell_type": "v5e-node", "cell_id": "node-a"}],
+        }
+        sched = TpuShareScheduler(topo, cluster)
+
+        def make_pod(name, request):
+            return Pod(
+                name=name, namespace="default",
+                labels={
+                    C.LABEL_TPU_REQUEST: str(request),
+                    C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+                },
+                scheduler_name=C.SCHEDULER_NAME,
+            )
+
+        pods = [cluster.create_pod(make_pod(f"p{i}", 0.4)) for i in range(2)]
+        for pod in pods:
+            assert sched.schedule_one(pod).status == "bound"
+        ports = [int(p.annotations[C.ANNOTATION_MANAGER_PORT]) for p in pods]
+        assert all(p.annotations[C.ANNOTATION_CHIP_UUID] == uuid for p in pods)
+
+        # metrics plane -> node config files
+        daemon = NodeConfigDaemon("node-a", base, Aggregator(cluster).samples)
+        assert daemon.sync() == {uuid: 2}
+
+        # launcher spawns the real arbiter + one pmgr per port entry
+        launcher = NodeLauncher(
+            base, [uuid], base_port=free_port(),
+            base_quota_ms=50, min_quota_ms=5, window_ms=1000,
+        )
+        try:
+            launcher.start_arbiters()
+            wait_for_port(launcher.chips[uuid].port)
+            launcher.reconcile()
+            for port in ports:
+                wait_for_port(port)
+
+            # app-side: both pods gated through their own managers
+            with TokenClient("127.0.0.1", ports[0]) as c0:
+                c0.acquire()
+                c0.release(2.0)
+                assert {s.pod for s in c0.stats()} == {
+                    "default/p0", "default/p1"
+                }
+
+            # teardown: pod p1 deleted -> requirement gone -> file
+            # rewritten -> launcher kills its manager, p0 survives
+            time.sleep(1.1)  # distinct mtime second for the reconcile diff
+            cluster.delete_pod("default/p1")
+            assert daemon.sync() == {uuid: 1}
+            launcher.reconcile()
+            time.sleep(0.3)
+            with pytest.raises(OSError):
+                socket.create_connection(
+                    ("127.0.0.1", ports[1]), timeout=0.3
+                )
+            with TokenClient("127.0.0.1", ports[0]) as c0:
+                assert c0.ping()
+        finally:
+            launcher.shutdown()
