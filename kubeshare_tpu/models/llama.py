@@ -99,6 +99,22 @@ def llama_apply(
     use_flash: Optional[bool] = None,
 ) -> jnp.ndarray:
     """tokens [B, T] int32 -> logits [B, T, vocab]."""
+    x = llama_hidden(params, tokens, cfg, positions, use_flash)
+    return _matmul(x, params["lm_head"], jnp.dtype(cfg.dtype)).astype(
+        jnp.float32
+    )
+
+
+def llama_hidden(
+    params: Dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig = LlamaConfig(),
+    positions: Optional[jnp.ndarray] = None,
+    use_flash: Optional[bool] = None,
+) -> jnp.ndarray:
+    """The trunk: tokens [B, T] -> final-norm hidden [B, T, dim]
+    (everything but the lm_head matmul — the chunked loss fuses that
+    matmul into its online softmax, ops/xent.py)."""
     dtype = jnp.dtype(cfg.dtype)
     batch, seq = tokens.shape
     hd = cfg.dim // cfg.num_heads
@@ -125,11 +141,33 @@ def llama_apply(
         up = _matmul(h, layer["w_up"], dtype)
         x = x + _matmul(gate * up, layer["w_down"], dtype)
     x = rmsnorm(params["final_norm"], x)
-    return _matmul(x, params["lm_head"], dtype).astype(jnp.float32)
+    return x
 
 
-def llama_loss(params, tokens, cfg: LlamaConfig) -> jnp.ndarray:
-    """Next-token LM loss on a [B, T] batch."""
+def llama_loss(
+    params, tokens, cfg: LlamaConfig, vocab_chunk: int = 0
+) -> jnp.ndarray:
+    """Next-token LM loss on a [B, T] batch.
+
+    ``vocab_chunk > 0`` routes through the fused chunked
+    linear-cross-entropy (ops/xent.py): the [B, T, vocab] logit tensor
+    is never materialized — the memory saver for long-context training
+    with large vocabularies.
+    """
+    if vocab_chunk > 0:
+        from ..ops.xent import chunked_linear_xent
+
+        dtype = jnp.dtype(cfg.dtype)
+        hidden = llama_hidden(params, tokens[:, :-1], cfg)
+        n = hidden.shape[0] * hidden.shape[1]
+        # tile matmuls run in cfg.dtype (f32 accumulation inside), same
+        # operand dtypes as the dense path's _matmul
+        return chunked_linear_xent(
+            hidden.reshape(n, -1).astype(dtype),
+            params["lm_head"].astype(dtype),
+            tokens[:, 1:].reshape(n),
+            vocab_chunk,
+        )
     logits = llama_apply(params, tokens[:, :-1], cfg)
     return cross_entropy_loss(logits, tokens[:, 1:])
 

@@ -1,0 +1,137 @@
+"""Fused linear + softmax-cross-entropy over vocab chunks.
+
+The LM loss is the canonical long-context HBM hog: materializing
+``hidden @ lm_head`` logits costs O(B*T*V) — at 128k vocab and 8k
+tokens that is 4 GiB of f32 before softmax even starts, rivaling the
+model itself. This computes the loss (and, via a custom VJP, both
+gradients) while only ever holding one ``[N, chunk]`` logit tile:
+
+- forward: ``lax.scan`` over vocab chunks with an online
+  max/log-sum-exp (the flash-attention trick applied to the
+  classifier), gathering each token's label logit on the fly;
+- backward: a second scan recomputes each chunk's logits from the
+  saved normalizer and accumulates ``dHidden`` (chunk @ Wᵀ) and ``dW``
+  (hiddenᵀ @ chunk) per tile.
+
+Chunk matmuls stay big, static-shaped, and bf16-friendly, so they tile
+straight onto the MXU; XLA fuses the elementwise online update into
+their epilogue. Memory drops from O(N·V) to O(N·chunk + D·chunk).
+
+The reference has no compute ops at all (its workloads are containers,
+SURVEY.md §2.8); this belongs to the same workload library as the
+Pallas flash attention (ops/attention.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_to_chunks(w, chunk: int):
+    """Pad [D, V] -> [D, steps*chunk] so tile slices never clamp.
+    Padded columns are masked to -inf downstream, never read back."""
+    vocab = w.shape[1]
+    steps = -(-vocab // chunk)
+    pad = steps * chunk - vocab
+    if pad:
+        w = jnp.pad(w, ((0, 0), (0, pad)))
+    return w, steps
+
+
+def _chunk_logits(hidden, w_pad, vocab: int, chunk: int, i):
+    """Logits [N, chunk] of tile i; columns >= vocab -> -inf."""
+    d = w_pad.shape[0]
+    w_c = jax.lax.dynamic_slice(w_pad, (0, i * chunk), (d, chunk))
+    logits = jnp.dot(
+        hidden, w_c, preferred_element_type=jnp.float32
+    ).astype(jnp.float32)
+    cols = i * chunk + jnp.arange(chunk)
+    return jnp.where((cols < vocab)[None, :], logits, -jnp.inf), cols, w_c
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_linear_xent(hidden, w, labels, chunk: int = 2048):
+    """Mean cross-entropy of ``softmax(hidden @ w)`` against ``labels``
+    without materializing the logits.
+
+    hidden: [N, D] (any float dtype; accumulation is f32)
+    w:      [D, V] classifier / lm_head matrix
+    labels: [N] int32 in [0, V)
+    chunk:  vocab tile width (static); V need not divide it
+    """
+    loss, _ = _xent_fwd(hidden, w, labels, chunk)
+    return loss
+
+
+def _xent_fwd(hidden, w, labels, chunk: int):
+    n = hidden.shape[0]
+    vocab = w.shape[1]
+    w_pad, steps = _pad_to_chunks(w, chunk)
+
+    def body(carry, i):
+        m, s, lab = carry
+        logits, cols, _ = _chunk_logits(hidden, w_pad, vocab, chunk, i)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # exp(-inf - m) == 0 handles both padded cols and the first tile
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        hit = cols[None, :] == labels[:, None]
+        lab = lab + jnp.sum(jnp.where(hit, logits, 0.0), axis=-1)
+        return (m_new, s, lab), None
+
+    init = (
+        jnp.full((n,), -jnp.inf, jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+        jnp.zeros((n,), jnp.float32),
+    )
+    (m, s, label_logit), _ = jax.lax.scan(body, init, jnp.arange(steps))
+    logz = m + jnp.log(s)
+    loss = jnp.mean(logz - label_logit)
+    return loss, (hidden, w, labels, logz)
+
+
+def _xent_bwd(chunk: int, res, g):
+    hidden, w, labels, logz = res
+    n, d = hidden.shape
+    vocab = w.shape[1]
+    w_pad, steps = _pad_to_chunks(w, chunk)
+    scale = g / n  # d(mean)/d(per-token)
+
+    def body(carry, i):
+        dh, dw = carry
+        logits, cols, w_c = _chunk_logits(hidden, w_pad, vocab, chunk, i)
+        p = jnp.exp(logits - logz[:, None])          # softmax tile
+        hit = cols[None, :] == labels[:, None]
+        dlogits = (p - hit.astype(p.dtype)) * scale  # [N, chunk]
+        dh = dh + jnp.dot(
+            dlogits, w_c.T.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        dw_c = jnp.dot(
+            hidden.T.astype(jnp.float32), dlogits,
+            preferred_element_type=jnp.float32,
+        )
+        dw = jax.lax.dynamic_update_slice(
+            dw,
+            jax.lax.dynamic_slice(dw, (0, i * chunk), (d, chunk)) + dw_c,
+            (0, i * chunk),
+        )
+        return (dh, dw), None
+
+    init = (
+        jnp.zeros((n, d), jnp.float32),
+        jnp.zeros((d, w_pad.shape[1]), jnp.float32),
+    )
+    (dh, dw), _ = jax.lax.scan(body, init, jnp.arange(steps))
+    return (
+        dh.astype(hidden.dtype),
+        dw[:, :vocab].astype(w.dtype),
+        None,
+    )
+
+
+chunked_linear_xent.defvjp(_xent_fwd, _xent_bwd)

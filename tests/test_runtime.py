@@ -394,6 +394,8 @@ class TestNodePlaneIntegration:
             s.bind(("127.0.0.1", 0))
             base = s.getsockname()[1]
             s.close()
+            if base + 1 > 65535:
+                continue
             try:
                 probe = socket.socket()
                 probe.bind(("127.0.0.1", base + 1))
