@@ -15,7 +15,9 @@ gradients) while only ever holding one ``[N, chunk]`` logit tile:
 
 Chunk matmuls stay big, static-shaped, and bf16-friendly, so they tile
 straight onto the MXU; XLA fuses the elementwise online update into
-their epilogue. Memory drops from O(N·V) to O(N·chunk + D·chunk).
+their epilogue. Memory drops from O(N·V) to O(N·chunk + D·chunk); the
+weight matrix is never copied — a ragged final tile is handled by
+letting ``dynamic_slice`` clamp and masking the re-read columns.
 
 The reference has no compute ops at all (its workloads are containers,
 SURVEY.md §2.8); this belongs to the same workload library as the
@@ -30,26 +32,32 @@ import jax
 import jax.numpy as jnp
 
 
-def _pad_to_chunks(w, chunk: int):
-    """Pad [D, V] -> [D, steps*chunk] so tile slices never clamp.
-    Padded columns are masked to -inf downstream, never read back."""
-    vocab = w.shape[1]
+def _tile_plan(vocab: int, chunk: int):
+    """(chunk, steps, ragged): tile width never exceeds vocab, and the
+    last tile of a ragged vocab is clamped to end at ``vocab`` —
+    overlapping columns are masked out rather than the weight padded
+    (padding would copy the full lm_head, the very tensor this op
+    exists to avoid duplicating)."""
+    chunk = min(chunk, vocab)
     steps = -(-vocab // chunk)
-    pad = steps * chunk - vocab
-    if pad:
-        w = jnp.pad(w, ((0, 0), (0, pad)))
-    return w, steps
+    return chunk, steps, vocab % chunk != 0
 
 
-def _chunk_logits(hidden, w_pad, vocab: int, chunk: int, i):
-    """Logits [N, chunk] of tile i; columns >= vocab -> -inf."""
-    d = w_pad.shape[0]
-    w_c = jax.lax.dynamic_slice(w_pad, (0, i * chunk), (d, chunk))
+def _chunk_logits(hidden, w, chunk: int, i):
+    """Logits [N, chunk] of tile i plus its true column ids and a mask
+    for columns this tile owns (False on the clamped tail's re-read
+    overlap — those columns belong to the previous tile)."""
+    d, vocab = w.shape
+    # dynamic_slice clamps the start to vocab - chunk; mirror that
+    # clamp to know which columns we actually loaded
+    start = jnp.minimum(i * chunk, vocab - chunk)
+    w_c = jax.lax.dynamic_slice(w, (0, start), (d, chunk))
     logits = jnp.dot(
         hidden, w_c, preferred_element_type=jnp.float32
     ).astype(jnp.float32)
-    cols = i * chunk + jnp.arange(chunk)
-    return jnp.where((cols < vocab)[None, :], logits, -jnp.inf), cols, w_c
+    cols = start + jnp.arange(chunk)
+    owned = cols >= i * chunk
+    return logits, cols, owned, w_c, start
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -68,18 +76,18 @@ def chunked_linear_xent(hidden, w, labels, chunk: int = 2048):
 
 def _xent_fwd(hidden, w, labels, chunk: int):
     n = hidden.shape[0]
-    vocab = w.shape[1]
-    w_pad, steps = _pad_to_chunks(w, chunk)
+    chunk, steps, _ = _tile_plan(w.shape[1], chunk)
 
     def body(carry, i):
         m, s, lab = carry
-        logits, cols, _ = _chunk_logits(hidden, w_pad, vocab, chunk, i)
+        logits, cols, owned, _, _ = _chunk_logits(hidden, w, chunk, i)
+        logits = jnp.where(owned[None, :], logits, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
-        # exp(-inf - m) == 0 handles both padded cols and the first tile
+        # exp(-inf - m) == 0 covers both masked cols and the first tile
         s = s * jnp.exp(m - m_new) + jnp.sum(
             jnp.exp(logits - m_new[:, None]), axis=-1
         )
-        hit = cols[None, :] == labels[:, None]
+        hit = (cols[None, :] == labels[:, None]) & owned[None, :]
         lab = lab + jnp.sum(jnp.where(hit, logits, 0.0), axis=-1)
         return (m_new, s, lab), None
 
@@ -98,15 +106,17 @@ def _xent_bwd(chunk: int, res, g):
     hidden, w, labels, logz = res
     n, d = hidden.shape
     vocab = w.shape[1]
-    w_pad, steps = _pad_to_chunks(w, chunk)
+    chunk, steps, ragged = _tile_plan(vocab, chunk)
     scale = g / n  # d(mean)/d(per-token)
 
     def body(carry, i):
         dh, dw = carry
-        logits, cols, w_c = _chunk_logits(hidden, w_pad, vocab, chunk, i)
+        logits, cols, owned, w_c, start = _chunk_logits(hidden, w, chunk, i)
         p = jnp.exp(logits - logz[:, None])          # softmax tile
-        hit = cols[None, :] == labels[:, None]
-        dlogits = (p - hit.astype(p.dtype)) * scale  # [N, chunk]
+        hit = (cols[None, :] == labels[:, None]) & owned[None, :]
+        dlogits = jnp.where(
+            owned[None, :], (p - hit.astype(p.dtype)) * scale, 0.0
+        )
         dh = dh + jnp.dot(
             dlogits, w_c.T.astype(jnp.float32),
             preferred_element_type=jnp.float32,
@@ -115,23 +125,20 @@ def _xent_bwd(chunk: int, res, g):
             hidden.T.astype(jnp.float32), dlogits,
             preferred_element_type=jnp.float32,
         )
-        dw = jax.lax.dynamic_update_slice(
-            dw,
-            jax.lax.dynamic_slice(dw, (0, i * chunk), (d, chunk)) + dw_c,
-            (0, i * chunk),
-        )
+        if ragged:
+            # the clamped tail tile overlaps the previous tile's
+            # columns; its overlap rows are 0 in dw_c, so read+add
+            # preserves what the owner tile wrote
+            dw_c = dw_c + jax.lax.dynamic_slice(dw, (0, start), (d, chunk))
+        dw = jax.lax.dynamic_update_slice(dw, dw_c, (0, start))
         return (dh, dw), None
 
     init = (
         jnp.zeros((n, d), jnp.float32),
-        jnp.zeros((d, w_pad.shape[1]), jnp.float32),
+        jnp.zeros((d, vocab), jnp.float32),
     )
     (dh, dw), _ = jax.lax.scan(body, init, jnp.arange(steps))
-    return (
-        dh.astype(hidden.dtype),
-        dw[:, :vocab].astype(w.dtype),
-        None,
-    )
+    return dh.astype(hidden.dtype), dw.astype(w.dtype), None
 
 
 chunked_linear_xent.defvjp(_xent_fwd, _xent_bwd)

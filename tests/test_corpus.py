@@ -107,6 +107,71 @@ class TestCorpus:
                     f"{rel(path)}: {decision.message}"
                 )
 
+    def test_baseline_config_2_cifar_pair_colocates(self):
+        # BASELINE config 2: two 0.5 CIFAR pods share ONE chip
+        cluster, sched = make_env()
+        pods = [
+            cluster.create_pod(p)
+            for p in load_pods(os.path.join(WORKLOADS, "cifar", "cifar-pair.yaml"))
+        ]
+        assert len(pods) == 2
+        for pod in pods:
+            assert sched.schedule_one(pod).status == "bound"
+        uuids = {
+            sched.status.get(p.key).uuids[0] for p in pods
+        }
+        assert len(uuids) == 1  # co-located on the same chip
+
+    def test_baseline_config_3_lstm_gang_low_threshold(self):
+        # BASELINE config 3: headcount=5, threshold=0.2 -> min_available
+        # 1: members start as they arrive, no barrier stall
+        cluster, sched = make_env()
+        pods = [
+            cluster.create_pod(p)
+            for p in load_pods(os.path.join(WORKLOADS, "lstm", "lstm-gang.yaml"))
+        ]
+        assert len(pods) == 5
+        decisions = [sched.schedule_one(p) for p in pods]
+        assert all(d.status == "bound" for d in decisions)
+
+    def test_baseline_config_4_dp_resnet_fills_both_nodes(self):
+        # BASELINE config 4: 8 whole-chip gang members over 2x4 chips,
+        # threshold 1.0 -> all bind together at the 8th
+        cluster, sched = make_env()
+        pods = [
+            cluster.create_pod(p)
+            for p in load_pods(
+                os.path.join(WORKLOADS, "distribute", "dp-resnet-8chip.yaml")
+            )
+        ]
+        assert len(pods) == 8
+        decisions = [sched.schedule_one(p) for p in pods]
+        assert all(d.status == "waiting" for d in decisions[:7])
+        assert decisions[7].status == "bound"
+        assert len(decisions[7].bound_with) == 7
+        per_node = {}
+        for p in pods:
+            per_node.setdefault(sched.status.get(p.key).node_name, []).append(p)
+        assert {len(v) for v in per_node.values()} == {4}
+
+    def test_baseline_config_5_llama_serving_defrag_with_mem_cap(self):
+        # BASELINE config 5: 4 x 0.25 opportunistic pods pack onto one
+        # chip, each with an explicit 4 GiB HBM cap annotation
+        cluster, sched = make_env()
+        pods = [
+            cluster.create_pod(p)
+            for p in load_pods(
+                os.path.join(WORKLOADS, "serving", "llama-serve-quarter.yaml")
+            )
+        ]
+        assert len(pods) == 4
+        for pod in pods:
+            assert sched.schedule_one(pod).status == "bound"
+        uuids = {sched.status.get(p.key).uuids[0] for p in pods}
+        assert len(uuids) == 1
+        for pod in pods:
+            assert pod.annotations[C.ANNOTATION_TPU_MEMORY] == str(4 * GIB)
+
     def test_scaled_to_zero_deployment_yields_no_pods(self):
         from kubeshare_tpu.cluster.k8syaml import pods_from_manifest
 
