@@ -23,10 +23,8 @@ Prints ONE json line:
 
 import json
 import os
-import subprocess
 import sys
 import tempfile
-import threading
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
@@ -35,8 +33,9 @@ sys.path.insert(0, REPO)
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+from bench_common import p99, run_threads, start_arbiter as _start, stop_arbiter  # noqa: E402
 from kubeshare_tpu.models import MnistConfig, init_mnist, make_mnist_train_step  # noqa: E402
-from kubeshare_tpu.nodeconfig.files import ConfigEntry, write_config_file  # noqa: E402
+from kubeshare_tpu.nodeconfig.files import ConfigEntry  # noqa: E402
 from kubeshare_tpu.runtime.client import TokenClient  # noqa: E402
 from kubeshare_tpu.runtime.hook import SharedChipGate  # noqa: E402
 
@@ -83,30 +82,11 @@ def run_stream(step, params, images, labels, seconds, stall_s, gate=None,
 
 
 def start_arbiter(tmpdir: str):
-    schd = os.path.join(REPO, "runtime_native", "build", "tpu-schd")
-    if not os.path.exists(schd):
-        subprocess.run(["make", "-C", os.path.join(REPO, "runtime_native")],
-                       check=False, capture_output=True)
-    if not os.path.exists(schd):
-        return None
-    entries = [
-        ConfigEntry(f"bench/pod-{i}", 1.0, 0.125, 0) for i in range(PODS)
-    ]
-    write_config_file(tmpdir, "bench-chip", entries)
-    proc = subprocess.Popen(
-        [schd, "-p", os.path.join(tmpdir, "config"), "-f", "bench-chip",
-         "-P", str(ARBITER_PORT), "-q", "20", "-m", "2", "-w", "1000",
-         "-c", "2", "-H", "127.0.0.1"],
-        stderr=subprocess.DEVNULL,
+    return _start(
+        tmpdir, "bench-chip",
+        [ConfigEntry(f"bench/pod-{i}", 1.0, 0.125, 0) for i in range(PODS)],
+        ARBITER_PORT,
     )
-    for _ in range(100):
-        try:
-            TokenClient("127.0.0.1", ARBITER_PORT, pod="probe").close()
-            return proc
-        except OSError:
-            time.sleep(0.05)
-    proc.kill()
-    return None
 
 
 def run_colocated(step, params_per_pod, data, stall_s, gates, seconds,
@@ -116,26 +96,15 @@ def run_colocated(step, params_per_pod, data, stall_s, gates, seconds,
     latencies = [[] for _ in range(PODS)]
 
     def worker(i):
-        results[i] = run_stream(step, params_per_pod[i], images, labels,
-                                seconds, stall_s, gate=gates[i],
-                                burst_steps=burst_steps,
-                                latencies=latencies[i])
+        def run():
+            results[i] = run_stream(step, params_per_pod[i], images, labels,
+                                    seconds, stall_s, gate=gates[i],
+                                    burst_steps=burst_steps,
+                                    latencies=latencies[i])
+        return run
 
-    threads = [threading.Thread(target=worker, args=(i,)) for i in range(PODS)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    elapsed = time.perf_counter() - t0
+    elapsed = run_threads([worker(i) for i in range(PODS)])
     return sum(results) * BATCH / elapsed, results, elapsed, latencies
-
-
-def p99(values):
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
 
 
 def main() -> None:
@@ -196,28 +165,33 @@ def main() -> None:
     # The tunneled chip's speed drifts on the tens-of-seconds scale, so
     # each round measures all three phases back to back and the ratios
     # are taken within a round; the reported round is the median by
-    # gated/solo ratio.
+    # gated/solo ratio. try/finally: a failed round must not leak the
+    # arbiter holding ARBITER_PORT for the next invocation.
     rounds = []
-    for r in range(ROUNDS):
-        steps = run_stream(step, params_per_pod[0], images, labels,
-                           PHASE_SECONDS, stall_s,
-                           burst_steps=burst_steps)
-        solo_r = steps * BATCH / PHASE_SECONDS
-        raw_r, _, _, _ = run_colocated(
-            step, params_per_pod, (images, labels), stall_s,
-            [None] * PODS, PHASE_SECONDS, burst_steps=burst_steps,
-        )
-        gated_r, results, elapsed, lats = run_colocated(
-            step, params_per_pod, (images, labels), stall_s, gates,
-            PHASE_SECONDS, burst_steps=burst_steps,
-        )
-        rounds.append({
-            "solo": solo_r, "ungated": raw_r, "gated": gated_r,
-            "ratio": gated_r / solo_r,
-            "results": results, "elapsed": elapsed, "lats": lats,
-        })
-        log(f"round {r}: solo {solo_r:,.0f} | ungated {raw_r:,.0f} | "
-            f"gated {gated_r:,.0f} samples/s ({gated_r / solo_r:.2f}x)")
+    try:
+        for r in range(ROUNDS):
+            steps = run_stream(step, params_per_pod[0], images, labels,
+                               PHASE_SECONDS, stall_s,
+                               burst_steps=burst_steps)
+            solo_r = steps * BATCH / PHASE_SECONDS
+            raw_r, _, _, _ = run_colocated(
+                step, params_per_pod, (images, labels), stall_s,
+                [None] * PODS, PHASE_SECONDS, burst_steps=burst_steps,
+            )
+            gated_r, results, elapsed, lats = run_colocated(
+                step, params_per_pod, (images, labels), stall_s, gates,
+                PHASE_SECONDS, burst_steps=burst_steps,
+            )
+            rounds.append({
+                "solo": solo_r, "ungated": raw_r, "gated": gated_r,
+                "ratio": gated_r / solo_r,
+                "results": results, "elapsed": elapsed, "lats": lats,
+            })
+            log(f"round {r}: solo {solo_r:,.0f} | ungated {raw_r:,.0f} | "
+                f"gated {gated_r:,.0f} samples/s ({gated_r / solo_r:.2f}x)")
+    except BaseException:
+        stop_arbiter(arbiter)
+        raise
 
     mid = sorted(rounds, key=lambda x: x["ratio"])[len(rounds) // 2]
     solo, raw_aggregate, aggregate = (
@@ -239,7 +213,7 @@ def main() -> None:
         with TokenClient("127.0.0.1", ARBITER_PORT, pod="probe") as c:
             usage = {s.pod: round(s.window_usage_ms, 1) for s in c.stats()}
         log(f"arbiter window usage (ms): {usage}")
-        arbiter.kill()
+        stop_arbiter(arbiter)
         for gate in gates:
             gate.close()
 

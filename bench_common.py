@@ -1,0 +1,88 @@
+"""Shared plumbing for the live-chip benchmarks (bench.py,
+bench_serving.py): arbiter launch/probe, percentile, and a thread
+fan-out that fails loudly instead of reporting a wrong number."""
+
+import os
+import subprocess
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from kubeshare_tpu.nodeconfig.files import ConfigEntry, write_config_file
+from kubeshare_tpu.runtime.client import TokenClient
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+SCHD = os.path.join(REPO, "runtime_native", "build", "tpu-schd")
+
+
+def start_arbiter(
+    tmpdir: str,
+    chip: str,
+    entries: Sequence[ConfigEntry],
+    port: int,
+    base_quota_ms: float = 20,
+    min_quota_ms: float = 2,
+    window_ms: float = 1000,
+    slots: int = 2,
+) -> Optional[subprocess.Popen]:
+    """Spawn a real tpu-schd on ``port`` over a fresh config file;
+    returns the process once it answers, or None if unavailable."""
+    if not os.path.exists(SCHD):
+        subprocess.run(["make", "-C", os.path.join(REPO, "runtime_native")],
+                       check=False, capture_output=True)
+    if not os.path.exists(SCHD):
+        return None
+    write_config_file(tmpdir, chip, list(entries))
+    proc = subprocess.Popen(
+        [SCHD, "-p", os.path.join(tmpdir, "config"), "-f", chip,
+         "-P", str(port), "-q", str(base_quota_ms), "-m", str(min_quota_ms),
+         "-w", str(window_ms), "-c", str(slots), "-H", "127.0.0.1"],
+        stderr=subprocess.DEVNULL,
+    )
+    for _ in range(100):
+        try:
+            TokenClient("127.0.0.1", port, pod="probe").close()
+            return proc
+        except OSError:
+            time.sleep(0.05)
+    proc.kill()
+    proc.wait()
+    return None
+
+
+def stop_arbiter(proc: Optional[subprocess.Popen]) -> None:
+    if proc is not None:
+        proc.kill()
+        proc.wait()
+
+
+def p99(values: Sequence[float]) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+
+
+def run_threads(workers: List[Callable[[], None]]) -> float:
+    """Run workers concurrently; re-raise the first worker exception
+    (a benchmark must fail loudly, not emit a bogus number). Returns
+    elapsed wall seconds."""
+    errors: List[BaseException] = []
+
+    def guard(fn):
+        def run():
+            try:
+                fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+        return run
+
+    threads = [threading.Thread(target=guard(fn)) for fn in workers]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return time.perf_counter() - t0

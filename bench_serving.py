@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Serving benchmark: BASELINE config 5 — KV-cache Llama decode,
+4 co-located 0.25-chip pods vs whole-chip serial allocation.
+
+Each pod serves 8 concurrent sequences with a compiled single-token
+decode step (models/llama.py llama_apply_cached). Serving is
+request-gapped: bursts of decode steps separated by an idle wait
+(arrival gaps), the under-utilization fractional sharing monetizes.
+Under whole-chip allocation the 4 pods run serially (aggregate = one
+pod); co-located they interleave through the live tpu-schd arbiter.
+
+Prints ONE json line:
+  {"metric": ..., "value": N, "unit": "tokens/sec", "vs_baseline": N}
+(vs_baseline = aggregate co-located gated / whole-chip serial.)
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from bench_common import p99, run_threads, start_arbiter as _start, stop_arbiter  # noqa: E402
+from kubeshare_tpu.models import LlamaConfig, init_llama  # noqa: E402
+from kubeshare_tpu.models.llama import init_kv_cache, llama_apply_cached  # noqa: E402
+from kubeshare_tpu.nodeconfig.files import ConfigEntry  # noqa: E402
+from kubeshare_tpu.runtime.client import TokenClient  # noqa: E402
+from kubeshare_tpu.runtime.hook import SharedChipGate  # noqa: E402
+
+PODS = 4
+BATCH = 8                   # concurrent sequences per pod
+TOKENS_PER_BURST = 16       # floor; raised to >= MIN_BURST_MS
+MIN_BURST_MS = 4.0
+STALL_FACTOR = 2.5          # request-arrival gap = 2.5x device burst
+PHASE_SECONDS = 6.0
+ROUNDS = 3
+ARBITER_PORT = 45911
+
+CFG = LlamaConfig(
+    vocab=2048, dim=256, layers=4, num_heads=8, num_kv_heads=4,
+    mlp_dim=512, max_seq_len=512,
+)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def make_decode(params):
+    @jax.jit
+    def decode(token, cache):
+        logits, cache = llama_apply_cached(params, token, cache, CFG)
+        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), cache
+
+    return decode
+
+
+def run_stream(decode, seconds, stall_s, burst, gate=None, latencies=None):
+    token = jnp.zeros((BATCH,), jnp.int32)
+    cache = init_kv_cache(CFG, BATCH)
+    base_len = cache["length"]
+    deadline = time.perf_counter() + seconds
+    steps = 0
+    while time.perf_counter() < deadline:
+        t0 = time.perf_counter()
+        if gate is not None:
+            gate.begin()
+        tok = token
+        for _ in range(burst):
+            tok, cache = decode(tok[:, None], cache)
+        if gate is not None:
+            gate.flush(tok)
+        else:
+            tok.block_until_ready()
+        # reset cache length so the phase never overruns max_seq_len
+        cache = dict(cache, length=base_len)
+        if latencies is not None:
+            latencies.append((time.perf_counter() - t0) / burst)
+        steps += burst
+        time.sleep(stall_s)
+    return steps
+
+
+def start_arbiter(tmpdir):
+    return _start(
+        tmpdir, "serve-chip",
+        [ConfigEntry(f"serve/pod-{i}", 1.0, 0.25, 0) for i in range(PODS)],
+        ARBITER_PORT,
+    )
+
+
+def main():
+    log(f"serving bench platform: {jax.devices()[0].platform} "
+        f"({jax.devices()[0]})")
+    rng = jax.random.PRNGKey(7)
+    decodes = [
+        make_decode(init_llama(jax.random.fold_in(rng, i), CFG))
+        for i in range(PODS)
+    ]
+    # warm EVERY pod's decode fn (separate jit caches) + calibrate
+    token = jnp.zeros((BATCH,), jnp.int32)
+    for decode in decodes:
+        cache = init_kv_cache(CFG, BATCH)
+        tok, cache = decode(token[:, None], cache)
+        tok.block_until_ready()
+    samples = []
+    for _ in range(3):
+        c = init_kv_cache(CFG, BATCH)
+        t = tok
+        t0 = time.perf_counter()
+        for _ in range(TOKENS_PER_BURST * 4):
+            t, c = decodes[0](t[:, None], c)
+        t.block_until_ready()
+        samples.append((time.perf_counter() - t0) / (TOKENS_PER_BURST * 4))
+    step_s = sorted(samples)[1]
+    burst = max(TOKENS_PER_BURST, int(MIN_BURST_MS / 1e3 / step_s + 0.5))
+    burst = min(burst, CFG.max_seq_len - 2)
+    stall_s = STALL_FACTOR * burst * step_s
+    log(f"decode step {step_s * 1e6:.0f} us x {BATCH} seqs; burst {burst} "
+        f"tokens; arrival gap {stall_s * 1e3:.2f} ms "
+        f"(duty {1 / (1 + STALL_FACTOR):.0%})")
+
+    tmpdir = tempfile.mkdtemp(prefix="ksserve-")
+    arbiter = start_arbiter(tmpdir)
+    gates = [None] * PODS
+    if arbiter is not None:
+        gates = [
+            SharedChipGate(TokenClient("127.0.0.1", ARBITER_PORT,
+                                       pod=f"serve/pod-{i}"))
+            for i in range(PODS)
+        ]
+        log("isolation runtime: live tpu-schd token arbiter")
+    else:
+        log("isolation runtime: UNAVAILABLE (gated phase runs ungated)")
+
+    rounds = []
+    try:
+        for r in range(ROUNDS):
+            solo = run_stream(decodes[0], PHASE_SECONDS, stall_s, burst)
+            solo_rate = solo * BATCH / PHASE_SECONDS
+
+            results = [0] * PODS
+            lats = [[] for _ in range(PODS)]
+
+            def worker(i):
+                def run():
+                    results[i] = run_stream(
+                        decodes[i], PHASE_SECONDS, stall_s, burst,
+                        gate=gates[i], latencies=lats[i],
+                    )
+                return run
+
+            elapsed = run_threads([worker(i) for i in range(PODS)])
+            gated_rate = sum(results) * BATCH / elapsed
+            rounds.append({
+                "solo": solo_rate, "gated": gated_rate,
+                "ratio": gated_rate / solo_rate, "lats": lats,
+            })
+            log(f"round {r}: solo {solo_rate:,.0f} | co-located gated "
+                f"{gated_rate:,.0f} tokens/s ({gated_rate / solo_rate:.2f}x)")
+
+        mid = sorted(rounds, key=lambda x: x["ratio"])[len(rounds) // 2]
+        pod_p99s = [p99(l) * 1e3 for l in mid["lats"] if l]
+        log(f"median round {mid['gated']:,.0f} tokens/s "
+            f"({mid['ratio']:.2f}x); per-pod p99 token latency (ms): "
+            f"min {min(pod_p99s):.2f} max {max(pod_p99s):.2f}")
+        if arbiter is not None:
+            with TokenClient("127.0.0.1", ARBITER_PORT, pod="probe") as c:
+                log(f"arbiter window usage (ms): "
+                    f"{ {s.pod: round(s.window_usage_ms, 1) for s in c.stats()} }")
+    finally:
+        stop_arbiter(arbiter)
+        for gate in gates:
+            if gate is not None:
+                gate.close()
+
+    print(json.dumps({
+        "metric": "aggregate decode tokens/sec, 4 co-located 0.25-chip "
+                  "KV-cache Llama pods vs whole-chip allocation",
+        "value": round(mid["gated"], 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mid["ratio"], 3),
+        "isolated": arbiter is not None,
+    }))
+
+
+if __name__ == "__main__":
+    main()
