@@ -14,8 +14,14 @@ proxy). Implements exactly the verbs the engine uses:
   annotations, which the aggregator reads — aggregator.py);
 - ``poll`` — full list + uid/phase diff against the local cache,
   driving the same add/delete handlers the informer-style adapters
-  fire (O(cluster) per tick; a watch-stream upgrade can slot in behind
-  the same handler contract).
+  fire (O(cluster) per tick);
+- watch mode (``use_watch=True``) — real informer semantics: one
+  relist captures the resourceVersion, then background readers hold
+  ``?watch=true`` streams and queue events; ``poll()`` drains and
+  applies them on the caller's thread (handlers never run on the IO
+  threads), falling back to relist + re-watch whenever a stream drops
+  or the server reports 410 Gone, exactly the reference's
+  client-go reflector contract (informers at scheduler.go:199-224).
 
 Chip inventory comes from the collector scrape, not this adapter
 (``scrape.scrape_capacity``), mirroring the reference's
@@ -26,10 +32,12 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import ssl
+import threading
 import urllib.error
 import urllib.request
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .api import Container, Node, Pod, PodPhase
 
@@ -84,12 +92,87 @@ def node_from_k8s(obj: dict) -> Node:
     )
 
 
+class _WatchChannel:
+    """Background reader of one ``?watch=true`` stream.
+
+    The reader thread only does IO + JSON parsing into ``events``;
+    nothing fires handlers here — the scheduler thread drains via
+    ``KubeCluster.poll()``, preserving the engine's single-threaded
+    discipline. ``alive`` flips False on EOF/timeout/error; the next
+    poll() relists and reopens (reflector resync)."""
+
+    def __init__(self, open_stream: Callable, path: str):
+        self.events: "queue.Queue" = queue.Queue()
+        self.pending: List[dict] = []  # drained but not yet applied
+        self.alive = True
+        self.path = path
+        self._resp = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, args=(open_stream,), daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, open_stream):
+        resp = None
+        try:
+            resp = open_stream(self.path)
+            self._resp = resp
+            if self._closed:
+                return  # close() raced the connect; don't read on
+            for raw in resp:
+                if self._closed:
+                    break
+                line = raw.strip()
+                if not line:
+                    continue
+                self.events.put(json.loads(line))
+        except Exception:
+            pass  # dropped stream: alive=False below triggers relist
+        finally:
+            self.alive = False
+            try:
+                if resp is not None:
+                    resp.close()
+            except Exception:
+                pass
+
+    def drain(self) -> List[dict]:
+        out = []
+        while True:
+            try:
+                out.append(self.events.get_nowait())
+            except queue.Empty:
+                return out
+
+    def close(self) -> None:
+        """Interrupt the reader NOW: shut down the response's socket
+        rather than close the buffered stream — close() would block on
+        the buffer lock held by the reader's in-flight read until the
+        watch timeout expires."""
+        import socket as _socket
+
+        self._closed = True
+        resp = self._resp
+        if resp is None:
+            return
+        try:
+            resp.fp.raw._sock.shutdown(_socket.SHUT_RDWR)
+        except Exception:
+            try:
+                resp.close()
+            except Exception:
+                pass
+
+
 class KubeCluster:
     """ClusterAPI against a live apiserver.
 
     ``poll()`` must be called periodically (the scheduler loop's tick);
     it diffs pod/node state and fires the registered handlers, the same
-    contract the hermetic adapters implement with file mtimes.
+    contract the hermetic adapters implement with file mtimes. With
+    ``use_watch=True`` poll() applies streamed watch events instead of
+    relisting every tick.
     """
 
     def __init__(
@@ -99,6 +182,8 @@ class KubeCluster:
         ca_file: str = "",
         namespace_selector: str = "",
         timeout: float = 10.0,
+        use_watch: bool = False,
+        watch_timeout: float = 120.0,
     ):
         if not api_server:
             host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
@@ -126,11 +211,18 @@ class KubeCluster:
             self._ctx = None
         self.timeout = timeout
         self.ns_selector = namespace_selector
+        self.use_watch = use_watch
+        self.watch_timeout = watch_timeout
         self._pods: Dict[str, Pod] = {}
         self._nodes: Dict[str, Node] = {}
         self._pod_add: List[Callable[[Pod], None]] = []
         self._pod_delete: List[Callable[[Pod], None]] = []
         self._node_update: List[Callable[[Node], None]] = []
+        self._pod_watch: Optional[_WatchChannel] = None
+        self._node_watch: Optional[_WatchChannel] = None
+        self._pod_rv = ""
+        self._node_rv = ""
+        self._watch_expired = False
 
     # ---- HTTP plumbing ---------------------------------------------
 
@@ -160,16 +252,22 @@ class KubeCluster:
 
     # ---- ClusterAPI ------------------------------------------------
 
-    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+    def _pods_path(self, namespace: Optional[str]) -> str:
         if namespace:
-            path = f"/api/v1/namespaces/{namespace}/pods"
-        else:
-            path = "/api/v1/pods"
-        items = self._request("GET", path).get("items", [])
+            return f"/api/v1/namespaces/{namespace}/pods"
+        return "/api/v1/pods"
+
+    def _list(self, path: str) -> Tuple[List[dict], str]:
+        doc = self._request("GET", path)
+        rv = (doc.get("metadata") or {}).get("resourceVersion", "") or ""
+        return doc.get("items", []), rv
+
+    def list_pods(self, namespace: Optional[str] = None) -> List[Pod]:
+        items, _ = self._list(self._pods_path(namespace))
         return [pod_from_k8s(o) for o in items]
 
     def list_nodes(self) -> List[Node]:
-        items = self._request("GET", "/api/v1/nodes").get("items", [])
+        items, _ = self._list("/api/v1/nodes")
         return [node_from_k8s(o) for o in items]
 
     def get_pod(self, key: str) -> Optional[Pod]:
@@ -237,11 +335,161 @@ class KubeCluster:
     def on_node_event(self, update) -> None:
         self._node_update.append(update)
 
-    # ---- polling sync ----------------------------------------------
+    # ---- polling / watching sync -----------------------------------
 
     def poll(self) -> None:
+        """One sync pass, firing handlers on THIS thread.
+
+        Plain mode: full list + diff. Watch mode: drain the streamed
+        events; on a dropped/expired stream, relist and re-watch."""
+        if not self.use_watch:
+            self._relist()
+            return
+        if (
+            self._pod_watch is None
+            or not self._pod_watch.alive
+            or self._node_watch is None
+            or not self._node_watch.alive
+        ):
+            # drain what the dying streams already delivered, then
+            # either resume from the tracked resourceVersion (routine
+            # drop / timeout) or relist (first sync, or the server said
+            # the rv expired via an ERROR/410 event)
+            self._drain_apply()
+            self._close_watches()
+            if not (self._pod_rv and self._node_rv) or self._watch_expired:
+                self._relist()
+                self._watch_expired = False
+            self._open_watches()
+            return
+        self._drain_apply()
+
+    def _drain_apply(self) -> None:
+        """Apply queued events on the caller's thread. A handler
+        exception leaves the failed event (and everything after it) in
+        ``pending`` for the next poll — the cache is only committed
+        after its handlers ran, so a blip never desyncs the engine
+        (the scheduler loop catches and retries, cmd/scheduler.py)."""
+        for ch, apply in (
+            (self._node_watch, self._apply_node_event),
+            (self._pod_watch, self._apply_pod_event),
+        ):
+            if ch is None:
+                continue
+            ch.pending.extend(ch.drain())
+            while ch.pending:
+                apply(ch.pending[0])  # may raise; event stays queued
+                ch.pending.pop(0)
+
+    def close(self) -> None:
+        self._close_watches()
+
+    def _open_stream(self, path: str):
+        req = urllib.request.Request(self.base + path)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        return urllib.request.urlopen(
+            req, timeout=self.watch_timeout, context=self._ctx
+        )
+
+    def _open_watches(self) -> None:
+        q = "?watch=true&allowWatchBookmarks=true"
+        pod_q = q + (f"&resourceVersion={self._pod_rv}" if self._pod_rv else "")
+        node_q = q + (
+            f"&resourceVersion={self._node_rv}" if self._node_rv else ""
+        )
+        self._pod_watch = _WatchChannel(
+            self._open_stream, self._pods_path(self.ns_selector or None) + pod_q
+        )
+        self._node_watch = _WatchChannel(
+            self._open_stream, "/api/v1/nodes" + node_q
+        )
+
+    def _close_watches(self) -> None:
+        for ch in (self._pod_watch, self._node_watch):
+            if ch is not None:
+                ch.close()
+        self._pod_watch = None
+        self._node_watch = None
+
+    def _apply_node_event(self, ev: dict) -> None:
+        etype = ev.get("type", "")
+        obj = ev.get("object") or {}
+        rv = (obj.get("metadata") or {}).get("resourceVersion", "")
+        if rv:
+            self._node_rv = rv
+        if etype == "BOOKMARK":
+            return
+        if etype == "ERROR":
+            # e.g. 410 Gone: resourceVersion too old — force relist
+            self._watch_expired = True
+            if self._node_watch is not None:
+                self._node_watch.alive = False
+            return
+        node = node_from_k8s(obj)
+        if not node.name:
+            return
+        old = self._nodes.get(node.name)
+        # handlers fire BEFORE the cache commit: a handler exception
+        # must leave the cache as-is so the retried event still diffs
+        if etype == "DELETED":
+            node.ready = False
+            for handler in self._node_update:
+                handler(node)
+            self._nodes.pop(node.name, None)
+            return
+        if old is None or (old.ready, old.unschedulable) != (
+            node.ready, node.unschedulable
+        ):
+            for handler in self._node_update:
+                handler(node)
+        self._nodes[node.name] = node
+
+    def _apply_pod_event(self, ev: dict) -> None:
+        etype = ev.get("type", "")
+        obj = ev.get("object") or {}
+        rv = (obj.get("metadata") or {}).get("resourceVersion", "")
+        if rv:
+            self._pod_rv = rv
+        if etype == "BOOKMARK":
+            return
+        if etype == "ERROR":
+            self._watch_expired = True
+            if self._pod_watch is not None:
+                self._pod_watch.alive = False
+            return
+        pod = pod_from_k8s(obj)
+        if not pod.name:
+            return
+        if self.ns_selector and pod.namespace != self.ns_selector:
+            return
+        old = self._pods.get(pod.key)
+        # handlers fire BEFORE the cache commit (see _apply_node_event)
+        if etype == "DELETED":
+            if old is None or not old.is_completed:
+                for handler in self._pod_delete:
+                    handler(pod)
+            self._pods.pop(pod.key, None)
+            return
+        # ADDED / MODIFIED
+        if old is None or old.uid != pod.uid:
+            if old is not None:  # name reuse: retire old incarnation
+                for handler in self._pod_delete:
+                    handler(old)
+            for handler in self._pod_add:
+                handler(pod)
+            self._pods[pod.key] = pod
+        else:
+            if pod.is_completed and not old.is_completed:
+                for handler in self._pod_delete:
+                    handler(pod)
+            self._pods[pod.key] = pod
+
+    def _relist(self) -> None:
         """One list+diff pass over nodes and pods, firing handlers."""
-        nodes = {n.name: n for n in self.list_nodes()}
+        node_items, node_rv = self._list("/api/v1/nodes")
+        self._node_rv = node_rv
+        nodes = {n.name: n for n in map(node_from_k8s, node_items)}
         for name, node in nodes.items():
             old = self._nodes.get(name)
             if old is None or (old.ready, old.unschedulable) != (
@@ -256,7 +504,9 @@ class KubeCluster:
                 handler(gone)
         self._nodes = nodes
 
-        pods = {p.key: p for p in self.list_pods(self.ns_selector or None)}
+        pod_items, pod_rv = self._list(self._pods_path(self.ns_selector or None))
+        self._pod_rv = pod_rv
+        pods = {p.key: p for p in map(pod_from_k8s, pod_items)}
         for key, pod in pods.items():
             old = self._pods.get(key)
             if old is None or old.uid != pod.uid:

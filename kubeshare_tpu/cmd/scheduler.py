@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="apiserver URL for --kube (default: in-cluster env)",
     )
     parser.add_argument(
+        "--watch", action=argparse.BooleanOptionalAction, default=True,
+        help="--kube mode: stream watch events between passes instead "
+             "of relisting every tick (falls back to relist whenever "
+             "the stream drops)",
+    )
+    parser.add_argument(
         "--capacity-url", default="",
         help="tpu_capacity endpoint for chip inventory in --kube mode "
              "(collector service or Prometheus federate)",
@@ -264,7 +270,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise SystemExit(
                 "--kube requires --capacity-url (chip inventory source)"
             )
-        cluster = KubeCluster(api_server=args.api_server)
+        cluster = KubeCluster(api_server=args.api_server, use_watch=args.watch)
         inventory = CapacityInventory(args.capacity_url, log=log)
     else:
         cluster = SnapshotCluster(args.cluster_state)

@@ -1,8 +1,9 @@
 """KubeCluster adapter against a stub apiserver (plain HTTP)."""
 
 import json
+import queue
 import threading
-from http.server import BaseHTTPRequestHandler, HTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 
@@ -10,7 +11,8 @@ from kubeshare_tpu.cluster.kube import KubeCluster, KubeError
 
 
 class StubApiServer:
-    """Minimal /api/v1 pods+nodes apiserver recording writes."""
+    """Minimal /api/v1 pods+nodes apiserver recording writes, with
+    ``?watch=true`` streaming fed from per-kind event queues."""
 
     def __init__(self):
         self.pods = {}    # (ns, name) -> k8s object dict
@@ -18,10 +20,15 @@ class StubApiServer:
         self.bindings = []
         self.patches = []
         self.auth_headers = []
+        self.watch_queues = {"pods": [], "nodes": []}  # live streams
+        self.watch_opens = {"pods": 0, "nodes": 0}
+        self._stopping = False
 
         stub = self
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *a):
                 pass
 
@@ -33,13 +40,54 @@ class StubApiServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _stream_watch(self, kind):
+                stub.watch_opens[kind] += 1
+                q = queue.Queue()
+                stub.watch_queues[kind].append(q)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def write_chunk(data: bytes):
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                    )
+                    self.wfile.flush()
+
+                try:
+                    while not stub._stopping:
+                        try:
+                            ev = q.get(timeout=0.1)
+                        except queue.Empty:
+                            continue
+                        if ev is None:  # server-initiated stream end
+                            break
+                        write_chunk(json.dumps(ev).encode() + b"\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass  # client closed
+                finally:
+                    stub.watch_queues[kind].remove(q)
+
             def do_GET(self):
                 stub.auth_headers.append(self.headers.get("Authorization"))
                 parts = [p for p in self.path.split("/") if p]
-                if self.path == "/api/v1/nodes":
-                    self._send({"items": list(stub.nodes.values())})
-                elif self.path == "/api/v1/pods":
-                    self._send({"items": list(stub.pods.values())})
+                path, _, query = self.path.partition("?")
+                if "watch=true" in query:
+                    kind = "nodes" if path.endswith("/nodes") else "pods"
+                    self._stream_watch(kind)
+                    return
+                if path == "/api/v1/nodes":
+                    self._send({
+                        "items": list(stub.nodes.values()),
+                        "metadata": {"resourceVersion": "7"},
+                    })
+                elif path == "/api/v1/pods":
+                    self._send({
+                        "items": list(stub.pods.values()),
+                        "metadata": {"resourceVersion": "7"},
+                    })
                 elif len(parts) == 5 and parts[2] == "namespaces":
                     # /api/v1/namespaces/<ns>/pods
                     ns = parts[3]
@@ -76,13 +124,34 @@ class StubApiServer:
                 )
                 self._send({})
 
-        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.server.daemon_threads = True
         self.port = self.server.server_address[1]
         threading.Thread(target=self.server.serve_forever, daemon=True).start()
 
     def stop(self):
+        self._stopping = True
         self.server.shutdown()
         self.server.server_close()
+
+    def push_watch(self, kind, etype, obj):
+        """Send one watch event to every live <kind> stream."""
+        for q in list(self.watch_queues[kind]):
+            q.put({"type": etype, "object": obj})
+
+    def end_watch(self, kind):
+        for q in list(self.watch_queues[kind]):
+            q.put(None)
+
+    def wait_watches(self, kinds=("pods", "nodes"), timeout=3.0):
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(self.watch_queues[k] for k in kinds):
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"watch streams never opened: {kinds}")
 
     # -- fixture helpers --
 
@@ -211,6 +280,179 @@ class TestKubeCluster:
         monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
         with pytest.raises(KubeError, match="in-cluster"):
             KubeCluster()
+
+
+def pod_obj(name, ns="default", uid="u1", phase="Pending", rv="8"):
+    return {
+        "metadata": {"name": name, "namespace": ns, "uid": uid,
+                     "resourceVersion": rv, "labels": {}, "annotations": {}},
+        "spec": {"schedulerName": "kubeshare-tpu-scheduler",
+                 "containers": [{"name": "main", "env": []}]},
+        "status": {"phase": phase},
+    }
+
+
+class TestWatchMode:
+    def _watching_cluster(self, stub):
+        cluster = KubeCluster(
+            api_server=f"http://127.0.0.1:{stub.port}", token="t",
+            use_watch=True, watch_timeout=5.0,
+        )
+        return cluster
+
+    def test_events_applied_without_relist(self, stub):
+        stub.add_node("node-a")
+        stub.add_pod("p1", uid="u1")
+        cluster = self._watching_cluster(stub)
+        adds, deletes = [], []
+        cluster.on_pod_event(lambda p: adds.append(p.uid),
+                             lambda p: deletes.append(p.uid))
+        cluster.on_node_event(lambda n: None)
+        try:
+            cluster.poll()   # relist + open watches
+            assert adds == ["u1"]
+            stub.wait_watches()
+            lists_so_far = stub.auth_headers.copy()
+
+            stub.push_watch("pods", "ADDED", pod_obj("p2", uid="u2"))
+            deadline_poll(cluster, lambda: "u2" in adds)
+            assert adds == ["u1", "u2"]
+
+            # completion via MODIFIED fires delete once
+            stub.push_watch(
+                "pods", "MODIFIED", pod_obj("p2", uid="u2", phase="Succeeded")
+            )
+            deadline_poll(cluster, lambda: deletes == ["u2"])
+
+            # explicit DELETED of completed pod does not re-fire
+            stub.push_watch(
+                "pods", "DELETED", pod_obj("p2", uid="u2", phase="Succeeded")
+            )
+            deadline_poll(cluster, lambda: False, quiet=0.3)
+            assert deletes == ["u2"]
+
+            # no relist happened while the stream was healthy (only
+            # watch GETs opened, which also carry auth headers; filter
+            # for list-shaped requests by count delta)
+            assert len(stub.auth_headers) == len(lists_so_far)
+        finally:
+            cluster.close()
+
+    def test_node_flap_via_watch(self, stub):
+        stub.add_node("node-a")
+        cluster = self._watching_cluster(stub)
+        nodes = []
+        cluster.on_pod_event(lambda p: None, lambda p: None)
+        cluster.on_node_event(lambda n: nodes.append((n.name, n.ready)))
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            down = {
+                "metadata": {"name": "node-a", "resourceVersion": "9"},
+                "spec": {},
+                "status": {"conditions": [
+                    {"type": "Ready", "status": "False"}
+                ]},
+            }
+            stub.push_watch("nodes", "MODIFIED", down)
+            deadline_poll(cluster, lambda: ("node-a", False) in nodes)
+        finally:
+            cluster.close()
+
+    def test_dropped_stream_resumes_from_rv_without_relist(self, stub):
+        stub.add_pod("p1", uid="u1")
+        cluster = self._watching_cluster(stub)
+        adds = []
+        cluster.on_pod_event(lambda p: adds.append(p.uid), lambda p: None)
+        cluster.on_node_event(lambda n: None)
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            requests_after_sync = len(stub.auth_headers) - stub.watch_opens[
+                "pods"] - stub.watch_opens["nodes"]
+            # routine stream end: reflector resumes from the tracked
+            # resourceVersion — new watch opens, NO relist
+            stub.end_watch("pods")
+            stub.end_watch("nodes")
+            deadline_poll(
+                cluster, lambda: stub.watch_opens["pods"] >= 2, quiet=0.0
+            )
+            stub.wait_watches()
+            list_requests = (
+                len(stub.auth_headers)
+                - stub.watch_opens["pods"] - stub.watch_opens["nodes"]
+            )
+            assert list_requests == requests_after_sync  # no relist
+            # continuity: an event on the resumed stream still applies
+            stub.push_watch("pods", "ADDED", pod_obj("p2", uid="u2"))
+            deadline_poll(cluster, lambda: "u2" in adds)
+        finally:
+            cluster.close()
+
+    def test_handler_exception_retries_event(self, stub):
+        cluster = self._watching_cluster(stub)
+        adds = []
+        boom = {"armed": True}
+
+        def flaky_add(pod):
+            if boom["armed"]:
+                boom["armed"] = False
+                raise RuntimeError("transient blip")
+            adds.append(pod.uid)
+
+        cluster.on_pod_event(flaky_add, lambda p: None)
+        cluster.on_node_event(lambda n: None)
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            stub.push_watch("pods", "ADDED", pod_obj("pf", uid="uf"))
+            # first poll seeing the event raises; the event must be
+            # retried (not lost) and the cache must not be poisoned
+            with pytest.raises(RuntimeError):
+                deadline_poll(cluster, lambda: "uf" in adds)
+            deadline_poll(cluster, lambda: "uf" in adds)
+            assert adds == ["uf"]
+        finally:
+            cluster.close()
+
+    def test_error_event_forces_relist(self, stub):
+        cluster = self._watching_cluster(stub)
+        cluster.on_pod_event(lambda p: None, lambda p: None)
+        cluster.on_node_event(lambda n: None)
+        adds = []
+        cluster.on_pod_event(lambda p: adds.append(p.uid), lambda p: None)
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            opens = stub.watch_opens["pods"]
+            stub.push_watch("pods", "ERROR", {
+                "kind": "Status", "code": 410, "reason": "Expired",
+            })
+            stub.add_pod("px", uid="ux")
+            deadline_poll(cluster, lambda: "ux" in adds)
+            assert stub.watch_opens["pods"] > opens
+        finally:
+            cluster.close()
+
+
+def deadline_poll(cluster, cond, timeout=3.0, quiet=0.0):
+    """poll() until cond() or timeout; with ``quiet``, poll for that
+    long asserting nothing (used for must-NOT-happen checks)."""
+    import time
+
+    if quiet:
+        end = time.time() + quiet
+        while time.time() < end:
+            cluster.poll()
+            time.sleep(0.02)
+        return
+    end = time.time() + timeout
+    while time.time() < end:
+        cluster.poll()
+        if cond():
+            return
+        time.sleep(0.02)
+    raise TimeoutError("condition never became true")
 
 
 TOPO_YAML = """
