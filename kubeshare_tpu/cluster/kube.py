@@ -105,6 +105,7 @@ class _WatchChannel:
         self.events: "queue.Queue" = queue.Queue()
         self.pending: List[dict] = []  # drained but not yet applied
         self.alive = True
+        self.delivered = False  # saw at least one event (incl. bookmarks)
         self.path = path
         self._resp = None
         self._closed = False
@@ -126,6 +127,7 @@ class _WatchChannel:
                 line = raw.strip()
                 if not line:
                     continue
+                self.delivered = True
                 self.events.put(json.loads(line))
         except Exception:
             pass  # dropped stream: alive=False below triggers relist
@@ -353,11 +355,24 @@ class KubeCluster:
         ):
             # drain what the dying streams already delivered, then
             # either resume from the tracked resourceVersion (routine
-            # drop / timeout) or relist (first sync, or the server said
-            # the rv expired via an ERROR/410 event)
+            # drop after a live stream) or relist: first sync, an
+            # ERROR/410 event, or a stream that died WITHOUT delivering
+            # anything — the open itself is failing (403 after an RBAC
+            # change, cert rotation, rv past etcd compaction), and
+            # resuming would silently spin on a stale cache forever;
+            # relist goes through _request, whose errors raise KubeError
+            # and get logged by the scheduler loop.
             self._drain_apply()
+            barren = any(
+                ch is not None and not ch.alive and not ch.delivered
+                for ch in (self._pod_watch, self._node_watch)
+            )
             self._close_watches()
-            if not (self._pod_rv and self._node_rv) or self._watch_expired:
+            if (
+                not (self._pod_rv and self._node_rv)
+                or self._watch_expired
+                or barren
+            ):
                 self._relist()
                 self._watch_expired = False
             self._open_watches()
@@ -466,7 +481,10 @@ class KubeCluster:
         old = self._pods.get(pod.key)
         # handlers fire BEFORE the cache commit (see _apply_node_event)
         if etype == "DELETED":
-            if old is None or not old.is_completed:
+            # only for pods the engine saw added — the relist invariant;
+            # a DELETED replayed for an uncached pod must not fire
+            # delete handlers for something never announced
+            if old is not None and not old.is_completed:
                 for handler in self._pod_delete:
                     handler(pod)
             self._pods.pop(pod.key, None)

@@ -75,3 +75,115 @@ class TestManifests:
                     assert rule["apiGroups"] != ["*"]
                     assert rule["resources"] != ["*"]
                     assert rule["verbs"] != ["*"]
+
+
+class TestShippedTopologyScheduling:
+    """The deploy/config examples driven through the real engine —
+    the shipped artifacts must not just parse, they must steer."""
+
+    def test_heterogeneous_priority_steering(self):
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.scheduler import constants as C
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        GIB = 1 << 30
+        cluster = FakeCluster()
+        fleet = {
+            "tpu-v5p-a": ("tpu-v5p", 95 * GIB),
+            "tpu-v5e-a": ("tpu-v5e", 16 * GIB),
+            "tpu-v5e-b": ("tpu-v5e", 16 * GIB),
+            "tpu-v4-a": ("tpu-v4", 32 * GIB),
+        }
+        for node, (model, mem) in fleet.items():
+            cluster.add_node(node, [
+                ChipInfo(f"{node}-chip-{i}", model, mem, i) for i in range(4)
+            ])
+        sched = TpuShareScheduler(
+            os.path.join(REPO, "deploy", "config", "heterogeneous.yaml"),
+            cluster,
+        )
+
+        def pod(name, priority=0):
+            labels = {
+                C.LABEL_TPU_REQUEST: "0.5",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+            }
+            if priority:
+                labels[C.LABEL_PRIORITY] = str(priority)
+            return cluster.create_pod(Pod(
+                name=name, namespace="default", labels=labels,
+                scheduler_name=C.SCHEDULER_NAME,
+            ))
+
+        # guarantee pods steer to the fastest (highest-priority) model
+        d_guar = sched.schedule_one(pod("guar", priority=90))
+        assert d_guar.status == "bound"
+        assert fleet[d_guar.node][0] == "tpu-v5p"
+        # opportunistic pods pack onto the busiest chip (reference
+        # score.go:42-68 usage bonus): the first fills the guarantee
+        # pod's half-used chip rather than opening a fresh one
+        d_opp = sched.schedule_one(pod("opp"))
+        assert d_opp.status == "bound" and d_opp.node == d_guar.node
+        s_guar = sched.status.get("default/guar")
+        s_opp = sched.status.get("default/opp")
+        assert s_opp.leaves[0] is s_guar.leaves[0]
+        # a second guarantee pod gets its own whole-free chip
+        d_guar2 = sched.schedule_one(pod("guar2", priority=90))
+        assert d_guar2.status == "bound"
+        assert sched.status.get("default/guar2").leaves[0] is not s_guar.leaves[0]
+
+    def test_subcore_inventory_end_to_end(self):
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.metrics.collector import (
+            FakeChipBackend, SubcoreBackend,
+        )
+        from kubeshare_tpu.scheduler import constants as C
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        GIB = 1 << 30
+        chips = [
+            ChipInfo(f"node-a-chip-{i}", "tpu-v5p", 16 * GIB, i)
+            for i in range(4)
+        ]
+        subcores = SubcoreBackend(FakeChipBackend(chips), cores=2).enumerate()
+        assert len(subcores) == 8
+        assert subcores[0].uuid == "node-a-chip-0-c0"
+        assert subcores[0].memory == 8 * GIB
+
+        topo = {
+            "cell_types": {
+                "v5p-node": {
+                    "child_cell_type": "tpu-v5p",
+                    "child_cell_number": 8,   # 4 chips x 2 TensorCores
+                    "child_cell_priority": 100,
+                    "is_node_level": True,
+                },
+            },
+            "cells": [{"cell_type": "v5p-node", "cell_id": "node-a"}],
+        }
+        cluster = FakeCluster()
+        cluster.add_node("node-a", subcores)
+        sched = TpuShareScheduler(topo, cluster)
+        pods = [
+            cluster.create_pod(Pod(
+                name=f"p{i}", namespace="default",
+                labels={
+                    C.LABEL_TPU_REQUEST: "0.5",
+                    C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+                },
+                scheduler_name=C.SCHEDULER_NAME,
+            ))
+            for i in range(2)
+        ]
+        for p in pods:
+            assert sched.schedule_one(p).status == "bound"
+        # both halves pack one subcore, and the annotation names it
+        uuid0 = pods[0].annotations[C.ANNOTATION_CHIP_UUID]
+        assert uuid0.endswith(("-c0", "-c1"))
+        assert pods[1].annotations[C.ANNOTATION_CHIP_UUID] == uuid0
+        # default memory = floor(request x subcore HBM), not chip HBM
+        assert pods[0].annotations[C.ANNOTATION_TPU_MEMORY] == str(4 * GIB)

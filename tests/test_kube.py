@@ -368,10 +368,18 @@ class TestWatchMode:
         try:
             cluster.poll()
             stub.wait_watches()
+            # live streams: a bookmark proves each delivered something
+            bookmark = {"metadata": {"resourceVersion": "9"}}
+            stub.push_watch("pods", "BOOKMARK", bookmark)
+            stub.push_watch("nodes", "BOOKMARK", bookmark)
+            deadline_poll(cluster, lambda: (
+                cluster._pod_watch.delivered
+                and cluster._node_watch.delivered
+            ))
             requests_after_sync = len(stub.auth_headers) - stub.watch_opens[
                 "pods"] - stub.watch_opens["nodes"]
-            # routine stream end: reflector resumes from the tracked
-            # resourceVersion — new watch opens, NO relist
+            # routine drop of a live stream: reflector resumes from the
+            # tracked resourceVersion — new watch opens, NO relist
             stub.end_watch("pods")
             stub.end_watch("nodes")
             deadline_poll(
@@ -386,6 +394,41 @@ class TestWatchMode:
             # continuity: an event on the resumed stream still applies
             stub.push_watch("pods", "ADDED", pod_obj("p2", uid="u2"))
             deadline_poll(cluster, lambda: "u2" in adds)
+        finally:
+            cluster.close()
+
+    def test_barren_stream_death_forces_relist(self, stub):
+        # a stream that dies without delivering ANY event means the
+        # open path itself may be failing — the adapter must relist
+        # (loudly, via _request) instead of spinning on a stale cache
+        stub.add_pod("p1", uid="u1")
+        cluster = self._watching_cluster(stub)
+        adds = []
+        cluster.on_pod_event(lambda p: adds.append(p.uid), lambda p: None)
+        cluster.on_node_event(lambda n: None)
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            stub.add_pod("p2", uid="u2")   # change invisible to watch
+            stub.end_watch("pods")          # dies barren
+            stub.end_watch("nodes")
+            deadline_poll(cluster, lambda: "u2" in adds)  # via relist
+        finally:
+            cluster.close()
+
+    def test_deleted_for_uncached_pod_not_announced(self, stub):
+        cluster = self._watching_cluster(stub)
+        deletes = []
+        cluster.on_pod_event(lambda p: None, lambda p: deletes.append(p.uid))
+        cluster.on_node_event(lambda n: None)
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            stub.push_watch(
+                "pods", "DELETED", pod_obj("ghost", uid="ug")
+            )
+            deadline_poll(cluster, lambda: False, quiet=0.3)
+            assert deletes == []
         finally:
             cluster.close()
 
@@ -430,6 +473,9 @@ class TestWatchMode:
             })
             stub.add_pod("px", uid="ux")
             deadline_poll(cluster, lambda: "ux" in adds)
+            # the replacement watch opens asynchronously after the
+            # relist; wait for it to land before counting
+            stub.wait_watches(("pods",))
             assert stub.watch_opens["pods"] > opens
         finally:
             cluster.close()
