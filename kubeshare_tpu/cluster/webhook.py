@@ -35,65 +35,72 @@ VOLUME_NAME = "kubeshare-tpu-library"
 SHIM_PATH = C.LIBRARY_PATH + "/libpjrt_interposer.so"
 
 
-def _is_fractional_shared(labels: Dict[str, str]) -> bool:
-    """True for pods the isolation runtime must wrap: fractional
-    requests only — whole-chip pods get exclusive chips and no hook,
-    matching the reference's multi-GPU path (pod.go:348-400)."""
-    if C.LABEL_TPU_REQUEST not in labels:
-        return False
-    try:
-        req = parse_pod(Pod(name="admission", labels=dict(labels)))
-    except LabelError:
-        return False  # PreFilter will reject it with a real message
-    return req.kind == PodKind.SHARED
-
-
 def mutate_pod(pod: Dict) -> List[Dict]:
-    """Compute the JSONPatch for one pod object (or [] if not ours)."""
+    """Compute the JSONPatch for one pod object (or [] if not ours).
+
+    Fractional shared pods get the isolation-runtime wiring (hostPath
+    library volume + shim env). ANY gang member additionally gets
+    ``KUBESHARE_GROUP_HEADCOUNT`` so multi-host JAX init
+    (parallel/multihost.py spec_from_env) learns the process count
+    without the manifest duplicating its own gang label."""
     meta = pod.get("metadata", {})
     labels = meta.get("labels", {}) or {}
     spec = pod.get("spec", {}) or {}
     if spec.get("schedulerName") != C.SCHEDULER_NAME:
         return []
-    if not _is_fractional_shared(labels):
+    # one source of truth: the scheduler's own label parsing decides
+    # both what counts as fractional (isolation wiring — whole-chip
+    # pods get exclusive chips and no hook, reference pod.go:348-400)
+    # and what counts as a gang (env must only be injected for gangs
+    # the scheduler will actually co-schedule)
+    try:
+        req = parse_pod(Pod(name="admission", labels=dict(labels)))
+    except LabelError:
+        return []  # PreFilter will reject it with a real message
+    fractional = req.kind == PodKind.SHARED
+    inject_env: Dict[str, str] = {}
+    if fractional:
+        inject_env[C.ENV_LIBRARY_PATH] = C.LIBRARY_PATH
+        inject_env["TPU_LIBRARY_PATH"] = SHIM_PATH
+    if req.gang is not None:
+        inject_env[C.ENV_GROUP_HEADCOUNT] = str(req.gang.headcount)
+    if not inject_env:
         return []
 
     patches: List[Dict] = []
-    volumes = spec.get("volumes") or []
-    if not any(v.get("name") == VOLUME_NAME for v in volumes):
-        volume = {
-            "name": VOLUME_NAME,
-            "hostPath": {"path": C.LIBRARY_PATH,
-                         "type": "DirectoryOrCreate"},
-        }
-        if "volumes" in spec:
-            patches.append({"op": "add", "path": "/spec/volumes/-",
-                            "value": volume})
-        else:
-            patches.append({"op": "add", "path": "/spec/volumes",
-                            "value": [volume]})
-
-    inject_env = {
-        C.ENV_LIBRARY_PATH: C.LIBRARY_PATH,
-        "TPU_LIBRARY_PATH": SHIM_PATH,
-    }
-    for i, container in enumerate(spec.get("containers", [])):
-        mounts = container.get("volumeMounts") or []
-        if not any(m.get("name") == VOLUME_NAME for m in mounts):
-            mount = {"name": VOLUME_NAME, "mountPath": C.LIBRARY_PATH,
-                     "readOnly": True}
-            if "volumeMounts" in container:
-                patches.append({
-                    "op": "add",
-                    "path": f"/spec/containers/{i}/volumeMounts/-",
-                    "value": mount,
-                })
+    if fractional:
+        volumes = spec.get("volumes") or []
+        if not any(v.get("name") == VOLUME_NAME for v in volumes):
+            volume = {
+                "name": VOLUME_NAME,
+                "hostPath": {"path": C.LIBRARY_PATH,
+                             "type": "DirectoryOrCreate"},
+            }
+            if "volumes" in spec:
+                patches.append({"op": "add", "path": "/spec/volumes/-",
+                                "value": volume})
             else:
-                patches.append({
-                    "op": "add",
-                    "path": f"/spec/containers/{i}/volumeMounts",
-                    "value": [mount],
-                })
+                patches.append({"op": "add", "path": "/spec/volumes",
+                                "value": [volume]})
+
+    for i, container in enumerate(spec.get("containers", [])):
+        if fractional:
+            mounts = container.get("volumeMounts") or []
+            if not any(m.get("name") == VOLUME_NAME for m in mounts):
+                mount = {"name": VOLUME_NAME, "mountPath": C.LIBRARY_PATH,
+                         "readOnly": True}
+                if "volumeMounts" in container:
+                    patches.append({
+                        "op": "add",
+                        "path": f"/spec/containers/{i}/volumeMounts/-",
+                        "value": mount,
+                    })
+                else:
+                    patches.append({
+                        "op": "add",
+                        "path": f"/spec/containers/{i}/volumeMounts",
+                        "value": [mount],
+                    })
         env = container.get("env") or []
         present = {e.get("name") for e in env}
         additions = [

@@ -39,6 +39,8 @@ from .mesh import MeshPlan, make_mesh
 ENV_COORDINATOR = "JAX_COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "KUBESHARE_NUM_PROCESSES"
 ENV_PROCESS_ID = "KUBESHARE_PROCESS_ID"
+# injected by the admission webhook from the pod's gang labels
+# (cluster/webhook.py mutate_pod; name owned by scheduler/constants.py)
 ENV_GANG_HEADCOUNT = "KUBESHARE_GROUP_HEADCOUNT"
 
 

@@ -36,6 +36,9 @@ ENV_VISIBLE_DEVICE_IDS = "TPU_VISIBLE_DEVICES"  # chip indices on the node
 ENV_POD_MANAGER_PORT = "KUBESHARE_POD_MANAGER_PORT"
 ENV_POD_NAME = "KUBESHARE_POD_NAME"            # namespace/name
 ENV_HBM_LIMIT = "KUBESHARE_HBM_LIMIT_BYTES"
+ENV_GROUP_HEADCOUNT = "KUBESHARE_GROUP_HEADCOUNT"  # gang size, for
+                                                   # jax.distributed init
+                                                   # (parallel/multihost.py)
 ENV_LIBRARY_PATH = "KUBESHARE_LIBRARY_PATH"
 
 # hostPath where the hook library + scheduler IP file live on each node

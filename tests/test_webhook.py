@@ -111,6 +111,72 @@ class TestMutatePod:
                    for m in c["volumeMounts"])
 
 
+class TestGangEnvInjection:
+    GANG = {
+        C.LABEL_GROUP_NAME: "band",
+        C.LABEL_GROUP_HEADCOUNT: "4",
+        C.LABEL_GROUP_THRESHOLD: "1.0",
+    }
+
+    def test_fractional_gang_gets_headcount_env(self):
+        labels = {
+            C.LABEL_TPU_REQUEST: "0.5",
+            C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+            **self.GANG,
+        }
+        pod = apply_patch(shared_pod(labels=labels),
+                          mutate_pod(shared_pod(labels=labels)))
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env[C.ENV_GROUP_HEADCOUNT] == "4"
+        assert env[C.ENV_LIBRARY_PATH] == C.LIBRARY_PATH
+
+    def test_multi_chip_gang_gets_env_but_no_volume(self):
+        labels = {
+            C.LABEL_TPU_REQUEST: "2.0",
+            C.LABEL_TPU_LIMIT_ALIASES[1]: "2.0",
+            **self.GANG,
+        }
+        patches = mutate_pod(shared_pod(labels=labels))
+        pod = apply_patch(shared_pod(labels=labels), patches)
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env == {C.ENV_GROUP_HEADCOUNT: "4"}
+        assert "volumes" not in pod["spec"]
+
+    def test_injected_env_feeds_multihost_init(self):
+        from kubeshare_tpu.parallel.multihost import spec_from_env
+
+        labels = {
+            C.LABEL_TPU_REQUEST: "2.0",
+            C.LABEL_TPU_LIMIT_ALIASES[1]: "2.0",
+            **self.GANG,
+        }
+        pod = apply_patch(shared_pod(labels=labels),
+                          mutate_pod(shared_pod(labels=labels)))
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        env["JAX_COORDINATOR_ADDRESS"] = "band-0.band:8476"
+        spec = spec_from_env(env, hostname="band-2")
+        assert spec is not None
+        assert (spec.num_processes, spec.process_id) == (4, 2)
+
+    @pytest.mark.parametrize("partial", [
+        {C.LABEL_GROUP_NAME: "band"},                                # no headcount
+        {C.LABEL_GROUP_NAME: "band", C.LABEL_GROUP_HEADCOUNT: "4"},  # no threshold
+    ])
+    def test_incomplete_gang_labels_no_env(self, partial):
+        # the scheduler treats incomplete gang labels as a solo pod
+        # (labels.parse_gang); the webhook must not inject a process
+        # count jax.distributed would then block on forever
+        labels = {
+            C.LABEL_TPU_REQUEST: "2.0",
+            C.LABEL_TPU_LIMIT_ALIASES[1]: "2.0",
+            **partial,
+        }
+        assert mutate_pod(shared_pod(labels=labels)) == []
+
+
 class TestAdmissionReview:
     def make_review(self, pod):
         return {
