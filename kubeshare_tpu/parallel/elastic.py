@@ -42,14 +42,21 @@ class ElasticTrainer:
         params: Dict,
         learning_rate: float = 1e-3,
         devices: Optional[Sequence] = None,
+        opt_state=None,
+        steps: int = 0,
     ):
+        """``opt_state``/``steps`` resume a checkpointed run (see
+        models/checkpoint.py restore_checkpoint): resize() owns the
+        device placement either way, so restored host arrays are fine."""
         self.loss_fn = loss_fn
         self.optimizer = optax.adamw(learning_rate)
         self.params = params
-        self.opt_state = self.optimizer.init(params)
+        self.opt_state = (
+            opt_state if opt_state is not None else self.optimizer.init(params)
+        )
         self.generation = -1
         self.dp = 0
-        self.steps = 0
+        self.steps = steps
         self.resize(devices if devices is not None else jax.devices())
 
     def resize(self, devices: Sequence) -> None:

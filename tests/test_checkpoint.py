@@ -119,3 +119,77 @@ class TestWorkloadCliCheckpoint:
         ])
         assert rc == 0
         assert latest_checkpoint(ckpt_dir) == 10
+
+
+class TestElasticRecoveryIntegration:
+    """SURVEY.md §5 failure-recovery story end-to-end: a gang member
+    dies mid-training -> elastic resize to the survivors -> periodic
+    checkpoint -> full process loss -> restore and resume on a
+    re-grown device set. Loss must keep descending across every
+    transition."""
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 4, reason="needs >= 4 devices"
+    )
+    def test_kill_resize_checkpoint_restore_resume(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from kubeshare_tpu.models import MnistConfig, init_mnist
+        from kubeshare_tpu.models.mnist import mnist_apply
+        from kubeshare_tpu.models.common import cross_entropy_loss
+        from kubeshare_tpu.models.checkpoint import (
+            latest_checkpoint, restore_checkpoint, save_checkpoint,
+        )
+        from kubeshare_tpu.parallel.elastic import ElasticTrainer
+
+        cfg = MnistConfig(hidden=32)
+        rng = jax.random.PRNGKey(0)
+        images = jax.random.normal(rng, (32, 28, 28, 1), jnp.float32)
+        labels = jax.random.randint(rng, (32,), 0, 10, dtype=jnp.int32)
+        batch = {"images": images, "labels": labels}
+
+        def loss_fn(params, batch):
+            return cross_entropy_loss(
+                mnist_apply(params, batch["images"], cfg), batch["labels"]
+            )
+
+        devices = jax.devices()[:4]
+        trainer = ElasticTrainer(
+            loss_fn, init_mnist(rng, cfg), learning_rate=1e-2,
+            devices=devices,
+        )
+        losses = [float(trainer.step(batch)) for _ in range(3)]
+
+        # two members die -> resize to survivors, training continues
+        trainer.resize(devices[:2])
+        assert trainer.dp == 2 and trainer.generation == 1
+        losses += [float(trainer.step(batch)) for _ in range(3)]
+
+        # periodic checkpoint, then the whole process "dies"
+        save_checkpoint(
+            str(tmp_path), trainer.steps, trainer.params,
+            trainer.opt_state,
+        )
+        assert latest_checkpoint(str(tmp_path)) == trainer.steps
+
+        # restart: restore and resume on a re-grown device set via the
+        # trainer's own resume path (opt_state + step counter)
+        step, params, opt_state = restore_checkpoint(
+            str(tmp_path),
+            jax.device_get(trainer.params),
+            jax.device_get(trainer.opt_state),
+        )
+        assert step == trainer.steps
+        reborn = ElasticTrainer(
+            loss_fn, params, learning_rate=1e-2, devices=devices[:3],
+            opt_state=opt_state, steps=step,
+        )
+        assert reborn.dp == 3 and reborn.steps == step
+        # batch of 30 divides by 3, not by 4 or 2 — truly a new world
+        small = jax.tree.map(lambda x: x[:30], batch)
+        losses += [float(reborn.step(small)) for _ in range(3)]
+
+        assert all(jnp.isfinite(jnp.asarray(losses)))
+        # training made progress across kill + resize + restore
+        assert losses[-1] < losses[0]
