@@ -47,7 +47,8 @@ MIN_BURST_MS = 4.0          # a realistic input pipeline delivers a few ms
                             # lease-transfer RTT amortized on fast chips
 STALL_FACTOR = 2.5          # input stall = 2.5x device burst (~28% duty)
 PHASE_SECONDS = 6.0
-ROUNDS = 3                  # interleaved solo/ungated/gated rounds
+ROUNDS = 5                  # interleaved solo/ungated/gated rounds; the
+                            # tunneled chip drifts, median of 5 is steady
 ARBITER_PORT = 45901
 
 

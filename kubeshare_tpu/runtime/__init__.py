@@ -1,4 +1,5 @@
 from .client import TokenClient, NativeTokenClient, load_native_library
+from .executor import ChipExecutor
 from .hook import SharedChipGate, install_gate, current_gate
 from .interposer import enable as enable_pjrt_interposer
 
@@ -6,6 +7,7 @@ __all__ = [
     "TokenClient",
     "NativeTokenClient",
     "load_native_library",
+    "ChipExecutor",
     "SharedChipGate",
     "install_gate",
     "current_gate",
