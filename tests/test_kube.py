@@ -474,9 +474,11 @@ class TestWatchMode:
             stub.add_pod("px", uid="ux")
             deadline_poll(cluster, lambda: "ux" in adds)
             # the replacement watch opens asynchronously after the
-            # relist; wait for it to land before counting
-            stub.wait_watches(("pods",))
-            assert stub.watch_opens["pods"] > opens
+            # relist; the OLD stream's queue may still be registered,
+            # so wait on the open COUNTER, not wait_watches
+            deadline_poll(
+                cluster, lambda: stub.watch_opens["pods"] > opens
+            )
         finally:
             cluster.close()
 
