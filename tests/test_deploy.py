@@ -65,6 +65,41 @@ class TestManifests:
                 "ServiceMonitor",
             }, path
 
+    def test_scheduler_pod_variant_tracks_deployment(self):
+        # the debug bare pod must not drift from the real Deployment
+        sched_docs = list(yaml.safe_load_all(
+            open(os.path.join(REPO, "deploy", "scheduler.yaml"))
+        ))
+        [deploy] = [d for d in sched_docs if d and d["kind"] == "Deployment"]
+        [pod] = [d for d in yaml.safe_load_all(
+            open(os.path.join(REPO, "deploy", "scheduler-pod.yaml"))
+        ) if d]
+        dspec = deploy["spec"]["template"]["spec"]
+        pspec = pod["spec"]
+        assert pspec["serviceAccountName"] == dspec["serviceAccountName"]
+        assert (
+            pspec["volumes"][0]["configMap"]
+            == dspec["volumes"][0]["configMap"]
+        )
+        dcmd = dspec["containers"][0]["command"]
+        pcmd = pspec["containers"][0]["command"]
+        # same mode and inventory source
+        assert "--kube" in dcmd and "--kube" in pcmd
+        assert [a for a in dcmd if a.startswith("--capacity-url")] == \
+               [a for a in pcmd if a.startswith("--capacity-url")]
+
+    def test_in_cluster_manifests_use_kube_mode(self):
+        # regression: the in-cluster scheduler/aggregator must watch
+        # the apiserver, not read a snapshot file that never exists
+        for name in ("scheduler", "aggregator"):
+            docs = list(yaml.safe_load_all(
+                open(os.path.join(REPO, "deploy", f"{name}.yaml"))
+            ))
+            [deploy] = [d for d in docs if d and d.get("kind") == "Deployment"]
+            cmd = deploy["spec"]["template"]["spec"]["containers"][0]["command"]
+            assert "--kube" in cmd, name
+            assert not any("--cluster-state" in a for a in cmd), name
+
     def test_scheduler_rbac_not_wildcard(self):
         # the reference ships a wildcard ClusterRole
         # (deploy/scheduler.yaml:12-17); ours must stay scoped
