@@ -37,6 +37,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--checkpoint-dir", default="",
                         help="save/resume (params, opt_state, step) here")
+    parser.add_argument(
+        "--profile-dir", default="",
+        help="capture a jax.profiler trace of the training loop here "
+             "(view at ui.perfetto.dev or tensorboard)",
+    )
     parser.add_argument("--checkpoint-every", type=int, default=50,
                         help="steps between checkpoints")
     return parser
@@ -159,45 +164,57 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     del _warm_params, _warm_opt
 
     log.info("workload %s batch=%d starting", args.model, args.batch)
+    if args.profile_dir:
+        # device + host trace of the whole training loop — the
+        # profiling story the reference leaves to the apps entirely.
+        # try/finally (below): an interrupted run must still flush the
+        # trace — that's exactly the run someone wants to inspect
+        jax.profiler.start_trace(args.profile_dir)
     started = time.perf_counter()
     steps_done = 0
     last_saved = -1
     result = None
-    while True:
-        if args.steps and steps_done >= args.steps:
-            break
-        if args.duration and time.perf_counter() - started >= args.duration:
-            break
-        key, sub = jax.random.split(key)
-        batch = make_batch(sub)
-        gate.begin()
-        params, opt_state, loss = step(params, opt_state, *batch)
-        result = gate.maybe_release(loss)
-        steps_done += 1
+    try:
+        while True:
+            if args.steps and steps_done >= args.steps:
+                break
+            if args.duration and time.perf_counter() - started >= args.duration:
+                break
+            key, sub = jax.random.split(key)
+            batch = make_batch(sub)
+            gate.begin()
+            params, opt_state, loss = step(params, opt_state, *batch)
+            result = gate.maybe_release(loss)
+            steps_done += 1
+            if (
+                args.checkpoint_dir
+                and steps_done % max(1, args.checkpoint_every) == 0
+            ):
+                # return the lease BEFORE the drain + disk write:
+                # holding it would starve co-located pods and bill
+                # checkpoint I/O as device time
+                result = gate.flush(result)
+                jax.block_until_ready(loss)
+                save_checkpoint(
+                    args.checkpoint_dir, start_step + steps_done,
+                    params, opt_state,
+                )
+                last_saved = start_step + steps_done
+        gate.flush(result)
         if (
             args.checkpoint_dir
-            and steps_done % max(1, args.checkpoint_every) == 0
+            and steps_done
+            and last_saved != start_step + steps_done
         ):
-            # return the lease BEFORE the drain + disk write: holding it
-            # would starve co-located pods and bill checkpoint I/O as
-            # device time
-            result = gate.flush(result)
             jax.block_until_ready(loss)
             save_checkpoint(
                 args.checkpoint_dir, start_step + steps_done, params, opt_state
             )
-            last_saved = start_step + steps_done
-    gate.flush(result)
-    if (
-        args.checkpoint_dir
-        and steps_done
-        and last_saved != start_step + steps_done
-    ):
-        jax.block_until_ready(loss)
-        save_checkpoint(
-            args.checkpoint_dir, start_step + steps_done, params, opt_state
-        )
-    jax.block_until_ready(loss)  # async dispatch must not inflate throughput
+        jax.block_until_ready(loss)  # async dispatch must not inflate throughput
+    finally:
+        if args.profile_dir:
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", args.profile_dir)
     elapsed = time.perf_counter() - started
     gate.close()
     print(json.dumps({

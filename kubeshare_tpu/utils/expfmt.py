@@ -52,20 +52,42 @@ class Sample:
         return f"{self.name} {self.value}"
 
 
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
 def render(
     samples: Iterable[Sample],
     help_text: Optional[Mapping[str, str]] = None,
 ) -> str:
-    """Render samples grouped by metric family."""
-    by_name: Dict[str, List[Sample]] = {}
+    """Render samples grouped by metric family. ``x_bucket``/``x_sum``/
+    ``x_count`` series roll up under one ``# TYPE x histogram`` — but
+    only when an ``x_bucket{le=...}`` sibling actually exists, so a
+    plain gauge that merely ends in ``_count`` keeps its own name,
+    family comment, and help text."""
+    samples = list(samples)
+    hist_families = {
+        s.name[: -len("_bucket")]
+        for s in samples
+        if s.name.endswith("_bucket") and "le" in s.labels
+    }
+
+    def family(name: str) -> str:
+        for suffix in _HIST_SUFFIXES:
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and base in hist_families:
+                return base
+        return name
+
+    by_family: Dict[str, List[Sample]] = {}
     for s in samples:
-        by_name.setdefault(s.name, []).append(s)
+        by_family.setdefault(family(s.name), []).append(s)
     lines: List[str] = []
-    for name in sorted(by_name):
-        if help_text and name in help_text:
-            lines.append(f"# HELP {name} {help_text[name]}")
-        lines.append(f"# TYPE {name} gauge")
-        lines.extend(s.render() for s in by_name[name])
+    for fam in sorted(by_family):
+        if help_text and fam in help_text:
+            lines.append(f"# HELP {fam} {help_text[fam]}")
+        kind = "histogram" if fam in hist_families else "gauge"
+        lines.append(f"# TYPE {fam} {kind}")
+        lines.extend(s.render() for s in by_family[fam])
     return "\n".join(lines) + ("\n" if lines else "")
 
 

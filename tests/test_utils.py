@@ -83,6 +83,32 @@ class TestExpfmt:
         sel = expfmt.select(parsed, "tpu_capacity", node="n1")
         assert len(sel) == 1 and sel[0].labels["uuid"] == "chip-0"
 
+    def test_histogram_family_typed(self):
+        from kubeshare_tpu.utils.trace import Histogram
+
+        h = Histogram(buckets=(0.01, 0.1))
+        h.observe(0.05)
+        samples = h.samples("lat_seconds") + [expfmt.Sample("up", {}, 1)]
+        text = expfmt.render(samples)
+        assert "# TYPE lat_seconds histogram" in text
+        assert "# TYPE up gauge" in text
+        # bucket/sum/count all roll up under ONE family comment
+        assert text.count("# TYPE lat_seconds") == 1
+        # round trip still parses every series
+        names = {s.name for s in expfmt.parse(text)}
+        assert {"lat_seconds_bucket", "lat_seconds_sum",
+                "lat_seconds_count", "up"} <= names
+
+    def test_suffix_named_gauge_keeps_own_family(self):
+        # a plain gauge ending in _count must NOT be re-homed under a
+        # stripped family (no _bucket sibling exists)
+        text = expfmt.render(
+            [expfmt.Sample("tpu_pending_count", {}, 3)],
+            help_text={"tpu_pending_count": "queue depth"},
+        )
+        assert "# TYPE tpu_pending_count gauge" in text
+        assert "# HELP tpu_pending_count queue depth" in text
+
     def test_escaping(self):
         s = expfmt.Sample("m", {"k": 'a"b\\c\nd'}, 2.5)
         [back] = expfmt.parse(expfmt.render([s]))
