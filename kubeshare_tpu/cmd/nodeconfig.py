@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--chips", default="",
         help="comma-separated local chip uuids to pre-create zeroed files for",
     )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="one sync then exit (CI / cron mode)",
+    )
     return parser
 
 
@@ -64,6 +68,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if chips:
         daemon.ensure_chip_files(chips)
     log.info("nodeconfig for %s -> %s", args.node_name, args.base_dir)
+    if args.once:
+        try:
+            daemon.sync()
+        except OSError:
+            return 1  # scrape failure already logged by source()
+        return 0
     stop = setup_signal_handler()
     while not stop.is_set():
         try:

@@ -318,3 +318,109 @@ class TestDispatch:
         out = tmp_path / "ip.txt"
         assert dispatch(["query-ip", "--ip", "1.2.3.4", "--out", str(out)]) == 0
         assert out.read_text().strip() == "1.2.3.4"
+
+
+class TestNodeconfigCli:
+    def test_once_scrapes_aggregator_and_writes_files(self, tmp_path):
+        from kubeshare_tpu.cmd import nodeconfig as nodeconfig_cmd
+        from kubeshare_tpu.metrics.aggregator import Aggregator
+        from kubeshare_tpu.nodeconfig.files import read_config_file
+        from kubeshare_tpu.utils.httpserv import MetricServer
+        from kubeshare_tpu.utils import expfmt
+
+        # a bound pod on node-a, exported by a live aggregator endpoint
+        state = tmp_path / "state.json"
+        pod = shared_pod("p1")
+        pod.update({
+            "node_name": "node-a", "phase": "Running",
+            "annotations": {
+                C.ANNOTATION_CHIP_UUID: "node-a-chip-0",
+                C.ANNOTATION_TPU_MEMORY: str(2 * GIB),
+                C.ANNOTATION_MANAGER_PORT: "50050",
+            },
+        })
+        state.write_text(json.dumps(snapshot_dict([pod])))
+        cluster = SnapshotCluster(str(state))
+        agg = Aggregator(cluster)
+        server = MetricServer(port=0)
+        server.route("/metrics", lambda: expfmt.render(agg.samples()))
+        server.start()
+        try:
+            rc = nodeconfig_cmd.main([
+                "--node-name", "node-a",
+                "--base-dir", str(tmp_path),
+                "--aggregator-url",
+                f"http://127.0.0.1:{server.port}/metrics",
+                "--once",
+            ])
+        finally:
+            server.stop()
+        assert rc == 0
+        [entry] = read_config_file(
+            str(tmp_path / "config" / "node-a-chip-0")
+        )
+        assert entry.pod == "default/p1"
+        assert entry.request == 0.5 and entry.memory == 2 * GIB
+
+
+class TestLauncherCli:
+    def test_subprocess_runs_and_tears_down(self, tmp_path):
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import time
+
+        build = os.path.join(
+            os.path.dirname(__file__), "..", "runtime_native", "build"
+        )
+        if not os.path.exists(os.path.join(build, "tpu-schd")):
+            pytest.skip("native runtime not built")
+        env = dict(os.environ, PYTHONPATH=os.path.join(
+            os.path.dirname(__file__), ".."
+        ))
+
+        def spawn():
+            s = socket.socket(); s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]; s.close()
+            return port, subprocess.Popen([
+                sys.executable, "-m", "kubeshare_tpu", "launcher",
+                "--base-dir", str(tmp_path),
+                "--chips", "chip-0",
+                "--base-port", str(port),
+                "--poll-interval", "0.2",
+            ], env=env)
+
+        def wait_up(port, timeout=15):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                try:
+                    socket.create_connection(
+                        ("127.0.0.1", port), timeout=0.2
+                    ).close()
+                    return True
+                except OSError:
+                    time.sleep(0.1)
+            return False
+
+        base_port, proc = spawn()
+        if not wait_up(base_port):
+            # bind-then-close port reservation can race another
+            # process; one retry with a fresh port
+            proc.kill(); proc.wait()
+            base_port, proc = spawn()
+        try:
+            assert wait_up(base_port), \
+                "arbiter never came up under the launcher CLI"
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=10) == 0
+            # arbiter child torn down with the launcher
+            time.sleep(0.3)
+            with pytest.raises(OSError):
+                socket.create_connection(
+                    ("127.0.0.1", base_port), timeout=0.3
+                )
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
