@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
              "semantics; 2 hides per-hold drain latency)",
     )
     parser.add_argument("--poll-interval", type=float, default=0.5)
+    parser.add_argument(
+        "--metrics-port", type=int, default=0,
+        help="serve per-pod arbiter usage (tpu_pod_window_usage_ms) on "
+             "this port (0 = off)",
+    )
     return parser
 
 
@@ -67,11 +72,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         lease_slots=args.lease_slots,
         log=log,
     )
+    metrics_server = None
+    if args.metrics_port:
+        metrics_server = launcher.serve_metrics(port=args.metrics_port)
+        log.info("usage metrics on :%d/metrics", metrics_server.port)
     stop = setup_signal_handler()
     try:
         launcher.run(poll_interval=args.poll_interval, stop=stop)
     except KeyboardInterrupt:
         pass  # run()'s finally already tore the children down
+    finally:
+        if metrics_server is not None:
+            metrics_server.stop()
     return 0
 
 

@@ -181,6 +181,52 @@ class NodeLauncher:
                 proc.kill()
         proc.wait()
 
+    def usage_samples(self):
+        """Per-pod device-time usage straight from the live arbiters:
+        ``tpu_pod_window_usage_ms{chip,pod}`` (ms of compute-token hold
+        inside the arbiter's sliding window) plus an up gauge per chip.
+        The reference's Gemini exposes nothing — its per-pod usage was
+        only visible in debug logs."""
+        from ..utils import expfmt
+        from .client import TokenClient
+
+        samples = []
+        for chip in self.chips.values():
+            up = 0.0
+            try:
+                # short timeout: one wedged arbiter must not stall the
+                # whole scrape past Prometheus's scrape_timeout; broad
+                # except: a mid-conversation death raises protocol/
+                # parse errors, not just OSError, and a scrape must
+                # degrade to up=0, never abort (collector.py precedent)
+                with TokenClient(
+                    "127.0.0.1", chip.port, pod="launcher-metrics",
+                    timeout=2.0,
+                ) as client:
+                    for stat in client.stats():
+                        samples.append(expfmt.Sample(
+                            "tpu_pod_window_usage_ms",
+                            {"chip": chip.uuid, "pod": stat.pod},
+                            stat.window_usage_ms,
+                        ))
+                up = 1.0
+            except Exception:
+                pass  # arbiter restarting; reconcile will respawn it
+            samples.append(expfmt.Sample(
+                "tpu_chip_arbiter_up", {"chip": chip.uuid}, up
+            ))
+        return samples
+
+    def serve_metrics(self, host: str = "0.0.0.0", port: int = 0):
+        """Start a /metrics endpoint over :meth:`usage_samples`."""
+        from ..utils import expfmt
+        from ..utils.httpserv import MetricServer
+
+        server = MetricServer(host=host, port=port)
+        server.route("/metrics", lambda: expfmt.render(self.usage_samples()))
+        server.start()
+        return server
+
     def run(self, poll_interval: float = 0.5, stop=None) -> None:
         """Reconcile until ``stop`` (a threading.Event) is set — or
         forever if none given. Children are always torn down on exit."""

@@ -306,6 +306,50 @@ class TestLauncher:
             launcher.shutdown()
 
 
+class TestLauncherUsageMetrics:
+    def test_usage_samples_and_http_endpoint(self, tmp_path):
+        import urllib.request
+
+        base = str(tmp_path)
+        write_config_file(base, "chip-0", [ConfigEntry("default/x", 1.0, 0.5, 0)])
+        launcher = NodeLauncher(
+            base, ["chip-0"], base_port=free_port(),
+            base_quota_ms=50, min_quota_ms=5, window_ms=1000,
+        )
+        server = None
+        try:
+            launcher.start_arbiters()
+            chip = launcher.chips["chip-0"]
+            wait_for_port(chip.port)
+            # burn some device time as pod x
+            with TokenClient("127.0.0.1", chip.port, pod="default/x") as c:
+                c.acquire()
+                c.release(12.5)
+            server = launcher.serve_metrics(host="127.0.0.1")
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            ).read().decode()
+            assert 'tpu_chip_arbiter_up{chip="chip-0"} 1' in text
+            assert 'tpu_pod_window_usage_ms{chip="chip-0",pod="default/x"}' in text
+            from kubeshare_tpu.utils import expfmt
+
+            [usage] = expfmt.select(
+                expfmt.parse(text), "tpu_pod_window_usage_ms", pod="default/x"
+            )
+            assert usage.value >= 12.5
+            # dead arbiter -> up 0, no usage rows, endpoint still serves
+            chip.scheduler_proc.kill()
+            chip.scheduler_proc.wait()
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            ).read().decode()
+            assert 'tpu_chip_arbiter_up{chip="chip-0"} 0' in text
+        finally:
+            if server is not None:
+                server.stop()
+            launcher.shutdown()
+
+
 class TestReviewRegressions:
     def test_same_second_config_rewrite_reloads(self, arbiter):
         port, base = arbiter
