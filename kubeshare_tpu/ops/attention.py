@@ -3,12 +3,13 @@
 ``attention`` is the plain O(T^2)-memory einsum version (differentiable,
 runs anywhere). ``flash_attention`` is a Pallas kernel that streams K/V
 blocks through VMEM with an online softmax — O(T) memory, MXU-shaped
-block matmuls (guide: /opt/skills/guides/pallas_guide.md). Its backward
-pass is the autodiff of the reference implementation (custom_vjp), so
-it trains correctly while the forward stays flash; a fused backward
-kernel is a later optimization.
+block matmuls (guide: /opt/skills/guides/pallas_guide.md). The backward
+is fused too (FlashAttention-2 shape): the forward saves only the
+row-wise log-sum-exp, the backward precomputes ``delta = rowsum(dO*O)``
+and streams the same K/V tiles through two kernels (dq; dk/dv) — no
+O(T^2) probability tensor ever hits HBM in either direction.
 
-On CPU (tests) the kernel runs in interpret mode; on TPU it compiles
+On CPU (tests) the kernels run in interpret mode; on TPU they compile
 natively. Shapes: q [B, H, Tq, D], k/v [B, Hkv, Tk, D] with H a
 multiple of Hkv (GQA: kv heads are repeated).
 """
@@ -57,10 +58,39 @@ def attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
 # ---- Pallas flash forward ------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, out_ref, *, block_k: int,
+def _causal_mask(scores, q_offset, k_offset):
+    """Mask positions where k_pos > q_pos to -inf (shared by all three
+    kernels — one place for the position arithmetic)."""
+    block_q, block_k = scores.shape
+    q_pos = q_offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = k_offset + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+
+
+def _last_k_block(q_offset, block_q: int, block_k: int, num_k_blocks):
+    """Exclusive upper bound of k blocks a causal q block attends to."""
+    return jnp.minimum(
+        (q_offset + block_q + block_k - 1) // block_k, num_k_blocks
+    )
+
+
+def _resolve_defaults(q, scale, interpret):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return scale, interpret
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, *, block_k: int,
                   causal: bool, scale: float):
     """One (batch*head, q-block) program: stream K/V blocks with online
-    softmax. Refs: q [1, BQ, D], k/v [1, Tk, D], out [1, BQ, D]."""
+    softmax. Refs: q [1, BQ, D], k/v [1, Tk, D], out [1, BQ, D],
+    lse [1, BQ, 1] (row log-sum-exp, the backward's only residual)."""
     q = q_ref[0].astype(jnp.float32) * scale
     block_q, head_dim = q.shape
     t_k = k_ref.shape[1]
@@ -75,13 +105,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, out_ref, *, block_k: int,
         v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
         scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_offset + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+            scores = _causal_mask(scores, q_offset, kb * block_k)
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         correction = jnp.exp(m_prev - m_new)
@@ -98,14 +122,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, out_ref, *, block_k: int,
 
     if causal:
         # only k blocks at or before this q block contribute
-        last = jnp.minimum(
-            (q_offset + block_q + block_k - 1) // block_k, num_k_blocks
-        )
+        last = _last_k_block(q_offset, block_q, block_k, num_k_blocks)
         acc, m, l = jax.lax.fori_loop(0, last, body, (acc0, m0, l0))
     else:
         acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
 
     out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
+    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
 
 
 def flash_shapes_ok(q_shape, k_shape, causal: bool,
@@ -146,7 +169,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     kernel = functools.partial(
         _flash_kernel, block_k=block_k, causal=causal, scale=scale
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(batch * num_heads, t_q // block_q),
         in_specs=[
@@ -154,11 +177,179 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
             pl.BlockSpec((1, t_k, head_dim), lambda b, i: (kv_index(b, i), 0, 0)),
             pl.BlockSpec((1, t_k, head_dim), lambda b, i: (kv_index(b, i), 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((batch * num_heads, t_q, head_dim), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch * num_heads, t_q, head_dim), q.dtype),
+            jax.ShapeDtypeStruct((batch * num_heads, t_q, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(batch, num_heads, t_q, head_dim)
+    out = out.reshape(batch, num_heads, t_q, head_dim)
+    lse = lse.reshape(batch, num_heads, t_q, 1)
+    return out, lse
+
+
+# ---- Pallas flash backward -----------------------------------------
+#
+# FlashAttention-2 decomposition. With L = logsumexp rows saved from
+# the forward and delta_i = sum_d dO_id * O_id:
+#   P_ij  = exp(scale*q_i.k_j - L_i)
+#   dV_j  = sum_i P_ij * dO_i
+#   dS_ij = P_ij * (dO_i.v_j - delta_i)
+#   dQ_i  = scale * sum_j dS_ij * k_j
+#   dK_j  = scale * sum_i dS_ij * q_i
+# Two kernels: dq streams K/V per q-block (reads coalesce on q), dk/dv
+# streams Q/dO per k-block (reads coalesce on k). Each re-forms its
+# probability TILE in VMEM; nothing O(T^2) is materialized.
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]          # [BQ, 1] f32
+    delta = delta_ref[0]      # [BQ, 1] f32
+    block_q, head_dim = q.shape
+    t_k = k_ref.shape[1]
+    num_k_blocks = t_k // block_k
+    q_offset = pl.program_id(1) * block_q
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, q_offset, kb * block_k)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    if causal:
+        last = _last_k_block(q_offset, block_q, block_k, num_k_blocks)
+        dq = jax.lax.fori_loop(0, last, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, num_k_blocks, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, t_q: int,
+                          causal: bool, scale: float):
+    """One (batch*kv-head, k-block) program. The q-side refs carry this
+    kv head's WHOLE GROUP: the group's q heads are concatenated along
+    the row axis ([1, reps*Tq, D]), so grads accumulate across the
+    group inside the kernel and dk/dv come out already GQA-grouped —
+    no repeated K/V in HBM, no post-sum."""
+    k = k_ref[0].astype(jnp.float32)   # [BK, D]
+    v = v_ref[0].astype(jnp.float32)
+    block_k, head_dim = k.shape
+    rows = q_ref.shape[1]              # reps * t_q
+    num_row_blocks = rows // block_q
+    k_offset = pl.program_id(1) * block_k
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q), :]
+        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            # position within this block's own head (rows wrap per head;
+            # t_q % block_q == 0 so blocks never straddle heads)
+            s = _causal_mask(s, (qb * block_q) % t_q, k_offset)
+        p = jnp.exp(s - lse_blk)
+        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, num_row_blocks, body, (zeros, zeros))
+    dk_ref[0] = dk * scale
+    dv_ref[0] = dv
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    batch, num_heads, t_q, head_dim = q.shape
+    h_kv = k.shape[1]
+    reps = num_heads // h_kv
+    t_k = k.shape[2]
+    block_q = min(block_q, t_q)
+    block_k = min(block_k, t_k)
+
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # [B, H, Tq, 1]
+
+    qf = q.reshape(batch * num_heads, t_q, head_dim)
+    kf = k.reshape(batch * h_kv, t_k, head_dim)
+    vf = v.reshape(batch * h_kv, t_k, head_dim)
+    dof = g.reshape(batch * num_heads, t_q, head_dim)
+    lsef = lse.reshape(batch * num_heads, t_q, 1)
+    deltaf = delta.reshape(batch * num_heads, t_q, 1)
+
+    q_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0))
+
+    def kv_index(b, i):
+        del i
+        return (b // num_heads) * h_kv + (b % num_heads) // reps
+
+    kv_by_q = pl.BlockSpec((1, t_k, head_dim), lambda b, i: (kv_index(b, i), 0, 0))
+
+    # dq: same GQA index-map routing as the forward — K/V never repeat
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale
+        ),
+        grid=(batch * num_heads, t_q // block_q),
+        in_specs=[q_spec, kv_by_q, kv_by_q, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lsef, deltaf)
+
+    # dk/dv: group each kv head's q heads along the row axis so the
+    # kernel accumulates the whole group (f32) and emits grouped grads
+    qg = qf.reshape(batch * h_kv, reps * t_q, head_dim)
+    dog = dof.reshape(batch * h_kv, reps * t_q, head_dim)
+    lseg = lsef.reshape(batch * h_kv, reps * t_q, 1)
+    deltag = deltaf.reshape(batch * h_kv, reps * t_q, 1)
+    rows_full = pl.BlockSpec(
+        (1, reps * t_q, head_dim), lambda b, i: (b, 0, 0)
+    )
+    rows_full1 = pl.BlockSpec((1, reps * t_q, 1), lambda b, i: (b, 0, 0))
+    kv_spec = pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, block_q=block_q, t_q=t_q, causal=causal,
+            scale=scale,
+        ),
+        grid=(batch * h_kv, t_k // block_k),
+        in_specs=[rows_full, kv_spec, kv_spec, rows_full, rows_full1,
+                  rows_full1],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct(kf.shape, jnp.float32),
+            jax.ShapeDtypeStruct(vf.shape, jnp.float32),
+        ],
+        interpret=interpret,
+    )(qg, kf, vf, dog, lseg, deltag)
+
+    dq = dq.reshape(batch, num_heads, t_q, head_dim)
+    dk = dk.reshape(batch, h_kv, t_k, head_dim).astype(k.dtype)
+    dv = dv.reshape(batch, h_kv, t_k, head_dim).astype(v.dtype)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -166,23 +357,25 @@ def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None):
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: attention(q_, k_, v_, causal, scale),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

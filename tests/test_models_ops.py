@@ -219,6 +219,78 @@ class TestAttention:
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                    atol=5e-3, rtol=5e-3)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_fused_backward_all_grads(self, causal):
+        # the Pallas backward (dq + dk/dv kernels) against the dense
+        # vjp, over all three inputs with a non-symmetric cotangent
+        q, k, v = self._qkv(b=2, h=2, t=256, d=32)
+        key = jax.random.split(RNG, 5)[4]
+        g = jax.random.normal(key, q.shape, jnp.float32)
+
+        def flash_loss(q, k, v):
+            return jnp.vdot(
+                flash_attention(q, k, v, causal, None, 128, 128, True), g
+            )
+
+        def dense_loss(q, k, v):
+            return jnp.vdot(attention(q, k, v, causal=causal), g)
+
+        gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3,
+                err_msg=f"d{name} mismatch (causal={causal})",
+            )
+
+    def test_fused_backward_gqa_grouped_grads(self):
+        # dk/dv must sum across the q heads sharing each kv head
+        q, k, v = self._qkv(b=1, h=4, t=128, d=32, hkv=2)
+        g = jax.random.normal(jax.random.split(RNG, 7)[6], q.shape)
+
+        gf = jax.grad(
+            lambda q, k, v: jnp.vdot(
+                flash_attention(q, k, v, True, None, 128, 128, True), g
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.vdot(attention(q, k, v, causal=True), g),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        assert gf[1].shape == k.shape and gf[2].shape == v.shape
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3, rtol=5e-3
+            )
+
+    def test_fused_backward_multiblock_and_bf16(self):
+        # several q AND k blocks (exercises both fori_loop ranges and
+        # the causal first/last block arithmetic) + bf16 inputs
+        q, k, v = self._qkv(b=1, h=2, t=512, d=32)
+        q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+        def flash_loss(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, None, 128, 128, True)
+                .astype(jnp.float32) ** 2
+            )
+
+        def dense_loss(q, k, v):
+            return jnp.sum(
+                attention(q, k, v, causal=True).astype(jnp.float32) ** 2
+            )
+
+        gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            assert a.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(a, dtype=np.float32),
+                np.asarray(b, dtype=np.float32),
+                atol=0.15, rtol=0.1,  # bf16 grids accumulate noise
+            )
+
 
 class TestReviewRegressions:
     def test_mha_falls_back_on_untiled_shapes(self):
