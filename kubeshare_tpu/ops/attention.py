@@ -208,10 +208,14 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, *, block_k: int, causal: bool, scale: float):
+    """delta_ref carries ``delta - glse`` precomputed host-side: the
+    lse cotangent (nonzero when callers consume the lse output, e.g.
+    the ring-attention merge) enters as dS_ij += P_ij*glse_i, the same
+    row-broadcast shape as the delta term."""
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]          # [BQ, 1] f32
-    delta = delta_ref[0]      # [BQ, 1] f32
+    delta = delta_ref[0]      # [BQ, 1] f32 (already delta - glse)
     block_q, head_dim = q.shape
     t_k = k_ref.shape[1]
     num_k_blocks = t_k // block_k
@@ -277,7 +281,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, g_lse=None):
     batch, num_heads, t_q, head_dim = q.shape
     h_kv = k.shape[1]
     reps = num_heads // h_kv
@@ -289,6 +293,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
         keepdims=True,
     )  # [B, H, Tq, 1]
+    if g_lse is not None:
+        # lse cotangent folds into the shared row term: dS gains
+        # +P*glse, i.e. delta_eff = delta - glse
+        delta = delta - g_lse.astype(jnp.float32)
 
     qf = q.reshape(batch * num_heads, t_q, head_dim)
     kf = k.reshape(batch * h_kv, t_k, head_dim)
@@ -379,6 +387,40 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(q, k, v, causal: bool = True,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128,
+                             interpret: Optional[bool] = None):
+    """Flash attention that also returns the row log-sum-exp
+    [B, H, Tq, 1] — the ingredient block-merging callers (ring
+    attention) need. Differentiable in BOTH outputs: the lse cotangent
+    folds into the backward kernels' shared row term."""
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    out, lse = _flash_forward(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    g_out, g_lse = g
+    scale, interpret = _resolve_defaults(q, scale, interpret)
+    return _flash_backward(
+        q, k, v, out, lse, g_out, causal, scale, block_q, block_k,
+        interpret, g_lse=g_lse,
+    )
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def mha(q, k, v, causal: bool = True, use_flash: Optional[bool] = None):

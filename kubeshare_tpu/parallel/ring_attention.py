@@ -3,9 +3,12 @@
 Long-context support: Q/K/V are sharded along the sequence dimension
 across sp devices; each device keeps its Q shard resident and K/V
 shards rotate around the ring via ``ppermute`` (one ICI hop per step,
-overlappable with the block computation). Online-softmax accumulators
-make the result exact, not approximate. Memory per device is
-O(T/n * T/n) per block instead of O(T^2).
+overlappable with the block computation). Hops contribute normalized
+``(out, lse)`` pairs merged with max-shifted accumulators, so the
+result is exact, not approximate. The dense hop body holds one
+O(T/n x T/n) score tile; the flash hop body (``use_flash=True``) runs
+the Pallas kernel so even that tile stays in VMEM, forward and fused
+backward both.
 
 ``ring_attention`` is written to run *inside* ``shard_map`` (it uses
 ``axis_index``/``ppermute``); ``make_ring_attention`` builds the
@@ -15,7 +18,7 @@ shard_mapped callable over a mesh.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,67 +27,143 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
-                   scale: Optional[float] = None):
-    """Per-shard bodies: q/k/v [B, H, T_local, D] (already sharded on T).
+def _sp_varying(x, axis_name: str):
+    """Mark an accumulator as varying over the ring axis (its contents
+    depend on axis_index), so scan accepts it as a carry."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)  # older jax
 
-    Must be called inside shard_map over ``axis_name``.
+
+def _ring_merge_loop(q, k, v, axis_name: str, hop_fn: Callable):
+    """Shared ring scaffolding: rotate K/V around the ring and merge
+    each hop's normalized ``(out_h [B,H,T,D], lse_h [B,H,T,1])`` with
+    max-shifted accumulators. ``hop_fn(kv_idx, my_idx, k_cur, v_cur)``
+    computes one hop's contribution; a fully-masked hop signals itself
+    with ``lse_h = -inf`` rows (their weight becomes exactly 0).
+
+    Hop 0 is always the diagonal block, whose causal rows each see at
+    least their own position — m_run is finite after the first merge,
+    so the -inf arithmetic below never produces NaNs.
     """
     batch, heads, t_local, head_dim = q.shape
-    if scale is None:
-        scale = head_dim ** -0.5
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
-    q32 = q.astype(jnp.float32) * scale
-    q_pos = my_idx * t_local + jnp.arange(t_local)          # global positions
-
     def step(carry, i):
-        acc, m_prev, l_prev, k_cur, v_cur = carry
+        acc, m_run, l_run, k_cur, v_cur = carry
         kv_idx = (my_idx - i) % n
-        scores = jnp.einsum(
-            "bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
-        if causal:
-            k_pos = kv_idx * t_local + jnp.arange(t_local)
-            mask = k_pos[None, :] <= q_pos[:, None]
-            scores = jnp.where(mask[None, None], scores, _NEG_INF)
-        m_cur = jnp.max(scores, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        correction = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)
-        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * correction + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
+        out_h, lse_h = hop_fn(kv_idx, my_idx, k_cur, v_cur)
+        m_new = jnp.maximum(m_run, lse_h)
+        corr = jnp.exp(m_run - m_new)
+        w_h = jnp.exp(lse_h - m_new)
+        acc_new = acc * corr + out_h.astype(jnp.float32) * w_h
+        l_new = l_run * corr + w_h
         # rotate K/V one hop around the ring (device j -> j+1)
         k_next = jax.lax.ppermute(k_cur, axis_name, perm)
         v_next = jax.lax.ppermute(v_cur, axis_name, perm)
         return (acc_new, m_new, l_new, k_next, v_next), None
 
-    # pvary: the accumulators' contents diverge per shard (axis_index in
-    # the mask), so their type must carry the sp-varying annotation from
-    # the start or scan rejects the carry
-    acc0 = jax.lax.pvary(
+    acc0 = _sp_varying(
         jnp.zeros((batch, heads, t_local, head_dim), jnp.float32), axis_name
     )
-    m0 = jax.lax.pvary(
-        jnp.full((batch, heads, t_local, 1), _NEG_INF, jnp.float32), axis_name
+    m0 = _sp_varying(
+        jnp.full((batch, heads, t_local, 1), -jnp.inf, jnp.float32), axis_name
     )
-    l0 = jax.lax.pvary(
+    l0 = _sp_varying(
         jnp.zeros((batch, heads, t_local, 1), jnp.float32), axis_name
     )
-    (acc, m, l, _, _), _ = jax.lax.scan(
+    (acc, _, l, _, _), _ = jax.lax.scan(
         step, (acc0, m0, l0, k, v), jnp.arange(n)
     )
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None,
+                   use_flash: bool = False):
+    """Per-shard bodies: q/k/v [B, H, T_local, D] (already sharded on T).
+
+    Must be called inside shard_map over ``axis_name``.
+    ``use_flash=True`` computes each hop's block with the Pallas flash
+    kernel (fwd AND bwd fused) and merges hops by their log-sum-exp —
+    the per-hop O(T_local^2) score tile never touches HBM either; needs
+    T_local to tile by 128 (callers building the shard_map must also
+    pass ``check_vma=False``, see ``make_ring_attention``).
+    """
+    batch, heads, t_local, head_dim = q.shape
+    if scale is None:
+        scale = head_dim ** -0.5
+
+    if use_flash:
+        from ..ops.attention import flash_attention_with_lse
+
+        if t_local % 128:
+            raise ValueError(
+                f"ring flash path needs T_local in multiples of 128, got "
+                f"{t_local} (use the dense path for short shards)"
+            )
+
+        def hop_fn(kv_idx, my_idx, k_cur, v_cur):
+            def diag(_):
+                return flash_attention_with_lse(q, k_cur, v_cur, True, scale)
+
+            def full(_):
+                return flash_attention_with_lse(q, k_cur, v_cur, False, scale)
+
+            def skip(_):
+                return (
+                    jnp.zeros_like(q),
+                    jnp.full(
+                        (batch, heads, t_local, 1), -jnp.inf, jnp.float32
+                    ),
+                )
+
+            if causal:
+                branch = jnp.where(
+                    kv_idx == my_idx, 0, jnp.where(kv_idx < my_idx, 1, 2)
+                )
+            else:
+                branch = jnp.ones((), jnp.int32)  # every hop fully visible
+            return jax.lax.switch(branch, [diag, full, skip], None)
+
+        return _ring_merge_loop(q, k, v, axis_name, hop_fn)
+
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = jnp.arange(t_local)
+
+    def hop_fn(kv_idx, my_idx, k_cur, v_cur):
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            gq = my_idx * t_local + q_pos
+            gk = kv_idx * t_local + q_pos
+            mask = gk[None, :] <= gq[:, None]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_h = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - m_h)
+        l_h = jnp.sum(p, axis=-1, keepdims=True)
+        out_h = jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ) / jnp.maximum(l_h, 1e-30)
+        # fully-masked rows (a future hop): m_h == _NEG_INF and every
+        # p == 1, making out_h garbage — but lse -> -inf zeroes their
+        # merge weight exactly
+        lse_h = jnp.where(
+            m_h <= _NEG_INF / 2, -jnp.inf, m_h + jnp.log(jnp.maximum(l_h, 1e-30))
+        )
+        return out_h, lse_h
+
+    return _ring_merge_loop(q, k, v, axis_name, hop_fn)
+
+
 def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
-                        causal: bool = True):
+                        causal: bool = True, use_flash: bool = False):
     """Shard_mapped ring attention over full arrays [B, H, T, D] with T
     sharded on ``axis_name``."""
     spec = P(None, None, axis_name, None)
@@ -93,8 +172,13 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
         jax.shard_map, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        # the pallas_call inside the flash path predates shard_map's
+        # varying-mesh-axes (vma) annotations on out_shape; skip the
+        # check there (the dense path keeps it)
+        check_vma=not use_flash,
     )
     def sharded(q, k, v):
-        return ring_attention(q, k, v, axis_name=axis_name, causal=causal)
+        return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                              use_flash=use_flash)
 
     return sharded
