@@ -52,11 +52,16 @@ def _dense_attention(q, k, v, causal: bool, scale: float):
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      use_flash: bool = False):
     """Per-shard bodies: q/k/v [B, H, T_local, D] (sharded on T).
 
     Must be called inside shard_map over ``axis_name``; H must divide
-    evenly by the axis size.
+    evenly by the axis size. After the all-to-all each device holds
+    FULL sequences for its head slice, so ``use_flash=True`` drops the
+    whole-sequence O(T^2) score tensor straight into the Pallas kernel
+    (forward + fused backward); needs T to tile by 128 and
+    ``check_vma=False`` on the enclosing shard_map.
     """
     heads = q.shape[1]
     head_dim = q.shape[3]
@@ -71,7 +76,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
         )
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = _dense_attention(qh, kh, vh, causal=causal, scale=scale)
+    if use_flash:
+        from ..ops.attention import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal, scale)
+    else:
+        out = _dense_attention(qh, kh, vh, causal=causal, scale=scale)
     # [B, H/n, T, D] -> [B, H, T/n, D]
     del heads, n
     return jax.lax.all_to_all(
@@ -80,7 +90,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 
 
 def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
-                           causal: bool = True):
+                           causal: bool = True, use_flash: bool = False):
     """Shard_mapped Ulysses attention over full arrays [B, H, T, D] with
     T sharded on ``axis_name``."""
     spec = P(None, None, axis_name, None)
@@ -89,8 +99,10 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
         jax.shard_map, mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        check_vma=not use_flash,  # pallas out_shape carries no vma
     )
     def sharded(q, k, v):
-        return ulysses_attention(q, k, v, axis_name=axis_name, causal=causal)
+        return ulysses_attention(q, k, v, axis_name=axis_name,
+                                 causal=causal, use_flash=use_flash)
 
     return sharded
