@@ -84,6 +84,35 @@ PJRT_Error* call_execute(const PJRT_Api* api, PJRT_Event** events,
   return api->PJRT_LoadedExecutable_Execute(&args);
 }
 
+// Execute with a caller-allocated single-device output list, the way
+// JAX/PT-XLA drive PJRT (the plain call_execute above models the
+// zero-output corner).
+PJRT_Error* call_execute_outputs(const PJRT_Api* api,
+                                 PJRT_LoadedExecutable* exec,
+                                 PJRT_Buffer** out_slots) {
+  PJRT_Buffer** lists[1] = {out_slots};
+  PJRT_LoadedExecutable_Execute_Args args{};
+  args.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  args.executable = exec;
+  args.num_devices = 1;
+  args.num_args = 0;
+  args.output_lists = lists;
+  return api->PJRT_LoadedExecutable_Execute(&args);
+}
+
+void check_resource_exhausted(const PJRT_Api* api, PJRT_Error* err) {
+  CHECK(err != nullptr);
+  PJRT_Error_GetCode_Args gc{};
+  gc.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  gc.error = err;
+  CHECK(api->PJRT_Error_GetCode(&gc) == nullptr);
+  CHECK(gc.code == PJRT_Error_Code_RESOURCE_EXHAUSTED);
+  PJRT_Error_Destroy_Args ed{};
+  ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  ed.error = err;
+  api->PJRT_Error_Destroy(&ed);
+}
+
 PJRT_Error* alloc_buffer(const PJRT_Api* api, int64_t n_floats,
                          PJRT_Buffer** out) {
   static int64_t dims[1];
@@ -246,6 +275,152 @@ int main(int argc, char** argv) {
   CHECK(alloc_buffer(api, 512, &b3) == nullptr);
   destroy_buffer(api, b2);
   destroy_buffer(api, b3);
+  CHECK(mock_buffer_count() == 0);
+
+  // ---- execute-output HBM accounting (training-shaped loop) --------
+  // The dominant allocations in training are executable OUTPUTS, not
+  // host uploads: each step's outputs (2048B here) dwarf its host
+  // input (256B). The cap must bind on outputs, and charged bytes must
+  // track the plugin's live device bytes exactly.
+  auto mock_live_bytes = reinterpret_cast<long long (*)()>(
+      dlsym(mock_handle, "mock_live_bytes"));
+  CHECK(mock_live_bytes != nullptr);
+  auto check_ledger = [&](long long expect) {
+    CHECK(arbiter.stats().at(0).mem_used == expect);
+    CHECK(mock_live_bytes() == expect);
+  };
+  check_ledger(0);
+  setenv("MOCK_PJRT_OUT_FLOATS", "512", 1);  // one 2048-byte output
+  PJRT_LoadedExecutable* train_step =
+      reinterpret_cast<PJRT_LoadedExecutable*>(0x7e57);
+  PJRT_Buffer* input = nullptr;
+  CHECK(alloc_buffer(api, 64, &input) == nullptr);  // 256 bytes
+  check_ledger(256);
+  PJRT_Buffer* out_step1[1] = {nullptr};
+  CHECK(call_execute_outputs(api, train_step, out_step1) == nullptr);
+  CHECK(out_step1[0] != nullptr);
+  check_ledger(256 + 2048);
+  // holding step-1 outputs, step 2 would exceed the 4096 cap: denied
+  // BEFORE dispatch (execute count unchanged), lease state untouched
+  int execs_before = mock_execute_count();
+  PJRT_Buffer* out_step2[1] = {nullptr};
+  check_resource_exhausted(api,
+                           call_execute_outputs(api, train_step, out_step2));
+  CHECK(mock_execute_count() == execs_before);
+  check_ledger(256 + 2048);
+  // a real training loop frees the previous step's outputs: now it fits
+  destroy_buffer(api, out_step1[0]);
+  check_ledger(256);
+  CHECK(call_execute_outputs(api, train_step, out_step2) == nullptr);
+  check_ledger(256 + 2048);
+  destroy_buffer(api, out_step2[0]);
+  destroy_buffer(api, input);
+  check_ledger(0);
+  unsetenv("MOCK_PJRT_OUT_FLOATS");
+
+  // ---- device-to-device copy accounting ----------------------------
+  {
+    PJRT_Buffer* src = nullptr;
+    CHECK(alloc_buffer(api, 512, &src) == nullptr);  // 2048 bytes
+    PJRT_Buffer_CopyToDevice_Args ca{};
+    ca.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+    ca.buffer = src;
+    CHECK(api->PJRT_Buffer_CopyToDevice(&ca) == nullptr);
+    check_ledger(4096);  // src + copy == cap
+    PJRT_Buffer_CopyToMemory_Args cm{};
+    cm.struct_size = PJRT_Buffer_CopyToMemory_Args_STRUCT_SIZE;
+    cm.buffer = src;
+    check_resource_exhausted(
+        api, api->PJRT_Buffer_CopyToMemory(&cm));  // third copy: over cap
+    check_ledger(4096);
+    destroy_buffer(api, ca.dst_buffer);
+    CHECK(api->PJRT_Buffer_CopyToMemory(&cm) == nullptr);  // fits again
+    check_ledger(4096);
+    destroy_buffer(api, cm.dst_buffer);
+    destroy_buffer(api, src);
+    check_ledger(0);
+  }
+
+  // ---- async host-to-device staging accounting ---------------------
+  {
+    int64_t dims[1] = {256};
+    PJRT_ShapeSpec specs[2];
+    for (int i = 0; i < 2; ++i) {
+      specs[i] = PJRT_ShapeSpec{};
+      specs[i].struct_size = PJRT_ShapeSpec_STRUCT_SIZE;
+      specs[i].dims = dims;
+      specs[i].num_dims = 1;
+      specs[i].element_type = PJRT_Buffer_Type_F32;
+    }
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args aa{};
+    aa.struct_size =
+        PJRT_Client_CreateBuffersForAsyncHostToDevice_Args_STRUCT_SIZE;
+    aa.shape_specs = specs;
+    aa.num_shape_specs = 2;
+    CHECK(api->PJRT_Client_CreateBuffersForAsyncHostToDevice(&aa) == nullptr);
+    check_ledger(2048);  // both staging buffers charged at create
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args ra{};
+    ra.struct_size =
+        PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args_STRUCT_SIZE;
+    ra.transfer_manager = aa.transfer_manager;
+    ra.buffer_index = 0;
+    CHECK(api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer(&ra) ==
+          nullptr);
+    CHECK(ra.buffer_out != nullptr);
+    // destroying the manager refunds only the UN-retrieved buffer
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args da{};
+    da.struct_size =
+        PJRT_AsyncHostToDeviceTransferManager_Destroy_Args_STRUCT_SIZE;
+    da.transfer_manager = aa.transfer_manager;
+    CHECK(api->PJRT_AsyncHostToDeviceTransferManager_Destroy(&da) == nullptr);
+    check_ledger(1024);
+    destroy_buffer(api, ra.buffer_out);
+    check_ledger(0);
+    // over-cap staging request is denied outright
+    int64_t big_dims[1] = {4096};
+    specs[0].dims = big_dims;  // 16384 bytes > 4096 cap
+    aa.num_shape_specs = 1;
+    aa.transfer_manager = nullptr;
+    check_resource_exhausted(
+        api, api->PJRT_Client_CreateBuffersForAsyncHostToDevice(&aa));
+    check_ledger(0);
+  }
+  // ---- uninitialized-buffer accounting -----------------------------
+  {
+    int64_t udims[1] = {512};  // 2048 bytes
+    PJRT_Client_CreateUninitializedBuffer_Args ua{};
+    ua.struct_size = PJRT_Client_CreateUninitializedBuffer_Args_STRUCT_SIZE;
+    ua.shape_dims = udims;
+    ua.shape_num_dims = 1;
+    ua.shape_element_type = PJRT_Buffer_Type_F32;
+    CHECK(api->PJRT_Client_CreateUninitializedBuffer(&ua) == nullptr);
+    check_ledger(2048);
+    PJRT_Buffer* first = ua.buffer;
+    int64_t big[1] = {2048};  // 8192 bytes > 4096 cap
+    ua.shape_dims = big;
+    ua.buffer = nullptr;
+    check_resource_exhausted(api,
+                             api->PJRT_Client_CreateUninitializedBuffer(&ua));
+    check_ledger(2048);
+    destroy_buffer(api, first);
+    check_ledger(0);
+  }
+
+  // ---- an HBM-denied Execute still releases an expired lease -------
+  {
+    CHECK(call_execute(api, nullptr) == nullptr);  // hold a lease
+    std::this_thread::sleep_for(std::chrono::milliseconds(40));  // expire it
+    int rels = g_rel.load();
+    setenv("MOCK_PJRT_OUT_FLOATS", "2048", 1);  // 8192B outputs > 4096 cap
+    PJRT_Buffer* outs[1] = {nullptr};
+    PJRT_LoadedExecutable* big_step =
+        reinterpret_cast<PJRT_LoadedExecutable*>(0xb19);
+    check_resource_exhausted(api,
+                             call_execute_outputs(api, big_step, outs));
+    CHECK(g_rel.load() == rels + 1);  // released despite the denial
+    check_ledger(0);
+    unsetenv("MOCK_PJRT_OUT_FLOATS");
+  }
   CHECK(mock_buffer_count() == 0);
 
   // ---- final drain: lease returns cleanly --------------------------

@@ -38,9 +38,43 @@ struct MockBuffer {
   size_t bytes;
 };
 
+// Unloaded-executable stand-in: output shapes parsed from
+// MOCK_PJRT_OUT_FLOATS ("512,64" = two F32 outputs of 512 and 64
+// elements). Owns the flat dims storage the OutputDimensions API
+// returns pointers into.
+struct MockExecutable {
+  std::vector<PJRT_Buffer_Type> types;
+  std::vector<int64_t> dims_flat;
+  std::vector<size_t> dim_sizes;
+};
+
+std::vector<int64_t> parse_out_floats() {
+  std::vector<int64_t> out;
+  const char* spec = std::getenv("MOCK_PJRT_OUT_FLOATS");
+  if (!spec || !*spec) return out;
+  const char* p = spec;
+  while (*p) {
+    char* end = nullptr;
+    long long v = std::strtoll(p, &end, 10);
+    if (end == p) break;  // no progress: malformed spec, stop parsing
+    out.push_back(v);
+    p = end;
+    if (*p == ',') ++p;
+  }
+  return out;
+}
+
 std::atomic<int> g_execute_count{0};
 std::atomic<int> g_buffer_count{0};
 std::atomic<int> g_live_events{0};
+std::atomic<long long> g_live_bytes{0};
+
+MockBuffer* new_buffer(size_t bytes) {
+  MockBuffer* buf = new MockBuffer{bytes};
+  g_buffer_count++;
+  g_live_bytes += static_cast<long long>(bytes);
+  return buf;
+}
 
 void complete_event(MockEvent* ev) {
   std::vector<std::pair<PJRT_Event_OnReadyCallback, void*>> cbs;
@@ -115,6 +149,15 @@ PJRT_Error* Mock_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
   if (const char* d = std::getenv("MOCK_PJRT_EXEC_MS")) {
     delay_ms = std::atoi(d);
   }
+  if (args->output_lists != nullptr) {
+    std::vector<int64_t> floats = parse_out_floats();
+    for (size_t dev = 0; dev < args->num_devices; ++dev) {
+      for (size_t o = 0; o < floats.size(); ++o) {
+        args->output_lists[dev][o] = reinterpret_cast<PJRT_Buffer*>(
+            new_buffer(static_cast<size_t>(floats[o]) * 4));
+      }
+    }
+  }
   if (args->device_complete_events != nullptr) {
     for (size_t i = 0; i < args->num_devices; ++i) {
       MockEvent* ev = new MockEvent;
@@ -135,9 +178,7 @@ PJRT_Error* Mock_BufferFromHostBuffer(
   for (size_t i = 0; i < args->num_dims; ++i) {
     bytes *= static_cast<size_t>(args->dims[i]);
   }
-  MockBuffer* buf = new MockBuffer{bytes};
-  g_buffer_count++;
-  args->buffer = reinterpret_cast<PJRT_Buffer*>(buf);
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(new_buffer(bytes));
   args->done_with_host_buffer =
       reinterpret_cast<PJRT_Event*>(make_ready_event());
   return nullptr;
@@ -145,9 +186,131 @@ PJRT_Error* Mock_BufferFromHostBuffer(
 
 PJRT_Error* Mock_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
   if (args->buffer != nullptr) {
-    delete reinterpret_cast<MockBuffer*>(args->buffer);
+    MockBuffer* buf = reinterpret_cast<MockBuffer*>(args->buffer);
+    g_live_bytes -= static_cast<long long>(buf->bytes);
+    delete buf;
     g_buffer_count--;
   }
+  return nullptr;
+}
+
+PJRT_Error* Mock_CreateUninitializedBuffer(
+    PJRT_Client_CreateUninitializedBuffer_Args* args) {
+  size_t bytes = 4;
+  for (size_t i = 0; i < args->shape_num_dims; ++i) {
+    bytes *= static_cast<size_t>(args->shape_dims[i]);
+  }
+  args->buffer = reinterpret_cast<PJRT_Buffer*>(new_buffer(bytes));
+  return nullptr;
+}
+
+PJRT_Error* Mock_Buffer_CopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  size_t bytes = reinterpret_cast<MockBuffer*>(args->buffer)->bytes;
+  args->dst_buffer = reinterpret_cast<PJRT_Buffer*>(new_buffer(bytes));
+  return nullptr;
+}
+
+PJRT_Error* Mock_Buffer_CopyToMemory(PJRT_Buffer_CopyToMemory_Args* args) {
+  size_t bytes = reinterpret_cast<MockBuffer*>(args->buffer)->bytes;
+  args->dst_buffer = reinterpret_cast<PJRT_Buffer*>(new_buffer(bytes));
+  return nullptr;
+}
+
+// ---- unloaded executable (output-shape queries) ----------------------
+
+PJRT_Error* Mock_LoadedExecutable_GetExecutable(
+    PJRT_LoadedExecutable_GetExecutable_Args* args) {
+  MockExecutable* exec = new MockExecutable;
+  for (int64_t n : parse_out_floats()) {
+    exec->types.push_back(PJRT_Buffer_Type_F32);
+    exec->dims_flat.push_back(n);
+    exec->dim_sizes.push_back(1);  // each output is rank-1 [n]
+  }
+  args->executable = reinterpret_cast<PJRT_Executable*>(exec);
+  return nullptr;
+}
+
+PJRT_Error* Mock_Executable_Destroy(PJRT_Executable_Destroy_Args* args) {
+  delete reinterpret_cast<MockExecutable*>(args->executable);
+  return nullptr;
+}
+
+PJRT_Error* Mock_LoadedExecutable_Destroy(
+    PJRT_LoadedExecutable_Destroy_Args*) {
+  return nullptr;  // mock loaded executables are caller-fabricated tokens
+}
+
+PJRT_Error* Mock_Executable_NumOutputs(PJRT_Executable_NumOutputs_Args* args) {
+  args->num_outputs =
+      reinterpret_cast<MockExecutable*>(args->executable)->types.size();
+  return nullptr;
+}
+
+PJRT_Error* Mock_Executable_OutputElementTypes(
+    PJRT_Executable_OutputElementTypes_Args* args) {
+  MockExecutable* exec = reinterpret_cast<MockExecutable*>(args->executable);
+  args->output_types = exec->types.data();
+  args->num_output_types = exec->types.size();
+  return nullptr;
+}
+
+PJRT_Error* Mock_Executable_OutputDimensions(
+    PJRT_Executable_OutputDimensions_Args* args) {
+  MockExecutable* exec = reinterpret_cast<MockExecutable*>(args->executable);
+  args->num_outputs = exec->dim_sizes.size();
+  args->dims = exec->dims_flat.data();
+  args->dim_sizes = exec->dim_sizes.data();
+  return nullptr;
+}
+
+// ---- async host-to-device transfer manager ---------------------------
+
+struct MockTransferManager {
+  std::vector<MockBuffer*> bufs;      // created eagerly at Create
+  std::vector<bool> retrieved;        // ownership handed to the caller
+};
+
+PJRT_Error* Mock_CreateBuffersForAsyncH2D(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* args) {
+  MockTransferManager* tm = new MockTransferManager;
+  for (size_t i = 0; i < args->num_shape_specs; ++i) {
+    const PJRT_ShapeSpec& s = args->shape_specs[i];
+    size_t bytes = 4;
+    for (size_t d = 0; d < s.num_dims; ++d) {
+      bytes *= static_cast<size_t>(s.dims[d]);
+    }
+    tm->bufs.push_back(new_buffer(bytes));
+    tm->retrieved.push_back(false);
+  }
+  args->transfer_manager =
+      reinterpret_cast<PJRT_AsyncHostToDeviceTransferManager*>(tm);
+  return nullptr;
+}
+
+PJRT_Error* Mock_TM_RetrieveBuffer(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* args) {
+  MockTransferManager* tm =
+      reinterpret_cast<MockTransferManager*>(args->transfer_manager);
+  size_t i = static_cast<size_t>(args->buffer_index);
+  if (i >= tm->bufs.size()) return nullptr;
+  tm->retrieved[i] = true;
+  args->buffer_out = reinterpret_cast<PJRT_Buffer*>(tm->bufs[i]);
+  return nullptr;
+}
+
+PJRT_Error* Mock_TM_Destroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args* args) {
+  MockTransferManager* tm =
+      reinterpret_cast<MockTransferManager*>(args->transfer_manager);
+  if (tm == nullptr) return nullptr;
+  for (size_t i = 0; i < tm->bufs.size(); ++i) {
+    if (!tm->retrieved[i]) {
+      g_live_bytes -= static_cast<long long>(tm->bufs[i]->bytes);
+      g_buffer_count--;
+      delete tm->bufs[i];
+    }
+  }
+  delete tm;
   return nullptr;
 }
 
@@ -180,8 +343,22 @@ PJRT_Api g_api = [] {
   api.PJRT_Event_OnReady = Mock_Event_OnReady;
   api.PJRT_LoadedExecutable_Execute = Mock_Execute;
   api.PJRT_Client_BufferFromHostBuffer = Mock_BufferFromHostBuffer;
+  api.PJRT_Client_CreateUninitializedBuffer = Mock_CreateUninitializedBuffer;
   api.PJRT_Buffer_Destroy = Mock_Buffer_Destroy;
   api.PJRT_Buffer_OnDeviceSizeInBytes = Mock_Buffer_OnDeviceSizeInBytes;
+  api.PJRT_Buffer_CopyToDevice = Mock_Buffer_CopyToDevice;
+  api.PJRT_Buffer_CopyToMemory = Mock_Buffer_CopyToMemory;
+  api.PJRT_LoadedExecutable_GetExecutable = Mock_LoadedExecutable_GetExecutable;
+  api.PJRT_LoadedExecutable_Destroy = Mock_LoadedExecutable_Destroy;
+  api.PJRT_Executable_Destroy = Mock_Executable_Destroy;
+  api.PJRT_Executable_NumOutputs = Mock_Executable_NumOutputs;
+  api.PJRT_Executable_OutputElementTypes = Mock_Executable_OutputElementTypes;
+  api.PJRT_Executable_OutputDimensions = Mock_Executable_OutputDimensions;
+  api.PJRT_Client_CreateBuffersForAsyncHostToDevice =
+      Mock_CreateBuffersForAsyncH2D;
+  api.PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer =
+      Mock_TM_RetrieveBuffer;
+  api.PJRT_AsyncHostToDeviceTransferManager_Destroy = Mock_TM_Destroy;
   api.PJRT_Client_PlatformName = Mock_Client_PlatformName;
   return api;
 }();
@@ -195,5 +372,6 @@ const PJRT_Api* GetPjrtApi() { return &g_api; }
 int mock_execute_count() { return g_execute_count.load(); }
 int mock_buffer_count() { return g_buffer_count.load(); }
 int mock_live_events() { return g_live_events.load(); }
+long long mock_live_bytes() { return g_live_bytes.load(); }
 
 }  // extern "C"

@@ -8,16 +8,44 @@
 // point the framework at it instead of libtpu
 // (PJRT_NAMES_AND_LIBRARY_PATHS / TPU_LIBRARY_PATH) and it dlopens the
 // real plugin (env KUBESHARE_PJRT_REAL), forwards the full table, and
-// wraps exactly four entry points:
+// wraps the entry points through which device memory and compute flow:
 //
 //   PJRT_LoadedExecutable_Execute    - compute-token gating (amortized
-//                                      lease; see below)
+//                                      lease; see below) AND HBM
+//                                      accounting for executable OUTPUT
+//                                      buffers: output bytes are
+//                                      estimated from the executable's
+//                                      output shapes (cached per
+//                                      executable), pre-charged before
+//                                      dispatch — a denial fabricates
+//                                      RESOURCE_EXHAUSTED without
+//                                      executing — and reconciled to
+//                                      PJRT_Buffer_OnDeviceSizeInBytes
+//                                      after dispatch
 //   PJRT_Client_BufferFromHostBuffer - HBM accounting (+bytes)
+//   PJRT_Client_CreateUninitializedBuffer - HBM accounting (+bytes)
+//   PJRT_Buffer_CopyToDevice         - HBM accounting (+dst bytes)
+//   PJRT_Buffer_CopyToMemory         - HBM accounting (+dst bytes)
+//   PJRT_Client_CreateBuffersForAsyncHostToDevice
+//                                    - HBM accounting for async H2D
+//                                      staging buffers (charged at
+//                                      create, attributed per buffer at
+//                                      RetrieveBuffer, un-retrieved
+//                                      charges refunded at manager
+//                                      Destroy)
 //   PJRT_Buffer_Destroy              - HBM accounting (-bytes)
+//   PJRT_LoadedExecutable_Destroy    - drops the output-size cache entry
 //   PJRT_Error_{Message,GetCode,Destroy} - so fabricated
 //                                      RESOURCE_EXHAUSTED errors from a
 //                                      denied allocation round-trip
 //                                      through caller error handling
+//
+// Donation note: when an input buffer is donated to an execution, the
+// output may alias the input's memory, yet both are charged until the
+// framework destroys the donated input handle (which JAX/PT-XLA do
+// immediately after dispatch). The transient over-count is at most one
+// step of donated bytes and is conservative — the cap can never be
+// under-enforced by aliasing.
 //
 // Lease semantics match the Python gate (kubeshare_tpu/runtime/hook.py)
 // so either layer can enforce the same contract: a token is acquired on
@@ -34,10 +62,15 @@
 //
 // HBM caps: allocations past the arbiter's per-pod cap are denied with
 // a fabricated RESOURCE_EXHAUSTED PJRT_Error (the reference's memory
-// cap likewise surfaces as a failed cudaMalloc). Set
-// KUBESHARE_HBM_SOFT=1 to log-and-allow instead. Execute scratch/output
-// allocations are not tracked here; the premapped-pool cap applied by
-// apply_hbm_env_cap() remains the hard backstop.
+// cap likewise surfaces as a failed cudaMalloc — the Gemini hook caps
+// *all* device memory, reference pkg/config/query.go:56, and with
+// output tracking above so does this shim). Set KUBESHARE_HBM_SOFT=1
+// to log-and-allow instead. Known-untracked remainder: transient XLA
+// *scratch* space inside a single execution, and
+// PJRT_Client_CreateViewOfDeviceBuffer (a non-owned view of memory
+// some other library allocated — charging it would double-count).
+// The premapped-pool cap applied by apply_hbm_env_cap() backstops
+// both.
 
 #include <dlfcn.h>
 
@@ -131,6 +164,16 @@ struct Gate {
   // must never exceed what was charged, or a denied-but-kept (soft
   // mode) buffer would erase another buffer's legitimate accounting
   std::unordered_map<PJRT_Buffer*, long long> charged_bytes;
+  // per-loaded-executable output byte sizes (estimated from output
+  // element types × dimensions); erased on LoadedExecutable_Destroy so
+  // a reused heap pointer can't inherit stale sizes
+  std::unordered_map<PJRT_LoadedExecutable*, std::vector<long long>>
+      exec_out_sizes;
+  // async H2D staging charges: per-manager, per-buffer-index accepted
+  // bytes; -1 = already attributed to a retrieved PJRT_Buffer
+  std::unordered_map<PJRT_AsyncHostToDeviceTransferManager*,
+                     std::vector<long long>>
+      tm_charges;
   std::vector<PJRT_Event*> event_graveyard;  // deferred Event_Destroy
 
   bool roundtrip(const std::string& line, std::string* reply) {
@@ -146,7 +189,10 @@ struct Gate {
   }
 };
 
-Gate g;
+// Immortal: wrapped entry points and the unload-time graveyard drain
+// can run after this TU's static destructors would have fired, so the
+// gate must never be destroyed (leak-on-exit singleton).
+Gate& g = *new Gate;
 
 void connect_token_server() {
   const char* port = std::getenv("KUBESHARE_POD_MANAGER_PORT");
@@ -163,9 +209,18 @@ void connect_token_server() {
   g.hbm_soft = soft && *soft && std::strcmp(soft, "0") != 0;
 }
 
-// Drain the event graveyard. Caller holds g.mu.
-void reap_events_locked() {
-  for (PJRT_Event* ev : g.event_graveyard) {
+// Drain the event graveyard. Swaps the list out under g.mu and calls
+// the plugin with no lock held: the interposer never calls into the
+// real plugin while holding g.mu (a plugin callback thread that blocks
+// on g.mu in on_execute_complete would otherwise ABBA-deadlock against
+// any plugin-internal lock).
+void reap_events() {
+  std::vector<PJRT_Event*> dead;
+  {
+    std::lock_guard<std::mutex> lock(g.mu);
+    dead.swap(g.event_graveyard);
+  }
+  for (PJRT_Event* ev : dead) {
     PJRT_Event_Destroy_Args d{};
     d.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
     d.event = ev;
@@ -176,7 +231,6 @@ void reap_events_locked() {
       g.real->PJRT_Error_Destroy(&ed);
     }
   }
-  g.event_graveyard.clear();
 }
 
 // Release the lease if its quota has expired, draining in-flight work
@@ -234,14 +288,235 @@ void on_execute_complete(PJRT_Error* error, void* user_arg) {
   delete ctx;
 }
 
+// ---- HBM accounting helpers ------------------------------------------
+
+size_t dtype_bytes(PJRT_Buffer_Type t);
+long long charge_locked(long long delta);
+
+// Read a function-pointer field out of the REAL plugin's table only if
+// that field lies within the plugin's declared struct_size — a plugin
+// built against an older PJRT header simply ends earlier, and reading
+// past its end is UB even before calling through the garbage pointer.
+// (build_wrapped guards the fields it overrides the same way; this
+// covers the auxiliary fields the wrappers call.)
+template <typename F>
+F real_fn(const F* field_in_real) {
+  size_t offset = reinterpret_cast<const char*>(field_in_real) -
+                  reinterpret_cast<const char*>(g.real);
+  if (offset + sizeof(F) > g.real->struct_size) return nullptr;
+  return *field_in_real;
+}
+
+void drop_real_error(PJRT_Error* e) {
+  if (e == nullptr) return;
+  PJRT_Error_Destroy_Args ed{};
+  ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  ed.error = e;
+  g.real->PJRT_Error_Destroy(&ed);
+}
+
+// On-device size of `buf`, or `fallback` when the plugin can't say.
+// Calls the real plugin: caller must NOT hold g.mu.
+long long device_size_or(PJRT_Buffer* buf, long long fallback) {
+  auto size_fn = real_fn(&g.real->PJRT_Buffer_OnDeviceSizeInBytes);
+  if (size_fn == nullptr) return fallback;
+  PJRT_Buffer_OnDeviceSizeInBytes_Args sa{};
+  sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  sa.buffer = buf;
+  if (PJRT_Error* se = size_fn(&sa)) {
+    drop_real_error(se);
+    return fallback;
+  }
+  return sa.on_device_size_in_bytes > 0
+             ? static_cast<long long>(sa.on_device_size_in_bytes)
+             : fallback;
+}
+
+// True when `memory` is a host memory space ("pinned_host" /
+// "unpinned_host"): buffers there live in host RAM, not HBM, and must
+// not be charged against the HBM cap — charging them would block the
+// very offloading that frees HBM. Calls the real plugin: no g.mu.
+bool is_host_memory(PJRT_Memory* memory) {
+  if (memory == nullptr) return false;
+  auto kind_fn = real_fn(&g.real->PJRT_Memory_Kind);
+  if (kind_fn == nullptr) return false;
+  PJRT_Memory_Kind_Args ka{};
+  ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+  ka.memory = memory;
+  if (PJRT_Error* e = kind_fn(&ka)) {
+    drop_real_error(e);
+    return false;
+  }
+  std::string kind(ka.kind, ka.kind_size);
+  return kind.find("host") != std::string::npos;
+}
+
+// Charge `bytes` (>0) against the pod cap. On hard denial returns the
+// fabricated RESOURCE_EXHAUSTED error; otherwise returns nullptr with
+// *accepted set to the accepted bytes (0 = soft-denied or connection
+// down → caller leaves the allocation untracked). Caller holds g.mu.
+PJRT_Error* charge_or_deny_locked(long long bytes, const char* what,
+                                  long long* accepted) {
+  *accepted = charge_locked(bytes);
+  if (*accepted == 0 && g.fd >= 0) {  // denied (not a dead connection)
+    if (!g.hbm_soft) {
+      return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
+                        "kubeshare: HBM cap exceeded for pod " + g.pod +
+                            " (" + what + " +" + std::to_string(bytes) +
+                            " bytes)");
+    }
+    logf("HBM cap exceeded (soft mode): pod %s %s +%lld bytes",
+         g.pod.c_str(), what, bytes);
+  }
+  return nullptr;
+}
+
+// Reconcile a pre-charge of `precharged` bytes against `buf`'s actual
+// on-device size (padding/tiling) and record the result so
+// Wrapped_BufferDestroy refunds exactly what the server holds. A denied
+// positive padding delta records the estimate (the work already ran and
+// can't be undone) with a warning. Caller must NOT hold g.mu.
+void attribute_buffer(PJRT_Buffer* buf, long long precharged,
+                      const char* what) {
+  long long actual = device_size_or(buf, precharged);
+  std::lock_guard<std::mutex> lock(g.mu);
+  long long record = precharged;
+  long long delta = actual - precharged;
+  if (delta != 0) {
+    long long acc = charge_locked(delta);
+    if (acc != 0) {
+      record = actual;
+    } else if (delta > 0 && g.fd >= 0) {
+      logf("HBM padding delta +%lld denied for pod %s (%s; recording "
+           "estimate)",
+           delta, g.pod.c_str(), what);
+    }
+  }
+  g.charged_bytes[buf] = record;
+}
+
+// Per-output byte estimate computed from the unloaded executable's
+// output element types × dimensions. Sets *ok=false only on a
+// TRANSIENT failure (a plugin call returned an error) so the caller
+// can retry on the next dispatch instead of caching "no outputs"
+// forever; a plugin that simply lacks the query entry points is a
+// permanent condition (*ok=true, empty → outputs untracked, fail
+// open; the premapped-pool env cap backstops). Calls the real
+// plugin: no g.mu.
+std::vector<long long> query_output_sizes(PJRT_LoadedExecutable* lexec,
+                                          bool* ok) {
+  *ok = true;
+  std::vector<long long> sizes;
+  auto get_fn = real_fn(&g.real->PJRT_LoadedExecutable_GetExecutable);
+  auto types_fn = real_fn(&g.real->PJRT_Executable_OutputElementTypes);
+  auto dims_fn = real_fn(&g.real->PJRT_Executable_OutputDimensions);
+  if (lexec == nullptr || get_fn == nullptr || types_fn == nullptr ||
+      dims_fn == nullptr) {
+    return sizes;
+  }
+  PJRT_LoadedExecutable_GetExecutable_Args ga{};
+  ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+  ga.loaded_executable = lexec;
+  if (PJRT_Error* e = get_fn(&ga)) {
+    drop_real_error(e);
+    *ok = false;
+    return sizes;
+  }
+  PJRT_Executable* exec = ga.executable;
+  PJRT_Executable_OutputElementTypes_Args ta{};
+  ta.struct_size = PJRT_Executable_OutputElementTypes_Args_STRUCT_SIZE;
+  ta.executable = exec;
+  PJRT_Executable_OutputDimensions_Args da{};
+  da.struct_size = PJRT_Executable_OutputDimensions_Args_STRUCT_SIZE;
+  da.executable = exec;
+  PJRT_Error* te = types_fn(&ta);
+  PJRT_Error* de = dims_fn(&da);
+  if (te == nullptr && de == nullptr && da.num_outputs == ta.num_output_types) {
+    size_t dim_pos = 0;
+    for (size_t o = 0; o < da.num_outputs; ++o) {
+      long long bytes = static_cast<long long>(dtype_bytes(ta.output_types[o]));
+      for (size_t d = 0; d < da.dim_sizes[o]; ++d) {
+        bytes *= da.dims[dim_pos + d];
+      }
+      dim_pos += da.dim_sizes[o];
+      sizes.push_back(bytes);
+    }
+  } else if (te != nullptr || de != nullptr) {
+    *ok = false;
+  }
+  drop_real_error(te);
+  drop_real_error(de);
+  if (auto destroy_fn = real_fn(&g.real->PJRT_Executable_Destroy)) {
+    PJRT_Executable_Destroy_Args xd{};
+    xd.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+    xd.executable = exec;
+    drop_real_error(destroy_fn(&xd));
+  }
+  return sizes;
+}
+
+// Cached output sizes: hits resolve under the lock; a miss queries the
+// plugin with no lock held, then publishes (first writer wins — racing
+// queries compute identical results). Transient query failures are NOT
+// cached — the next dispatch retries rather than leaving a long-lived
+// executable's outputs untracked for the life of the process.
+std::vector<long long> output_sizes(PJRT_LoadedExecutable* lexec) {
+  {
+    std::lock_guard<std::mutex> lock(g.mu);
+    auto it = g.exec_out_sizes.find(lexec);
+    if (it != g.exec_out_sizes.end()) return it->second;
+  }
+  bool ok = true;
+  std::vector<long long> sizes = query_output_sizes(lexec, &ok);
+  if (!ok) {
+    logf("output-size query failed for executable %p (transient; will "
+         "retry next dispatch — outputs uncharged this step)",
+         static_cast<void*>(lexec));
+    return sizes;
+  }
+  std::lock_guard<std::mutex> lock(g.mu);
+  return g.exec_out_sizes.emplace(lexec, std::move(sizes)).first->second;
+}
+
 // ---- wrapped entry points --------------------------------------------
 
 PJRT_Error* Wrapped_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
+  // HBM pre-charge for the executable's output buffers. Done before any
+  // gate-state mutation so a denial leaves the lease untouched; the
+  // reference likewise denies at the allocation site before the kernel
+  // runs (its hook fails the cudaMalloc that backs the output).
+  // Lease maintenance FIRST: reap deferred events and release an
+  // expired lease even when the HBM pre-charge below denies —
+  // otherwise a pod whose executes are persistently denied would pin
+  // its expired compute lease forever and starve every other pod.
+  reap_events();
+  bool hbm_active;
+  {
+    std::unique_lock<std::mutex> lock(g.mu);
+    maybe_release_locked(lock);
+    hbm_active = g.fd >= 0;
+  }
+
+  long long est_total = 0;
+  std::vector<long long> est;
+  bool out_tracked = false;
+  if (hbm_active && args->output_lists != nullptr && args->num_devices > 0) {
+    est = output_sizes(args->executable);  // plugin queries: lock-free
+    for (long long b : est) est_total += b;
+    est_total *= static_cast<long long>(args->num_devices);
+    if (est_total > 0) {
+      std::lock_guard<std::mutex> lock(g.mu);
+      long long accepted = 0;
+      if (PJRT_Error* e = charge_or_deny_locked(est_total, "execute outputs",
+                                                &accepted)) {
+        return e;
+      }
+      out_tracked = accepted > 0;
+    }
+  }
   bool gating = false;
   {
     std::unique_lock<std::mutex> lock(g.mu);
-    reap_events_locked();
-    maybe_release_locked(lock);
     acquire_locked();
     // Capture the gating decision under the lock (fd can drop to -1 if
     // the server connection dies mid-acquire) and count the execution
@@ -295,6 +570,51 @@ PJRT_Error* Wrapped_Execute(PJRT_LoadedExecutable_Execute_Args* args) {
     }
   }
   if (!caller_events) args->device_complete_events = nullptr;
+
+  if (out_tracked) {
+    if (err != nullptr) {
+      // dispatch failed: no outputs exist, refund the whole estimate
+      std::lock_guard<std::mutex> lock(g.mu);
+      charge_locked(-est_total);
+    } else {
+      // Reconcile estimate → actual on-device sizes (padding/tiling)
+      // with ONE batched delta charge, then attribute per buffer so
+      // Wrapped_BufferDestroy refunds exactly what the server holds.
+      // Size queries hit the real plugin, so they run with no lock.
+      struct Rec {
+        PJRT_Buffer* buf;
+        long long actual, est;
+      };
+      std::vector<Rec> recs;
+      long long delta_total = 0, missing = 0;
+      for (size_t d = 0; d < args->num_devices; ++d) {
+        for (size_t o = 0; o < est.size(); ++o) {
+          PJRT_Buffer* buf = args->output_lists[d][o];
+          if (buf == nullptr) {  // plugin produced no buffer: refund slot
+            missing += est[o];
+            continue;
+          }
+          long long actual = device_size_or(buf, est[o]);
+          delta_total += actual - est[o];
+          recs.push_back({buf, actual, est[o]});
+        }
+      }
+      std::lock_guard<std::mutex> lock(g.mu);
+      if (missing > 0) charge_locked(-missing);
+      long long accepted = delta_total != 0 ? charge_locked(delta_total) : 0;
+      bool use_actual = delta_total == 0 || accepted != 0;
+      if (delta_total > 0 && accepted == 0 && g.fd >= 0) {
+        // padding pushed past the cap after the work already ran; the
+        // computation can't be undone, so record the estimates (exactly
+        // what the server accepted) and warn
+        logf("HBM padding delta +%lld denied for pod %s (recording estimates)",
+             delta_total, g.pod.c_str());
+      }
+      for (const Rec& r : recs) {
+        g.charged_bytes[r.buf] = use_actual ? r.actual : r.est;
+      }
+    }
+  }
   return err;
 }
 
@@ -338,79 +658,73 @@ long long charge_locked(long long delta) {
 
 PJRT_Error* Wrapped_BufferFromHostBuffer(
     PJRT_Client_BufferFromHostBuffer_Args* args) {
+  bool hbm_active;
   {
-    // passthrough mode: no server, no accounting, no extra size query
-    std::lock_guard<std::mutex> fast(g.mu);
-    if (g.fd < 0) {
-      return g.real->PJRT_Client_BufferFromHostBuffer(args);
-    }
+    std::lock_guard<std::mutex> lock(g.mu);
+    hbm_active = g.fd >= 0;
+  }
+  // No server → no accounting; host-memory destinations live in host
+  // RAM, not HBM, so uploads there are never charged either.
+  if (!hbm_active || is_host_memory(args->memory)) {
+    return g.real->PJRT_Client_BufferFromHostBuffer(args);
   }
   long long host_bytes = static_cast<long long>(dtype_bytes(args->type));
   for (size_t i = 0; i < args->num_dims; ++i) host_bytes *= args->dims[i];
 
   long long charged = 0;
-  {
-    std::unique_lock<std::mutex> lock(g.mu);
-    if (g.fd >= 0 && host_bytes > 0) {
-      charged = charge_locked(host_bytes);
-      if (charged == 0 && g.fd >= 0) {  // denied (not a dead connection)
-        if (!g.hbm_soft) {
-          return make_error(
-              PJRT_Error_Code_RESOURCE_EXHAUSTED,
-              "kubeshare: HBM cap exceeded for pod " + g.pod + " (+" +
-                  std::to_string(host_bytes) + " bytes requested)");
-        }
-        logf("HBM cap exceeded (soft mode): pod %s +%lld bytes",
-             g.pod.c_str(), host_bytes);
-      }
+  if (host_bytes > 0) {
+    std::lock_guard<std::mutex> lock(g.mu);
+    if (PJRT_Error* e =
+            charge_or_deny_locked(host_bytes, "host upload", &charged)) {
+      return e;
     }
   }
 
   PJRT_Error* err = g.real->PJRT_Client_BufferFromHostBuffer(args);
-  std::unique_lock<std::mutex> lock(g.mu);
   if (err == nullptr && args->buffer != nullptr && charged > 0) {
     // On-device size can differ from the host size (padding/tiling);
-    // charge the difference when the plugin reports one.
-    PJRT_Buffer_OnDeviceSizeInBytes_Args sa{};
-    sa.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
-    sa.buffer = args->buffer;
-    long long device_bytes = host_bytes;
-    if (PJRT_Error* se = g.real->PJRT_Buffer_OnDeviceSizeInBytes(&sa)) {
-      PJRT_Error_Destroy_Args ed{};
-      ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-      ed.error = se;
-      g.real->PJRT_Error_Destroy(&ed);
-    } else if (sa.on_device_size_in_bytes > 0) {
-      device_bytes = static_cast<long long>(sa.on_device_size_in_bytes);
-    }
-    if (charged > 0 && device_bytes > host_bytes) {
-      long long extra = charge_locked(device_bytes - host_bytes);
-      if (extra == 0 && g.fd >= 0 && !g.hbm_soft) {
-        // padding pushed the buffer over the cap: enforce it — undo
-        // the allocation and refund what we did charge
-        charge_locked(-charged);
-        PJRT_Buffer* buf = args->buffer;
-        args->buffer = nullptr;
-        lock.unlock();
-        PJRT_Buffer_Destroy_Args bd{};
-        bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-        bd.buffer = buf;
-        if (PJRT_Error* de = g.real->PJRT_Buffer_Destroy(&bd)) {
-          PJRT_Error_Destroy_Args ed{};
-          ed.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
-          ed.error = de;
-          g.real->PJRT_Error_Destroy(&ed);
+    // charge the difference when the plugin reports one. Unlike the
+    // execute-output path this allocation IS undoable, so a denied
+    // padding delta destroys the buffer and enforces the cap hard.
+    long long device_bytes = device_size_or(args->buffer, host_bytes);
+    bool deny = false;
+    {
+      std::lock_guard<std::mutex> lock(g.mu);
+      if (device_bytes > host_bytes) {
+        long long extra = charge_locked(device_bytes - host_bytes);
+        if (extra == 0 && g.fd >= 0 && !g.hbm_soft) {
+          charge_locked(-charged);
+          deny = true;
+        } else {
+          charged += extra;
         }
-        return make_error(
-            PJRT_Error_Code_RESOURCE_EXHAUSTED,
-            "kubeshare: HBM cap exceeded for pod " + g.pod +
-                " (on-device size " + std::to_string(device_bytes) + ")");
       }
-      charged += extra;
+      if (!deny) g.charged_bytes[args->buffer] = charged;
     }
-    g.charged_bytes[args->buffer] = charged;
+    if (deny) {
+      PJRT_Buffer* buf = args->buffer;
+      args->buffer = nullptr;
+      PJRT_Buffer_Destroy_Args bd{};
+      bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      bd.buffer = buf;
+      drop_real_error(g.real->PJRT_Buffer_Destroy(&bd));
+      // the caller sees an error and will never consume the
+      // done_with_host_buffer event the real plugin handed back
+      if (args->done_with_host_buffer != nullptr) {
+        PJRT_Event_Destroy_Args ed{};
+        ed.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+        ed.event = args->done_with_host_buffer;
+        drop_real_error(g.real->PJRT_Event_Destroy(&ed));
+        args->done_with_host_buffer = nullptr;
+      }
+      return make_error(
+          PJRT_Error_Code_RESOURCE_EXHAUSTED,
+          "kubeshare: HBM cap exceeded for pod " + g.pod +
+              " (on-device size " + std::to_string(device_bytes) + ")");
+    }
   } else if (charged > 0) {
     // allocation failed downstream: refund the accounting
+    std::lock_guard<std::mutex> lock(g.mu);
     charge_locked(-charged);
   }
   return err;
@@ -427,6 +741,179 @@ PJRT_Error* Wrapped_BufferDestroy(PJRT_Buffer_Destroy_Args* args) {
     }
   }
   return g.real->PJRT_Buffer_Destroy(args);
+}
+
+// Shared tail for the two device-to-device copy entry points: charge
+// the source buffer's on-device size up front (deny → fabricated
+// RESOURCE_EXHAUSTED), attribute to dst on success (reconciled to the
+// destination's actual on-device size — layouts can differ across
+// devices/memories), refund on failure. `dst_memory` non-null marks a
+// CopyToMemory whose destination may be host RAM (never charged).
+template <typename Args, typename Fn>
+PJRT_Error* copy_with_accounting(Args* args, Fn real_fn,
+                                 PJRT_Memory* dst_memory, const char* what) {
+  bool hbm_active;
+  {
+    std::lock_guard<std::mutex> lock(g.mu);
+    hbm_active = g.fd >= 0;
+  }
+  if (!hbm_active || is_host_memory(dst_memory)) return real_fn(args);
+  long long bytes = device_size_or(args->buffer, 0);
+  long long charged = 0;
+  if (bytes > 0) {
+    std::lock_guard<std::mutex> lock(g.mu);
+    if (PJRT_Error* e = charge_or_deny_locked(bytes, what, &charged)) {
+      return e;
+    }
+  }
+  PJRT_Error* err = real_fn(args);
+  if (err == nullptr && args->dst_buffer != nullptr) {
+    if (charged > 0) attribute_buffer(args->dst_buffer, charged, what);
+  } else if (charged > 0) {
+    std::lock_guard<std::mutex> lock(g.mu);
+    charge_locked(-charged);
+  }
+  return err;
+}
+
+PJRT_Error* Wrapped_CopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  return copy_with_accounting(args, g.real->PJRT_Buffer_CopyToDevice,
+                              nullptr, "copy-to-device");
+}
+
+PJRT_Error* Wrapped_CopyToMemory(PJRT_Buffer_CopyToMemory_Args* args) {
+  return copy_with_accounting(args, g.real->PJRT_Buffer_CopyToMemory,
+                              args->dst_memory, "copy-to-memory");
+}
+
+PJRT_Error* Wrapped_CreateBuffersForAsyncH2D(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* args) {
+  bool hbm_active;
+  {
+    std::lock_guard<std::mutex> lock(g.mu);
+    hbm_active = g.fd >= 0;
+  }
+  if (!hbm_active || is_host_memory(args->memory)) {
+    return g.real->PJRT_Client_CreateBuffersForAsyncHostToDevice(args);
+  }
+  std::vector<long long> per_buf;
+  long long total = 0;
+  for (size_t i = 0; i < args->num_shape_specs; ++i) {
+    const PJRT_ShapeSpec& s = args->shape_specs[i];
+    long long bytes = static_cast<long long>(dtype_bytes(s.element_type));
+    for (size_t d = 0; d < s.num_dims; ++d) bytes *= s.dims[d];
+    per_buf.push_back(bytes);
+    total += bytes;
+  }
+  if (total > 0) {
+    std::lock_guard<std::mutex> lock(g.mu);
+    long long accepted = 0;
+    if (PJRT_Error* e =
+            charge_or_deny_locked(total, "async H2D staging", &accepted)) {
+      return e;
+    }
+    if (accepted == 0) per_buf.clear();  // soft-denied/untracked
+  }
+  PJRT_Error* err =
+      g.real->PJRT_Client_CreateBuffersForAsyncHostToDevice(args);
+  std::lock_guard<std::mutex> lock(g.mu);
+  long long charged = 0;
+  for (long long b : per_buf) charged += b;
+  if (err == nullptr && args->transfer_manager != nullptr) {
+    if (!per_buf.empty()) g.tm_charges[args->transfer_manager] = per_buf;
+  } else if (charged > 0) {
+    charge_locked(-charged);
+  }
+  return err;
+}
+
+PJRT_Error* Wrapped_TMRetrieveBuffer(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* args) {
+  PJRT_Error* err =
+      g.real->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer(args);
+  if (err == nullptr && args->buffer_out != nullptr) {
+    long long precharged = -1;
+    {
+      std::lock_guard<std::mutex> lock(g.mu);
+      auto it = g.tm_charges.find(args->transfer_manager);
+      if (it != g.tm_charges.end() && args->buffer_index >= 0 &&
+          static_cast<size_t>(args->buffer_index) < it->second.size()) {
+        long long& slot = it->second[static_cast<size_t>(args->buffer_index)];
+        precharged = slot;
+        slot = -1;  // hand the charge to the concrete buffer
+      }
+    }
+    if (precharged >= 0) {
+      // reconcile to the realized buffer's actual on-device size and
+      // record it so Destroy refunds exactly what the server holds
+      attribute_buffer(args->buffer_out, precharged, "async H2D buffer");
+    }
+  }
+  return err;
+}
+
+PJRT_Error* Wrapped_TMDestroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args* args) {
+  // Refund un-retrieved staging charges only AFTER the real Destroy
+  // succeeds: a failed Destroy (e.g. transfers in flight) leaves the
+  // staging buffers alive in HBM, so their charges must stand.
+  PJRT_Error* err =
+      g.real->PJRT_AsyncHostToDeviceTransferManager_Destroy(args);
+  if (err == nullptr) {
+    std::lock_guard<std::mutex> lock(g.mu);
+    auto it = g.tm_charges.find(args->transfer_manager);
+    if (it != g.tm_charges.end()) {
+      long long unretrieved = 0;
+      for (long long b : it->second) {
+        if (b > 0) unretrieved += b;
+      }
+      if (unretrieved > 0) charge_locked(-unretrieved);
+      g.tm_charges.erase(it);
+    }
+  }
+  return err;
+}
+
+PJRT_Error* Wrapped_CreateUninitializedBuffer(
+    PJRT_Client_CreateUninitializedBuffer_Args* args) {
+  bool hbm_active;
+  {
+    std::lock_guard<std::mutex> lock(g.mu);
+    hbm_active = g.fd >= 0;
+  }
+  if (!hbm_active || is_host_memory(args->memory)) {
+    return g.real->PJRT_Client_CreateUninitializedBuffer(args);
+  }
+  long long bytes =
+      static_cast<long long>(dtype_bytes(args->shape_element_type));
+  for (size_t i = 0; i < args->shape_num_dims; ++i) {
+    bytes *= args->shape_dims[i];
+  }
+  long long charged = 0;
+  if (bytes > 0) {
+    std::lock_guard<std::mutex> lock(g.mu);
+    if (PJRT_Error* e = charge_or_deny_locked(bytes, "uninitialized buffer",
+                                              &charged)) {
+      return e;
+    }
+  }
+  PJRT_Error* err = g.real->PJRT_Client_CreateUninitializedBuffer(args);
+  if (err == nullptr && args->buffer != nullptr && charged > 0) {
+    attribute_buffer(args->buffer, charged, "uninitialized buffer");
+  } else if (charged > 0) {
+    std::lock_guard<std::mutex> lock(g.mu);
+    charge_locked(-charged);
+  }
+  return err;
+}
+
+PJRT_Error* Wrapped_LoadedExecutableDestroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  {
+    std::lock_guard<std::mutex> lock(g.mu);
+    g.exec_out_sizes.erase(args->executable);
+  }
+  return g.real->PJRT_LoadedExecutable_Destroy(args);
 }
 
 void Wrapped_ErrorDestroy(PJRT_Error_Destroy_Args* args) {
@@ -468,7 +955,11 @@ template <typename F>
 void override_field(F* field_in_copy, F replacement) {
   size_t offset = reinterpret_cast<char*>(field_in_copy) -
                   reinterpret_cast<char*>(wrapped_storage.data());
-  if (offset + sizeof(F) <= wrapped_storage.size()) {
+  // Skip fields beyond the real plugin's struct_size AND fields the
+  // real plugin left null (wrapping those would turn the caller's
+  // "not implemented" probe into a jump through nullptr).
+  if (offset + sizeof(F) <= wrapped_storage.size() &&
+      *field_in_copy != nullptr) {
     *field_in_copy = replacement;
   }
 }
@@ -482,11 +973,31 @@ const PJRT_Api* build_wrapped(const PJRT_Api* real) {
   override_field(&w->PJRT_LoadedExecutable_Execute, &Wrapped_Execute);
   override_field(&w->PJRT_Client_BufferFromHostBuffer,
                  &Wrapped_BufferFromHostBuffer);
+  override_field(&w->PJRT_Client_CreateUninitializedBuffer,
+                 &Wrapped_CreateUninitializedBuffer);
   override_field(&w->PJRT_Buffer_Destroy, &Wrapped_BufferDestroy);
+  override_field(&w->PJRT_Buffer_CopyToDevice, &Wrapped_CopyToDevice);
+  override_field(&w->PJRT_Buffer_CopyToMemory, &Wrapped_CopyToMemory);
+  override_field(&w->PJRT_Client_CreateBuffersForAsyncHostToDevice,
+                 &Wrapped_CreateBuffersForAsyncH2D);
+  override_field(&w->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer,
+                 &Wrapped_TMRetrieveBuffer);
+  override_field(&w->PJRT_AsyncHostToDeviceTransferManager_Destroy,
+                 &Wrapped_TMDestroy);
+  override_field(&w->PJRT_LoadedExecutable_Destroy,
+                 &Wrapped_LoadedExecutableDestroy);
   override_field(&w->PJRT_Error_Destroy, &Wrapped_ErrorDestroy);
   override_field(&w->PJRT_Error_Message, &Wrapped_ErrorMessage);
   override_field(&w->PJRT_Error_GetCode, &Wrapped_ErrorGetCode);
   return w;
+}
+
+// Drain the deferred-destroy graveyard on library unload so the last
+// execution's completion event (reaped lazily at the NEXT Execute
+// entry, which never comes at shutdown) is returned to the plugin.
+__attribute__((destructor)) void drain_graveyard_at_exit() {
+  if (g.real == nullptr) return;
+  reap_events();
 }
 
 }  // namespace

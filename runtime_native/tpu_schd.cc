@@ -159,7 +159,9 @@ int main(int argc, char** argv) {
     return 2;
   }
   TokenArbiter arbiter(base_quota, min_quota, window, slots);
-  std::string path = dir + "/" + file;
+  // -f is normally a filename under -p (the reference CLI contract);
+  // an absolute -f stands alone so operators can point at a full path
+  std::string path = file[0] == '/' ? file : dir + "/" + file;
   arbiter.set_quotas(load_config(path));
   std::atomic<bool> stop{false};
   std::thread watcher(watch_config, path, &arbiter, &stop);
