@@ -188,9 +188,10 @@ def main() -> None:
     # reported alongside. try/finally: a failed round must not leak
     # the arbiter holding ARBITER_PORT for the next invocation.
     rounds = []
-    try:
-        for r in range(ROUNDS):
-            pre_step_s = probe_step_s()
+    next_pre_step_s = step_s  # each round's post-probe doubles as the
+    try:                      # next round's pre-probe (probes are ~1s
+        for r in range(ROUNDS):  # of device time on a throttled chip)
+            pre_step_s = next_pre_step_s
             burst_steps, stall_s = calibrate(pre_step_s)
             steps = run_stream(step, params_per_pod[0], images, labels,
                                PHASE_SECONDS, stall_s,
@@ -205,6 +206,7 @@ def main() -> None:
                 PHASE_SECONDS, burst_steps=burst_steps,
             )
             post_step_s = probe_step_s()
+            next_pre_step_s = post_step_s
             drifted = post_step_s > 1.5 * pre_step_s
             rounds.append({
                 "solo": solo_r, "ungated": raw_r, "gated": gated_r,

@@ -39,13 +39,20 @@ import urllib.error
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
 
-from .api import Container, Node, Pod, PodPhase
+from .api import Conflict, Container, Node, Pod, PodPhase
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
 class KubeError(RuntimeError):
-    pass
+    def __init__(self, message: str, code: int = 0):
+        super().__init__(message)
+        self.code = code  # HTTP status; 0 for transport-level failures
+
+
+class KubeConflict(KubeError, Conflict):
+    """HTTP 409 — catchable either as a KubeError (transport layer) or
+    as the adapter-neutral ``cluster.api.Conflict`` (engine layer)."""
 
 
 def pod_from_k8s(obj: dict) -> Pod:
@@ -151,20 +158,41 @@ class _WatchChannel:
         """Interrupt the reader NOW: shut down the response's socket
         rather than close the buffered stream — close() would block on
         the buffer lock held by the reader's in-flight read until the
-        watch timeout expires."""
+        watch timeout expires.
+
+        Only documented handles are used: ``resp.fileno()`` plus a
+        dup'd ``socket.socket(fileno=...)`` — shutdown() acts on the
+        underlying connection (shared across dups), and closing the dup
+        leaves the original fd to the reader thread's normal teardown.
+        If that path fails we fall back to ``resp.close()``, which can
+        block for up to the watch timeout while the reader holds the
+        buffer lock — degraded but safe: the reader exits at the next
+        stream timeout and poll() relists.
+        """
+        import os as _os
         import socket as _socket
 
         self._closed = True
         resp = self._resp
         if resp is None:
             return
+        sock = None
         try:
-            resp.fp.raw._sock.shutdown(_socket.SHUT_RDWR)
+            fd = _os.dup(resp.fileno())
+            try:
+                sock = _socket.socket(fileno=fd)  # family/type auto-detected
+            except Exception:
+                _os.close(fd)
+                raise
+            sock.shutdown(_socket.SHUT_RDWR)
         except Exception:
             try:
                 resp.close()
             except Exception:
                 pass
+        finally:
+            if sock is not None:
+                sock.close()
 
 
 class KubeCluster:
@@ -245,8 +273,10 @@ class KubeCluster:
             ) as resp:
                 payload = resp.read().decode()
         except urllib.error.HTTPError as e:
-            raise KubeError(
-                f"{method} {path}: HTTP {e.code} {e.read().decode()[:300]}"
+            cls = KubeConflict if e.code == 409 else KubeError
+            raise cls(
+                f"{method} {path}: HTTP {e.code} {e.read().decode()[:300]}",
+                code=e.code,
             ) from e
         except (urllib.error.URLError, OSError) as e:
             raise KubeError(f"{method} {path}: {e}") from e
@@ -329,6 +359,39 @@ class KubeCluster:
         cached = self._pods.get(pod_key)
         if cached is not None and annotations:
             cached.annotations.update(annotations)
+
+    # ---- coordination.k8s.io leases (leader election) ---------------
+
+    def _lease_path(self, namespace: str, name: str = "") -> str:
+        base = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        return f"{base}/{name}" if name else base
+
+    def get_lease(self, namespace: str, name: str) -> Optional[dict]:
+        try:
+            return self._request("GET", self._lease_path(namespace, name))
+        except KubeError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def create_lease(self, namespace: str, name: str, spec: dict) -> dict:
+        return self._request(
+            "POST", self._lease_path(namespace),
+            body={
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": spec,
+            },
+        )
+
+    def update_lease(self, namespace: str, name: str, lease: dict) -> dict:
+        """PUT carrying the lease's observed resourceVersion — the
+        apiserver rejects stale writes with 409 (``KubeConflict``),
+        which is the whole election mechanism."""
+        return self._request(
+            "PUT", self._lease_path(namespace, name), body=lease
+        )
 
     def on_pod_event(self, add, delete) -> None:
         self._pod_add.append(add)

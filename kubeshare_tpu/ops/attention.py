@@ -151,10 +151,11 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     t_k = k.shape[2]
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
-    assert flash_shapes_ok(q.shape, k.shape, causal, block_q, block_k), (
-        f"flash tiling violated: t_q={t_q} t_k={t_k} blocks=({block_q},"
-        f"{block_k}) causal={causal} — use attention()"
-    )
+    if not flash_shapes_ok(q.shape, k.shape, causal, block_q, block_k):
+        raise ValueError(
+            f"flash tiling violated: t_q={t_q} t_k={t_k} blocks=({block_q},"
+            f"{block_k}) causal={causal} — use attention()"
+        )
     qf = q.reshape(batch * num_heads, t_q, head_dim)
     # GQA without materializing repeats: K/V stay [B*Hkv, T, D] and the
     # BlockSpec index map routes each q head to its kv head, so each
@@ -242,42 +243,43 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, block_q: int, t_q: int,
-                          causal: bool, scale: float):
-    """One (batch*kv-head, k-block) program. The q-side refs carry this
-    kv head's WHOLE GROUP: the group's q heads are concatenated along
-    the row axis ([1, reps*Tq, D]), so grads accumulate across the
-    group inside the kernel and dk/dv come out already GQA-grouped —
+                          dk_ref, dv_ref, *, t_q: int, causal: bool,
+                          scale: float):
+    """One (batch*kv-head, k-block, row-block) program. The row axis is
+    the kv head's WHOLE GROUP (its q heads concatenated, reps*Tq rows),
+    tiled into [1, BQ, D] VMEM blocks by the grid rather than resident
+    all at once — at long context the whole group would blow VMEM. The
+    dk/dv output index maps ignore the row axis, so the same output
+    block is revisited across the (innermost) row sweep and group
+    gradients accumulate in VMEM; dk/dv come out already GQA-grouped —
     no repeated K/V in HBM, no post-sum."""
+    qb = pl.program_id(2)
     k = k_ref[0].astype(jnp.float32)   # [BK, D]
     v = v_ref[0].astype(jnp.float32)
-    block_k, head_dim = k.shape
-    rows = q_ref.shape[1]              # reps * t_q
-    num_row_blocks = rows // block_q
-    k_offset = pl.program_id(1) * block_k
+    block_q = q_ref.shape[1]
+    k_offset = pl.program_id(1) * k.shape[0]
 
-    def body(qb, carry):
-        dk, dv = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse_blk = lse_ref[0, pl.ds(qb * block_q, block_q), :]
-        delta_blk = delta_ref[0, pl.ds(qb * block_q, block_q), :]
-        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
-        if causal:
-            # position within this block's own head (rows wrap per head;
-            # t_q % block_q == 0 so blocks never straddle heads)
-            s = _causal_mask(s, (qb * block_q) % t_q, k_offset)
-        p = jnp.exp(s - lse_blk)
-        dv = dv + jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_blk)
-        dk = dk + jnp.dot(ds.T, q_blk, preferred_element_type=jnp.float32)
-        return dk, dv
+    @pl.when(qb == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, num_row_blocks, body, (zeros, zeros))
-    dk_ref[0] = dk * scale
-    dv_ref[0] = dv
+    q_blk = q_ref[0].astype(jnp.float32)
+    do_blk = do_ref[0].astype(jnp.float32)
+    lse_blk = lse_ref[0]
+    delta_blk = delta_ref[0]
+    s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        # position within this block's own head (rows wrap per head;
+        # t_q % block_q == 0 so blocks never straddle heads)
+        s = _causal_mask(s, (qb * block_q) % t_q, k_offset)
+    p = jnp.exp(s - lse_blk)
+    dv_ref[0] += jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+    dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_blk)
+    dk_ref[0] += scale * jnp.dot(
+        ds.T, q_blk, preferred_element_type=jnp.float32
+    )
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
@@ -288,6 +290,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     t_k = k.shape[2]
     block_q = min(block_q, t_q)
     block_k = min(block_k, t_k)
+    if not flash_shapes_ok(q.shape, k.shape, causal, block_q, block_k):
+        raise ValueError(
+            f"flash tiling violated in backward: t_q={t_q} t_k={t_k} "
+            f"blocks=({block_q},{block_k}) causal={causal}"
+        )
 
     delta = jnp.sum(
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
@@ -327,25 +334,25 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     )(qf, kf, vf, dof, lsef, deltaf)
 
     # dk/dv: group each kv head's q heads along the row axis so the
-    # kernel accumulates the whole group (f32) and emits grouped grads
+    # kernel accumulates the whole group (f32) and emits grouped grads;
+    # the row axis is gridded (innermost) so VMEM holds one row block
+    # at a time, not the whole group
     qg = qf.reshape(batch * h_kv, reps * t_q, head_dim)
     dog = dof.reshape(batch * h_kv, reps * t_q, head_dim)
     lseg = lsef.reshape(batch * h_kv, reps * t_q, 1)
     deltag = deltaf.reshape(batch * h_kv, reps * t_q, 1)
-    rows_full = pl.BlockSpec(
-        (1, reps * t_q, head_dim), lambda b, i: (b, 0, 0)
+    row_blk = pl.BlockSpec(
+        (1, block_q, head_dim), lambda b, i, j: (b, j, 0)
     )
-    rows_full1 = pl.BlockSpec((1, reps * t_q, 1), lambda b, i: (b, 0, 0))
-    kv_spec = pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0))
+    row_blk1 = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    kv_spec = pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, i, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, block_q=block_q, t_q=t_q, causal=causal,
-            scale=scale,
+            _flash_bwd_dkv_kernel, t_q=t_q, causal=causal, scale=scale,
         ),
-        grid=(batch * h_kv, t_k // block_k),
-        in_specs=[rows_full, kv_spec, kv_spec, rows_full, rows_full1,
-                  rows_full1],
+        grid=(batch * h_kv, t_k // block_k, (reps * t_q) // block_q),
+        in_specs=[row_blk, kv_spec, kv_spec, row_blk, row_blk1, row_blk1],
         out_specs=[kv_spec, kv_spec],
         out_shape=[
             jax.ShapeDtypeStruct(kf.shape, jnp.float32),

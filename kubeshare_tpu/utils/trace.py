@@ -231,12 +231,14 @@ class Tracer:
     def metric_samples(self, prefix: str = "tpu_trace") -> List[expfmt.Sample]:
         """All histograms as ``<prefix>_<span>_seconds`` families."""
         out: List[expfmt.Sample] = []
+        # render while holding the lock: observe() mutates counts/sum/
+        # count under it, so rendering outside could emit a family whose
+        # _count disagrees with its +Inf bucket
         with self._lock:
-            items = sorted(self.histograms.items())
             dropped = self._dropped
-        for name, hist in items:
-            metric = f"{prefix}_{name.replace('.', '_')}_seconds"
-            out.extend(hist.samples(metric))
+            for name, hist in sorted(self.histograms.items()):
+                metric = f"{prefix}_{name.replace('.', '_')}_seconds"
+                out.extend(hist.samples(metric))
         out.append(
             expfmt.Sample(f"{prefix}_events_dropped_total", {}, dropped)
         )
