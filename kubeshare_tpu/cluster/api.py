@@ -73,6 +73,13 @@ class Node:
         return self.ready and not self.unschedulable
 
 
+class Conflict(RuntimeError):
+    """Optimistic-concurrency loss on a cluster write (HTTP 409): the
+    object changed under us — another actor (a second scheduler
+    replica) bound/updated it first. Callers treat it as a lost race
+    and requeue, never as a fatal error."""
+
+
 class ClusterAPI(Protocol):
     """Minimal verbs the scheduler needs from the cluster."""
 

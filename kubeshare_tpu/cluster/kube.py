@@ -599,6 +599,12 @@ class KubeCluster:
             elif pod.is_completed and not old.is_completed:
                 for handler in self._pod_delete:
                     handler(pod)
+            elif pod.is_bound and not old.is_bound:
+                # bound by someone else between relists (a peer replica
+                # winning a bind race): deliver it like watch mode's
+                # MODIFIED so the engine reconciles the placement
+                for handler in self._pod_add:
+                    handler(pod)
         for key in [k for k in self._pods if k not in pods]:
             gone = self._pods.pop(key)
             if not gone.is_completed:

@@ -1,0 +1,209 @@
+"""Lease-based leader election for scheduler HA.
+
+The reference inherits election from the stock kube-scheduler
+(/root/reference/cmd/kubeshare-scheduler/main.go:26-38 registers into
+``app.NewSchedulerCommand``, which brings the client-go leaderelection
+machinery). This standalone rebuild implements the same protocol
+directly against ``coordination.k8s.io/v1`` Leases:
+
+- one Lease object names the election; its ``holderIdentity`` is the
+  current leader;
+- the leader renews ``renewTime`` every tick; every write carries the
+  observed ``resourceVersion``, so a concurrent writer loses with a
+  409 and backs off;
+- non-leaders acquire only after ``renewTime + leaseDurationSeconds``
+  has passed (the previous leader died or lost connectivity);
+- a clean shutdown releases the lease (empties ``holderIdentity``) so
+  failover is immediate rather than a full lease-duration away.
+
+Works against any adapter exposing ``get_lease``/``create_lease``/
+``update_lease`` with Conflict-on-stale-write semantics (KubeCluster,
+or the hermetic stub in tests).
+"""
+
+from __future__ import annotations
+
+import datetime
+import time
+from typing import Callable, Optional
+
+from .api import Conflict
+
+_FMT = "%Y-%m-%dT%H:%M:%S.%fZ"  # k8s MicroTime
+
+
+def _render_time(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(
+        ts, tz=datetime.timezone.utc
+    ).strftime(_FMT)
+
+
+def _parse_time(raw: str) -> Optional[float]:
+    if not raw:
+        return None
+    try:
+        return (
+            datetime.datetime.strptime(raw, _FMT)
+            .replace(tzinfo=datetime.timezone.utc)
+            .timestamp()
+        )
+    except ValueError:
+        # RFC3339 without fractional seconds (other writers may round)
+        try:
+            return (
+                datetime.datetime.strptime(raw, "%Y-%m-%dT%H:%M:%SZ")
+                .replace(tzinfo=datetime.timezone.utc)
+                .timestamp()
+            )
+        except ValueError:
+            return None
+
+
+class LeaderElector:
+    """Drive with ``tick()`` once per scheduler loop iteration; read
+    ``is_leader``. Uses wall-clock time (renewTime is compared across
+    processes)."""
+
+    def __init__(
+        self,
+        cluster,
+        identity: str,
+        namespace: str = "kube-system",
+        name: str = "kubeshare-tpu-scheduler",
+        lease_duration: float = 15.0,
+        clock: Callable[[], float] = time.time,
+        log=None,
+    ):
+        self.cluster = cluster
+        self.identity = identity
+        self.namespace = namespace
+        self.name = name
+        self.lease_duration = lease_duration
+        self.clock = clock
+        self.log = log
+        self.is_leader = False
+        self.leader_identity = ""  # last observed holder ("" = vacant)
+        self.last_renew = 0.0      # clock() of our last successful write
+
+    # ---- protocol ----------------------------------------------------
+
+    def _spec(self, now: float, acquire_time: Optional[str],
+              transitions: int) -> dict:
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration),
+            "acquireTime": acquire_time or _render_time(now),
+            "renewTime": _render_time(now),
+            "leaseTransitions": transitions,
+        }
+
+    def tick(self) -> bool:
+        """One acquire-or-renew attempt. Returns ``is_leader``. Never
+        raises: apiserver errors demote to non-leader (fail-safe — a
+        scheduler that can't write the lease must not keep binding).
+
+        While leading, the lease is actually rewritten only every
+        ``lease_duration/3`` (client-go's renew cadence) — within that
+        window no peer can legally take over (takeover requires
+        ``renewTime + duration`` to pass), so the GET+PUT round trip is
+        skipped and tick() is cheap enough to call before every bind
+        (see ``held``)."""
+        try:
+            return self._tick()
+        except Conflict:
+            # someone else wrote first; observe their claim next tick
+            self._demote("lost lease write race")
+            return False
+        except Exception as e:
+            self._demote(f"lease error: {e}")
+            return False
+
+    def held(self) -> bool:
+        """Whether leadership is still provably ours RIGHT NOW: we are
+        leader and our last successful renew is within the lease
+        duration, so no standby can have legally taken over. The
+        residual unsafety is one in-flight write started just before
+        the boundary — the same window client-go's renewDeadline
+        leaves. Callers use this (via tick()) as a per-bind guard."""
+        return (
+            self.is_leader
+            and self.clock() < self.last_renew + self.lease_duration
+        )
+
+    def _tick(self) -> bool:
+        now = self.clock()
+        if (
+            self.is_leader
+            and now - self.last_renew < self.lease_duration / 3.0
+        ):
+            return True  # renewed recently; skip the API round trip
+        lease = self.cluster.get_lease(self.namespace, self.name)
+        if lease is None:
+            try:
+                self.cluster.create_lease(
+                    self.namespace, self.name, self._spec(now, None, 0)
+                )
+            except Conflict:
+                self._demote("lease created by peer")
+                return False
+            self.last_renew = now
+            self._promote()
+            return True
+
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity") or ""
+        self.leader_identity = holder
+        renew = _parse_time(spec.get("renewTime") or "")
+        duration = float(
+            spec.get("leaseDurationSeconds") or self.lease_duration
+        )
+        expired = renew is None or now > renew + duration
+
+        if holder and holder != self.identity and not expired:
+            self._demote(f"lease held by {holder}")
+            return False
+
+        # vacant, expired, or ours: write our claim at the observed
+        # resourceVersion; 409 = a peer claimed it first
+        transitions = int(spec.get("leaseTransitions") or 0)
+        acquire_time = None
+        if holder == self.identity:
+            acquire_time = spec.get("acquireTime")
+        else:
+            transitions += 1
+        lease["spec"] = self._spec(now, acquire_time, transitions)
+        self.cluster.update_lease(self.namespace, self.name, lease)
+        self.last_renew = now
+        self._promote()
+        return True
+
+    def release(self) -> None:
+        """Clean shutdown: vacate the lease so a standby takes over
+        immediately instead of waiting out the lease duration."""
+        if not self.is_leader:
+            return
+        try:
+            lease = self.cluster.get_lease(self.namespace, self.name)
+            if (
+                lease
+                and (lease.get("spec") or {}).get("holderIdentity")
+                == self.identity
+            ):
+                lease["spec"]["holderIdentity"] = ""
+                self.cluster.update_lease(self.namespace, self.name, lease)
+        except Exception:
+            pass  # best effort; expiry is the backstop
+        self.is_leader = False
+
+    # ---- bookkeeping -------------------------------------------------
+
+    def _promote(self) -> None:
+        if not self.is_leader and self.log:
+            self.log.info("leader election: acquired (%s)", self.identity)
+        self.is_leader = True
+        self.leader_identity = self.identity
+
+    def _demote(self, why: str) -> None:
+        if self.is_leader and self.log:
+            self.log.info("leader election: lost leadership (%s)", why)
+        self.is_leader = False

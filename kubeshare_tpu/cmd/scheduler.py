@@ -74,6 +74,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="gang barrier base timeout, multiplied by headcount",
     )
     parser.add_argument(
+        "--leader-elect", action="store_true",
+        help="--kube mode: run Lease-based leader election "
+             "(coordination.k8s.io); non-leaders stand by, so multiple "
+             "replicas never double-bind",
+    )
+    parser.add_argument(
+        "--leader-elect-namespace", default="kube-system",
+        help="namespace of the election Lease",
+    )
+    parser.add_argument(
+        "--leader-elect-name", default="kubeshare-tpu-scheduler",
+        help="name of the election Lease",
+    )
+    parser.add_argument(
+        "--leader-elect-lease-duration", type=float, default=15.0,
+        help="seconds a dead leader's lease survives before takeover",
+    )
+    parser.add_argument(
         "--metrics-port", type=int, default=0,
         help="serve scheduler self-metrics (tpu_scheduler_*) on this "
              "port (0 = off)",
@@ -127,10 +145,12 @@ class SchedulerMetrics:
     observability layer the reference only has as log lines
     (scheduler.go [Filter]/[Score]/[Reserve] Infof)."""
 
-    def __init__(self, clock=time.time, tracer=None, engine=None):
+    def __init__(self, clock=time.time, tracer=None, engine=None,
+                 elector=None):
         self.clock = clock
         self.tracer = tracer
         self.engine = engine
+        self.elector = elector
         self.decisions = {"bound": 0, "waiting": 0, "unschedulable": 0}
         self.passes = 0
         self.last_pass_seconds = 0.0
@@ -164,6 +184,13 @@ class SchedulerMetrics:
                 "tpu_scheduler_last_pass_pods", {}, self.last_pass_pods
             ),
             expfmt.Sample("tpu_scheduler_up", {}, 1),
+            *(
+                [expfmt.Sample(
+                    "tpu_scheduler_leader", {},
+                    1 if self.elector.is_leader else 0,
+                )]
+                if self.elector is not None else []
+            ),
             expfmt.Sample(
                 "tpu_scheduler_last_render_timestamp_seconds", {}, now
             ),
@@ -215,16 +242,25 @@ class TopologyWatcher:
         return True
 
 
-def run_pass(engine: TpuShareScheduler, cluster, journal, metrics=None) -> int:
-    """One queue drain. Returns number of pods scheduled/acted on."""
+def run_pass(engine: TpuShareScheduler, cluster, journal, metrics=None,
+             guard=None) -> int:
+    """One queue drain. Returns number of pods scheduled/acted on.
+
+    ``guard`` (from leader election) is re-proven before EVERY pod: a
+    long pass must not keep binding after the lease lapsed mid-pass —
+    that is how two replicas end up placing different pods onto the
+    same fractional chip. The guard renews the lease when it is due,
+    so a slow pass also keeps leadership alive."""
     from ..utils.trace import maybe_span
 
     started = time.monotonic()
     with maybe_span(engine.tracer, "pass"):
-        return _run_pass_inner(engine, cluster, journal, metrics, started)
+        return _run_pass_inner(engine, cluster, journal, metrics, started,
+                               guard)
 
 
-def _run_pass_inner(engine, cluster, journal, metrics, started) -> int:
+def _run_pass_inner(engine, cluster, journal, metrics, started,
+                    guard=None) -> int:
     pending = [
         p
         for p in cluster.list_pods()
@@ -236,6 +272,8 @@ def _run_pass_inner(engine, cluster, journal, metrics, started) -> int:
     pending.sort(key=engine.queue_sort_key)
     acted = 0
     for pod in pending:
+        if guard is not None and not guard():
+            break  # leadership lapsed mid-pass; stop binding NOW
         decision = engine.schedule_one(pod)
         acted += 1
         if metrics is not None:
@@ -290,6 +328,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         log=log,
         tracer=tracer,
     )
+    elector = None
+    if args.leader_elect:
+        if not args.kube:
+            raise SystemExit("--leader-elect requires --kube")
+        import os
+        import socket
+
+        from ..cluster.leaderelect import LeaderElector
+
+        elector = LeaderElector(
+            cluster,
+            identity=f"{socket.gethostname()}_{os.getpid()}",
+            namespace=args.leader_elect_namespace,
+            name=args.leader_elect_name,
+            lease_duration=args.leader_elect_lease_duration,
+            log=log,
+        )
+
     journal = None
     if args.decisions_out == "-":
         journal = sys.stdout
@@ -299,7 +355,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # snapshot adapters expose refresh(); the kube adapter poll()
     sync = getattr(cluster, "refresh", None) or cluster.poll
 
-    metrics = SchedulerMetrics(tracer=tracer, engine=engine)
+    metrics = SchedulerMetrics(tracer=tracer, engine=engine, elector=elector)
     metrics_server = None
     if args.metrics_port:
         from ..utils.httpserv import MetricServer
@@ -309,9 +365,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         metrics_server.start()
         log.info("self-metrics on :%d/metrics", metrics_server.port)
 
+    # guard: re-proves (and when due, renews) leadership before every
+    # bind; None when election is off
+    guard = None
+    if elector is not None:
+        guard = lambda: elector.tick() and elector.held()  # noqa: E731
+
     if args.once:
-        sync()
-        run_pass(engine, cluster, journal, metrics)
+        if elector is not None and not elector.tick():
+            log.error(
+                "not leader (lease held by %s); refusing the pass",
+                elector.leader_identity,
+            )
+            return 1
+        try:
+            sync()
+            run_pass(engine, cluster, journal, metrics, guard)
+        finally:
+            # a raised pass must still vacate the lease, or the next
+            # --once run is locked out for the full lease duration
+            if elector is not None:
+                elector.release()
         if args.trace_out:
             tracer.write_chrome_trace(args.trace_out)
         return 0
@@ -327,9 +401,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     while not stop.is_set():
         started = time.monotonic()
         try:
+            if elector is not None and not elector.tick():
+                # standby replica: no sync, no pass — the engine's view
+                # is rebuilt fresh (informer resync of bound pods) once
+                # leadership arrives
+                stop.wait(max(0.05, args.interval))
+                continue
             watcher.poll()
             sync()
-            run_pass(engine, cluster, journal, metrics)
+            run_pass(engine, cluster, journal, metrics, guard)
         except Exception as e:  # apiserver blips must not kill the loop
             log.error("scheduling pass failed: %s", e)
         if args.trace_out and metrics.passes - trace_written_at >= 100:
@@ -337,6 +417,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             trace_written_at = metrics.passes
         elapsed = time.monotonic() - started
         stop.wait(max(0.05, args.interval - elapsed))
+    if elector is not None:
+        elector.release()
     if args.trace_out:
         tracer.write_chrome_trace(args.trace_out)
     if metrics_server is not None:

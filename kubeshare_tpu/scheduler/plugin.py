@@ -23,7 +23,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 from ..cells.cell import Cell, CellTree, ChipInfo
 from ..cells.spec import TopologyConfig, load_topology
-from ..cluster.api import ClusterAPI, Node, Pod
+from ..cluster.api import ClusterAPI, Conflict, Node, Pod
 from ..utils import expfmt
 from ..utils.bitmap import RRBitmap
 from ..utils.logger import get_logger
@@ -165,8 +165,22 @@ class TpuShareScheduler:
             return
         if not pod.is_bound or pod.is_completed:
             return
-        if self.status.get(pod.key) is not None:
-            return
+        status = self.status.get(pod.key)
+        if status is not None:
+            if status.state == PodState.BOUND:
+                return  # our own bind echoing back through the informer
+            # A bound event against a stale local reservation: another
+            # replica won the bind race while we held RESERVED/WAITING
+            # (or PENDING) state. The cluster object is authoritative —
+            # drop our view and fall through to restore the winner's
+            # placement, otherwise this pod's real occupancy would be
+            # lost forever in watch mode (no relist to re-deliver it).
+            self.log.info(
+                "pod %s bound externally while locally %s; reconciling",
+                pod.key, status.state.name,
+            )
+            self.unreserve(pod.key, reject_group=False)
+            self.status.pop(pod.key)  # no-op if unreserve popped it
         if C.ANNOTATION_CHIP_UUID not in pod.annotations:
             return  # regular pod, nothing to restore
         if pod.node_name in self._synced_nodes:
@@ -416,7 +430,18 @@ class TpuShareScheduler:
         if len(held) >= group.min_available:
             members = []
             for waiting in list(self._waiting.get(group_key, {}).values()):
-                self._bind(waiting.pod_key, waiting.node)
+                try:
+                    self._bind(waiting.pod_key, waiting.node)
+                except Conflict:
+                    # another replica bound it first (lost leader race);
+                    # drop our reservation — the informer resync will
+                    # pick up the winner's placement
+                    self.unreserve(waiting.pod_key, reject_group=False)
+                    self.log.info(
+                        "bind conflict on gang member %s; released",
+                        waiting.pod_key,
+                    )
+                    continue
                 members.append(waiting.pod_key)
             self._waiting.pop(group_key, None)
             return "allow", members
@@ -471,7 +496,13 @@ class TpuShareScheduler:
             best = max(feasible, key=lambda n: (normalized[n], n))
 
         if req.kind == PodKind.REGULAR:
-            self._bind_regular(pod, best)
+            try:
+                self._bind_regular(pod, best)
+            except Conflict:
+                return Decision(
+                    "unschedulable", pod.key, retryable=True,
+                    message="bind conflict (another replica acted); requeued",
+                )
             return Decision("bound", pod.key, node=best)
 
         try:
@@ -484,7 +515,14 @@ class TpuShareScheduler:
         with maybe_span(self.tracer, "permit", pod=pod.key):
             action, extra = self.permit(pod, status)
         if action == "allow":
-            self._bind(pod.key, best)
+            try:
+                self._bind(pod.key, best)
+            except Conflict:
+                self.unreserve(pod.key, reject_group=False)
+                return Decision(
+                    "unschedulable", pod.key, retryable=True,
+                    message="bind conflict (another replica acted); requeued",
+                )
             return Decision("bound", pod.key, node=best, bound_with=extra)
         return Decision(
             "waiting", pod.key, node=best,

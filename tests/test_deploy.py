@@ -85,7 +85,9 @@ class TestManifests:
         pcmd = pspec["containers"][0]["command"]
         # identical command, modulo intentionally-divergent flags
         # (the debug pod runs more verbose)
-        allowed_drift = ("--level",)
+        # --leader-elect: the debug pod must act immediately, not
+        # contend with (or stand behind) the Deployment's replicas
+        allowed_drift = ("--level", "--leader-elect")
 
         def normalized(cmd):
             return [a for a in cmd

@@ -17,12 +17,15 @@ class StubApiServer:
     def __init__(self):
         self.pods = {}    # (ns, name) -> k8s object dict
         self.nodes = {}   # name -> k8s object dict
+        self.leases = {}  # (ns, name) -> Lease dict (resourceVersion'd)
         self.bindings = []
         self.patches = []
         self.auth_headers = []
         self.watch_queues = {"pods": [], "nodes": []}  # live streams
         self.watch_opens = {"pods": 0, "nodes": 0}
         self._stopping = False
+        self._lock = threading.Lock()  # lease/binding write atomicity
+        self._rv = 0
 
         stub = self
 
@@ -70,10 +73,32 @@ class StubApiServer:
                 finally:
                     stub.watch_queues[kind].remove(q)
 
+            def _lease_key(self):
+                # /apis/coordination.k8s.io/v1/namespaces/<ns>/leases[/<name>]
+                parts = [p for p in self.path.split("/") if p]
+                if (
+                    len(parts) >= 6
+                    and parts[0] == "apis"
+                    and parts[1] == "coordination.k8s.io"
+                    and parts[5] == "leases"
+                ):
+                    return parts[4], parts[6] if len(parts) > 6 else ""
+                return None
+
             def do_GET(self):
                 stub.auth_headers.append(self.headers.get("Authorization"))
                 parts = [p for p in self.path.split("/") if p]
                 path, _, query = self.path.partition("?")
+                lease_key = self._lease_key()
+                if lease_key is not None:
+                    ns, name = lease_key
+                    with stub._lock:
+                        obj = stub.leases.get((ns, name))
+                    if obj is None:
+                        self._send({"message": "not found"}, code=404)
+                    else:
+                        self._send(obj)
+                    return
                 if "watch=true" in query:
                     kind = "nodes" if path.endswith("/nodes") else "pods"
                     self._stream_watch(kind)
@@ -106,15 +131,75 @@ class StubApiServer:
             def do_POST(self):
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
+                lease_key = self._lease_key()
+                if lease_key is not None:
+                    ns = lease_key[0]
+                    name = (body.get("metadata") or {}).get("name", "")
+                    with stub._lock:
+                        if (ns, name) in stub.leases:
+                            self._send(
+                                {"message": "already exists"}, code=409
+                            )
+                            return
+                        stub._rv += 1
+                        body.setdefault("metadata", {})[
+                            "resourceVersion"
+                        ] = str(stub._rv)
+                        stub.leases[(ns, name)] = body
+                    self._send(body, code=201)
+                    return
                 if self.path.endswith("/binding"):
                     parts = [p for p in self.path.split("/") if p]
-                    if (parts[3], parts[5]) not in stub.pods:
-                        self._send({"message": "not found"}, code=404)
-                        return
-                    stub.bindings.append((self.path, body))
+                    with stub._lock:
+                        pod = stub.pods.get((parts[3], parts[5]))
+                        if pod is None:
+                            self._send({"message": "not found"}, code=404)
+                            return
+                        if pod["spec"].get("nodeName"):
+                            # real apiserver: binding an already-bound
+                            # pod is a conflict
+                            self._send(
+                                {"message": "pod is already assigned "
+                                            f"to node "
+                                            f"{pod['spec']['nodeName']}"},
+                                code=409,
+                            )
+                            return
+                        pod["spec"]["nodeName"] = (
+                            body.get("target", {}).get("name", "")
+                        )
+                        stub.bindings.append((self.path, body))
                     self._send({}, code=201)
                 else:
                     self._send({"message": "bad path"}, code=404)
+
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                lease_key = self._lease_key()
+                if lease_key is None:
+                    self._send({"message": "bad path"}, code=404)
+                    return
+                ns, name = lease_key
+                with stub._lock:
+                    current = stub.leases.get((ns, name))
+                    if current is None:
+                        self._send({"message": "not found"}, code=404)
+                        return
+                    sent_rv = (body.get("metadata") or {}).get(
+                        "resourceVersion", ""
+                    )
+                    cur_rv = current["metadata"]["resourceVersion"]
+                    if sent_rv != cur_rv:
+                        self._send(
+                            {"message": "the object has been modified"},
+                            code=409,
+                        )
+                        return
+                    stub._rv += 1
+                    body["metadata"]["resourceVersion"] = str(stub._rv)
+                    stub.leases[(ns, name)] = body
+                self._send(body)
 
             def do_PATCH(self):
                 length = int(self.headers.get("Content-Length", "0"))

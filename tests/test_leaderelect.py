@@ -1,0 +1,285 @@
+"""Leader election + conflict-safe bind: two scheduler replicas must
+never double-bind, and a standby must take over on leader death.
+
+The reference inherits all of this from the stock kube-scheduler
+framework (/root/reference/cmd/kubeshare-scheduler/main.go:26-38); the
+standalone rebuild implements it against coordination.k8s.io Leases
+(kubeshare_tpu/cluster/leaderelect.py) and surfaces bind 409s as
+``cluster.api.Conflict`` for lost-race requeue.
+"""
+
+import json
+
+import pytest
+
+from kubeshare_tpu.cluster.api import Conflict
+from kubeshare_tpu.cluster.kube import KubeCluster, KubeConflict
+from kubeshare_tpu.cluster.leaderelect import LeaderElector
+
+from test_kube import TOPO_YAML, StubApiServer, make_cluster, stub  # noqa: F401
+
+
+def elector(stub_server, ident, clock=None, **kw):
+    kwargs = dict(namespace="kube-system", name="test-sched", **kw)
+    if clock is not None:
+        kwargs["clock"] = clock
+    return LeaderElector(make_cluster(stub_server), ident, **kwargs)
+
+
+class TestLeaderElector:
+    def test_first_elector_acquires(self, stub):
+        a = elector(stub, "sched-a")
+        assert a.tick() is True
+        assert a.is_leader
+        lease = stub.leases[("kube-system", "test-sched")]
+        assert lease["spec"]["holderIdentity"] == "sched-a"
+        assert lease["spec"]["leaseTransitions"] == 0
+
+    def test_second_elector_stands_by(self, stub):
+        a = elector(stub, "sched-a")
+        b = elector(stub, "sched-b")
+        assert a.tick() and not b.tick()
+        assert not b.is_leader
+        assert b.leader_identity == "sched-a"
+        # and keeps standing by while the leader renews
+        assert a.tick() and not b.tick()
+
+    def test_takeover_after_lease_expiry(self, stub):
+        a_now = {"t": 1000.0}
+        a = elector(stub, "sched-a", clock=lambda: a_now["t"])
+        assert a.tick()
+        # within the 15s lease: no takeover
+        b_early = elector(stub, "sched-b", clock=lambda: 1010.0)
+        assert not b_early.tick()
+        # past it (dead leader): takeover, transition counted
+        b = elector(stub, "sched-b", clock=lambda: 1016.0)
+        assert b.tick() and b.is_leader
+        lease = stub.leases[("kube-system", "test-sched")]
+        assert lease["spec"]["holderIdentity"] == "sched-b"
+        assert lease["spec"]["leaseTransitions"] == 1
+        # the deposed leader (its clock caught up) observes the new
+        # holder and demotes; held() goes false with it
+        a_now["t"] = 1016.0
+        assert not a.tick()
+        assert not a.is_leader
+        assert not a.held()
+
+    def test_renew_cadence_skips_fresh_lease_writes(self, stub):
+        now = {"t": 0.0}
+        a = elector(stub, "sched-a", clock=lambda: now["t"])
+        assert a.tick()
+        rv0 = stub.leases[("kube-system", "test-sched")]["metadata"][
+            "resourceVersion"]
+        # within lease_duration/3: tick() is a no-op on the apiserver
+        now["t"] = 2.0
+        assert a.tick()
+        assert stub.leases[("kube-system", "test-sched")]["metadata"][
+            "resourceVersion"] == rv0
+        assert a.held()
+        # past the renew cadence: the lease is actually rewritten
+        now["t"] = 6.0
+        assert a.tick()
+        assert stub.leases[("kube-system", "test-sched")]["metadata"][
+            "resourceVersion"] != rv0
+        # held() flips once the full lease duration has lapsed without
+        # a successful renew (even though is_leader was never demoted)
+        now["t"] = 6.0 + 16.0
+        assert not a.held()
+
+    def test_release_gives_immediate_failover(self, stub):
+        a = elector(stub, "sched-a")
+        b = elector(stub, "sched-b")
+        assert a.tick() and not b.tick()
+        a.release()
+        assert not a.is_leader
+        assert b.tick() and b.is_leader  # no lease-duration wait
+
+    def test_stale_update_conflicts(self, stub):
+        now = {"t": 0.0}
+        a = elector(stub, "sched-a", clock=lambda: now["t"])
+        assert a.tick()
+        stale = make_cluster(stub).get_lease("kube-system", "test-sched")
+        now["t"] = 6.0  # past the renew cadence
+        assert a.tick()  # renews, bumping resourceVersion
+        with pytest.raises(Conflict):
+            make_cluster(stub).update_lease(
+                "kube-system", "test-sched", stale
+            )
+
+    def test_apiserver_down_demotes(self, stub):
+        now = {"t": 0.0}
+        a = elector(stub, "sched-a", clock=lambda: now["t"])
+        assert a.tick()
+        stub.stop()
+        now["t"] = 6.0  # past the renew cadence: must hit the apiserver
+        assert a.tick() is False  # fail-safe: can't renew -> not leader
+        assert not a.is_leader
+        assert not a.held()
+
+
+class TestConflictSafeBind:
+    def test_second_bind_raises_conflict(self, stub):
+        stub.add_pod("p1")
+        c1, c2 = make_cluster(stub), make_cluster(stub)
+        c1.bind("default/p1", "node-a")
+        with pytest.raises(KubeConflict) as ei:
+            c2.bind("default/p1", "node-b")
+        assert isinstance(ei.value, Conflict)
+        assert ei.value.code == 409
+        # only the first binding landed
+        assert len(stub.bindings) == 1
+        assert stub.pods[("default", "p1")]["spec"]["nodeName"] == "node-a"
+
+    def test_two_engines_never_double_bind(self, stub, tmp_path):
+        """Split-brain moment: two engines hold a stale PENDING view of
+        the same pod; the loser's bind 409s, its reservation is
+        released, and the decision is a retryable requeue."""
+        import yaml
+
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        stub.add_node("node-a")
+        stub.add_pod("p1", labels={
+            "sharedtpu/tpu_request": "0.5", "sharedtpu/tpu_limit": "1.0",
+        })
+        chips = [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 << 30, i)
+                 for i in range(4)]
+        topo = yaml.safe_load(TOPO_YAML)
+        engines = []
+        for _ in range(2):
+            cluster = make_cluster(stub)
+            engine = TpuShareScheduler(
+                topology=topo, cluster=cluster,
+                inventory=lambda node: chips,
+            )
+            cluster.poll()
+            engines.append((cluster, engine))
+        # both snapshot the pod while it is still pending
+        (c1, e1), (c2, e2) = engines
+        [p1] = [p for p in c1.list_pods() if not p.is_bound]
+        [p2] = [p for p in c2.list_pods() if not p.is_bound]
+
+        d1 = e1.schedule_one(p1)
+        assert d1.status == "bound" and d1.node == "node-a"
+
+        d2 = e2.schedule_one(p2)
+        assert d2.status == "unschedulable"
+        assert d2.retryable
+        assert "conflict" in d2.message
+        # the loser leaked nothing: no status entry, no reservation
+        assert e2.status.get("default/p1") is None
+        assert len(stub.bindings) == 1
+
+
+class TestExternalBindReconcile:
+    def test_bound_event_replaces_stale_reservation(self, stub):
+        """A bound-pod informer event arriving while we hold a stale
+        RESERVED/WAITING view (we lost the bind race) must RELEASE our
+        reservation and restore the winner's placement — in watch mode
+        no relist will ever re-deliver that pod, so dropping the event
+        loses its occupancy forever."""
+        import yaml
+
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.scheduler import constants as C
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+        from kubeshare_tpu.scheduler.state import PodState
+
+        stub.add_node("node-a")
+        # a 2-member gang: scheduling member one leaves it WAITING at
+        # the permit barrier — a live stale reservation
+        gang_labels = {
+            "sharedtpu/tpu_request": "0.5", "sharedtpu/tpu_limit": "1.0",
+            "sharedtpu/group_name": "g1", "sharedtpu/group_headcount": "2",
+            "sharedtpu/group_threshold": "1.0",
+        }
+        stub.add_pod("p1", labels=gang_labels)
+        stub.add_pod("p2", uid="u2", labels=gang_labels)
+        chips = [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 << 30, i)
+                 for i in range(4)]
+        cluster = make_cluster(stub)
+        engine = TpuShareScheduler(
+            topology=yaml.safe_load(TOPO_YAML), cluster=cluster,
+            inventory=lambda node: chips,
+        )
+        cluster.poll()
+        [p1] = [p for p in cluster.list_pods() if p.name == "p1"]
+        d = engine.schedule_one(p1)
+        assert d.status == "waiting"
+        ours = engine.status.get("default/p1")
+        assert ours.state == PodState.WAITING
+        our_uuid = ours.uuids[0]
+
+        # the peer replica wins the race and binds p1 onto a DIFFERENT
+        # chip; its bound pod object arrives through the informer
+        stub.pods[("default", "p1")]["spec"]["nodeName"] = "node-a"
+        stub.pods[("default", "p1")]["metadata"]["annotations"] = {
+            C.ANNOTATION_CHIP_UUID: "node-a-chip-3",
+            C.ANNOTATION_TPU_MEMORY: str(8 << 30),
+            C.ANNOTATION_MANAGER_PORT: str(C.POD_MANAGER_PORT_START),
+        }
+        cluster.poll()  # fires _on_pod_add with the bound pod
+
+        status = engine.status.get("default/p1")
+        assert status is not None and status.state == PodState.BOUND
+        assert status.uuids == ["node-a-chip-3"]
+        # our stale half-chip reservation was reclaimed
+        leaf = engine.tree.leaf_cells[our_uuid]
+        assert leaf.available == 1.0 or our_uuid == "node-a-chip-3"
+
+
+class TestSchedulerCliElection:
+    def _run_once(self, stub_server, tmp_path, extra):
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.cmd import scheduler as scheduler_cmd
+        from kubeshare_tpu.metrics.collector import Collector, FakeChipBackend
+
+        chips = [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 << 30, i)
+                 for i in range(4)]
+        collector = Collector("node-a", FakeChipBackend(chips))
+        server = collector.serve(host="127.0.0.1", port=0)
+        topo = tmp_path / "topo.yaml"
+        topo.write_text(TOPO_YAML)
+        out = tmp_path / "decisions.jsonl"
+        try:
+            rc = scheduler_cmd.main([
+                "--topology", str(topo),
+                "--kube",
+                "--api-server", f"http://127.0.0.1:{stub_server.port}",
+                "--capacity-url",
+                f"http://127.0.0.1:{server.port}/metrics",
+                "--decisions-out", str(out),
+                "--once",
+            ] + extra)
+        finally:
+            server.stop()
+        return rc, out
+
+    def test_once_refuses_without_leadership(self, stub, tmp_path):
+        stub.add_node("node-a")
+        stub.add_pod("p1", labels={
+            "sharedtpu/tpu_request": "0.5", "sharedtpu/tpu_limit": "1.0",
+        })
+        # a live peer holds the lease
+        peer = LeaderElector(
+            make_cluster(stub), "peer",
+            namespace="kube-system", name="kubeshare-tpu-scheduler",
+        )
+        assert peer.tick()
+        rc, out = self._run_once(stub, tmp_path, ["--leader-elect"])
+        assert rc == 1
+        assert not stub.bindings  # refused the pass entirely
+
+    def test_once_schedules_as_leader_and_releases(self, stub, tmp_path):
+        stub.add_node("node-a")
+        stub.add_pod("p1", labels={
+            "sharedtpu/tpu_request": "0.5", "sharedtpu/tpu_limit": "1.0",
+        })
+        rc, out = self._run_once(stub, tmp_path, ["--leader-elect"])
+        assert rc == 0
+        [decision] = [json.loads(l) for l in out.read_text().splitlines()]
+        assert decision["status"] == "bound"
+        # clean exit vacated the lease for instant failover
+        lease = stub.leases[("kube-system", "kubeshare-tpu-scheduler")]
+        assert lease["spec"]["holderIdentity"] == ""
