@@ -14,6 +14,9 @@ test: native
 bench: native
 	$(PYTHON) bench.py
 
+engine-bench:
+	$(PYTHON) tools/engine_bench.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -25,4 +28,4 @@ images:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench dryrun images clean
+.PHONY: all native test bench engine-bench dryrun images clean

@@ -1,0 +1,288 @@
+"""Compute-bound perf evidence: llama train-step MFU + Pallas-kernel
+speedups vs plain-XLA reference paths, on the real chip.
+
+Round-1 verdict: the headline bench is stall-dominated by design (it
+measures the co-location thesis), so nothing showed the COMPUTE path
+is fast. This module closes that: a dependency-chained train step on a
+~200M-param llama reporting MFU against the chip's bf16 peak, plus
+flash-attention (ops/attention.py) vs the O(T^2) jnp reference and
+chunked fused linear-cross-entropy (ops/xent.py) vs the materialized
+[N, vocab] naive loss, at T in {2048, 4096}.
+
+Methodology notes:
+- every timed iteration feeds its output back into the next input
+  (the axon tunnel memoizes independent same-input dispatches — an
+  unchained loop measures the cache, not the chip);
+- every timed window ends with a HOST FETCH of a scalar depending on
+  the whole chain: on the axon tunnel ``block_until_ready`` returns
+  without waiting for real completion (measured 1.2ms/step "latency"
+  on a 272ms step), so only the fetch is a completion barrier;
+- A/B pairs are interleaved within the same window so the tunnel
+  chip's tens-of-seconds speed drift cancels out of the ratios;
+- ratios use the median of per-round medians.
+
+Consumed by bench.py (merged into the one-line JSON); run standalone
+for the human-readable breakdown.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak FLOPs by device kind (dense MXU). The tunnel chip reports
+# "TPU v5 lite" = v5e: 197 TFLOP/s.
+_PEAK_BF16 = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,      # v5p
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,  # v6e
+}
+
+
+def _peak_flops() -> float:
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in _PEAK_BF16.items():
+        if kind.startswith(prefix):
+            return peak
+    return 197e12  # assume v5e-class
+
+
+def _timed_window(fn, state, chain, inner: int) -> tuple:
+    """Wall seconds per iteration over one window of ``inner`` chained
+    dispatches, closed by a host fetch of the final (chain-dependent)
+    scalar — the only real completion barrier on the axon tunnel.
+    ``fn`` must return a scalar. Returns (seconds_per_iter, state)."""
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(inner):
+        out = fn(state)
+        state = chain(state, out)
+    float(out)  # forces the whole chain
+    return (time.perf_counter() - t0) / inner, state
+
+
+# ---- llama train-step MFU ------------------------------------------
+
+
+def llama_train_mfu(batch: int = 4, seq: int = 2048, steps: int = 6):
+    """Single-chip train step (forward + backward + adamw) on a
+    ~200M-param llama; returns step time and MFU vs bf16 peak."""
+    import optax
+
+    from kubeshare_tpu.models.llama import (
+        LlamaConfig, init_llama, llama_loss,
+    )
+
+    cfg = LlamaConfig(
+        vocab=32000, dim=1024, layers=12, num_heads=16, num_kv_heads=8,
+        mlp_dim=2816, max_seq_len=seq,
+    )
+    rng = jax.random.PRNGKey(0)
+    params = init_llama(rng, cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    optimizer = optax.adamw(1e-4)
+    opt_state = jax.jit(optimizer.init)(params)
+    tokens = jax.random.randint(rng, (batch, seq + 1), 0, cfg.vocab,
+                                dtype=jnp.int32)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(llama_loss)(
+            params, tokens, cfg, 8192
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # compile + warm (the fetch is the real completion barrier)
+    params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+
+    # params/opt_state chain every step by construction; the final
+    # loss fetch forces the WHOLE chain, so wall/steps is real compute
+    windows = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        float(loss)
+        windows.append((time.perf_counter() - t0) / steps)
+    step_s = statistics.median(windows)
+
+    # standard accounting (PaLM appendix B): 6*N flops per token for
+    # the dense weights (fwd+bwd), + 12*L*H*T*hd per token for
+    # attention scores/values (fwd+bwd)
+    tokens_per_step = batch * seq
+    hd = cfg.dim // cfg.num_heads
+    flops_per_token = 6 * n_params + 12 * cfg.layers * cfg.num_heads * seq * hd
+    flops_per_step = flops_per_token * tokens_per_step
+    mfu = flops_per_step / step_s / _peak_flops()
+    return {
+        "llama_params_millions": round(n_params / 1e6, 1),
+        "llama_batch_x_seq": f"{batch}x{seq}",
+        "llama_step_ms": round(step_s * 1e3, 2),
+        "llama_tokens_per_sec": round(tokens_per_step / step_s),
+        "mfu": round(mfu, 4),
+    }
+
+
+# ---- flash attention vs XLA reference ------------------------------
+
+
+def flash_vs_xla(seq: int, batch: int = 2, heads: int = 8,
+                 kv_heads: int = 4, head_dim: int = 128,
+                 rounds: int = 6):
+    """Interleaved fwd+bwd timing: Pallas flash kernel vs the O(T^2)
+    einsum reference, same shapes, bf16."""
+    from kubeshare_tpu.ops.attention import attention, flash_attention
+
+    rng = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (batch, heads, seq, head_dim), jnp.bfloat16)
+    k = jax.random.normal(kk, (batch, kv_heads, seq, head_dim), jnp.bfloat16)
+    v = jax.random.normal(kv, (batch, kv_heads, seq, head_dim), jnp.bfloat16)
+
+    def make(fn):
+        @jax.jit
+        def fwd_bwd(q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(fn(q, k, v, True).astype(jnp.float32))
+            l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return jnp.sum(grads[0].astype(jnp.float32))  # scalar: fetchable
+        return fwd_bwd
+
+    flash = make(flash_attention)
+    ref = make(attention)
+    float(flash(q, k, v))  # compile; fetch = completion barrier
+    float(ref(q, k, v))
+
+    def chain(state, out):
+        q, k, v = state
+        # fold a hair of the output back in: dependency without drift
+        return (q + (out * 1e-6).astype(q.dtype), k, v)
+
+    ratios = []
+    state_f = state_r = (q, k, v)
+    for _ in range(rounds):
+        t_f, state_f = _timed_window(lambda s: flash(*s), state_f, chain, 3)
+        t_r, state_r = _timed_window(lambda s: ref(*s), state_r, chain, 3)
+        ratios.append(t_r / t_f)
+    return {
+        f"flash_attn_speedup_t{seq}": round(statistics.median(ratios), 3),
+    }
+
+
+# ---- chunked fused xent vs naive -----------------------------------
+
+
+def xent_vs_naive(seq: int, batch: int = 2, dim: int = 1024,
+                  vocab: int = 32000, rounds: int = 4):
+    """Fused chunked linear-cross-entropy (never materializes logits)
+    vs the naive dense [N, vocab] loss, fwd+bwd, bf16 operands."""
+    from kubeshare_tpu.ops.xent import chunked_linear_xent
+
+    n = batch * seq
+    rng = jax.random.PRNGKey(2)
+    kh, kw, kl = jax.random.split(rng, 3)
+    hidden = jax.random.normal(kh, (n, dim), jnp.bfloat16)
+    w = jax.random.normal(kw, (dim, vocab), jnp.bfloat16) * 0.02
+    labels = jax.random.randint(kl, (n,), 0, vocab, dtype=jnp.int32)
+
+    @jax.jit
+    def fused(hidden, w):
+        def loss(hidden, w):
+            return chunked_linear_xent(hidden, w, labels, 8192)
+        _, grads = jax.value_and_grad(loss, argnums=(0, 1))(hidden, w)
+        return jnp.sum(grads[0].astype(jnp.float32))
+
+    @jax.jit
+    def naive(hidden, w):
+        def loss(hidden, w):
+            logits = jnp.dot(
+                hidden, w, preferred_element_type=jnp.float32
+            )
+            logz = jax.scipy.special.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(
+                logits, labels[:, None], axis=-1
+            )[:, 0]
+            return jnp.mean(logz - picked)
+        _, grads = jax.value_and_grad(loss, argnums=(0, 1))(hidden, w)
+        return jnp.sum(grads[0].astype(jnp.float32))
+
+    float(fused(hidden, w))  # compile; fetch = completion barrier
+    float(naive(hidden, w))
+
+    def chain(state, out):
+        hidden, w = state
+        return (hidden + (out * 1e-6).astype(hidden.dtype), w)
+
+    ratios = []
+    state_f = state_n = (hidden, w)
+    for _ in range(rounds):
+        t_f, state_f = _timed_window(lambda s: fused(*s), state_f, chain, 3)
+        t_n, state_n = _timed_window(lambda s: naive(*s), state_n, chain, 3)
+        ratios.append(t_n / t_f)
+    return {
+        f"xent_speedup_t{seq}": round(statistics.median(ratios), 3),
+    }
+
+
+# ---- top level ------------------------------------------------------
+
+
+def run_all(log=print, budget_s: float = None) -> dict:
+    """All kernel benches under a wall budget: the driver runs bench.py
+    with a hard timeout, so a slow-compile day must degrade to fewer
+    kernel numbers, never to a dead bench. Benches run in MFU → flash
+    → xent order; whatever doesn't fit is skipped and flagged."""
+    import os
+
+    if budget_s is None:
+        budget_s = float(
+            os.environ.get("KUBESHARE_BENCH_KERNEL_BUDGET", "180")
+        )
+    out = {}
+    t0 = time.perf_counter()
+
+    def over():
+        return time.perf_counter() - t0 > budget_s
+
+    log("kernel bench: llama train-step MFU ...")
+    out.update(llama_train_mfu())
+    log(f"  {out['llama_params_millions']}M params, "
+        f"{out['llama_step_ms']}ms/step, MFU {out['mfu']:.1%}")
+    for seq in (2048, 4096):
+        if over():
+            out["kernel_bench_truncated"] = True
+            log("kernel bench: budget exhausted, skipping the rest")
+            return out
+        log(f"kernel bench: flash attention T={seq} ...")
+        out.update(flash_vs_xla(seq))
+        log(f"  speedup {out[f'flash_attn_speedup_t{seq}']}x vs XLA einsum")
+    for seq in (2048, 4096):
+        if over():
+            out["kernel_bench_truncated"] = True
+            log("kernel bench: budget exhausted, skipping the rest")
+            return out
+        log(f"kernel bench: chunked xent T={seq} ...")
+        out.update(xent_vs_naive(seq))
+        log(f"  speedup {out[f'xent_speedup_t{seq}']}x vs naive dense loss")
+    return out
+
+
+if __name__ == "__main__":
+    import json
+
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    print(json.dumps(run_all(log)))

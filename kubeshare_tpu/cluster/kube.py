@@ -360,6 +360,49 @@ class KubeCluster:
         if cached is not None and annotations:
             cached.annotations.update(annotations)
 
+    # ---- secrets + webhook config (certgen bootstrap) ---------------
+
+    def upsert_secret(self, namespace: str, name: str,
+                      data: Dict[str, bytes],
+                      secret_type: str = "Opaque") -> None:
+        """Create the secret, or replace its data if it exists."""
+        import base64
+
+        body = {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {"name": name, "namespace": namespace},
+            "type": secret_type,
+            "data": {
+                k: base64.b64encode(v).decode() for k, v in data.items()
+            },
+        }
+        base = f"/api/v1/namespaces/{namespace}/secrets"
+        try:
+            self._request("POST", base, body=body)
+        except KubeError as e:
+            if e.code != 409:
+                raise
+            self._request(
+                "PATCH", f"{base}/{name}", body=body,
+                content_type="application/strategic-merge-patch+json",
+            )
+
+    def patch_mutating_webhook_ca(self, config_name: str,
+                                  ca_bundle_b64: str,
+                                  webhook_index: int = 0) -> None:
+        self._request(
+            "PATCH",
+            "/apis/admissionregistration.k8s.io/v1/"
+            f"mutatingwebhookconfigurations/{config_name}",
+            body=[{
+                "op": "replace",
+                "path": f"/webhooks/{webhook_index}/clientConfig/caBundle",
+                "value": ca_bundle_b64,
+            }],
+            content_type="application/json-patch+json",
+        )
+
     # ---- coordination.k8s.io leases (leader election) ---------------
 
     def _lease_path(self, namespace: str, name: str = "") -> str:

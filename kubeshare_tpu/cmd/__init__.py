@@ -19,4 +19,5 @@ COMPONENTS = {
     "workload": "kubeshare_tpu.cmd.workload",
     "simulate": "kubeshare_tpu.cmd.simulate",
     "webhook": "kubeshare_tpu.cmd.webhook",
+    "certgen": "kubeshare_tpu.cmd.certgen",
 }

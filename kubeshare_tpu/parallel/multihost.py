@@ -112,30 +112,46 @@ def maybe_initialize(
 
 
 def hybrid_mesh(plan: Optional[MeshPlan] = None) -> Mesh:
-    """Mesh whose dp axis spans hosts (DCN) and whose remaining axes
-    stay within each host's ICI domain.
+    """Mesh whose dp axis spans ICI granules (over DCN) and whose
+    remaining axes stay within each granule's ICI domain.
 
-    ``plan`` describes the PER-HOST layout (dp = per-host data
-    parallelism, usually 1); the host count multiplies into dp. With one
-    process this is exactly ``make_mesh(plan)``.
+    ``plan`` describes the PER-GRANULE layout (dp = in-granule data
+    parallelism, usually 1); the granule count multiplies into dp. A
+    granule is a pod slice when devices report distinct slice_index
+    values (multi-slice training), else a process (CPU simulation,
+    single-slice). With one process this is exactly ``make_mesh(plan)``.
     """
     n_local = jax.local_device_count()
     n_hosts = jax.process_count()
+    # granule = the ICI-connected unit the dp/DCN axis spans. TPU pod
+    # slices carry a slice_index and one slice can span processes (the
+    # plan then describes a whole SLICE); CPU simulation and
+    # single-host slices don't, so the granule is the process there.
+    slice_ids = {
+        s for s in (
+            getattr(d, "slice_index", None) for d in jax.devices()
+        ) if s is not None
+    }
+    by_slice = len(slice_ids) > 1
+    n_granules = len(slice_ids) if by_slice else n_hosts
+    per_granule = jax.device_count() // max(n_granules, 1)
     if plan is None:
-        plan = MeshPlan(tp=n_local) if n_local > 1 else MeshPlan()
-    if plan.total != n_local:
+        plan = MeshPlan(tp=per_granule) if per_granule > 1 else MeshPlan()
+    if plan.total != per_granule:
+        unit = "slice" if by_slice else "host"
         raise ValueError(
-            f"per-host plan {plan.shape} needs {plan.total} devices, "
-            f"host has {n_local}"
+            f"per-{unit} plan {plan.shape} needs {plan.total} devices, "
+            f"{unit} has {per_granule}"
         )
-    if n_hosts == 1:
+    if n_granules == 1 and n_hosts == 1:
         return make_mesh(plan)
 
     from jax.experimental import mesh_utils
 
     ici_shape = plan.shape
-    dcn_shape = (n_hosts,) + (1,) * (len(ici_shape) - 1)  # dp is axis 0
+    dcn_shape = (n_granules,) + (1,) * (len(ici_shape) - 1)  # dp is axis 0
     devices = mesh_utils.create_hybrid_device_mesh(
-        ici_shape, dcn_mesh_shape=dcn_shape, devices=jax.devices()
+        ici_shape, dcn_mesh_shape=dcn_shape, devices=jax.devices(),
+        process_is_granule=not by_slice,
     )
     return Mesh(devices, plan.axis_names)

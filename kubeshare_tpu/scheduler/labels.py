@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import enum
 import math
+import re
 from dataclasses import dataclass, field
 from typing import Optional, Tuple
 
@@ -26,6 +27,13 @@ from ..cluster.api import Pod
 from . import constants as C
 
 _EPS = 1e-9
+# digits with optional decimal part — the reference's valueFormat regex
+# (pod.go:249, `len(format) != len(label)` rejects any extra chars).
+# Stricter than float(): "1e3", "nan", "inf", "+0.5" are all label
+# errors, and NaN must never reach the limit/request comparisons.
+# re.ASCII: the Go reference's \d is ASCII-only; without it Python
+# matches Unicode digits that float() happily parses.
+_NUM_RE = re.compile(r"\d+(\.\d+)?", re.ASCII)
 
 
 class PodKind(enum.Enum):
@@ -70,10 +78,9 @@ class PodRequirements:
 
 
 def _parse_float(pod: Pod, label: str, raw: str) -> float:
-    try:
-        value = float(raw)
-    except ValueError as e:
-        raise LabelError(f"pod {pod.key}: {label}={raw!r} is not a number") from e
+    if _NUM_RE.fullmatch(raw) is None:
+        raise LabelError(f"pod {pod.key}: {label}={raw!r} is not a number")
+    value = float(raw)
     if value < 0:
         raise LabelError(f"pod {pod.key}: {label}={raw!r} must be >= 0")
     return value

@@ -1,0 +1,112 @@
+"""Worker subprocess for the two-process jax.distributed e2e test.
+
+Launched by tests/test_multihost_e2e.py with webhook-shaped gang env
+(JAX_COORDINATOR_ADDRESS + KUBESHARE_GROUP_HEADCOUNT, process id
+derived from the StatefulSet-style hostname ordinal). Bootstraps the
+distributed backend through ``maybe_initialize`` — the exact path a
+gang pod takes — then proves cross-process collectives and a hybrid
+dp-over-DCN x tp-over-ICI train step, and writes results as JSON for
+the parent to cross-check.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    out_path = os.environ["MULTIHOST_OUT"]
+    hostname = os.environ["MULTIHOST_HOSTNAME"]  # e.g. gang-worker-1
+
+    # the site TPU plugin (axon) force-selects itself over the
+    # JAX_PLATFORMS env var; the config override is authoritative
+    # (same dance as tests/conftest.py)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from kubeshare_tpu.parallel.multihost import maybe_initialize
+
+    spec = maybe_initialize(hostname=hostname)
+    assert spec is not None, "gang env did not produce a DistSpec"
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == spec.num_processes
+    assert jax.process_index() == spec.process_id
+
+    # 1. cross-process collective: allgather each process's id
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        jnp.array([float(spec.process_id)])
+    )
+
+    # 2. hybrid mesh: dp spans the two processes (DCN), tp stays local
+    from kubeshare_tpu.parallel.mesh import MeshPlan
+    from kubeshare_tpu.parallel.multihost import hybrid_mesh
+    from kubeshare_tpu.parallel.train import make_sharded_train_step
+
+    n_local = jax.local_device_count()
+    mesh = hybrid_mesh(MeshPlan(tp=n_local))
+    assert mesh.shape["dp"] == spec.num_processes
+    assert mesh.shape["tp"] == n_local
+
+    # identical params on every process (same seed)
+    rng = jax.random.PRNGKey(7)
+    k1, k2 = jax.random.split(rng)
+    params = {
+        "w1": jax.random.normal(k1, (16, 32), jnp.float32) * 0.1,
+        "w2": jax.random.normal(k2, (32, 4), jnp.float32) * 0.1,
+    }
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = jnp.tanh(x @ params["w1"])
+        logits = h @ params["w2"]
+        return jnp.mean((logits - y) ** 2)
+
+    step, params, opt_state = make_sharded_train_step(
+        loss_fn, params, mesh, learning_rate=1e-2,
+        # tiny test params: no use sharding 16x32 over fsdp
+        fsdp=False,
+    )
+
+    # global batch of 8 rows sharded over dp: each process contributes
+    # its local half, built with the public global-array API
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    g = np.random.RandomState(123)  # same on both: global batch defined once
+    full_x = g.randn(8, 16).astype(np.float32)
+    full_y = g.randn(8, 4).astype(np.float32)
+    half = 8 // spec.num_processes
+    lo = spec.process_id * half
+    x = jax.make_array_from_process_local_data(
+        batch_sharding, full_x[lo:lo + half], global_shape=(8, 16)
+    )
+    y = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), full_y[lo:lo + half],
+        global_shape=(8, 4),
+    )
+
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, (x, y))
+        losses.append(float(loss))
+
+    with open(out_path, "w") as f:
+        json.dump({
+            "process_id": spec.process_id,
+            "num_processes": spec.num_processes,
+            "device_count": jax.device_count(),
+            "gathered": [float(v) for v in np.asarray(gathered).ravel()],
+            "mesh_shape": dict(mesh.shape),
+            "losses": losses,
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

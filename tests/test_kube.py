@@ -18,6 +18,7 @@ class StubApiServer:
         self.pods = {}    # (ns, name) -> k8s object dict
         self.nodes = {}   # name -> k8s object dict
         self.leases = {}  # (ns, name) -> Lease dict (resourceVersion'd)
+        self.secrets = {}  # (ns, name) -> Secret dict
         self.bindings = []
         self.patches = []
         self.auth_headers = []
@@ -146,6 +147,19 @@ class StubApiServer:
                             "resourceVersion"
                         ] = str(stub._rv)
                         stub.leases[(ns, name)] = body
+                    self._send(body, code=201)
+                    return
+                if self.path.rstrip("/").endswith("/secrets"):
+                    parts = [p for p in self.path.split("/") if p]
+                    ns = parts[3]
+                    name = (body.get("metadata") or {}).get("name", "")
+                    with stub._lock:
+                        if (ns, name) in stub.secrets:
+                            self._send(
+                                {"message": "already exists"}, code=409
+                            )
+                            return
+                        stub.secrets[(ns, name)] = body
                     self._send(body, code=201)
                     return
                 if self.path.endswith("/binding"):

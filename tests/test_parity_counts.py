@@ -1,0 +1,31 @@
+"""Enforce the corpus/suite size claims PARITY.md makes, so the doc
+can reference floors instead of quoting numbers that rot
+(VERDICT r1 weak #7)."""
+
+import glob
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def test_corpus_floor_matches_reference_scale():
+    # the reference's validation corpus is 76 YAMLs (SURVEY §4); ours
+    # must stay at that scale
+    yamls = glob.glob(
+        os.path.join(REPO, "workloads", "**", "*.yaml"), recursive=True
+    )
+    assert len(yamls) >= 70, f"corpus shrank to {len(yamls)} files"
+
+
+def test_suite_floor():
+    # cheap proxy for collected-test count (pytest --collect-only is
+    # slow here): test functions/methods defined under tests/
+    n = 0
+    for path in glob.glob(os.path.join(HERE, "test_*.py")):
+        with open(path) as f:
+            n += sum(
+                1 for line in f
+                if line.lstrip().startswith("def test_")
+            )
+    assert n >= 300, f"test-function count fell to {n}"

@@ -1,0 +1,124 @@
+"""Every row of the reference validation table, both directions.
+
+Parametrizes over tests/validation_matrix.py's MATRIX (the pod.go:
+240-327 table enumerated) at two levels: parse (labels -> requirements
+or LabelError) and full scheduling cycle (valid rows must bind/wait/
+park transiently; reject rows must park permanently). Also pins the
+generated workloads/matrix/ corpus to the same table so the two can't
+drift.
+"""
+
+import os
+
+import pytest
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.labels import LabelError, PodKind, parse_pod
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+from validation_matrix import MATRIX, generate, pod_yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOPO = {
+    "cell_types": {
+        "v5e-node": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 8,
+            "child_cell_priority": 50,
+            "is_node_level": True,
+        },
+    },
+    "cells": [{"cell_type": "v5e-node", "cell_id": "node-a"}],
+}
+
+
+def mk_pod(name, labels):
+    return Pod(
+        name=name,
+        labels={C.DOMAIN + k: v for k, v in labels.items()},
+        scheduler_name=C.SCHEDULER_NAME,
+    )
+
+
+@pytest.mark.parametrize(
+    "row_id,labels,expect", MATRIX, ids=[r[0] for r in MATRIX]
+)
+def test_parse_direction(row_id, labels, expect):
+    import re
+
+    pod = mk_pod(row_id, labels)
+    if expect[0] == "reject":
+        with pytest.raises(LabelError, match=re.escape(expect[1])):
+            parse_pod(pod)
+        return
+    req = parse_pod(pod)
+    if expect[0] == "regular":
+        assert req.kind == PodKind.REGULAR
+    elif expect[0] == "shared":
+        assert req.kind == PodKind.SHARED
+        assert req.limit == expect[1] and req.request == expect[2]
+    elif expect[0] == "multi":
+        assert req.kind == PodKind.MULTI_CHIP
+        assert req.chip_count == expect[1]
+    if "tpu_model" in labels:
+        assert req.model == labels["tpu_model"]
+    if "priority" in labels:
+        assert req.priority == int(labels["priority"])
+        assert req.is_guarantee == (int(labels["priority"]) > 0)
+    if "group_name" in labels and "group_threshold" in labels:
+        assert req.gang is not None and req.gang.name == labels["group_name"]
+
+
+@pytest.mark.parametrize(
+    "row_id,labels,expect", MATRIX, ids=[r[0] for r in MATRIX]
+)
+def test_cycle_direction(row_id, labels, expect):
+    cluster = FakeCluster()
+    cluster.add_node(
+        "node-a",
+        [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 << 30, i)
+         for i in range(8)],
+    )
+    engine = TpuShareScheduler(topology=TOPO, cluster=cluster)
+    pod = cluster.create_pod(mk_pod(row_id, labels))
+    decision = engine.schedule_one(pod)
+    if expect[0] == "reject":
+        assert decision.status == "unschedulable"
+        assert not decision.retryable  # permanent: a requeue can't fix labels
+    elif expect[0] == "regular":
+        assert decision.status == "bound"  # regular pods bind anywhere
+    elif "group_name" in labels and "group_threshold" in labels:
+        # gang of N with one member present: barrier, or parked as a
+        # TRANSIENT shortfall (membership may still arrive) — never a
+        # permanent reject
+        assert decision.status in ("bound", "waiting", "unschedulable")
+        if decision.status == "unschedulable":
+            assert decision.retryable, decision.message
+    else:
+        assert decision.status == "bound", decision.message
+
+
+class TestGeneratedCorpus:
+    def test_matrix_corpus_in_sync(self, tmp_path):
+        """workloads/matrix/ must be exactly what the generator emits —
+        regenerate with `python tests/validation_matrix.py` after
+        editing the MATRIX."""
+        out = tmp_path / "matrix"
+        names = generate(str(out))
+        on_disk = sorted(os.listdir(os.path.join(REPO, "workloads", "matrix")))
+        assert sorted(names) == on_disk
+        for name in names:
+            want = (out / name).read_text()
+            got = open(
+                os.path.join(REPO, "workloads", "matrix", name)
+            ).read()
+            assert got == want, f"{name} drifted from the generator"
+
+    def test_invalid_marker_matches_expectation(self):
+        for row_id, labels, expect in MATRIX:
+            text = pod_yaml(row_id, labels, expect)
+            assert text.startswith("# INVALID") == (expect[0] == "reject")

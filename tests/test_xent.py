@@ -41,6 +41,21 @@ class TestChunkedXent:
         got = chunked_linear_xent(hidden, w, labels, chunk)
         assert abs(float(ref) - float(got)) < 1e-5
 
+    def test_auto_chunk(self):
+        from kubeshare_tpu.ops.xent import _tile_plan
+
+        # default (chunk=0) auto-sizes and stays correct
+        hidden, w, labels = make_case()
+        ref = naive(hidden, w, labels)
+        got = chunked_linear_xent(hidden, w, labels)
+        assert abs(float(ref) - float(got)) < 1e-5
+        # policy: ~512MB f32 tile budget, power of two, floor 2048,
+        # never past the vocab
+        assert _tile_plan(32000, 0, 16384)[0] == 8192
+        assert _tile_plan(32000, 0, 1 << 20)[0] == 2048
+        assert _tile_plan(32000, 0, 1024)[0] == 32000
+        assert _tile_plan(1000, 0, 24)[0] == 1000
+
     @pytest.mark.parametrize("chunk", [16, 40])
     def test_grads_match_naive(self, chunk):
         hidden, w, labels = make_case()

@@ -1,0 +1,206 @@
+"""The reference's admission-validation matrix, enumerated.
+
+One row per combination of the pod.go:240-327 validation table
+(request:limit value classes x kind x memory x pinning x priority x
+gang), in BOTH directions — accept rows state the expected parse
+result, reject rows the expected error. The reference spreads this
+matrix over its 76-file test corpus (test/mnist/mnist1.yaml ladder,
+test/OpportunisticPod/pod11..16, ...); here it is one table consumed
+twice: tests/test_validation_matrix.py parametrizes over it, and
+workloads/matrix/*.yaml is generated from it (same file, kept in sync
+by a test).
+
+Row fields: (row_id, labels, expect) where expect is
+  ("regular",)                      parse -> kind REGULAR
+  ("shared", limit, request)        parse -> SHARED with those values
+  ("multi", chips)                  parse -> MULTI_CHIP, chip_count
+  ("reject", substr)                parse -> LabelError matching substr
+"""
+
+# ---- the matrix ----------------------------------------------------
+
+GIB = 1 << 30
+
+MATRIX = [
+    # -- no labels / zero: regular ----------------------------------
+    ("regular-none", {}, ("regular",)),
+    ("regular-zero-zero", {"tpu_limit": "0.0", "tpu_request": "0.0"},
+     ("regular",)),
+    ("regular-zero-limit-only", {"tpu_limit": "0"}, ("regular",)),
+
+    # -- fractional (limit <= 1.0): 0 <= request <= limit -----------
+    ("shared-limit-only", {"tpu_limit": "0.5"}, ("shared", 0.5, 0.0)),
+    ("shared-under", {"tpu_limit": "1.0", "tpu_request": "0.3"},
+     ("shared", 1.0, 0.3)),
+    ("shared-half", {"tpu_limit": "1.0", "tpu_request": "0.5"},
+     ("shared", 1.0, 0.5)),
+    ("shared-equal", {"tpu_limit": "0.5", "tpu_request": "0.5"},
+     ("shared", 0.5, 0.5)),
+    ("shared-whole", {"tpu_limit": "1.0", "tpu_request": "1"},
+     ("shared", 1.0, 1.0)),
+    ("shared-int-limit", {"tpu_limit": "1", "tpu_request": "0.2"},
+     ("shared", 1.0, 0.2)),
+    ("shared-tiny", {"tpu_limit": "0.2", "tpu_request": "0.1"},
+     ("shared", 0.2, 0.1)),
+    ("shared-mem", {"tpu_limit": "1.0", "tpu_request": "0.3",
+                    "tpu_mem": str(3 * GIB)}, ("shared", 1.0, 0.3)),
+    ("shared-mem-zero", {"tpu_limit": "0.5", "tpu_mem": "0"},
+     ("shared", 0.5, 0.0)),
+
+    # -- multi-chip (limit > 1.0): integer, request == limit --------
+    ("multi-two", {"tpu_limit": "2.0", "tpu_request": "2.0"}, ("multi", 2)),
+    ("multi-two-intstr", {"tpu_limit": "2", "tpu_request": "2"},
+     ("multi", 2)),
+    ("multi-four-mem", {"tpu_limit": "4", "tpu_request": "4",
+                        "tpu_mem": str(8 * GIB)}, ("multi", 4)),
+
+    # -- model pinning ----------------------------------------------
+    ("pinned-shared", {"tpu_limit": "0.5", "tpu_request": "0.5",
+                       "tpu_model": "tpu-v5e"}, ("shared", 0.5, 0.5)),
+    ("pinned-multi", {"tpu_limit": "2", "tpu_request": "2",
+                      "tpu_model": "tpu-v5e"}, ("multi", 2)),
+
+    # -- priority ----------------------------------------------------
+    ("prio-guarantee", {"tpu_limit": "0.5", "tpu_request": "0.5",
+                        "priority": "100"}, ("shared", 0.5, 0.5)),
+    ("prio-floor", {"tpu_limit": "0.5", "priority": "1"},
+     ("shared", 0.5, 0.0)),
+    ("prio-zero-opportunistic", {"tpu_limit": "0.5", "priority": "0"},
+     ("shared", 0.5, 0.0)),
+
+    # -- gang cross-products -----------------------------------------
+    ("gang-shared", {"tpu_limit": "1.0", "tpu_request": "0.5",
+                     "group_name": "g1", "group_headcount": "2",
+                     "group_threshold": "1.0"}, ("shared", 1.0, 0.5)),
+    ("gang-multi", {"tpu_limit": "2", "tpu_request": "2",
+                    "group_name": "g2", "group_headcount": "3",
+                    "group_threshold": "0.67"}, ("multi", 2)),
+    ("gang-incomplete-solo", {"tpu_limit": "0.5", "group_name": "g3"},
+     ("shared", 0.5, 0.0)),  # incomplete gang degrades to solo
+
+    # ================ reject direction ==============================
+    # -- missing limit ----------------------------------------------
+    ("bad-request-only", {"tpu_request": "0.5"}, ("reject", "must set")),
+    ("bad-mem-only", {"tpu_mem": str(GIB)}, ("reject", "must set")),
+
+    # -- request:limit pair errors (the mnist ladder) ---------------
+    ("bad-request-over-limit", {"tpu_limit": "0.5", "tpu_request": "1.0"},
+     ("reject", "exceeds limit")),
+    ("bad-request-over-limit-frac", {"tpu_limit": "0.3",
+                                     "tpu_request": "0.4"},
+     ("reject", "exceeds limit")),
+    ("bad-multi-fractional", {"tpu_limit": "1.5", "tpu_request": "1.5"},
+     ("reject", "integer")),
+    ("bad-multi-mismatch", {"tpu_limit": "3.0", "tpu_request": "2.0"},
+     ("reject", "request == limit")),
+    ("bad-multi-limit-only", {"tpu_limit": "2.0"},
+     ("reject", "request == limit")),
+    ("bad-multi-request-over", {"tpu_limit": "2", "tpu_request": "3"},
+     ("reject", "request == limit")),
+
+    # -- malformed values (valueFormat regex, pod.go:249) -----------
+    ("bad-limit-garbage", {"tpu_limit": "abc"}, ("reject", "not a number")),
+    ("bad-limit-suffix", {"tpu_limit": "0.5x"}, ("reject", "not a number")),
+    ("bad-limit-negative", {"tpu_limit": "-0.5"},
+     ("reject", "not a number")),
+    ("bad-limit-scinot", {"tpu_limit": "1e3"}, ("reject", "not a number")),
+    # Unicode digits: float() parses them, the reference's ASCII regex
+    # does not — must reject
+    ("bad-limit-unicode", {"tpu_limit": "١٢"},
+     ("reject", "not a number")),
+    ("bad-limit-nan", {"tpu_limit": "nan"}, ("reject", "not a number")),
+    ("bad-limit-inf", {"tpu_limit": "inf"}, ("reject", "not a number")),
+    ("bad-request-garbage", {"tpu_limit": "1.0", "tpu_request": "lots"},
+     ("reject", "not a number")),
+    ("bad-request-negative", {"tpu_limit": "1.0", "tpu_request": "-1"},
+     ("reject", "not a number")),
+    ("bad-mem-garbage", {"tpu_limit": "1.0", "tpu_mem": "lots"},
+     ("reject", "not an integer")),
+    ("bad-mem-fractional", {"tpu_limit": "1.0", "tpu_mem": "1.5"},
+     ("reject", "not an integer")),
+    ("bad-mem-negative", {"tpu_limit": "1.0", "tpu_mem": "-1"},
+     ("reject", ">= 0")),
+
+    # -- priority out of range / malformed --------------------------
+    ("bad-prio-over", {"tpu_limit": "0.5", "priority": "101"},
+     ("reject", "0..100")),
+    ("bad-prio-negative", {"tpu_limit": "0.5", "priority": "-2"},
+     ("reject", "0..100")),
+    ("bad-prio-garbage", {"tpu_limit": "0.5", "priority": "high"},
+     ("reject", "not an integer")),
+
+    # -- gang label errors ------------------------------------------
+    ("bad-gang-headcount-zero", {"tpu_limit": "0.5", "group_name": "g",
+                                 "group_headcount": "0",
+                                 "group_threshold": "0.5"},
+     ("reject", ">= 1")),
+    ("bad-gang-threshold-over", {"tpu_limit": "0.5", "group_name": "g",
+                                 "group_headcount": "2",
+                                 "group_threshold": "1.5"},
+     ("reject", "(0, 1]")),
+    ("bad-gang-threshold-zero", {"tpu_limit": "0.5", "group_name": "g",
+                                 "group_headcount": "2",
+                                 "group_threshold": "0"},
+     ("reject", "(0, 1]")),
+    ("bad-gang-garbage", {"tpu_limit": "0.5", "group_name": "g",
+                          "group_headcount": "two",
+                          "group_threshold": "0.5"},
+     ("reject", "malformed")),
+]
+
+
+# ---- corpus generation ---------------------------------------------
+
+
+def pod_yaml(row_id: str, labels: dict, expect: tuple) -> str:
+    """One workload manifest for this row, reference-corpus shaped
+    (a sleep container, as in test/mnist/mnist1.yaml)."""
+    lines = []
+    if expect[0] == "reject":
+        lines.append(f"# INVALID {expect[1]}")
+    lines += [
+        f"# generated from tests/validation_matrix.py row {row_id!r}",
+        "apiVersion: v1",
+        "kind: Pod",
+        "metadata:",
+        f"  name: matrix-{row_id}",
+    ]
+    if labels:
+        lines.append("  labels:")
+        for k, v in labels.items():
+            lines.append(f'    "sharedtpu/{k}": "{v}"')
+    lines += [
+        "spec:",
+        "  schedulerName: kubeshare-tpu-scheduler",
+        "  containers:",
+        "    - name: sleep",
+        "      image: busybox",
+        '      command: ["sleep", "86400"]',
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def generate(out_dir: str) -> list:
+    """Write the whole matrix as workload YAMLs; returns file names."""
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    names = []
+    for row_id, labels, expect in MATRIX:
+        name = f"{row_id}.yaml"
+        with open(os.path.join(out_dir, name), "w") as f:
+            f.write(pod_yaml(row_id, labels, expect))
+        names.append(name)
+    return names
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "workloads", "matrix",
+    )
+    for name in generate(out):
+        print(name)

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Scheduler-engine performance floor: placements/sec at cluster scale.
+
+Runs the virtual-clock simulator (no JAX, no chips, pure engine hot
+path: PreFilter -> Filter over all nodes -> Score -> Reserve -> bind)
+over a synthetic Poisson trace at 32 and 128 nodes and writes
+ENGINE_BENCH.json at the repo root. tests/test_engine_bench.py asserts
+a regression floor against a fresh in-process run, and that this
+artifact stays in sync with the tool.
+
+Regenerate: ``make engine-bench`` (or ``python tools/engine_bench.py``).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kubeshare_tpu.sim.simulator import Simulator  # noqa: E402
+from kubeshare_tpu.sim.trace import generate_trace  # noqa: E402
+from kubeshare_tpu.utils.trace import Tracer  # noqa: E402
+
+CHIPS_PER_NODE = 4
+EVENTS = 2000
+
+
+def topology(n_nodes: int) -> dict:
+    return {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": CHIPS_PER_NODE,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": f"node-{i:03d}"}
+            for i in range(n_nodes)
+        ],
+    }
+
+
+def run(n_nodes: int, events: int = EVENTS, seed: int = 0) -> dict:
+    trace = generate_trace(count=events, seed=seed)
+    tracer = Tracer(keep_events=False)
+    sim = Simulator(
+        topology(n_nodes),
+        {f"node-{i:03d}": CHIPS_PER_NODE for i in range(n_nodes)},
+        seed=seed,
+        tracer=tracer,
+    )
+    wall0 = time.perf_counter()
+    report = sim.run(trace)
+    wall = time.perf_counter() - wall0
+    attempts = tracer.histograms.get("prefilter")
+    return {
+        "nodes": n_nodes,
+        "chips": n_nodes * CHIPS_PER_NODE,
+        "events": events,
+        "bound": report.bound,
+        "wall_seconds": round(wall, 3),
+        "placements_per_sec": round(report.bound / wall, 1),
+        "schedule_attempts_per_sec": round(
+            (attempts.count if attempts else 0) / wall, 1
+        ),
+    }
+
+
+def main() -> None:
+    results = [run(32), run(128)]
+    doc = {
+        "generated_by": "tools/engine_bench.py",
+        "note": "virtual-clock simulator; engine hot path only "
+                "(no apiserver, no JAX). Regression floors asserted by "
+                "tests/test_engine_bench.py.",
+        "results": results,
+    }
+    out = os.path.join(REPO, "ENGINE_BENCH.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    for r in results:
+        print(
+            f"{r['nodes']:4d} nodes: {r['placements_per_sec']:,.0f} "
+            f"placements/s, {r['schedule_attempts_per_sec']:,.0f} "
+            f"attempts/s"
+        )
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
