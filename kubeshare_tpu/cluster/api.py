@@ -107,6 +107,12 @@ class ClusterAPI(Protocol):
         """Merge annotations and per-container env into the pod."""
         ...
 
+    def evict(self, pod_key: str) -> None:
+        """Evict a pod (defrag): the controller recreates it and it
+        reschedules. Kube adapter uses the Eviction subresource so
+        PodDisruptionBudgets are honored."""
+        ...
+
     def on_pod_event(
         self, add: Callable[[Pod], None], delete: Callable[[Pod], None]
     ) -> None:

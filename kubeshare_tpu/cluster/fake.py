@@ -24,6 +24,7 @@ class FakeCluster:
         self._pod_delete_handlers: List[Callable[[Pod], None]] = []
         self._node_handlers: List[Callable[[Node], None]] = []
         self._uid_counter = itertools.count(1)
+        self.evictions: List[str] = []  # defrag evict() calls, in order
 
     # ---- ClusterAPI ------------------------------------------------
 
@@ -94,6 +95,12 @@ class FakeCluster:
         for handler in self._pod_add_handlers:
             handler(pod)
         return pod
+
+    def evict(self, pod_key: str) -> None:
+        """Defrag eviction: synchronous delete (handlers fire now, as
+        an informer would deliver eventually); recorded for tests."""
+        self.evictions.append(pod_key)
+        self.delete_pod(pod_key)
 
     def delete_pod(self, key: str) -> Optional[Pod]:
         pod = self._pods.pop(key, None)

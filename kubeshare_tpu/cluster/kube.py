@@ -360,6 +360,20 @@ class KubeCluster:
         if cached is not None and annotations:
             cached.annotations.update(annotations)
 
+    def evict(self, pod_key: str) -> None:
+        """policy/v1 Eviction subresource — honors PDBs; a 429 (blocked
+        by budget) surfaces as KubeError for the engine to log+skip."""
+        namespace, _, name = pod_key.partition("/")
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
+            body={
+                "apiVersion": "policy/v1",
+                "kind": "Eviction",
+                "metadata": {"name": name, "namespace": namespace},
+            },
+        )
+
     # ---- secrets + webhook config (certgen bootstrap) ---------------
 
     def upsert_secret(self, namespace: str, name: str,

@@ -125,6 +125,12 @@ class SnapshotCluster:
             for container in pod.containers:
                 container.env.update(env)
 
+    def evict(self, pod_key: str) -> None:
+        pod = self._pods.pop(pod_key, None)
+        if pod is not None:
+            for handler in self._pod_delete:
+                handler(pod)
+
     def on_pod_event(self, add, delete) -> None:
         self._pod_add.append(add)
         self._pod_delete.append(delete)

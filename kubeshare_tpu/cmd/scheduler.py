@@ -74,6 +74,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="gang barrier base timeout, multiplied by headcount",
     )
     parser.add_argument(
+        "--defrag", action="store_true",
+        help="evict-to-fit: when a GUARANTEE pod fits nowhere, evict "
+             "the cheapest set of opportunistic (non-gang) pods whose "
+             "removal provably opens a slot (their controllers "
+             "recreate them); kube mode uses the Eviction subresource "
+             "so PDBs are honored",
+    )
+    parser.add_argument(
+        "--defrag-max-victims", type=int, default=2,
+        help="eviction cap per defrag attempt",
+    )
+    parser.add_argument(
         "--leader-elect", action="store_true",
         help="--kube mode: run Lease-based leader election "
              "(coordination.k8s.io); non-leaders stand by, so multiple "
@@ -327,6 +339,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         permit_wait_base=args.permit_wait_base,
         log=log,
         tracer=tracer,
+        defrag=args.defrag,
+        defrag_max_victims=args.defrag_max_victims,
     )
     elector = None
     if args.leader_elect:

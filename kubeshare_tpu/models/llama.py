@@ -198,9 +198,12 @@ def _attend_cached(q, k_cache, v_cache, length, num_heads, num_kv_heads):
     batch, _, tq, hd = q.shape
     max_s = k_cache.shape[2]
     qg = q.reshape(batch, num_kv_heads, groups, tq, hd)
+    # matmul operands stay bf16 (f32 accumulation via
+    # preferred_element_type) — an f32 upcast would halve the MXU rate
+    # in the decode hot path; softmax math is f32
     scores = jnp.einsum(
-        "bkgtd,bksd->bkgts", qg.astype(jnp.float32),
-        k_cache.astype(jnp.float32),
+        "bkgtd,bksd->bkgts", qg, k_cache.astype(qg.dtype),
+        preferred_element_type=jnp.float32,
     ) / (hd ** 0.5)
     # position s is visible to query t (absolute pos length-tq+t) iff
     # s <= that absolute position and s < length
@@ -210,7 +213,8 @@ def _attend_cached(q, k_cache, v_cache, length, num_heads, num_kv_heads):
     scores = jnp.where(mask, scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bkgts,bksd->bkgtd", weights, v_cache.astype(jnp.float32)
+        "bkgts,bksd->bkgtd", weights.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
     )
     return out.reshape(batch, num_heads, tq, hd)
 

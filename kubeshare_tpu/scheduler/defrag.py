@@ -1,0 +1,217 @@
+"""Opportunistic defragmentation: evict-to-fit for guarantee pods.
+
+The layer SURVEY.md §7 plans on top of the reference semantics
+("time-slicing fairness, gangs, ... opportunistic defrag ... layer on
+after"): spread-scored opportunistic pods fragment chips — 0.4 free
+here, 0.3 there — until a guarantee pod that would fit in aggregate
+fits nowhere. Kubernetes schedulers can't migrate, so consolidation is
+controlled EVICTION: delete an opportunistic pod (its controller
+recreates it; it reschedules into the remaining space) to open a
+contiguous slot for the guarantee pod.
+
+Policy, mirroring kube-scheduler preemption where it maps:
+- only GUARANTEE (priority >= 1) pending pods trigger defrag;
+- only BOUND, opportunistic (priority 0), non-gang pods are victims
+  (evicting one gang member cascades a whole-group restart);
+- victims are chosen on ONE leaf/node, smallest displaced request
+  first, and only when the eviction provably opens a fit — no
+  speculative eviction;
+- the engine enforces a per-pod cooldown and a per-attempt victim cap
+  (plugin.py), so a pod that still can't bind doesn't evict the
+  cluster dry.
+
+Pure functions over the engine's cell tree + status store; the plugin
+wires them to the cluster's evict verb.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from ..cells.cell import Cell, CellTree
+from .labels import PodKind, PodRequirements
+from .scoring import _resolved_memory
+from .state import PodState, PodStatus, PodStatusStore
+
+
+@dataclass
+class DefragPlan:
+    node: str
+    victims: List[str]          # pod keys, eviction order
+    displaced: float            # total displaced request (plan score)
+
+
+@dataclass
+class _Occupant:
+    status: PodStatus
+    cap: float   # capacity this pod holds ON THIS LEAF (1.0 for a
+                 # multi-chip occupant — not its whole-pod request)
+    mem: int     # HBM held on this leaf
+
+
+def _victims_by_leaf(
+    tree: CellTree, status_store: PodStatusStore,
+) -> Dict[str, List[_Occupant]]:
+    """leaf uuid -> evictable BOUND occupants (opportunistic, solo),
+    with PER-LEAF occupancy (a multi-chip pod holds each of its leaves
+    whole; summing its total request per leaf would be wrong in both
+    directions)."""
+    out: Dict[str, List[_Occupant]] = {}
+    for status in status_store.values():
+        if status.state != PodState.BOUND:
+            continue
+        if status.requirements.priority > 0:
+            continue  # guarantee pods are never victims
+        if status.group_key:
+            continue  # gang members are never victims
+        multi = status.requirements.kind == PodKind.MULTI_CHIP
+        for uuid in status.uuids:
+            leaf = tree.leaf_cells.get(uuid)
+            if multi:
+                cap, mem = 1.0, (leaf.full_memory if leaf else 0)
+            else:
+                cap, mem = status.requirements.request, status.memory
+            out.setdefault(uuid, []).append(_Occupant(status, cap, mem))
+    return out
+
+
+def _select_victims(
+    occupants: List[_Occupant], cap_gap: float, mem_gap: int,
+    max_victims: int,
+) -> Optional[List[_Occupant]]:
+    """Cheapest victim set closing both gaps within the cap.
+
+    Two candidate strategies (this is an approximation, not a subset-
+    sum solve): greedy smallest-first, and the single smallest victim
+    that closes both gaps alone (catches the case where greedy
+    accumulates several small pods past max_victims while one bigger
+    pod would have sufficed). Returns the valid set displacing least.
+    """
+    ordered = sorted(occupants, key=lambda o: (o.cap, o.status.key))
+    candidates: List[List[_Occupant]] = []
+
+    greedy: List[_Occupant] = []
+    freed_cap, freed_mem = 0.0, 0
+    for occ in ordered:
+        if freed_cap >= cap_gap and freed_mem >= mem_gap:
+            break
+        greedy.append(occ)
+        freed_cap += occ.cap
+        freed_mem += occ.mem
+    if (
+        greedy
+        and len(greedy) <= max_victims
+        and freed_cap >= cap_gap
+        and freed_mem >= mem_gap
+    ):
+        candidates.append(greedy)
+
+    for occ in ordered:  # smallest single closing both gaps
+        if occ.cap >= cap_gap and occ.mem >= mem_gap:
+            candidates.append([occ])
+            break
+
+    if not candidates:
+        return None
+    return min(candidates, key=lambda c: sum(o.cap for o in c))
+
+
+def _plan_shared(
+    tree: CellTree,
+    node: str,
+    req: PodRequirements,
+    by_leaf: Dict[str, List[_Occupant]],
+    max_victims: int,
+) -> Optional[DefragPlan]:
+    best: Optional[DefragPlan] = None
+    for leaf in tree.scan_bound_leaves(node):
+        if not leaf.healthy:
+            continue
+        if req.model and leaf.leaf_cell_type != req.model:
+            continue
+        mem_need = _resolved_memory(leaf, req)
+        cap_gap = req.request - leaf.available
+        mem_gap = mem_need - leaf.free_memory
+        if cap_gap <= 0 and mem_gap <= 0:
+            return None  # already fits — defrag is not the problem
+        chosen = _select_victims(
+            by_leaf.get(leaf.uuid, []), cap_gap, mem_gap, max_victims
+        )
+        if chosen is None:
+            continue  # leaf can't be cleared enough; no blind eviction
+        plan = DefragPlan(
+            node=node,
+            victims=[o.status.key for o in chosen],
+            displaced=sum(o.cap for o in chosen),
+        )
+        if best is None or plan.displaced < best.displaced:
+            best = plan
+    return best
+
+
+def _plan_multi_chip(
+    tree: CellTree,
+    node: str,
+    req: PodRequirements,
+    by_leaf: Dict[str, List[_Occupant]],
+    max_victims: int,
+) -> Optional[DefragPlan]:
+    need = req.chip_count
+    leaves = [l for l in tree.scan_bound_leaves(node) if l.healthy]
+    if req.model:
+        leaves = [l for l in leaves if l.leaf_cell_type == req.model]
+    whole_free = sum(1 for l in leaves if l.is_whole_free)
+    if whole_free >= need:
+        return None  # fits without eviction
+    # leaves fully occupied by evictable pods only, cheapest first
+    clearable: List[tuple] = []
+    for leaf in leaves:
+        if leaf.is_whole_free:
+            continue
+        occupants = by_leaf.get(leaf.uuid, [])
+        occ_cap = sum(o.cap for o in occupants)  # per-leaf occupancy
+        # all capacity in use on this leaf must belong to evictable
+        # pods, or clearing them won't make it whole-free
+        if occupants and abs((1.0 - leaf.available) - occ_cap) < 1e-9:
+            clearable.append((occ_cap, leaf.uuid, occupants))
+    clearable.sort(key=lambda t: (t[0], t[1]))
+    missing = need - whole_free
+    if len(clearable) < missing:
+        return None
+    victims: List[str] = []
+    displaced = 0.0
+    seen = set()
+    for occ_cap, _, occupants in clearable[:missing]:
+        displaced += occ_cap
+        for occ in occupants:
+            if occ.status.key not in seen:
+                seen.add(occ.status.key)
+                victims.append(occ.status.key)
+    if not victims or len(victims) > max_victims:
+        return None
+    return DefragPlan(node=node, victims=victims, displaced=displaced)
+
+
+def find_plan(
+    tree: CellTree,
+    status_store: PodStatusStore,
+    nodes: Sequence[str],
+    req: PodRequirements,
+    max_victims: int = 2,
+) -> Optional[DefragPlan]:
+    """Cheapest provable evict-to-fit plan across nodes, or None."""
+    if req.kind == PodKind.REGULAR:
+        return None
+    by_leaf = _victims_by_leaf(tree, status_store)
+    if not by_leaf:
+        return None
+    planner = (
+        _plan_multi_chip if req.kind == PodKind.MULTI_CHIP else _plan_shared
+    )
+    best: Optional[DefragPlan] = None
+    for node in sorted(nodes):
+        plan = planner(tree, node, req, by_leaf, max_victims)
+        if plan and (best is None or plan.displaced < best.displaced):
+            best = plan
+    return best

@@ -75,6 +75,9 @@ class TpuShareScheduler:
         permit_wait_base: float = C.PERMIT_WAIT_BASE_SECONDS,
         log=None,
         tracer: Optional[Tracer] = None,
+        defrag: bool = False,
+        defrag_max_victims: int = 2,
+        defrag_cooldown: float = 30.0,
     ):
         cfg = (
             topology
@@ -95,6 +98,12 @@ class TpuShareScheduler:
         self._waiting: Dict[str, Dict[str, _Waiting]] = {}  # group_key -> pods
         self._synced_nodes: Set[str] = set()
         self._bound_queue: Dict[str, List[Pod]] = {}  # node -> pods to resync
+
+        self.defrag = defrag
+        self.defrag_max_victims = defrag_max_victims
+        self.defrag_cooldown = defrag_cooldown
+        self.defrag_evictions = 0
+        self._defrag_last: Dict[str, float] = {}  # pending pod -> last attempt
 
         cluster.on_pod_event(self._on_pod_add, self._on_pod_delete)
         cluster.on_node_event(self._on_node_update)
@@ -130,6 +139,7 @@ class TpuShareScheduler:
         self._waiting = {}
         self._synced_nodes = set()
         self._bound_queue = {}
+        self._defrag_last = {}
         for node in self.cluster.list_nodes():
             self._on_node_update(node)
         for pod in self.cluster.list_pods():
@@ -189,6 +199,7 @@ class TpuShareScheduler:
             self._bound_queue.setdefault(pod.node_name, []).append(pod)
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        self._defrag_last.pop(pod.key, None)
         self.groups.forget_pod(pod.key)
         status = self.status.pop(pod.key)
         if status is not None:
@@ -481,6 +492,16 @@ class TpuShareScheduler:
                 elif reason:
                     reasons.append(reason)
         if not feasible:
+            evicted = self._maybe_defrag(pod, req, nodes)
+            if evicted:
+                return Decision(
+                    "unschedulable", pod.key, retryable=True,
+                    message=(
+                        "defrag: evicted "
+                        + ",".join(evicted)
+                        + "; requeued"
+                    ),
+                )
             return Decision(
                 "unschedulable", pod.key, message="; ".join(reasons) or "no nodes"
             )
@@ -529,6 +550,51 @@ class TpuShareScheduler:
             message=f"gang barrier, timeout {extra}s",
         )
 
+    def _maybe_defrag(self, pod: Pod, req, nodes) -> List[str]:
+        """Evict-to-fit for a guarantee pod no node can place (see
+        scheduler/defrag.py for the policy). Returns the evicted pod
+        keys ([] = no defrag happened)."""
+        if not self.defrag or not req.is_guarantee:
+            return []
+        now = self.clock()
+        last = self._defrag_last.get(pod.key)
+        if last is not None and now - last < self.defrag_cooldown:
+            return []  # this pod already cost evictions recently
+        from .defrag import find_plan
+
+        plan = find_plan(
+            self.tree, self.status, [n.name for n in nodes], req,
+            max_victims=self.defrag_max_victims,
+        )
+        if plan is None:
+            return []
+        self._defrag_last[pod.key] = now
+        evicted = []
+        for victim in plan.victims:
+            try:
+                self.cluster.evict(victim)
+            except Exception as e:
+                # PDB-blocked / apiserver error: the plan can no longer
+                # open the fit, so evicting the REST would be pure
+                # disruption — stop here ("no speculative eviction")
+                self.log.error(
+                    "defrag evict %s: %s; abandoning plan", victim, e
+                )
+                break
+            # Accounting is NOT released here: the victim frees its
+            # chip only when it actually terminates (grace period), and
+            # the informer's DELETED event releases it then — binding
+            # the guarantee pod before that would double-book HBM.
+            # (kube-scheduler preemption waits the same way.)
+            self.defrag_evictions += 1
+            evicted.append(victim)
+        if evicted:
+            self.log.info(
+                "defrag for %s on %s: evicted %s",
+                pod.key, plan.node, ",".join(evicted),
+            )
+        return evicted
+
     def tick(self) -> List[str]:
         """Expire gang barriers. Returns keys of rejected pods (they
         re-enter the queue)."""
@@ -550,7 +616,12 @@ class TpuShareScheduler:
         the pod-manager port pool headroom. The reference exposes no
         view of its cell tree at all — fragmentation was only
         observable by reading scheduler logs."""
-        samples: List[expfmt.Sample] = []
+        samples: List[expfmt.Sample] = [
+            expfmt.Sample(
+                "tpu_scheduler_defrag_evictions_total", {},
+                self.defrag_evictions,
+            )
+        ]
         for node in self.tree.nodes():
             # non-caching read: this runs on the metrics HTTP thread,
             # which must not write the scheduling thread's leaf cache

@@ -1,0 +1,288 @@
+"""Opportunistic defragmentation (evict-to-fit) — the layer SURVEY §7
+plans beyond the reference ("opportunistic defrag ... layer on after").
+Spread-scored opportunistic pods fragment chips; a guarantee pod that
+fits in aggregate but nowhere contiguous triggers a provable, minimal
+eviction of opportunistic pods."""
+
+import pytest
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+GIB = 1 << 30
+
+TOPO = {
+    "cell_types": {
+        "v5e-node": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 2,
+            "child_cell_priority": 50,
+            "is_node_level": True,
+        },
+    },
+    "cells": [{"cell_type": "v5e-node", "cell_id": "node-a"}],
+}
+
+
+def mk_pod(name, request, limit=None, priority=0, gang=None):
+    labels = {
+        C.LABEL_TPU_REQUEST: str(request),
+        C.LABEL_TPU_LIMIT_ALIASES[1]: str(limit if limit is not None
+                                          else max(1.0, request)),
+    }
+    if priority:
+        labels[C.LABEL_PRIORITY] = str(priority)
+    if gang:
+        labels[C.LABEL_GROUP_NAME] = gang[0]
+        labels[C.LABEL_GROUP_HEADCOUNT] = str(gang[1])
+        labels[C.LABEL_GROUP_THRESHOLD] = "1.0"
+    return Pod(name=name, labels=labels, scheduler_name=C.SCHEDULER_NAME)
+
+
+def make_env(defrag=True, chips=2, **kw):
+    cluster = FakeCluster()
+    cluster.add_node(
+        "node-a",
+        [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 * GIB, i)
+         for i in range(chips)],
+    )
+    engine = TpuShareScheduler(TOPO, cluster, defrag=defrag, **kw)
+    return cluster, engine
+
+
+def fragment(cluster, engine):
+    """Two 0.6 opportunistic pods: spread scoring puts one per chip,
+    leaving 0.4 + 0.4 free — 0.8 in aggregate, nowhere contiguous."""
+    for name in ("opp-1", "opp-2"):
+        pod = cluster.create_pod(mk_pod(name, 0.6))
+        decision = engine.schedule_one(pod)
+        assert decision.status == "bound"
+    frees = sorted(
+        l.available for l in engine.tree.scan_bound_leaves("node-a")
+    )
+    assert frees == pytest.approx([0.4, 0.4])
+
+
+class TestDefrag:
+    def test_guarantee_pod_triggers_minimal_eviction(self):
+        cluster, engine = make_env()
+        fragment(cluster, engine)
+        hero = cluster.create_pod(mk_pod("hero", 0.8, priority=50))
+        decision = engine.schedule_one(hero)
+        assert decision.status == "unschedulable" and decision.retryable
+        assert "defrag" in decision.message
+        assert len(cluster.evictions) == 1  # minimal: one 0.6 suffices
+        assert engine.defrag_evictions == 1
+        # the freed slot now fits the guarantee pod
+        decision = engine.schedule_one(hero)
+        assert decision.status == "bound", decision.message
+
+    def test_opportunistic_pod_never_triggers(self):
+        cluster, engine = make_env()
+        fragment(cluster, engine)
+        pod = cluster.create_pod(mk_pod("more-opp", 0.8))  # priority 0
+        decision = engine.schedule_one(pod)
+        assert decision.status == "unschedulable"
+        assert cluster.evictions == []
+
+    def test_guarantee_pods_never_victims(self):
+        cluster, engine = make_env()
+        for name in ("g-1", "g-2"):
+            pod = cluster.create_pod(mk_pod(name, 0.6, priority=80))
+            assert engine.schedule_one(pod).status == "bound"
+        hero = cluster.create_pod(mk_pod("hero", 0.8, priority=90))
+        decision = engine.schedule_one(hero)
+        assert decision.status == "unschedulable"
+        assert "defrag" not in decision.message
+        assert cluster.evictions == []
+
+    def test_gang_members_never_victims(self):
+        cluster, engine = make_env()
+        for name in ("gm-1", "gm-2"):
+            cluster.create_pod(mk_pod(name, 0.6, gang=("g", 2)))
+        for pod in list(cluster.list_pods()):
+            engine.schedule_one(pod)
+        assert all(p.is_bound for p in cluster.list_pods())
+        hero = cluster.create_pod(mk_pod("hero", 0.8, priority=50))
+        decision = engine.schedule_one(hero)
+        assert decision.status == "unschedulable"
+        assert cluster.evictions == []
+
+    def test_disabled_by_default(self):
+        cluster, engine = make_env(defrag=False)
+        fragment(cluster, engine)
+        hero = cluster.create_pod(mk_pod("hero", 0.8, priority=50))
+        decision = engine.schedule_one(hero)
+        assert decision.status == "unschedulable"
+        assert "defrag" not in decision.message
+        assert cluster.evictions == []
+
+    def test_cooldown_limits_repeat_evictions(self):
+        now = {"t": 0.0}
+        cluster, engine = make_env(clock=lambda: now["t"])
+        fragment(cluster, engine)
+        # a pod that keeps failing for a NON-capacity reason after the
+        # first eviction must not keep evicting: the hero pod asks for
+        # more memory than any chip has
+        hero = cluster.create_pod(
+            mk_pod("hero", 0.8, priority=50)
+        )
+        hero.labels[C.LABEL_TPU_MEMORY] = str(64 * GIB)  # > chip HBM
+        d1 = engine.schedule_one(hero)
+        assert cluster.evictions == []  # memory can never fit: no plan
+        # now a fittable pod evicts once, then cools down
+        hero2 = cluster.create_pod(mk_pod("hero2", 0.8, priority=50))
+        d = engine.schedule_one(hero2)
+        assert "defrag" in d.message and len(cluster.evictions) == 1
+        # pretend the bind keeps failing; within cooldown: no more
+        engine.status.pop("default/hero2")
+        cluster.create_pod(mk_pod("opp-3", 0.6))
+        [opp3] = [p for p in cluster.list_pods() if p.name == "opp-3"]
+        engine.schedule_one(opp3)
+        now["t"] = 5.0
+        d = engine.schedule_one(hero2)
+        assert len(cluster.evictions) == 1  # cooldown held
+        now["t"] = 60.0
+        d = engine.schedule_one(hero2)
+        assert len(cluster.evictions) >= 1
+
+    def test_no_pointless_partial_eviction(self):
+        """If clearing every victim still can't open a fit, evict
+        nothing."""
+        cluster, engine = make_env()
+        fragment(cluster, engine)
+        giant = cluster.create_pod(mk_pod("giant", 3.0, 3.0, priority=50))
+        decision = engine.schedule_one(giant)
+        assert decision.status == "unschedulable"
+        assert cluster.evictions == []
+
+    def test_multi_chip_clears_whole_leaves(self):
+        cluster, engine = make_env(chips=2)
+        # two small opportunistic pods, one per chip
+        fragment(cluster, engine)
+        hero = cluster.create_pod(mk_pod("hero", 2.0, 2.0, priority=50))
+        decision = engine.schedule_one(hero)
+        assert decision.status == "unschedulable" and decision.retryable
+        assert "defrag" in decision.message
+        assert len(cluster.evictions) == 2  # both chips cleared
+        decision = engine.schedule_one(hero)
+        assert decision.status == "bound", decision.message
+
+
+class TestVictimSelection:
+    def test_single_large_victim_beats_greedy_overflow(self):
+        """Greedy smallest-first would need 3 victims (0.1+0.3+0.6);
+        the single 0.6 alone closes the 0.55 gap within the cap."""
+        cluster, engine = make_env(chips=1)
+        for name, frac in (("a", 0.1), ("b", 0.3), ("c", 0.6)):
+            pod = cluster.create_pod(mk_pod(name, frac))
+            assert engine.schedule_one(pod).status == "bound"
+        # chip: 0.0 free; hero needs 0.55 -> gap 0.55
+        hero = cluster.create_pod(mk_pod("hero", 0.55, 1.0, priority=50))
+        decision = engine.schedule_one(hero)
+        assert "defrag" in decision.message
+        assert cluster.evictions == ["default/c"]  # the one 0.6, alone
+        assert engine.schedule_one(hero).status == "bound"
+
+    def test_multi_chip_opportunistic_occupant_is_clearable(self):
+        """A priority-0 multi-chip pod holds each leaf WHOLE; per-leaf
+        occupancy (1.0) — not its total request — must satisfy the
+        clearable check."""
+        cluster, engine = make_env(chips=2)
+        opp = cluster.create_pod(mk_pod("opp-multi", 2.0, 2.0))
+        assert engine.schedule_one(opp).status == "bound"
+        hero = cluster.create_pod(mk_pod("hero", 2.0, 2.0, priority=50))
+        decision = engine.schedule_one(hero)
+        assert "defrag" in decision.message
+        assert cluster.evictions == ["default/opp-multi"]
+        assert engine.schedule_one(hero).status == "bound"
+
+    def test_eviction_failure_abandons_plan(self):
+        """A PDB-blocked first eviction must not take the remaining
+        victims down for nothing."""
+
+        class BlockingCluster(FakeCluster):
+            def __init__(self):
+                super().__init__()
+                self.attempts = []
+
+            def evict(self, pod_key):
+                self.attempts.append(pod_key)
+                raise RuntimeError("blocked by PodDisruptionBudget")
+
+        cluster = BlockingCluster()
+        cluster.add_node(
+            "node-a",
+            [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 * GIB, i)
+             for i in range(2)],
+        )
+        engine = TpuShareScheduler(TOPO, cluster, defrag=True)
+        fragment(cluster, engine)
+        hero = cluster.create_pod(mk_pod("hero", 2.0, 2.0, priority=50))
+        decision = engine.schedule_one(hero)
+        assert decision.status == "unschedulable"
+        assert len(cluster.attempts) == 1  # stopped at the first failure
+        assert engine.defrag_evictions == 0
+
+
+class TestDefragOverKube:
+    def test_evict_to_fit_via_eviction_subresource(self):
+        """Full path over HTTP: engine + KubeCluster against the stub
+        apiserver; the defrag eviction goes through the PDB-aware
+        policy/v1 Eviction subresource, and the freed slot binds the
+        guarantee pod on the next pass."""
+        from test_kube import StubApiServer, make_cluster
+
+        stub = StubApiServer()
+        try:
+            stub.add_node("node-a")
+            for i, name in enumerate(("opp-1", "opp-2")):
+                stub.add_pod(name, uid=f"u{i}", labels={
+                    "sharedtpu/tpu_request": "0.6",
+                    "sharedtpu/tpu_limit": "1.0",
+                })
+            chips = [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 * GIB, i)
+                     for i in range(2)]
+            cluster = make_cluster(stub)
+            engine = TpuShareScheduler(
+                TOPO, cluster, inventory=lambda node: chips, defrag=True,
+            )
+            cluster.poll()
+            for pod in list(cluster.list_pods()):
+                assert engine.schedule_one(pod).status == "bound"
+            stub.add_pod("hero", uid="uh", labels={
+                "sharedtpu/tpu_request": "0.8",
+                "sharedtpu/tpu_limit": "1.0",
+                "sharedtpu/priority": "50",
+            })
+            cluster.poll()
+            [hero] = [p for p in cluster.list_pods() if p.name == "hero"]
+            decision = engine.schedule_one(hero)
+            assert "defrag" in decision.message
+            assert len(stub.evictions) == 1
+            cluster.poll()  # the victim's deletion flows back in
+            decision = engine.schedule_one(hero)
+            assert decision.status == "bound", decision.message
+        finally:
+            stub.stop()
+
+
+class TestDefragCli:
+    def test_flag_wires_through(self, tmp_path):
+        import yaml
+
+        from kubeshare_tpu.cmd import scheduler as scheduler_cmd
+
+        topo = tmp_path / "topo.yaml"
+        topo.write_text(yaml.safe_dump(TOPO))
+        state = tmp_path / "state.json"
+        state.write_text('{"nodes": [], "pods": []}')
+        args = scheduler_cmd.build_parser().parse_args([
+            "--topology", str(topo),
+            "--cluster-state", str(state),
+            "--defrag", "--defrag-max-victims", "3",
+        ])
+        assert args.defrag and args.defrag_max_victims == 3

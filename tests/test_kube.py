@@ -19,6 +19,7 @@ class StubApiServer:
         self.nodes = {}   # name -> k8s object dict
         self.leases = {}  # (ns, name) -> Lease dict (resourceVersion'd)
         self.secrets = {}  # (ns, name) -> Secret dict
+        self.evictions = []  # pod keys POSTed to the eviction subresource
         self.bindings = []
         self.patches = []
         self.auth_headers = []
@@ -161,6 +162,16 @@ class StubApiServer:
                             return
                         stub.secrets[(ns, name)] = body
                     self._send(body, code=201)
+                    return
+                if self.path.endswith("/eviction"):
+                    parts = [p for p in self.path.split("/") if p]
+                    with stub._lock:
+                        pod = stub.pods.pop((parts[3], parts[5]), None)
+                        if pod is None:
+                            self._send({"message": "not found"}, code=404)
+                            return
+                        stub.evictions.append(f"{parts[3]}/{parts[5]}")
+                    self._send({}, code=201)
                     return
                 if self.path.endswith("/binding"):
                     parts = [p for p in self.path.split("/") if p]
