@@ -113,6 +113,13 @@ class ClusterAPI(Protocol):
         PodDisruptionBudgets are honored."""
         ...
 
+    def post_event(self, pod_key: str, reason: str, message: str,
+                   event_type: str = "Normal") -> None:
+        """Record a v1 Event against the pod (``kubectl describe pod``
+        visibility — Scheduled / FailedScheduling / DefragEvicted).
+        Best-effort: adapters must not raise from here."""
+        ...
+
     def on_pod_event(
         self, add: Callable[[Pod], None], delete: Callable[[Pod], None]
     ) -> None:

@@ -25,6 +25,7 @@ class FakeCluster:
         self._node_handlers: List[Callable[[Node], None]] = []
         self._uid_counter = itertools.count(1)
         self.evictions: List[str] = []  # defrag evict() calls, in order
+        self.events: List[tuple] = []   # post_event records
 
     # ---- ClusterAPI ------------------------------------------------
 
@@ -101,6 +102,10 @@ class FakeCluster:
         an informer would deliver eventually); recorded for tests."""
         self.evictions.append(pod_key)
         self.delete_pod(pod_key)
+
+    def post_event(self, pod_key: str, reason: str, message: str,
+                   event_type: str = "Normal") -> None:
+        self.events.append((pod_key, reason, message, event_type))
 
     def delete_pod(self, key: str) -> Optional[Pod]:
         pod = self._pods.pop(key, None)

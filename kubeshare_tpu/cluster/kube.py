@@ -30,11 +30,13 @@ Prometheus-backed ``getGPUByNode`` (pkg/scheduler/gpu.go:22-53).
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import queue
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
@@ -253,6 +255,8 @@ class KubeCluster:
         self._pod_rv = ""
         self._node_rv = ""
         self._watch_expired = False
+        self._event_sent: Dict[tuple, float] = {}  # dedup (see post_event)
+        self._event_errors = 0
 
     # ---- HTTP plumbing ---------------------------------------------
 
@@ -359,6 +363,70 @@ class KubeCluster:
         cached = self._pods.get(pod_key)
         if cached is not None and annotations:
             cached.annotations.update(annotations)
+
+    def post_event(self, pod_key: str, reason: str, message: str,
+                   event_type: str = "Normal") -> None:
+        """Best-effort v1 Event. Client-side dedup: the same
+        (pod, reason, message) within 60s is suppressed — a transiently
+        unschedulable pod is re-examined every pass and must not write
+        an Event per tick the way the apiserver-side count aggregation
+        would eventually throttle anyway."""
+        now = time.time()
+        dedup_key = (pod_key, reason, message)
+        last = self._event_sent.get(dedup_key, 0.0)
+        if now - last < 60.0:
+            return
+        if len(self._event_sent) > 4096:  # bound the dedup cache
+            cutoff = now - 120.0
+            self._event_sent = {
+                k: t for k, t in self._event_sent.items() if t > cutoff
+            }
+        namespace, _, name = pod_key.partition("/")
+        stamp = (
+            datetime.datetime.now(datetime.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%SZ")
+        )
+        pod = self._pods.get(pod_key)
+        try:
+            self._request(
+                "POST",
+                f"/api/v1/namespaces/{namespace}/events",
+                body={
+                    "apiVersion": "v1",
+                    "kind": "Event",
+                    "metadata": {
+                        "generateName": f"{name}.",
+                        "namespace": namespace,
+                    },
+                    "involvedObject": {
+                        "apiVersion": "v1",
+                        "kind": "Pod",
+                        "name": name,
+                        "namespace": namespace,
+                        "uid": pod.uid if pod is not None else "",
+                    },
+                    "reason": reason,
+                    "message": message,
+                    "type": event_type,
+                    "source": {"component": "kubeshare-tpu-scheduler"},
+                    "firstTimestamp": stamp,
+                    "lastTimestamp": stamp,
+                    "count": 1,
+                },
+            )
+            # dedup-stamp only AFTER a successful send: a transient
+            # apiserver error must not suppress a one-shot event (e.g.
+            # a pod's single Scheduled) for the whole window
+            self._event_sent[dedup_key] = now
+        except KubeError as e:
+            # observability must never break scheduling
+            self._event_errors += 1
+            if self._event_errors <= 3:
+                import logging
+
+                logging.getLogger("kubeshare.kube").warning(
+                    "event post failed: %s", e
+                )
 
     def evict(self, pod_key: str) -> None:
         """policy/v1 Eviction subresource — honors PDBs; a 429 (blocked

@@ -131,6 +131,10 @@ class SnapshotCluster:
             for handler in self._pod_delete:
                 handler(pod)
 
+    def post_event(self, pod_key, reason, message,
+                   event_type="Normal") -> None:
+        pass  # snapshot mode has no event store
+
     def on_pod_event(self, add, delete) -> None:
         self._pod_add.append(add)
         self._pod_delete.append(delete)

@@ -283,11 +283,14 @@ def _run_pass_inner(engine, cluster, journal, metrics, started,
     ]
     pending.sort(key=engine.queue_sort_key)
     acted = 0
+    post = getattr(cluster, "post_event", None)
     for pod in pending:
         if guard is not None and not guard():
             break  # leadership lapsed mid-pass; stop binding NOW
         decision = engine.schedule_one(pod)
         acted += 1
+        if post is not None:
+            _post_decision_event(post, decision)
         if metrics is not None:
             metrics.record(decision)
         if journal is not None:
@@ -308,6 +311,34 @@ def _run_pass_inner(engine, cluster, journal, metrics, started,
     if metrics is not None:
         metrics.record_pass(time.monotonic() - started, acted)
     return acted
+
+
+def _post_decision_event(post, decision) -> None:
+    """kubectl-describe visibility, mirroring the stock kube-scheduler
+    (Scheduled / FailedScheduling); the kube adapter dedups repeats.
+    Best-effort: event plumbing must never fail a pass."""
+    try:
+        if decision.status == "bound":
+            post(
+                decision.pod_key, "Scheduled",
+                f"Successfully assigned {decision.pod_key} to "
+                f"{decision.node}",
+            )
+            for member in decision.bound_with:
+                post(
+                    member, "Scheduled",
+                    f"Successfully assigned {member} (gang with "
+                    f"{decision.pod_key})",
+                )
+        elif decision.status == "waiting":
+            post(decision.pod_key, "WaitingForGang", decision.message)
+        elif decision.status == "unschedulable":
+            post(
+                decision.pod_key, "FailedScheduling", decision.message,
+                "Warning",
+            )
+    except Exception:
+        pass
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

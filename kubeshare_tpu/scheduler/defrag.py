@@ -52,6 +52,7 @@ class _Occupant:
 
 def _victims_by_leaf(
     tree: CellTree, status_store: PodStatusStore,
+    excluded: Optional[set] = None,
 ) -> Dict[str, List[_Occupant]]:
     """leaf uuid -> evictable BOUND occupants (opportunistic, solo),
     with PER-LEAF occupancy (a multi-chip pod holds each of its leaves
@@ -60,6 +61,11 @@ def _victims_by_leaf(
     out: Dict[str, List[_Occupant]] = {}
     for status in status_store.values():
         if status.state != PodState.BOUND:
+            continue
+        if excluded and status.key in excluded:
+            # already evicted (termination in flight) or recently
+            # eviction-blocked (PDB): planning over these either
+            # double-evicts or retries a known-failing plan forever
             continue
         if status.requirements.priority > 0:
             continue  # guarantee pods are never victims
@@ -161,6 +167,11 @@ def _plan_multi_chip(
     leaves = [l for l in tree.scan_bound_leaves(node) if l.healthy]
     if req.model:
         leaves = [l for l in leaves if l.leaf_cell_type == req.model]
+    # memory feasibility first (mirrors filtering.multi_chip_fit):
+    # even with every chip cleared, total HBM caps what the pod can
+    # ask — eviction can never fix an impossible memory request
+    if req.memory > sum(l.full_memory for l in leaves):
+        return None
     whole_free = sum(1 for l in leaves if l.is_whole_free)
     if whole_free >= need:
         return None  # fits without eviction
@@ -181,6 +192,7 @@ def _plan_multi_chip(
         return None
     victims: List[str] = []
     displaced = 0.0
+    freed_mem = 0
     seen = set()
     for occ_cap, _, occupants in clearable[:missing]:
         displaced += occ_cap
@@ -188,7 +200,12 @@ def _plan_multi_chip(
             if occ.status.key not in seen:
                 seen.add(occ.status.key)
                 victims.append(occ.status.key)
+                freed_mem += occ.mem
     if not victims or len(victims) > max_victims:
+        return None
+    # the plan must also open enough HBM on the node cell
+    # (filtering.multi_chip_fit checks free_memory >= req.memory)
+    if req.memory > sum(l.free_memory for l in leaves) + freed_mem:
         return None
     return DefragPlan(node=node, victims=victims, displaced=displaced)
 
@@ -199,11 +216,14 @@ def find_plan(
     nodes: Sequence[str],
     req: PodRequirements,
     max_victims: int = 2,
+    excluded: Optional[set] = None,
 ) -> Optional[DefragPlan]:
-    """Cheapest provable evict-to-fit plan across nodes, or None."""
+    """Cheapest provable evict-to-fit plan across nodes, or None.
+    ``excluded`` pod keys are never victims (in-flight evictions,
+    PDB-blocked pods)."""
     if req.kind == PodKind.REGULAR:
         return None
-    by_leaf = _victims_by_leaf(tree, status_store)
+    by_leaf = _victims_by_leaf(tree, status_store, excluded)
     if not by_leaf:
         return None
     planner = (

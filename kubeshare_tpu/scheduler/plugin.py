@@ -104,6 +104,11 @@ class TpuShareScheduler:
         self.defrag_cooldown = defrag_cooldown
         self.defrag_evictions = 0
         self._defrag_last: Dict[str, float] = {}  # pending pod -> last attempt
+        # victims excluded from replanning: eviction accepted but pod
+        # still terminating (cleared by its informer delete), or
+        # eviction REFUSED (PDB) — blocked until the stamp expires
+        self._defrag_inflight: Set[str] = set()
+        self._defrag_blocked: Dict[str, float] = {}  # victim -> until
 
         cluster.on_pod_event(self._on_pod_add, self._on_pod_delete)
         cluster.on_node_event(self._on_node_update)
@@ -140,6 +145,8 @@ class TpuShareScheduler:
         self._synced_nodes = set()
         self._bound_queue = {}
         self._defrag_last = {}
+        self._defrag_inflight = set()
+        self._defrag_blocked = {}
         for node in self.cluster.list_nodes():
             self._on_node_update(node)
         for pod in self.cluster.list_pods():
@@ -200,6 +207,7 @@ class TpuShareScheduler:
 
     def _on_pod_delete(self, pod: Pod) -> None:
         self._defrag_last.pop(pod.key, None)
+        self._defrag_inflight.discard(pod.key)  # eviction completed
         self.groups.forget_pod(pod.key)
         status = self.status.pop(pod.key)
         if status is not None:
@@ -562,9 +570,17 @@ class TpuShareScheduler:
             return []  # this pod already cost evictions recently
         from .defrag import find_plan
 
+        excluded = set(self._defrag_inflight)
+        excluded.update(
+            k for k, until in self._defrag_blocked.items() if until > now
+        )
+        if len(self._defrag_blocked) > 256:
+            self._defrag_blocked = {
+                k: u for k, u in self._defrag_blocked.items() if u > now
+            }
         plan = find_plan(
             self.tree, self.status, [n.name for n in nodes], req,
-            max_victims=self.defrag_max_victims,
+            max_victims=self.defrag_max_victims, excluded=excluded,
         )
         if plan is None:
             return []
@@ -576,7 +592,10 @@ class TpuShareScheduler:
             except Exception as e:
                 # PDB-blocked / apiserver error: the plan can no longer
                 # open the fit, so evicting the REST would be pure
-                # disruption — stop here ("no speculative eviction")
+                # disruption — stop here ("no speculative eviction"),
+                # and block this victim so the next attempt plans
+                # AROUND it instead of retrying the same refusal
+                self._defrag_blocked[victim] = now + 300.0
                 self.log.error(
                     "defrag evict %s: %s; abandoning plan", victim, e
                 )
@@ -587,7 +606,19 @@ class TpuShareScheduler:
             # the guarantee pod before that would double-book HBM.
             # (kube-scheduler preemption waits the same way.)
             self.defrag_evictions += 1
+            self._defrag_inflight.add(victim)
             evicted.append(victim)
+            post = getattr(self.cluster, "post_event", None)
+            if post is not None:
+                try:
+                    post(
+                        victim, "DefragEvicted",
+                        f"evicted to defragment capacity for guarantee "
+                        f"pod {pod.key}",
+                        "Warning",
+                    )
+                except Exception:
+                    pass  # best-effort observability
         if evicted:
             self.log.info(
                 "defrag for %s on %s: evicted %s",

@@ -228,6 +228,77 @@ class TestVictimSelection:
         assert engine.defrag_evictions == 0
 
 
+class TestExclusions:
+    def test_blocked_victim_is_planned_around(self):
+        """A PDB-refused victim must not be retried forever: the next
+        attempt (post-cooldown) plans around it."""
+
+        class PdbCluster(FakeCluster):
+            blocked = "default/opp-1"
+
+            def evict(self, pod_key):
+                if pod_key == self.blocked:
+                    raise RuntimeError("blocked by PodDisruptionBudget")
+                super().evict(pod_key)
+
+        now = {"t": 0.0}
+        cluster = PdbCluster()
+        cluster.add_node(
+            "node-a",
+            [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 * GIB, i)
+             for i in range(2)],
+        )
+        engine = TpuShareScheduler(
+            TOPO, cluster, defrag=True, clock=lambda: now["t"]
+        )
+        fragment(cluster, engine)
+        hero = cluster.create_pod(mk_pod("hero", 0.8, priority=50))
+        d1 = engine.schedule_one(hero)
+        assert d1.status == "unschedulable"
+        assert cluster.evictions == []  # opp-1 chosen, refused, abandoned
+        now["t"] = 60.0  # past the pod cooldown; opp-1 still blocked
+        d2 = engine.schedule_one(hero)
+        assert cluster.evictions == ["default/opp-2"]  # planned around
+        assert engine.schedule_one(hero).status == "bound"
+
+    def test_inflight_victim_not_reevicted(self):
+        """Kube mode: eviction accepted but the pod terminates with a
+        grace period (still BOUND until the informer DELETE). A second
+        guarantee pod must not re-plan over it."""
+
+        class DeferredCluster(FakeCluster):
+            def evict(self, pod_key):
+                self.evictions.append(pod_key)  # no synchronous delete
+
+        cluster = DeferredCluster()
+        cluster.add_node(
+            "node-a",
+            [ChipInfo("node-a-chip-0", "tpu-v5e", 16 * GIB, 0)],
+        )
+        engine = TpuShareScheduler(TOPO, cluster, defrag=True)
+        opp = cluster.create_pod(mk_pod("opp", 0.6))
+        assert engine.schedule_one(opp).status == "bound"
+        hero_a = cluster.create_pod(mk_pod("hero-a", 0.8, priority=50))
+        engine.schedule_one(hero_a)
+        assert cluster.evictions == ["default/opp"]
+        hero_b = cluster.create_pod(mk_pod("hero-b", 0.8, priority=60))
+        engine.schedule_one(hero_b)
+        assert cluster.evictions == ["default/opp"]  # no double-evict
+        # the informer delete completes the eviction and frees the slot
+        cluster.delete_pod("default/opp")
+        assert "default/opp" not in engine._defrag_inflight
+        assert engine.schedule_one(hero_a).status == "bound"
+
+    def test_multi_chip_impossible_memory_never_evicts(self):
+        cluster, engine = make_env(chips=2)
+        fragment(cluster, engine)
+        hero = cluster.create_pod(mk_pod("hero", 2.0, 2.0, priority=50))
+        hero.labels[C.LABEL_TPU_MEMORY] = str(48 * GIB)  # > 2x16GiB
+        decision = engine.schedule_one(hero)
+        assert decision.status == "unschedulable"
+        assert cluster.evictions == []  # eviction can never fix memory
+
+
 class TestDefragOverKube:
     def test_evict_to_fit_via_eviction_subresource(self):
         """Full path over HTTP: engine + KubeCluster against the stub

@@ -1,0 +1,92 @@
+"""Kubernetes Event emission (kubectl-describe visibility).
+
+The reference inherits Scheduled/FailedScheduling events from the
+stock kube-scheduler framework (its RBAC grants events create,
+deploy/scheduler.yaml); the standalone rebuild posts them through the
+cluster adapter with client-side dedup."""
+
+import json
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.kube import KubeCluster
+from kubeshare_tpu.cmd import scheduler as scheduler_cmd
+from kubeshare_tpu.metrics.collector import Collector, FakeChipBackend
+
+from test_kube import TOPO_YAML, make_cluster, stub  # noqa: F401
+
+
+class TestPostEvent:
+    def test_event_shape(self, stub):
+        cluster = make_cluster(stub)
+        stub.add_pod("p1", uid="u-77")
+        cluster.poll()  # warm the pod cache so the event carries the uid
+        cluster.post_event(
+            "default/p1", "Scheduled", "assigned to node-a"
+        )
+        [ev] = stub.events_posted
+        assert ev["involvedObject"] == {
+            "apiVersion": "v1", "kind": "Pod", "name": "p1",
+            "namespace": "default", "uid": "u-77",
+        }
+        assert ev["reason"] == "Scheduled"
+        assert ev["type"] == "Normal"
+        assert ev["source"]["component"] == "kubeshare-tpu-scheduler"
+        assert ev["metadata"]["generateName"] == "p1."
+
+    def test_dedup_suppresses_repeats(self, stub):
+        cluster = make_cluster(stub)
+        for _ in range(5):
+            cluster.post_event(
+                "default/p1", "FailedScheduling", "no capacity", "Warning"
+            )
+        assert len(stub.events_posted) == 1
+        # a different message is a different event
+        cluster.post_event(
+            "default/p1", "FailedScheduling", "no chips", "Warning"
+        )
+        assert len(stub.events_posted) == 2
+
+    def test_apiserver_failure_is_swallowed(self, stub):
+        cluster = make_cluster(stub)
+        stub.stop()
+        cluster.post_event("default/p1", "Scheduled", "x")  # must not raise
+
+
+class TestSchedulerEmitsEvents:
+    def test_bound_and_failed_events_over_stub(self, stub, tmp_path):
+        stub.add_node("node-a")
+        stub.add_pod("good", uid="u1", labels={
+            "sharedtpu/tpu_request": "0.5", "sharedtpu/tpu_limit": "1.0",
+        })
+        stub.add_pod("bad", uid="u2", labels={
+            "sharedtpu/tpu_request": "1.0", "sharedtpu/tpu_limit": "0.5",
+        })
+        chips = [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 << 30, i)
+                 for i in range(4)]
+        collector = Collector("node-a", FakeChipBackend(chips))
+        server = collector.serve(host="127.0.0.1", port=0)
+        topo = tmp_path / "topo.yaml"
+        topo.write_text(TOPO_YAML)
+        try:
+            rc = scheduler_cmd.main([
+                "--topology", str(topo),
+                "--kube",
+                "--api-server", f"http://127.0.0.1:{stub.port}",
+                "--capacity-url",
+                f"http://127.0.0.1:{server.port}/metrics",
+                "--decisions-out", "",
+                "--once",
+            ])
+        finally:
+            server.stop()
+        assert rc == 0
+        by_reason = {}
+        for ev in stub.events_posted:
+            by_reason.setdefault(ev["reason"], []).append(ev)
+        [sched] = by_reason["Scheduled"]
+        assert sched["involvedObject"]["name"] == "good"
+        assert "node-a" in sched["message"]
+        [failed] = by_reason["FailedScheduling"]
+        assert failed["involvedObject"]["name"] == "bad"
+        assert failed["type"] == "Warning"
+        assert "exceeds limit" in failed["message"]

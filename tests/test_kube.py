@@ -20,6 +20,7 @@ class StubApiServer:
         self.leases = {}  # (ns, name) -> Lease dict (resourceVersion'd)
         self.secrets = {}  # (ns, name) -> Secret dict
         self.evictions = []  # pod keys POSTed to the eviction subresource
+        self.events_posted = []  # v1 Event objects POSTed
         self.bindings = []
         self.patches = []
         self.auth_headers = []
@@ -161,6 +162,11 @@ class StubApiServer:
                             )
                             return
                         stub.secrets[(ns, name)] = body
+                    self._send(body, code=201)
+                    return
+                if self.path.rstrip("/").endswith("/events"):
+                    with stub._lock:
+                        stub.events_posted.append(body)
                     self._send(body, code=201)
                     return
                 if self.path.endswith("/eviction"):
