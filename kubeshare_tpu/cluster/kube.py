@@ -256,7 +256,8 @@ class KubeCluster:
         self._node_rv = ""
         self._watch_expired = False
         self._event_sent: Dict[tuple, float] = {}  # dedup (see post_event)
-        self._event_errors = 0
+        self._event_errors = 0          # consecutive failures
+        self._event_breaker_until = 0.0  # circuit breaker deadline
 
     # ---- HTTP plumbing ---------------------------------------------
 
@@ -372,6 +373,8 @@ class KubeCluster:
         an Event per tick the way the apiserver-side count aggregation
         would eventually throttle anyway."""
         now = time.time()
+        if now < self._event_breaker_until:
+            return  # persistent failures (e.g. missing RBAC): stand down
         dedup_key = (pod_key, reason, message)
         last = self._event_sent.get(dedup_key, 0.0)
         if now - last < 60.0:
@@ -418,15 +421,25 @@ class KubeCluster:
             # apiserver error must not suppress a one-shot event (e.g.
             # a pod's single Scheduled) for the whole window
             self._event_sent[dedup_key] = now
+            self._event_errors = 0
         except KubeError as e:
-            # observability must never break scheduling
+            # observability must never break scheduling. 3 consecutive
+            # failures open a 5-minute circuit breaker: a PERSISTENT
+            # failure (403 from missing events RBAC) must not keep
+            # adding a blocking POST per decision per pass forever
             self._event_errors += 1
-            if self._event_errors <= 3:
-                import logging
+            import logging
 
-                logging.getLogger("kubeshare.kube").warning(
-                    "event post failed: %s", e
+            log = logging.getLogger("kubeshare.kube")
+            if self._event_errors >= 3:
+                self._event_breaker_until = now + 300.0
+                self._event_errors = 0
+                log.warning(
+                    "event posts failing (%s); suspended for 5 minutes",
+                    e,
                 )
+            else:
+                log.warning("event post failed: %s", e)
 
     def evict(self, pod_key: str) -> None:
         """policy/v1 Eviction subresource — honors PDBs; a 429 (blocked

@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
              "in node_down|node_up|pod_kill (# comments allowed)",
     )
     parser.add_argument(
+        "--defrag", action="store_true",
+        help="enable evict-to-fit defragmentation for guarantee pods "
+             "(victims are resubmitted as controller-recreated pods)",
+    )
+    parser.add_argument(
         "--bench", action="store_true",
         help="add wall-clock engine performance to the report: schedule "
              "attempts/sec, placements/sec, and per-phase p50/p99 latency",
@@ -95,6 +100,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     sim = Simulator(
         args.topology, nodes,
         priority_ratio=args.priority_ratio, seed=args.seed, tracer=tracer,
+        defrag=args.defrag,
     )
     import time as _time
 

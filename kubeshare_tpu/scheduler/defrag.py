@@ -197,10 +197,13 @@ def _plan_multi_chip(
     for occ_cap, _, occupants in clearable[:missing]:
         displaced += occ_cap
         for occ in occupants:
+            # memory frees PER LEAF (a multi-chip victim spanning two
+            # cleared leaves frees both leaves' HBM) — only the victim
+            # KEY is deduped
+            freed_mem += occ.mem
             if occ.status.key not in seen:
                 seen.add(occ.status.key)
                 victims.append(occ.status.key)
-                freed_mem += occ.mem
     if not victims or len(victims) > max_victims:
         return None
     # the plan must also open enough HBM on the node cell

@@ -57,6 +57,7 @@ class SimReport:
     killed: int = 0            # pods lost to injected faults
     resubmitted: int = 0       # fault-killed pods requeued
     faults: int = 0            # fault events applied
+    defrag_evicted: int = 0    # evict-to-fit victims (resubmitted too)
 
     @property
     def mean_wait(self) -> float:
@@ -83,6 +84,7 @@ class SimReport:
             "mean_wait_s": round(self.mean_wait, 2),
             "utilization": round(self.utilization, 4),
             "peak_pending": self.peak_pending,
+            "defrag_evicted": self.defrag_evicted,
             "faults": self.faults,
             "killed": self.killed,
             "resubmitted": self.resubmitted,
@@ -95,6 +97,7 @@ class _Job:
     event: TraceEvent
     submitted_at: float
     bound_at: Optional[float] = None
+    credited: float = 0.0  # chip-seconds credited at bind (horizon-capped)
 
 
 class Simulator:
@@ -110,6 +113,7 @@ class Simulator:
         priority_ratio: float = 0.5,
         seed: int = 0,
         tracer=None,
+        defrag: bool = False,
     ):
         import random
 
@@ -125,7 +129,7 @@ class Simulator:
         self.clock_now = 0.0
         self.engine = TpuShareScheduler(
             topology, self.cluster, clock=lambda: self.clock_now,
-            tracer=tracer,
+            tracer=tracer, defrag=defrag,
         )
         self.total_chips = sum(nodes.values())
         self.priority_ratio = priority_ratio
@@ -148,12 +152,25 @@ class Simulator:
             scheduler_name=C.SCHEDULER_NAME,
         )
 
+    def _uncredit(self, job: "_Job", report: SimReport) -> None:
+        """A bound job leaving early (fault kill / defrag eviction)
+        forfeits the not-yet-run part of what was CREDITED at bind —
+        the credit was horizon-capped, so the refund must be too, or
+        utilization can go negative on horizon runs."""
+        if job.bound_at is None:
+            return
+        ran_credit = job.event.chips * (self.clock_now - job.bound_at)
+        refund = max(0.0, job.credited - ran_credit)
+        report.chip_seconds_used -= refund
+        job.credited -= refund
+
     def _kill_job(self, job: _Job, jobs: Dict[str, "_Job"],
                   pending: List["_Job"], report: SimReport) -> None:
         """Delete a fault-killed pod and resubmit it as a fresh arrival
         (a Job controller recreating its pod)."""
         jobs.pop(job.pod.key, None)
         self.cluster.delete_pod(job.pod.key)
+        self._uncredit(job, report)
         report.killed += 1
         self._resubmits += 1
         clone = Pod(
@@ -255,8 +272,33 @@ class Simulator:
             # one scheduling pass over the queue (queue-sorted)
             pending.sort(key=lambda j: self.engine.queue_sort_key(j.pod))
             still_pending: List[_Job] = []
+            evictions_seen = len(self.cluster.evictions)
             for job in pending:
                 decision = self.engine.schedule_one(job.pod)
+                # defrag victims: the engine evicted them through the
+                # cluster (FakeCluster deletes synchronously); their
+                # controller resubmits them as fresh arrivals
+                while evictions_seen < len(self.cluster.evictions):
+                    victim_key = self.cluster.evictions[evictions_seen]
+                    evictions_seen += 1
+                    victim = jobs.pop(victim_key, None)
+                    if victim is None:
+                        continue
+                    self._uncredit(victim, report)
+                    report.defrag_evicted += 1
+                    self._resubmits += 1
+                    clone = Pod(
+                        name=f"{victim.pod.name}-d{self._resubmits}",
+                        labels=dict(victim.pod.labels),
+                        scheduler_name=C.SCHEDULER_NAME,
+                    )
+                    self.cluster.create_pod(clone)
+                    requeued = _Job(pod=clone, event=victim.event,
+                                    submitted_at=self.clock_now)
+                    jobs[clone.key] = requeued
+                    still_pending.append(requeued)
+                    report.resubmitted += 1
+                    report.submitted += 1
                 if decision.status == "bound":
                     job.bound_at = self.clock_now
                     report.bound += 1
@@ -267,9 +309,10 @@ class Simulator:
                     )
                     # credit only work inside the horizon so utilization
                     # stays <= 1 on cut-off runs
-                    report.chip_seconds_used += job.event.chips * min(
+                    job.credited = job.event.chips * min(
                         job.event.runtime, max(0.0, end - self.clock_now)
                     )
+                    report.chip_seconds_used += job.credited
                 elif decision.status == "unschedulable" and not decision.retryable:
                     # malformed spec: permanent reject
                     self.cluster.delete_pod(job.pod.key)

@@ -51,6 +51,17 @@ class TestPostEvent:
         stub.stop()
         cluster.post_event("default/p1", "Scheduled", "x")  # must not raise
 
+    def test_persistent_failure_opens_circuit_breaker(self, stub):
+        cluster = make_cluster(stub)
+        stub.stop()
+        for i in range(3):  # distinct events dodge the dedup cache
+            cluster.post_event("default/p1", "Scheduled", f"msg-{i}")
+        assert cluster._event_breaker_until > 0  # suspended
+        # while open, posting is a no-op (no blocking HTTP attempts);
+        # indirectly observable: the consecutive-failure counter stays 0
+        cluster.post_event("default/p1", "Scheduled", "msg-x")
+        assert cluster._event_errors == 0
+
 
 class TestSchedulerEmitsEvents:
     def test_bound_and_failed_events_over_stub(self, stub, tmp_path):
