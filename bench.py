@@ -19,6 +19,16 @@ amortized token holds.
 Prints ONE json line:
   {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
 (vs_baseline = aggregate co-located gated / aggregate whole-chip.)
+
+Methodology note (axon tunnel): block_until_ready does not wait for
+real completion on this platform, so the absolute samples/sec here are
+dispatch-regime figures. This is DELIBERATE and kept consistent with
+how BASELINE/BENCH_r01 were recorded: vs_baseline compares solo /
+ungated / gated measured identically in that regime, and the input
+stalls + arbiter token waits inside it are real. Absolute
+compute-honest numbers live in bench_kernels.py (host-fetch barriers,
+MFU) and bench_serving.py (per-burst token fetch = real serving
+behavior) — do not mix figures across the two regimes.
 """
 
 import json

@@ -9,6 +9,13 @@ request-gapped: bursts of decode steps separated by an idle wait
 Under whole-chip allocation the 4 pods run serially (aggregate = one
 pod); co-located they interleave through the live tpu-schd arbiter.
 
+Timing is host-fetch honest: every burst ends with a device_get of the
+decoded tokens — which is both what real serving does (tokens stream
+to clients) and the only true completion barrier on the axon tunnel,
+where block_until_ready returns without waiting. The gates get the
+same fetch as their drain so arbiter hold times reflect real
+occupancy.
+
 Prints ONE json line:
   {"metric": ..., "value": N, "unit": "tokens/sec", "vs_baseline": N}
 (vs_baseline = aggregate co-located gated / whole-chip serial.)
@@ -52,6 +59,13 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def fetch(tok):
+    """Host-fetch the decoded tokens (the completion barrier; see
+    module docstring)."""
+    jax.device_get(tok)
+    return tok
+
+
 def make_decode(params):
     @jax.jit
     def decode(token, cache):
@@ -75,9 +89,9 @@ def run_stream(decode, seconds, stall_s, burst, gate=None, latencies=None):
         for _ in range(burst):
             tok, cache = decode(tok[:, None], cache)
         if gate is not None:
-            gate.flush(tok)
+            gate.flush(tok)  # gate.drain host-fetches inside the hold
         else:
-            tok.block_until_ready()
+            fetch(tok)
         # reset cache length so the phase never overruns max_seq_len
         cache = dict(cache, length=base_len)
         if latencies is not None:
@@ -108,7 +122,7 @@ def main():
     for decode in decodes:
         cache = init_kv_cache(CFG, BATCH)
         tok, cache = decode(token[:, None], cache)
-        tok.block_until_ready()
+        fetch(tok)
     samples = []
     for _ in range(3):
         c = init_kv_cache(CFG, BATCH)
@@ -116,7 +130,7 @@ def main():
         t0 = time.perf_counter()
         for _ in range(TOKENS_PER_BURST * 4):
             t, c = decodes[0](t[:, None], c)
-        t.block_until_ready()
+        fetch(t)
         samples.append((time.perf_counter() - t0) / (TOKENS_PER_BURST * 4))
     step_s = sorted(samples)[1]
     burst = max(TOKENS_PER_BURST, int(MIN_BURST_MS / 1e3 / step_s + 0.5))
@@ -132,7 +146,8 @@ def main():
     if arbiter is not None:
         gates = [
             SharedChipGate(TokenClient("127.0.0.1", ARBITER_PORT,
-                                       pod=f"serve/pod-{i}"))
+                                       pod=f"serve/pod-{i}"),
+                           drain=fetch)
             for i in range(PODS)
         ]
         log("isolation runtime: live tpu-schd token arbiter")

@@ -37,8 +37,7 @@ def save_checkpoint(directory: str, step: int, params: Any,
     if opt_state is not None:
         payload["opt_state"] = opt_state
     _checkpointer().save(path, payload, force=True)
-    for stale in sorted(_list_steps(directory))[:-keep]:
-        _rmtree(os.path.join(directory, f"step_{stale:010d}"))
+    _prune(directory, keep)
     return path
 
 
@@ -76,6 +75,61 @@ def restore_checkpoint(
         payload["params"],
         payload.get("opt_state"),
     )
+
+
+class AsyncCheckpointManager:
+    """Non-blocking saves: ``save()`` snapshots the on-device arrays
+    and returns while serialization runs in the background (orbax
+    AsyncCheckpointer) — the training loop keeps the chip busy instead
+    of stalling for checkpoint I/O. A new save first joins the
+    previous one; call ``wait()`` (or use as a context manager) before
+    exit so the last checkpoint lands.
+
+    On fractional TPU pods this matters doubly: a save stall inside a
+    token hold would bill idle I/O time against the pod's compute
+    quota (runtime/hook.py) — async saves keep holds compute-only.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.keep = keep
+        self._ckpt = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        self._pending_prune = False
+
+    def save(self, step: int, params: Any, opt_state: Any = None) -> str:
+        """Kick a background save of ``step`` (joins any in-flight
+        save first; orbax copies device arrays before returning, so
+        callers may mutate/donate params immediately after)."""
+        self.wait()
+        path = os.path.join(self.directory, f"step_{step:010d}")
+        payload: Dict[str, Any] = {"step": step, "params": params}
+        if opt_state is not None:
+            payload["opt_state"] = opt_state
+        self._ckpt.save(path, payload, force=True)
+        self._pending_prune = True
+        return path
+
+    def wait(self) -> None:
+        """Join the in-flight save (and prune to ``keep``)."""
+        self._ckpt.wait_until_finished()
+        if self._pending_prune:
+            self._pending_prune = False
+            _prune(self.directory, self.keep)
+
+    def __enter__(self) -> "AsyncCheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.wait()
+        self._ckpt.close()
+
+
+def _prune(directory: str, keep: int) -> None:
+    for stale in sorted(_list_steps(directory))[:-keep]:
+        _rmtree(os.path.join(directory, f"step_{stale:010d}"))
 
 
 def _list_steps(directory: str):

@@ -43,16 +43,29 @@ class SharedChipGate:
         client: Optional[TokenClient],
         hbm_limit_bytes: int = 0,
         fail_open: bool = True,
+        drain: Optional[Callable[[Any], Any]] = None,
     ):
+        """``drain`` overrides the completion barrier applied inside a
+        token hold (default: ``jax.block_until_ready``). Platforms
+        whose block_until_ready does not actually wait for device
+        completion (the axon TPU tunnel) should pass a host-fetching
+        drain, e.g. ``lambda r: (float(jnp.sum(r)), r)[1]`` — otherwise
+        released hold times reflect dispatch, not occupancy."""
         self.client = client
         self.hbm_limit = hbm_limit_bytes
         self.fail_open = fail_open
+        self.drain = drain
         self._hbm_used = 0
         self.tokens_acquired = 0
         self.compute_ms = 0.0
         self._held = False
         self._quota_ms = 0.0
         self._hold_start = 0.0
+
+    def _drain(self, result: Any) -> Any:
+        if self.drain is not None:
+            return self.drain(result)
+        return _block(result)
 
     # ---- compute gating --------------------------------------------
 
@@ -89,7 +102,7 @@ class SharedChipGate:
         def gated(*args, **kwargs):
             with self.compute(est_ms):
                 result = fn(*args, **kwargs)
-                result = _block(result)
+                result = self._drain(result)
             return result
 
         return gated
@@ -124,7 +137,7 @@ class SharedChipGate:
             return result
         elapsed_ms = (time.perf_counter() - self._hold_start) * 1e3
         if elapsed_ms >= self._quota_ms:
-            result = _block(result)
+            result = self._drain(result)
             used_ms = (time.perf_counter() - self._hold_start) * 1e3
             self.compute_ms += used_ms
             self._held = False
@@ -138,7 +151,7 @@ class SharedChipGate:
     def flush(self, result: Any = None) -> Any:
         """Drain and return the token unconditionally (end of stream)."""
         if self.client is not None and self._held:
-            result = _block(result)
+            result = self._drain(result)
             used_ms = (time.perf_counter() - self._hold_start) * 1e3
             self.compute_ms += used_ms
             self._held = False
@@ -212,6 +225,22 @@ def _block(result: Any) -> Any:
         return result
 
 
+def fetch_drain(result: Any) -> Any:
+    """Host-fetch completion barrier: transfers the result pytree to
+    the host, which is the only barrier that provably waits on
+    platforms whose ``block_until_ready`` returns early (the axon
+    tunnel). Costs one device->host RTT per drain — amortize by
+    draining per burst, not per step. Select with
+    ``KUBESHARE_DRAIN=fetch`` (see ``install_gate``)."""
+    try:
+        import jax
+
+        jax.device_get(result)
+    except ImportError:
+        pass
+    return result
+
+
 def apply_hbm_env_cap(limit_bytes: int, total_hbm: int = 0) -> None:
     """Cap libtpu's premapped HBM pool before JAX initializes — the
     hard backstop under the cooperative accounting. Must run before
@@ -249,7 +278,16 @@ def install_gate(
             if not fail_open:
                 raise
     apply_hbm_env_cap(hbm_limit)
-    _GATE = SharedChipGate(client, hbm_limit_bytes=hbm_limit, fail_open=fail_open)
+    # KUBESHARE_DRAIN=fetch: host-fetch completion barrier for
+    # platforms where block_until_ready doesn't wait (injectable via
+    # the webhook/env like the rest of the runtime contract)
+    drain = None
+    if os.environ.get("KUBESHARE_DRAIN", "") == "fetch":
+        drain = fetch_drain
+    _GATE = SharedChipGate(
+        client, hbm_limit_bytes=hbm_limit, fail_open=fail_open,
+        drain=drain,
+    )
     return _GATE
 
 

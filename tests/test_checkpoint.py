@@ -193,3 +193,41 @@ class TestElasticRecoveryIntegration:
         assert all(jnp.isfinite(jnp.asarray(losses)))
         # training made progress across kill + resize + restore
         assert losses[-1] < losses[0]
+
+
+class TestAsyncCheckpoint:
+    def test_async_roundtrip_and_prune(self, tmp_path):
+        from kubeshare_tpu.models.checkpoint import AsyncCheckpointManager
+
+        params = tiny_params()
+        with AsyncCheckpointManager(str(tmp_path), keep=2) as mgr:
+            for step in (1, 2, 3):
+                scaled = jax.tree.map(lambda x: x * step, params)
+                mgr.save(step, scaled, opt_state={"count": jnp.int32(step)})
+            mgr.wait()
+        steps = sorted(
+            int(p.name[5:]) for p in tmp_path.iterdir()
+            if p.name.startswith("step_")
+        )
+        assert steps == [2, 3]  # pruned to keep=2
+        got = restore_checkpoint(str(tmp_path))
+        step, restored, opt = got
+        assert step == 3
+        np.testing.assert_allclose(restored["w"], params["w"] * 3)
+        assert int(opt["count"]) == 3
+
+    def test_save_returns_before_wait_needed(self, tmp_path):
+        """save() must not block on serialization: the caller may keep
+        training and even mutate its own references immediately."""
+        from kubeshare_tpu.models.checkpoint import AsyncCheckpointManager
+
+        params = tiny_params()
+        with AsyncCheckpointManager(str(tmp_path)) as mgr:
+            mgr.save(1, params)
+            # mutate the live copy right away; the snapshot must hold
+            # the ORIGINAL values
+            params["w"] = params["w"] * 100.0
+        _, restored, _ = restore_checkpoint(str(tmp_path))
+        np.testing.assert_allclose(
+            restored["w"], tiny_params()["w"], rtol=1e-6
+        )
