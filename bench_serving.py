@@ -38,7 +38,7 @@ from kubeshare_tpu.models import LlamaConfig, init_llama  # noqa: E402
 from kubeshare_tpu.models.llama import init_kv_cache, llama_apply_cached  # noqa: E402
 from kubeshare_tpu.nodeconfig.files import ConfigEntry  # noqa: E402
 from kubeshare_tpu.runtime.client import TokenClient  # noqa: E402
-from kubeshare_tpu.runtime.hook import SharedChipGate  # noqa: E402
+from kubeshare_tpu.runtime.hook import SharedChipGate, fetch_drain as fetch  # noqa: E402
 
 PODS = 4
 BATCH = 8                   # concurrent sequences per pod
@@ -57,13 +57,6 @@ CFG = LlamaConfig(
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
-
-
-def fetch(tok):
-    """Host-fetch the decoded tokens (the completion barrier; see
-    module docstring)."""
-    jax.device_get(tok)
-    return tok
 
 
 def make_decode(params):

@@ -79,13 +79,6 @@ def _causal_mask(scores, q_offset, k_offset):
     return jnp.where(k_pos <= q_pos, scores, _NEG_INF)
 
 
-def _last_k_block(q_offset, block_q: int, block_k: int, num_k_blocks):
-    """Exclusive upper bound of k blocks a causal q block attends to."""
-    return jnp.minimum(
-        (q_offset + block_q + block_k - 1) // block_k, num_k_blocks
-    )
-
-
 def _resolve_defaults(q, scale, interpret):
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -94,59 +87,73 @@ def _resolve_defaults(q, scale, interpret):
     return scale, interpret
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref, *, block_k: int,
+def _flash_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref,
+                  acc_ref, m_ref, l_ref, *, num_k_blocks: int,
                   causal: bool, scale: float):
-    """One (batch*head, q-block) program: stream K/V blocks with online
-    softmax. Refs: q [1, BQ, D], k/v [1, Tk, D], out [1, BQ, D],
-    lse [1, BQ, 1] (row log-sum-exp, the backward's only residual).
+    """One (batch*head, q-block, K-BLOCK) program: the K/V sequence
+    streams through the GRID (innermost axis), never resident whole —
+    a [1, Tk, D] block was 4MB/operand at T=16k and blew the ~16MB
+    VMEM with pipelining double-buffers (real-TPU compile failure the
+    CPU interpret tests can't see). Online-softmax state (acc/m/l)
+    carries across the k sweep in VMEM scratch; out/lse are written at
+    the final k block. Refs: q [1, BQ, D], k/v [1, BK, D], out
+    [1, BQ, D], lse [1, BQ, 1] (row log-sum-exp, the backward's only
+    residual).
 
     Matmul operands stay in the INPUT dtype (bf16 in training) so the
     MXU runs at full rate — an f32 upcast before the dots halves
     throughput and loses to plain XLA. Accumulation, softmax and the
     running max/sum are f32 (preferred_element_type); probabilities
     drop to the V dtype for the PV dot, exactly like the reference
-    einsum path (attention() line: weights.astype(v.dtype))."""
+    einsum path (attention() line: weights.astype(v.dtype)).
+
+    Causal masking skips the COMPUTE of fully-masked upper-triangle
+    blocks via pl.when (their DMA still runs — the index maps are
+    shape-static)."""
     q = q_ref[0]
     block_q, head_dim = q.shape
-    t_k = k_ref.shape[1]
-    q_block_idx = pl.program_id(1)
-    q_offset = q_block_idx * block_q
+    block_k = k_ref.shape[1]
+    q_offset = pl.program_id(1) * block_q
+    kb = pl.program_id(2)
+    k_offset = kb * block_k
 
-    num_k_blocks = t_k // block_k
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(kb, carry):
-        acc, m_prev, l_prev = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+    # fully-masked block: every k position is beyond every q position
+    live = (not causal) or (k_offset <= q_offset + block_q - 1)
+
+    @pl.when(live)
+    def _body():
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         scores = jnp.dot(
             q, k_blk.T, preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            scores = _causal_mask(scores, q_offset, kb * block_k)
+            scores = _causal_mask(scores, q_offset, k_offset)
+        m_prev, l_prev = m_ref[...], l_ref[...]
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         correction = jnp.exp(m_prev - m_new)
         p = jnp.exp(scores - m_new)
-        l_new = l_prev * correction + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * correction + jnp.dot(
+        l_ref[...] = l_prev * correction + jnp.sum(
+            p, axis=-1, keepdims=True
+        )
+        m_ref[...] = m_new
+        acc_ref[...] = acc_ref[...] * correction + jnp.dot(
             p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
         )
-        return acc, m_new, l_new
 
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-
-    if causal:
-        # only k blocks at or before this q block contribute
-        last = _last_k_block(q_offset, block_q, block_k, num_k_blocks)
-        acc, m, l = jax.lax.fori_loop(0, last, body, (acc0, m0, l0))
-    else:
-        acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
-
-    out_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(out_ref.dtype)
-    lse_ref[0] = m + jnp.log(jnp.maximum(l, 1e-30))
+    @pl.when(kb == num_k_blocks - 1)
+    def _final():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        out_ref[0] = (acc_ref[...] / l).astype(out_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
 # Preferred tile edges, largest first. Measured on v5e (bf16, D=128,
@@ -199,32 +206,43 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     kf = k.reshape(batch * h_kv, t_k, head_dim)
     vf = v.reshape(batch * h_kv, t_k, head_dim)
 
-    def kv_index(b, i):
+    def kv_index(b, i, j):
         del i
-        return (b // num_heads) * h_kv + (b % num_heads) // reps
+        return (b // num_heads) * h_kv + (b % num_heads) // reps, j, 0
 
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_k_blocks = t_k // block_k
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, scale=scale
+        _flash_kernel, num_k_blocks=num_k_blocks, causal=causal,
+        scale=scale,
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(batch * num_heads, t_q // block_q),
+        grid=(batch * num_heads, t_q // block_q, num_k_blocks),
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t_k, head_dim), lambda b, i: (kv_index(b, i), 0, 0)),
-            pl.BlockSpec((1, t_k, head_dim), lambda b, i: (kv_index(b, i), 0, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), kv_index),
+            pl.BlockSpec((1, block_k, head_dim), kv_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((batch * num_heads, t_q, head_dim), q.dtype),
             jax.ShapeDtypeStruct((batch * num_heads, t_q, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),         # m
+            pltpu.VMEM((block_q, 1), jnp.float32),         # l
+        ],
         interpret=interpret,
+        # the k sweep (innermost) carries the online-softmax state
         compiler_params=(
-            None if interpret else _tpu_params("parallel", "parallel")
+            None if interpret
+            else _tpu_params("parallel", "parallel", "arbitrary")
         ),
     )(qf, kf, vf)
     out = out.reshape(batch, num_heads, t_q, head_dim)
@@ -247,8 +265,14 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, block_k: int, causal: bool, scale: float):
-    """delta_ref carries ``delta - glse`` precomputed host-side: the
+                         dq_ref, acc_ref, *, num_k_blocks: int,
+                         causal: bool, scale: float):
+    """One (batch*head, q-block, K-BLOCK) program — K/V stream through
+    the grid like the forward (whole-sequence VMEM residency fails to
+    compile at long T); dq accumulates in f32 scratch across the k
+    sweep and lands once at the final block.
+
+    delta_ref carries ``delta - glse`` precomputed host-side: the
     lse cotangent (nonzero when callers consume the lse output, e.g.
     the ring-attention merge) enters as dS_ij += P_ij*glse_i, the same
     row-broadcast shape as the delta term."""
@@ -257,31 +281,35 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     lse = lse_ref[0]          # [BQ, 1] f32
     delta = delta_ref[0]      # [BQ, 1] f32 (already delta - glse)
     block_q, head_dim = q.shape
-    t_k = k_ref.shape[1]
-    num_k_blocks = t_k // block_k
+    block_k = k_ref.shape[1]
     q_offset = pl.program_id(1) * block_q
+    kb = pl.program_id(2)
+    k_offset = kb * block_k
 
-    def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = (not causal) or (k_offset <= q_offset + block_q - 1)
+
+    @pl.when(live)
+    def _body():
+        k_blk = k_ref[0]
+        v_blk = v_ref[0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, q_offset, kb * block_k)
+            s = _causal_mask(s, q_offset, k_offset)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(
+        acc_ref[...] += jnp.dot(
             ds.astype(k_blk.dtype), k_blk,
             preferred_element_type=jnp.float32,
         )
 
-    dq0 = jnp.zeros((block_q, head_dim), jnp.float32)
-    if causal:
-        last = _last_k_block(q_offset, block_q, block_k, num_k_blocks)
-        dq = jax.lax.fori_loop(0, last, body, dq0)
-    else:
-        dq = jax.lax.fori_loop(0, num_k_blocks, body, dq0)
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    @pl.when(kb == num_k_blocks - 1)
+    def _final():
+        dq_ref[0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -358,27 +386,36 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     lsef = lse.reshape(batch * num_heads, t_q, 1)
     deltaf = delta.reshape(batch * num_heads, t_q, 1)
 
-    q_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
-    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0))
+    from jax.experimental.pallas import tpu as pltpu
 
-    def kv_index(b, i):
+    q_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+
+    def kv_index(b, i, j):
         del i
-        return (b // num_heads) * h_kv + (b % num_heads) // reps
+        return (b // num_heads) * h_kv + (b % num_heads) // reps, j, 0
 
-    kv_by_q = pl.BlockSpec((1, t_k, head_dim), lambda b, i: (kv_index(b, i), 0, 0))
+    kv_by_q = pl.BlockSpec((1, block_k, head_dim), kv_index)
+    num_k_blocks = t_k // block_k
 
-    # dq: same GQA index-map routing as the forward — K/V never repeat
+    # dq: same GQA index-map routing as the forward — K/V never repeat,
+    # and they stream through the (innermost) grid axis
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale
+            _flash_bwd_dq_kernel, num_k_blocks=num_k_blocks,
+            causal=causal, scale=scale,
         ),
-        grid=(batch * num_heads, t_q // block_q),
+        grid=(batch * num_heads, t_q // block_q, num_k_blocks),
         in_specs=[q_spec, kv_by_q, kv_by_q, q_spec, row_spec, row_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # dq acc
+        ],
         interpret=interpret,
         compiler_params=(
-            None if interpret else _tpu_params("parallel", "parallel")
+            None if interpret
+            else _tpu_params("parallel", "parallel", "arbitrary")
         ),
     )(qf, kf, vf, dof, lsef, deltaf)
 
