@@ -368,14 +368,18 @@ class KubeCluster:
     def post_event(self, pod_key: str, reason: str, message: str,
                    event_type: str = "Normal") -> None:
         """Best-effort v1 Event. Client-side dedup: the same
-        (pod, reason, message) within 60s is suppressed — a transiently
+        (pod, reason) within 60s is suppressed — a transiently
         unschedulable pod is re-examined every pass and must not write
         an Event per tick the way the apiserver-side count aggregation
-        would eventually throttle anyway."""
+        would eventually throttle anyway. The message is deliberately
+        NOT part of the key: FailedScheduling messages concatenate
+        per-node reasons, so any per-pass fluctuation in wording would
+        defeat the window and re-add a blocking POST per stuck pod per
+        pass (the breaker only trips on errors, not volume)."""
         now = time.time()
         if now < self._event_breaker_until:
             return  # persistent failures (e.g. missing RBAC): stand down
-        dedup_key = (pod_key, reason, message)
+        dedup_key = (pod_key, reason)
         last = self._event_sent.get(dedup_key, 0.0)
         if now - last < 60.0:
             return

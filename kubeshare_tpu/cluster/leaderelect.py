@@ -78,7 +78,17 @@ class LeaderElector:
         self.identity = identity
         self.namespace = namespace
         self.name = name
-        self.lease_duration = lease_duration
+        # the Lease spec carries whole seconds (leaseDurationSeconds),
+        # so truncate HERE: comparing held() against a fractional local
+        # value while peers see the truncated one would leave a
+        # sub-second double-leader window at the boundary. Sub-second
+        # durations would truncate to a perpetually-expired lease
+        # (held() never true, takeover flapping every tick) — reject.
+        if lease_duration < 1:
+            raise ValueError(
+                f"lease_duration must be >= 1s, got {lease_duration}"
+            )
+        self.lease_duration = float(int(lease_duration))
         self.clock = clock
         self.log = log
         self.is_leader = False

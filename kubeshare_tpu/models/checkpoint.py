@@ -93,6 +93,10 @@ class AsyncCheckpointManager:
     def __init__(self, directory: str, keep: int = 3):
         import orbax.checkpoint as ocp
 
+        if keep < 1:
+            # keep=0 would make _prune's [:-keep] slice empty and
+            # silently retain EVERY checkpoint
+            raise ValueError(f"keep must be >= 1, got {keep}")
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.keep = keep
@@ -128,6 +132,8 @@ class AsyncCheckpointManager:
 
 
 def _prune(directory: str, keep: int) -> None:
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
     for stale in sorted(_list_steps(directory))[:-keep]:
         _rmtree(os.path.join(directory, f"step_{stale:010d}"))
 

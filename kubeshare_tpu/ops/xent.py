@@ -42,11 +42,18 @@ def _tile_plan(vocab: int, chunk: int, n: int = 0):
     ``chunk <= 0`` auto-sizes: the widest power of two keeping one f32
     [N, chunk] tile near ~512MB (measured sweet spot on v5e — wider
     tiles amortize the scan; narrower only pays off once N is large
-    enough that the tile itself threatens HBM), floored at 2048."""
+    enough that the tile itself threatens HBM), floored at 2048.
+    Auto-sizing needs the real row count: ``n >= 1`` is required then
+    (budgeting against a defaulted N=1 would pick a near-vocab-wide
+    tile and defeat the op's whole purpose)."""
     if chunk <= 0:
-        budget_cols = (512 << 20) // 4 // max(n, 1)
+        if n < 1:
+            raise ValueError(
+                f"auto-sized tile plan needs the row count: n={n}"
+            )
+        budget_cols = (512 << 20) // 4 // n
         chunk = 2048
-        while chunk * 2 <= budget_cols and chunk * 2 < vocab * 2:
+        while chunk * 2 <= budget_cols and chunk < vocab:
             chunk *= 2
     chunk = min(chunk, vocab)
     steps = -(-vocab // chunk)

@@ -13,8 +13,11 @@ Policy, mirroring kube-scheduler preemption where it maps:
 - only GUARANTEE (priority >= 1) pending pods trigger defrag;
 - only BOUND, opportunistic (priority 0), non-gang pods are victims
   (evicting one gang member cascades a whole-group restart);
-- victims are chosen on ONE leaf/node, smallest displaced request
-  first, and only when the eviction provably opens a fit — no
+- victims are chosen under ONE node: a SHARED pod clears one leaf
+  (smallest displaced request first); a MULTI_CHIP pod may clear N
+  whole leaves under that node (one blocking opportunistic pod per
+  leaf is the canonical case), cheapest-occupancy leaves first. Either
+  way the plan is accepted only when it provably opens a fit — no
   speculative eviction;
 - the engine enforces a per-pod cooldown and a per-attempt victim cap
   (plugin.py), so a pod that still can't bind doesn't evict the

@@ -78,6 +78,7 @@ class TpuShareScheduler:
         defrag: bool = False,
         defrag_max_victims: int = 2,
         defrag_cooldown: float = 30.0,
+        defrag_hold_ttl: float = 45.0,
     ):
         cfg = (
             topology
@@ -109,6 +110,13 @@ class TpuShareScheduler:
         # eviction REFUSED (PDB) — blocked until the stamp expires
         self._defrag_inflight: Set[str] = set()
         self._defrag_blocked: Dict[str, float] = {}  # victim -> until
+        # Space freed by an eviction is RESERVED for the pod that paid
+        # for it: without a hold, an opportunistic pod arriving before
+        # the beneficiary's requeue can bind straight into the hole and
+        # restart the evict->refill->evict churn (the kube-scheduler
+        # analog is nominatedNodeName). node -> (beneficiary, until).
+        self.defrag_hold_ttl = defrag_hold_ttl
+        self._defrag_holds: Dict[str, tuple] = {}
 
         cluster.on_pod_event(self._on_pod_add, self._on_pod_delete)
         cluster.on_node_event(self._on_node_update)
@@ -147,6 +155,7 @@ class TpuShareScheduler:
         self._defrag_last = {}
         self._defrag_inflight = set()
         self._defrag_blocked = {}
+        self._defrag_holds = {}
         for node in self.cluster.list_nodes():
             self._on_node_update(node)
         for pod in self.cluster.list_pods():
@@ -208,6 +217,7 @@ class TpuShareScheduler:
     def _on_pod_delete(self, pod: Pod) -> None:
         self._defrag_last.pop(pod.key, None)
         self._defrag_inflight.discard(pod.key)  # eviction completed
+        self._drop_defrag_holds(pod.key)  # beneficiary gone -> free the space
         self.groups.forget_pod(pod.key)
         status = self.status.pop(pod.key)
         if status is not None:
@@ -333,7 +343,21 @@ class TpuShareScheduler:
         (fit, reason)."""
         self._ensure_synced(node_name)
         if req.kind == PodKind.REGULAR:
+            # regular pods consume no TPU capacity, so a defrag hold
+            # (below) never applies to them
             return True, ""
+        hold = self._defrag_holds.get(node_name)
+        if hold is not None:
+            beneficiary, until = hold
+            if until <= self.clock():
+                self._defrag_holds.pop(node_name, None)  # expired
+            elif not req.is_guarantee and pod.key != beneficiary:
+                # evictions bought this space for a guarantee pod;
+                # letting priority-0 pods refill it restarts the churn
+                return False, (
+                    f"node {node_name}: capacity held for defrag "
+                    f"beneficiary {beneficiary}"
+                )
         if req.kind == PodKind.SHARED:
             if self._node_ports(node_name).find_next_from_current() == -1:
                 return False, f"node {node_name}: pod-manager port pool full"
@@ -620,11 +644,26 @@ class TpuShareScheduler:
                 except Exception:
                     pass  # best-effort observability
         if evicted:
+            # hold the node for the beneficiary until it retries (or
+            # the hold expires — a crashed beneficiary must not pin
+            # capacity forever)
+            self._defrag_holds[plan.node] = (
+                pod.key, now + self.defrag_hold_ttl
+            )
             self.log.info(
                 "defrag for %s on %s: evicted %s",
                 pod.key, plan.node, ",".join(evicted),
             )
         return evicted
+
+    def _drop_defrag_holds(self, pod_key: str) -> None:
+        """Release every hold owned by ``pod_key`` (it bound somewhere
+        or was deleted — either way the space is no longer owed)."""
+        for node in [
+            n for n, (owner, _) in self._defrag_holds.items()
+            if owner == pod_key
+        ]:
+            self._defrag_holds.pop(node, None)
 
     def tick(self) -> List[str]:
         """Expire gang barriers. Returns keys of rejected pods (they
@@ -699,6 +738,7 @@ class TpuShareScheduler:
 
     def _bind(self, pod_key: str, node_name: str) -> None:
         self.cluster.bind(pod_key, node_name)
+        self._drop_defrag_holds(pod_key)  # beneficiary placed; debt paid
         status = self.status.get(pod_key)
         if status is not None:
             status.state = PodState.BOUND
@@ -708,6 +748,7 @@ class TpuShareScheduler:
 
     def _bind_regular(self, pod: Pod, node_name: str) -> None:
         self.cluster.bind(pod.key, node_name)
+        self._drop_defrag_holds(pod.key)
 
     def _ensure_synced(self, node_name: str) -> None:
         if node_name not in self._synced_nodes:

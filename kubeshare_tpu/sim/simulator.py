@@ -144,7 +144,10 @@ class Simulator:
         else:
             labels[C.LABEL_TPU_REQUEST] = str(chips)
             labels[C.LABEL_TPU_LIMIT_ALIASES[1]] = str(chips)
-        if self._rng.random() < self.priority_ratio:
+        if event.priority >= 0:  # trace pins it (deterministic A/Bs)
+            if event.priority > 0:
+                labels[C.LABEL_PRIORITY] = str(event.priority)
+        elif self._rng.random() < self.priority_ratio:
             labels[C.LABEL_PRIORITY] = str(self._rng.randint(1, 100))
         return Pod(
             name=f"sim-{idx}",
@@ -229,8 +232,15 @@ class Simulator:
         # caps runaway replays
         end = horizon or float("inf")
         i = 0
+        # pending retries normally wait for the next arrival/finish, but
+        # a defrag eviction must retry the beneficiary PROMPTLY: in the
+        # live engine the victim's DELETE watch event requeues pending
+        # pods immediately, and the freed space is held for the
+        # beneficiary (plugin defrag hold) — waiting minutes for an
+        # unrelated completion would mismodel that
+        retry_at: Optional[float] = None
         while i < len(arrivals) or pending or finishes or fi < len(fault_queue):
-            # next event time: arrival, finish, or injected fault
+            # next event time: arrival, finish, fault, or prompt retry
             candidates = []
             if i < len(arrivals):
                 candidates.append(arrivals[i].start)
@@ -238,6 +248,9 @@ class Simulator:
                 candidates.append(finishes[0][0])
             if fi < len(fault_queue):
                 candidates.append(fault_queue[fi].time)
+            if retry_at is not None:
+                candidates.append(retry_at)
+                retry_at = None
             if not candidates:
                 break
             next_t = max(self.clock_now, min(candidates))
@@ -272,7 +285,9 @@ class Simulator:
             # one scheduling pass over the queue (queue-sorted)
             pending.sort(key=lambda j: self.engine.queue_sort_key(j.pod))
             still_pending: List[_Job] = []
-            evictions_seen = len(self.cluster.evictions)
+            evictions_seen = evictions_at_pass_start = len(
+                self.cluster.evictions
+            )
             for job in pending:
                 decision = self.engine.schedule_one(job.pod)
                 # defrag victims: the engine evicted them through the
@@ -321,6 +336,8 @@ class Simulator:
                 else:
                     still_pending.append(job)  # capacity: retry next tick
             pending = still_pending
+            if evictions_seen > evictions_at_pass_start and pending:
+                retry_at = self.clock_now + 1.0  # requeue-on-delete
             report.peak_pending = max(report.peak_pending, len(pending))
             self.engine.tick()
 

@@ -23,6 +23,9 @@ class TraceEvent:
     start: float       # seconds from trace start
     chips: float       # requested chips (fractional < 1.0 => sharing)
     runtime: float     # seconds of work
+    priority: int = -1  # explicit pod priority (optional 4th column);
+                        # -1 = let the simulator assign randomly, so
+                        # 3-column traces replay exactly as before
 
     @property
     def is_fractional(self) -> bool:
@@ -37,10 +40,13 @@ def load_trace(path: str) -> List[TraceEvent]:
             if not line or line.startswith("#"):
                 continue
             parts = line.split()
-            if len(parts) != 3:
-                raise ValueError(f"{path}:{line_no}: expected 3 columns")
+            if len(parts) not in (3, 4):
+                raise ValueError(f"{path}:{line_no}: expected 3-4 columns")
             events.append(
-                TraceEvent(float(parts[0]), float(parts[1]), float(parts[2]))
+                TraceEvent(
+                    float(parts[0]), float(parts[1]), float(parts[2]),
+                    int(parts[3]) if len(parts) == 4 else -1,
+                )
             )
     events.sort(key=lambda e: e.start)
     return events
@@ -48,9 +54,11 @@ def load_trace(path: str) -> List[TraceEvent]:
 
 def save_trace(path: str, events: List[TraceEvent]) -> None:
     with open(path, "w") as f:
-        f.write("# start_offset\tchips\truntime\n")
+        f.write("# start_offset\tchips\truntime[\tpriority]\n")
         for e in events:
-            f.write(f"{e.start:g}\t{e.chips:g}\t{e.runtime:g}\n")
+            f.write(f"{e.start:g}\t{e.chips:g}\t{e.runtime:g}"
+                    + (f"\t{e.priority}" if e.priority >= 0 else "")
+                    + "\n")
 
 
 def generate_trace(

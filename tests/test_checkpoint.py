@@ -216,6 +216,14 @@ class TestAsyncCheckpoint:
         np.testing.assert_allclose(restored["w"], params["w"] * 3)
         assert int(opt["count"]) == 3
 
+    def test_keep_must_be_positive(self, tmp_path):
+        """keep=0 used to make the prune slice [:-0] empty and silently
+        retain every checkpoint; it must be rejected up front."""
+        from kubeshare_tpu.models.checkpoint import AsyncCheckpointManager
+
+        with pytest.raises(ValueError, match="keep"):
+            AsyncCheckpointManager(str(tmp_path), keep=0)
+
     def test_save_returns_before_wait_needed(self, tmp_path):
         """save() must not block on serialization: the caller may keep
         training and even mutate its own references immediately."""

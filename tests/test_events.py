@@ -40,9 +40,17 @@ class TestPostEvent:
                 "default/p1", "FailedScheduling", "no capacity", "Warning"
             )
         assert len(stub.events_posted) == 1
-        # a different message is a different event
+        # a rephrased message under the SAME reason is still suppressed
+        # within the window: FailedScheduling messages concatenate
+        # per-node reasons, and any fluctuation used to defeat the
+        # window and re-add a blocking POST per stuck pod per pass
         cluster.post_event(
             "default/p1", "FailedScheduling", "no chips", "Warning"
+        )
+        assert len(stub.events_posted) == 1
+        # a different reason is a different event
+        cluster.post_event(
+            "default/p1", "DefragEvicted", "evicted", "Warning"
         )
         assert len(stub.events_posted) == 2
 

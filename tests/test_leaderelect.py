@@ -64,6 +64,25 @@ class TestLeaderElector:
         assert not a.is_leader
         assert not a.held()
 
+    def test_fractional_lease_duration_truncates_consistently(self, stub):
+        """The Lease spec carries whole seconds; held() must compare
+        against the SAME truncated value the peers see, or a standby
+        can legally take over at renew+15 while the old leader's
+        held() stays true until renew+15.9."""
+        now = {"t": 1000.0}
+        a = elector(stub, "sched-a", clock=lambda: now["t"],
+                    lease_duration=15.9)
+        assert a.tick()
+        assert a.lease_duration == 15.0
+        lease = stub.leases[("kube-system", "test-sched")]
+        assert lease["spec"]["leaseDurationSeconds"] == 15
+        # at renew+15.5 a standby may already take over -> held() must
+        # already be false
+        now["t"] = 1015.5
+        assert not a.held()
+        b = elector(stub, "sched-b", clock=lambda: 1015.5)
+        assert b.tick() and b.is_leader
+
     def test_renew_cadence_skips_fresh_lease_writes(self, stub):
         now = {"t": 0.0}
         a = elector(stub, "sched-a", clock=lambda: now["t"])

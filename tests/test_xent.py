@@ -51,6 +51,10 @@ class TestChunkedXent:
         assert abs(float(ref) - float(got)) < 1e-5
         # policy: ~512MB f32 tile budget, power of two, floor 2048,
         # never past the vocab
+        # auto-sizing without the real row count must refuse: budgeting
+        # against a defaulted N=1 would pick a near-vocab-wide tile
+        with pytest.raises(ValueError, match="row count"):
+            _tile_plan(32000, 0)
         assert _tile_plan(32000, 0, 16384)[0] == 8192
         assert _tile_plan(32000, 0, 1 << 20)[0] == 2048
         assert _tile_plan(32000, 0, 1024)[0] == 32000
