@@ -43,6 +43,12 @@ class DefragPlan:
     node: str
     victims: List[str]          # pod keys, eviction order
     displaced: float            # total displaced request (plan score)
+    leaves: List[str] = None    # uuids of the leaves the plan frees —
+                                # the scope of the post-eviction hold
+                                # (plugin._defrag_holds); holding the
+                                # whole node would starve opportunistic
+                                # pods of capacity the beneficiary
+                                # never asked for
 
 
 @dataclass
@@ -153,6 +159,7 @@ def _plan_shared(
             node=node,
             victims=[o.status.key for o in chosen],
             displaced=sum(o.cap for o in chosen),
+            leaves=[leaf.uuid],
         )
         if best is None or plan.displaced < best.displaced:
             best = plan
@@ -197,8 +204,10 @@ def _plan_multi_chip(
     displaced = 0.0
     freed_mem = 0
     seen = set()
-    for occ_cap, _, occupants in clearable[:missing]:
+    freed_leaves: List[str] = []
+    for occ_cap, leaf_uuid, occupants in clearable[:missing]:
         displaced += occ_cap
+        freed_leaves.append(leaf_uuid)
         for occ in occupants:
             # memory frees PER LEAF (a multi-chip victim spanning two
             # cleared leaves frees both leaves' HBM) — only the victim
@@ -213,7 +222,16 @@ def _plan_multi_chip(
     # (filtering.multi_chip_fit checks free_memory >= req.memory)
     if req.memory > sum(l.free_memory for l in leaves) + freed_mem:
         return None
-    return DefragPlan(node=node, victims=victims, displaced=displaced)
+    # the hold must cover every leaf the beneficiary will NEED, not
+    # just the cleared ones: the plan counts on the pre-existing
+    # whole-free leaves too, and a shared pod binding onto one of them
+    # before the beneficiary's requeue would force a re-evict — the
+    # churn the hold exists to prevent
+    hold_leaves = freed_leaves + [
+        l.uuid for l in leaves if l.is_whole_free
+    ]
+    return DefragPlan(node=node, victims=victims, displaced=displaced,
+                      leaves=hold_leaves)
 
 
 def find_plan(

@@ -14,17 +14,23 @@ fits.
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import FrozenSet, List, Optional, Tuple
 
 from ..cells.cell import Cell, CellTree, fge
 from .labels import PodKind, PodRequirements
 
+_NO_LEAVES: FrozenSet[str] = frozenset()
+
 
 def shared_fit(
-    tree: CellTree, node: str, model: str, request: float, memory: int
+    tree: CellTree, node: str, model: str, request: float, memory: int,
+    exclude: FrozenSet[str] = _NO_LEAVES,
 ) -> bool:
-    """A fractional pod fits if one healthy bound leaf has capacity."""
+    """A fractional pod fits if one healthy bound leaf has capacity.
+    ``exclude`` leaves (defrag holds) are invisible to this pod."""
     for leaf in tree.leaves_on_node(node, model):
+        if exclude and leaf.uuid in exclude:
+            continue
         if leaf.healthy and fge(leaf.available, request) and leaf.free_memory >= memory:
             return True
     return False
@@ -42,28 +48,59 @@ def _node_level_cells(tree: CellTree, node: str, model: str) -> List[Cell]:
 
 
 def multi_chip_fit(
-    tree: CellTree, node: str, model: str, chips: int, memory: int
+    tree: CellTree, node: str, model: str, chips: int, memory: int,
+    exclude: FrozenSet[str] = _NO_LEAVES,
 ) -> bool:
     """An integer pod fits if a node-level cell has enough whole free
-    chips (and HBM) under it."""
-    for cell in _node_level_cells(tree, node, model):
-        if cell.healthy and cell.available_whole_cell >= chips and cell.free_memory >= memory:
+    chips (and HBM) under it. With ``exclude`` (defrag-held leaves) the
+    aggregate shortcut is corrected by walking the held leaves — the
+    slow path only runs while a hold is live, which is rare and
+    short."""
+    if not exclude:
+        for cell in _node_level_cells(tree, node, model):
+            if cell.healthy and cell.available_whole_cell >= chips and cell.free_memory >= memory:
+                return True
+        return False
+    groups: dict = {}
+    for leaf in tree.leaves_on_node(node, model):
+        cell: Optional[Cell] = leaf
+        while cell is not None and not cell.is_node:
+            cell = cell.parent
+        if cell is not None:
+            groups.setdefault(id(cell), (cell, []))[1].append(leaf)
+    for cell, leaves in groups.values():
+        if not cell.healthy:
+            continue
+        usable_whole = sum(
+            1 for l in leaves if l.is_whole_free and l.uuid not in exclude
+        )
+        held_mem = sum(l.free_memory for l in leaves if l.uuid in exclude)
+        if usable_whole >= chips and cell.free_memory - held_mem >= memory:
             return True
     return False
 
 
 def node_fits(
-    tree: CellTree, node: str, req: PodRequirements
+    tree: CellTree, node: str, req: PodRequirements,
+    exclude: FrozenSet[str] = _NO_LEAVES,
 ) -> Tuple[bool, str]:
-    """Full Filter verdict for one node. Returns (fit, reason)."""
+    """Full Filter verdict for one node. Returns (fit, reason).
+    ``exclude`` leaves are treated as nonexistent (defrag holds)."""
     models = [req.model] if req.model else tree.models_on_node(node)
     if req.model and req.model not in tree.models_on_node(node):
         return False, f"node {node} has no {req.model} chips"
     for model in models:
         if req.kind == PodKind.MULTI_CHIP:
-            if multi_chip_fit(tree, node, model, req.chip_count, req.memory):
+            if multi_chip_fit(tree, node, model, req.chip_count,
+                              req.memory, exclude):
                 return True, ""
         else:
-            if shared_fit(tree, node, model, req.request, req.memory):
+            if shared_fit(tree, node, model, req.request, req.memory,
+                          exclude):
                 return True, ""
+    if exclude:
+        return False, (
+            f"node {node} cannot fit request={req.request} "
+            f"mem={req.memory} outside defrag-held leaves"
+        )
     return False, f"node {node} cannot fit request={req.request} mem={req.memory}"

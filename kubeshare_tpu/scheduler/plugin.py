@@ -79,6 +79,8 @@ class TpuShareScheduler:
         defrag_max_victims: int = 2,
         defrag_cooldown: float = 30.0,
         defrag_hold_ttl: float = 45.0,
+        percentage_of_nodes_to_score: int = 0,
+        min_feasible_nodes: int = 64,
     ):
         cfg = (
             topology
@@ -114,9 +116,25 @@ class TpuShareScheduler:
         # for it: without a hold, an opportunistic pod arriving before
         # the beneficiary's requeue can bind straight into the hole and
         # restart the evict->refill->evict churn (the kube-scheduler
-        # analog is nominatedNodeName). node -> (beneficiary, until).
+        # analog is nominatedNodeName, which likewise subtracts only
+        # the nominated pod's resources). The hold is LEAF-scoped: the
+        # plan's freed leaves become invisible to priority-0 pods, but
+        # untouched capacity on the same node stays usable.
+        # node -> (beneficiary, until, frozenset(leaf uuids)).
         self.defrag_hold_ttl = defrag_hold_ttl
         self._defrag_holds: Dict[str, tuple] = {}
+
+        # Feasible-node sampling (kube-scheduler percentageOfNodesToScore
+        # analog): on big clusters, stop filtering once enough feasible
+        # candidates are found and score only those — per-pod cost stays
+        # O(sample), not O(cluster). 0 = adaptive percentage; the
+        # rotating cursor spreads which nodes get examined first so the
+        # sample isn't always the same prefix. Clusters at or under
+        # min_feasible_nodes are always scanned in full (exact behavior,
+        # which is also what every small-topology test sees).
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.min_feasible_nodes = min_feasible_nodes
+        self._filter_cursor = 0
 
         cluster.on_pod_event(self._on_pod_add, self._on_pod_delete)
         cluster.on_node_event(self._on_node_update)
@@ -344,24 +362,13 @@ class TpuShareScheduler:
         self._ensure_synced(node_name)
         if req.kind == PodKind.REGULAR:
             # regular pods consume no TPU capacity, so a defrag hold
-            # (below) never applies to them
+            # never applies to them
             return True, ""
-        hold = self._defrag_holds.get(node_name)
-        if hold is not None:
-            beneficiary, until = hold
-            if until <= self.clock():
-                self._defrag_holds.pop(node_name, None)  # expired
-            elif not req.is_guarantee and pod.key != beneficiary:
-                # evictions bought this space for a guarantee pod;
-                # letting priority-0 pods refill it restarts the churn
-                return False, (
-                    f"node {node_name}: capacity held for defrag "
-                    f"beneficiary {beneficiary}"
-                )
         if req.kind == PodKind.SHARED:
             if self._node_ports(node_name).find_next_from_current() == -1:
                 return False, f"node {node_name}: pod-manager port pool full"
-        return node_fits(self.tree, node_name, req)
+        return node_fits(self.tree, node_name, req,
+                         self._held_leaves(pod, req, node_name))
 
     def score(
         self,
@@ -381,7 +388,8 @@ class TpuShareScheduler:
     def reserve(self, pod: Pod, req: PodRequirements, node_name: str) -> PodStatus:
         group = self.groups.get_or_create(pod, req.gang)
         anchors = self.status.group_placed_leaves(group.key)
-        leaves = select_leaves(self.tree, node_name, req, anchors)
+        leaves = select_leaves(self.tree, node_name, req, anchors,
+                               self._held_leaves(pod, req, node_name))
         if not leaves:
             raise Unschedulable(
                 f"pod {pod.key}: no chips left on {node_name} at reserve time"
@@ -514,15 +522,43 @@ class TpuShareScheduler:
                             retryable=e.retryable)
 
         nodes = [n for n in self.cluster.list_nodes() if n.healthy]
+        # gang anchors are needed twice: anchor NODES must be examined
+        # first (sampling must never hide the node the rest of the gang
+        # sits on), and the leaves weight locality scoring below
+        anchors = self.status.group_placed_leaves(
+            self.groups.get_or_create(pod, req.gang).key
+        )
         feasible: List[str] = []
         reasons: List[str] = []
         with maybe_span(self.tracer, "filter", pod=pod.key):
-            for node in sorted(nodes, key=lambda n: n.name):
-                fit, reason = self.filter(pod, req, node.name)
+            names = sorted(n.name for n in nodes)
+            target = self._feasible_target(len(names))
+            anchor_nodes = {l.node for l in anchors if l.node}
+            start = self._filter_cursor % max(1, len(names))
+            for name in sorted(anchor_nodes & set(names)):
+                fit, reason = self.filter(pod, req, name)
                 if fit:
-                    feasible.append(node.name)
+                    feasible.append(name)
                 elif reason:
                     reasons.append(reason)
+            # the cursor advances only by rotation-window progress —
+            # counting the anchor scans above would skip never-examined
+            # nodes and systematically under-sample a wedge of the
+            # cluster under steady gang traffic
+            consumed = 0
+            if len(feasible) < target:
+                for name in names[start:] + names[:start]:
+                    consumed += 1
+                    if name in anchor_nodes:
+                        continue  # examined above
+                    fit, reason = self.filter(pod, req, name)
+                    if fit:
+                        feasible.append(name)
+                        if len(feasible) >= target:
+                            break
+                    elif reason:
+                        reasons.append(reason)
+            self._filter_cursor = (start + consumed) % max(1, len(names))
         if not feasible:
             evicted = self._maybe_defrag(pod, req, nodes)
             if evicted:
@@ -539,9 +575,6 @@ class TpuShareScheduler:
             )
 
         with maybe_span(self.tracer, "score", pod=pod.key):
-            anchors = self.status.group_placed_leaves(
-                self.groups.get_or_create(pod, req.gang).key
-            )
             scores = {
                 name: self.score(pod, req, name, anchors) for name in feasible
             }
@@ -581,6 +614,35 @@ class TpuShareScheduler:
             "waiting", pod.key, node=best,
             message=f"gang barrier, timeout {extra}s",
         )
+
+    def _held_leaves(self, pod: Pod, req, node_name: str):
+        """Leaves on ``node_name`` this pod must treat as nonexistent:
+        a live defrag hold scopes its freed leaves to the beneficiary.
+        Guarantee pods and the beneficiary itself see everything."""
+        hold = self._defrag_holds.get(node_name)
+        if hold is None:
+            return frozenset()
+        beneficiary, until, leaves = hold
+        if until <= self.clock():
+            self._defrag_holds.pop(node_name, None)  # expired
+            return frozenset()
+        if req.is_guarantee or pod.key == beneficiary:
+            return frozenset()
+        return leaves
+
+    def _feasible_target(self, n_nodes: int) -> int:
+        """How many feasible nodes to find before scoring (kube's
+        numFeasibleNodesToFind). Full scan at or under the floor;
+        above it, an adaptive percentage that shrinks as the cluster
+        grows (a 512-node cluster does not need 512 candidates to
+        place one pod well), floored so small samples never starve
+        scoring of choice."""
+        if n_nodes <= self.min_feasible_nodes:
+            return n_nodes
+        pct = self.percentage_of_nodes_to_score
+        if pct <= 0:
+            pct = max(5, 50 - n_nodes // 8)
+        return max(self.min_feasible_nodes, n_nodes * pct // 100)
 
     def _maybe_defrag(self, pod: Pod, req, nodes) -> List[str]:
         """Evict-to-fit for a guarantee pod no node can place (see
@@ -644,11 +706,12 @@ class TpuShareScheduler:
                 except Exception:
                     pass  # best-effort observability
         if evicted:
-            # hold the node for the beneficiary until it retries (or
-            # the hold expires — a crashed beneficiary must not pin
-            # capacity forever)
+            # hold the plan's freed LEAVES for the beneficiary until it
+            # retries (or the hold expires — a crashed beneficiary must
+            # not pin capacity forever)
             self._defrag_holds[plan.node] = (
-                pod.key, now + self.defrag_hold_ttl
+                pod.key, now + self.defrag_hold_ttl,
+                frozenset(plan.leaves or ()),
             )
             self.log.info(
                 "defrag for %s on %s: evicted %s",
@@ -660,8 +723,8 @@ class TpuShareScheduler:
         """Release every hold owned by ``pod_key`` (it bound somewhere
         or was deleted — either way the space is no longer owed)."""
         for node in [
-            n for n, (owner, _) in self._defrag_holds.items()
-            if owner == pod_key
+            n for n, hold in self._defrag_holds.items()
+            if hold[0] == pod_key
         ]:
             self._defrag_holds.pop(node, None)
 

@@ -120,6 +120,7 @@ def select_leaves(
     node: str,
     req: PodRequirements,
     anchors: Sequence[Anchor] = (),
+    exclude: frozenset = frozenset(),
 ) -> List[Cell]:
     """Reserve-time chip choice on the winning node. Returns the leaf
     list to reserve ([] if nothing fits — the caller unreserves).
@@ -131,7 +132,8 @@ def select_leaves(
     (divergence: the reference scores picks independently and can
     scatter a multi-chip pod across the fabric)."""
     leaves = [
-        l for l in tree.leaves_on_node(node, req.model or None) if l.healthy
+        l for l in tree.leaves_on_node(node, req.model or None)
+        if l.healthy and (not exclude or l.uuid not in exclude)
     ]
     if req.kind == PodKind.MULTI_CHIP:
         return _select_whole_leaves(leaves, req, anchors)

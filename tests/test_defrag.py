@@ -369,17 +369,17 @@ class TestDefragHold:
         d = engine.schedule_one(hero)
         assert "defrag" in d.message and len(cluster.evictions) == 1
         # an opportunistic pod racing in before hero's requeue is
-        # refused the held node (the only node)
+        # refused the held leaf (nothing else on the node fits 0.6)
         opp = cluster.create_pod(mk_pod("opp-3", 0.6))
         d_opp = engine.schedule_one(opp)
         assert d_opp.status == "unschedulable"
-        assert "held for defrag" in d_opp.message
+        assert "defrag-held" in d_opp.message
         # guarantee pods are NOT blocked by the hold (they could not
         # cause the churn the hold prevents) — this one simply fails to
         # fit (3.0 > the node's 2 chips, so it can't defrag either)
         big = cluster.create_pod(mk_pod("big", 3.0, 3.0, priority=50))
         d_big = engine.schedule_one(big)
-        assert "held for defrag" not in (d_big.message or "")
+        assert "defrag-held" not in (d_big.message or "")
         # the beneficiary binds into its space
         d = engine.schedule_one(hero)
         assert d.status == "bound", d.message
@@ -387,7 +387,64 @@ class TestDefragHold:
         # whatever is genuinely left (0.4 on the other chip: too small
         # for 0.6, but the refusal is capacity, not the hold)
         d_opp = engine.schedule_one(opp)
-        assert "held for defrag" not in (d_opp.message or "")
+        assert "defrag-held" not in (d_opp.message or "")
+
+    def test_hold_is_leaf_scoped_not_node_wide(self):
+        """Capacity the eviction did NOT free stays usable: a small
+        opportunistic pod that fits on the untouched leaf binds during
+        the hold (kube's nominatedNodeName likewise subtracts only the
+        nominated pod's resources)."""
+        cluster, engine = make_env()
+        fragment(cluster, engine)
+        hero = cluster.create_pod(mk_pod("hero", 0.8, priority=50))
+        engine.schedule_one(hero)
+        assert len(cluster.evictions) == 1
+        # 0.3 fits in the surviving opportunistic leaf's 0.4 free —
+        # the hold must not block it
+        small = cluster.create_pod(mk_pod("small", 0.3))
+        d = engine.schedule_one(small)
+        assert d.status == "bound", d.message
+        # and the held leaf still has room for the beneficiary
+        d = engine.schedule_one(hero)
+        assert d.status == "bound", d.message
+
+    def test_multi_chip_hold_covers_whole_free_leaves(self):
+        """The hold must protect every leaf the beneficiary needs —
+        including the pre-existing whole-free ones the plan counted on,
+        not just the cleared ones. A shared pod grabbing a whole-free
+        leaf before the requeue would force a re-evict."""
+        topo4 = {
+            "cell_types": {
+                "v5e-node4": {
+                    "child_cell_type": "tpu-v5e",
+                    "child_cell_number": 4,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+            },
+            "cells": [{"cell_type": "v5e-node4", "cell_id": "node-a"}],
+        }
+        cluster = FakeCluster()
+        cluster.add_node(
+            "node-a",
+            [ChipInfo(f"c{i}", "tpu-v5e", 16 * GIB, i) for i in range(4)],
+        )
+        engine = TpuShareScheduler(topo4, cluster, defrag=True)
+        for name in ("o1", "o2"):  # 0.6 each: two leaves partially used
+            assert engine.schedule_one(
+                cluster.create_pod(mk_pod(name, 0.6))
+            ).status == "bound"
+        hero = cluster.create_pod(mk_pod("hero", 4.0, 4.0, priority=50))
+        d = engine.schedule_one(hero)
+        assert "defrag" in d.message and len(cluster.evictions) == 2
+        # a shared pod that would fit on a WHOLE-FREE leaf is refused:
+        # the beneficiary needs all four
+        small = cluster.create_pod(mk_pod("small", 0.5))
+        d_small = engine.schedule_one(small)
+        assert d_small.status == "unschedulable"
+        assert "defrag-held" in d_small.message
+        d = engine.schedule_one(hero)
+        assert d.status == "bound", d.message
 
     def test_hold_expires_if_beneficiary_never_returns(self):
         now = {"t": 0.0}

@@ -27,7 +27,7 @@ class TestCommittedArtifact:
         doc = json.load(open(ARTIFACT))
         assert doc["generated_by"] == "tools/engine_bench.py"
         by_nodes = {r["nodes"]: r for r in doc["results"]}
-        assert set(by_nodes) == {32, 128}
+        assert set(by_nodes) == {32, 128, 512}
         for r in doc["results"]:
             assert r["placements_per_sec"] > 0
             assert r["bound"] > 0
@@ -40,6 +40,16 @@ class TestCommittedArtifact:
             "investigate before regenerating ENGINE_BENCH.json"
         )
 
+    def test_recorded_floor_512_nodes(self):
+        """Pod-slice scale (2048 chips) must hold >= 1k placements/s
+        (VERDICT r2 #7); feasible-node sampling is what buys this."""
+        doc = json.load(open(ARTIFACT))
+        [r512] = [r for r in doc["results"] if r["nodes"] == 512]
+        assert r512["placements_per_sec"] >= 1000, (
+            "committed 512-node engine bench fell below the floor; "
+            "investigate before regenerating ENGINE_BENCH.json"
+        )
+
 
 class TestFreshRunFloor:
     def test_live_floor_32_nodes(self):
@@ -48,4 +58,13 @@ class TestFreshRunFloor:
             f"engine hot path regressed: {r['placements_per_sec']:.0f} "
             "placements/s @ 32 nodes (committed artifact has "
             ">= 3000; floor leaves CI-noise margin)"
+        )
+
+    def test_live_floor_512_nodes(self):
+        """Catches an O(nodes)-per-pod regression (e.g. sampling
+        accidentally disabled): unsampled, this runs ~125/s."""
+        r = run(512, events=300)
+        assert r["placements_per_sec"] >= 700, (
+            f"engine hot path regressed at scale: "
+            f"{r['placements_per_sec']:.0f} placements/s @ 512 nodes"
         )
