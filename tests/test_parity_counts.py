@@ -1,12 +1,24 @@
-"""Enforce the corpus/suite size claims PARITY.md makes, so the doc
-can reference floors instead of quoting numbers that rot
-(VERDICT r1 weak #7)."""
+"""Enforce the corpus/suite size claims the docs make, so README and
+PARITY.md reference floors instead of quoting numbers that rot
+(VERDICT r1 weak #7; r2 #10 extended this to every doc-quoted count)."""
 
 import glob
 import os
+import re
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
+
+
+def _test_fn_count() -> int:
+    n = 0
+    for path in glob.glob(os.path.join(HERE, "test_*.py")):
+        with open(path) as f:
+            n += sum(
+                1 for line in f
+                if line.lstrip().startswith("def test_")
+            )
+    return n
 
 
 def test_corpus_floor_matches_reference_scale():
@@ -21,11 +33,57 @@ def test_corpus_floor_matches_reference_scale():
 def test_suite_floor():
     # cheap proxy for collected-test count (pytest --collect-only is
     # slow here): test functions/methods defined under tests/
-    n = 0
-    for path in glob.glob(os.path.join(HERE, "test_*.py")):
-        with open(path) as f:
-            n += sum(
-                1 for line in f
-                if line.lstrip().startswith("def test_")
-            )
+    n = _test_fn_count()
     assert n >= 300, f"test-function count fell to {n}"
+
+
+def test_trace_row_count_matches_parity_quote():
+    # PARITY.md quotes workloads/trace.txt as "989 rows, reference
+    # trace format" — the file must actually have them
+    with open(os.path.join(REPO, "workloads", "trace.txt")) as f:
+        rows = [
+            l for l in f if l.strip() and not l.lstrip().startswith("#")
+        ]
+    assert len(rows) == 989, f"trace.txt has {len(rows)} rows"
+
+
+def test_corpus_matches_reference_scale_quote():
+    # PARITY.md: "Reference's 76 label-matrix YAMLs ... -> workloads/"
+    yamls = glob.glob(
+        os.path.join(REPO, "workloads", "**", "*.yaml"), recursive=True
+    )
+    assert len(yamls) >= 76, f"corpus below reference scale: {len(yamls)}"
+
+
+def test_doc_quoted_counts_cannot_exceed_tree():
+    """Any 'N ... tests' / 'N ... YAMLs' figure quoted in README or
+    PARITY must be backed by the tree, so the docs cannot drift ahead
+    of reality (stale-low floors are fine; inflated claims are not).
+    The patterns allow up to three adjective words between the number
+    and the noun ('76 label-matrix YAMLs', '400+ unit tests'), and the
+    test FAILS if it matches nothing — a guard that greps for zero
+    claims guards nothing."""
+    actual_tests = _test_fn_count()
+    yamls = len(glob.glob(
+        os.path.join(REPO, "workloads", "**", "*.yaml"), recursive=True
+    ))
+    adj = r"\+?\s+(?:[\w-]+\s+){0,3}"
+    matched = 0
+    for name in ("README.md", "PARITY.md"):
+        text = open(os.path.join(REPO, name)).read()
+        for m in re.finditer(r"(\d{2,})" + adj + r"tests?\b", text):
+            matched += 1
+            assert int(m.group(1)) <= actual_tests, (
+                f"{name} claims {m.group(0)!r}; tree has "
+                f"{actual_tests} test functions"
+            )
+        for m in re.finditer(r"(\d{2,})" + adj + r"YAMLs?\b", text,
+                             re.IGNORECASE):
+            matched += 1
+            assert int(m.group(1)) <= yamls, (
+                f"{name} claims {m.group(0)!r}; tree has {yamls} YAMLs"
+            )
+    assert matched >= 1, (
+        "no quoted counts matched in README/PARITY — the drift guard "
+        "has gone vacuous; update the patterns to the docs' phrasing"
+    )
