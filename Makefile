@@ -25,7 +25,12 @@ images:
 	docker build -f docker/scheduler/Dockerfile -t kubeshare-tpu/scheduler:latest .
 	docker build -f docker/node/Dockerfile -t kubeshare-tpu/node:latest .
 
+# full control plane on a kind cluster with the fake chip backend;
+# requires docker + kind + kubectl (exits 2 = skip when absent)
+kind-e2e:
+	bash tools/kind_e2e.sh
+
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench dryrun images clean
+.PHONY: all native test bench engine-bench dryrun images kind-e2e clean

@@ -31,6 +31,147 @@ def _free_port() -> int:
     return port
 
 
+def _collect_results(procs, outs):
+    results = []
+    for rank, proc in enumerate(procs):
+        try:
+            stdout, _ = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        assert proc.returncode == 0, (
+            f"worker {rank} failed:\n{stdout.decode()[-2000:]}"
+        )
+        results.append(json.loads(outs[rank].read_text()))
+    return results
+
+
+def test_closed_loop_label_schedule_inject_bootstrap(tmp_path):
+    """The FULL loop with no hand-assembled env (VERDICT r2 #6): two
+    StatefulSet-style gang member manifests carry only labels plus the
+    workload-spec coordinator address; the REAL webhook mutation
+    injects the gang headcount, the engine schedules the 8-chip gang
+    (4 whole chips per member, one node each) and injects the chip
+    env, and the worker processes are launched with exactly the env
+    found on the BOUND pods — which must be sufficient for
+    ``jax.distributed`` bootstrap + the hybrid train step."""
+    from kubeshare_tpu.cells.cell import ChipInfo
+    from kubeshare_tpu.cluster.fake import FakeCluster
+    from kubeshare_tpu.cluster.k8syaml import pods_from_manifest
+    from kubeshare_tpu.cluster.webhook import mutate_pod
+    from kubeshare_tpu.scheduler import constants as C
+    from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+    from test_webhook import apply_patch
+
+    port = _free_port()
+    gib = 1 << 30
+
+    def member(rank: int) -> dict:
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": f"gang-worker-{rank}",
+                "labels": {
+                    "sharedtpu/group_name": "dist-train",
+                    "sharedtpu/group_headcount": "2",
+                    "sharedtpu/group_threshold": "1.0",
+                    "sharedtpu/priority": "50",
+                    "sharedtpu/tpu_request": "4.0",
+                    "sharedtpu/tpu_limit": "4.0",
+                },
+            },
+            "spec": {
+                "schedulerName": C.SCHEDULER_NAME,
+                "containers": [{
+                    "name": "worker",
+                    "image": "x",
+                    # the one thing the manifest owns: where the gang
+                    # leader listens (workloads/distribute corpus shape)
+                    "env": [{"name": "JAX_COORDINATOR_ADDRESS",
+                             "value": f"127.0.0.1:{port}"}],
+                }],
+            },
+        }
+
+    topo = {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": 4,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": "node-0"},
+            {"cell_type": "v5e-node", "cell_id": "node-1"},
+        ],
+    }
+    cluster = FakeCluster()
+    for name in ("node-0", "node-1"):
+        cluster.add_node(
+            name,
+            [ChipInfo(f"{name}-chip-{i}", "tpu-v5e", 16 * gib, i)
+             for i in range(4)],
+        )
+    engine = TpuShareScheduler(topo, cluster)
+
+    pods = []
+    for rank in range(2):
+        doc = member(rank)
+        doc = apply_patch(doc, mutate_pod(doc))  # REAL webhook mutation
+        [pod] = pods_from_manifest(doc)          # REAL manifest parsing
+        pods.append(cluster.create_pod(pod))
+
+    d0 = engine.schedule_one(pods[0])
+    assert d0.status == "waiting", d0.message    # gang barrier holds
+    d1 = engine.schedule_one(pods[1])
+    assert d1.status == "bound", d1.message
+    assert pods[0].key in d1.bound_with          # barrier released both
+    bound_nodes = {cluster.get_pod(p.key).node_name for p in pods}
+    assert len(bound_nodes) == 2                 # 4 whole chips each
+
+    procs, outs = [], []
+    for rank, pod in enumerate(pods):
+        live = cluster.get_pod(pod.key)
+        injected = {}
+        for container in live.containers:
+            injected.update(container.env)
+        # webhook's doing: the gang size
+        assert injected[C.ENV_GROUP_HEADCOUNT] == "2"
+        # scheduler's doing: this member's 4 chip uuids
+        assert len(injected[C.ENV_VISIBLE_CHIPS].split(",")) == 4
+        out = tmp_path / f"loop-worker{rank}.json"
+        outs.append(out)
+        env = {
+            **os.environ,
+            # test substrate only: virtual CPU devices + result file +
+            # the downward-API hostname every pod gets for free
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "MULTIHOST_HOSTNAME": live.name,
+            "MULTIHOST_OUT": str(out),
+            # everything the GANG needs came off the bound pod:
+            **injected,
+        }
+        env.pop("KUBESHARE_PROCESS_ID", None)
+        env.pop("KUBESHARE_NUM_PROCESSES", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    results = _collect_results(procs, outs)
+    for rank, r in enumerate(results):
+        assert r["process_id"] == rank
+        assert r["num_processes"] == 2
+        assert r["device_count"] == 8
+        assert r["gathered"] == [0.0, 1.0]
+        assert r["losses"][2] < r["losses"][0]
+    assert results[0]["losses"] == results[1]["losses"]
+
+
 def test_two_process_gang_bootstrap_and_hybrid_train(tmp_path):
     port = _free_port()
     procs = []
@@ -57,18 +198,7 @@ def test_two_process_gang_bootstrap_and_hybrid_train(tmp_path):
             [sys.executable, WORKER], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         ))
-    results = []
-    for rank, proc in enumerate(procs):
-        try:
-            stdout, _ = proc.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            raise
-        assert proc.returncode == 0, (
-            f"worker {rank} failed:\n{stdout.decode()[-2000:]}"
-        )
-        results.append(json.loads(outs[rank].read_text()))
+    results = _collect_results(procs, outs)
 
     for rank, r in enumerate(results):
         assert r["process_id"] == rank
