@@ -30,7 +30,12 @@ images:
 kind-e2e:
 	bash tools/kind_e2e.sh
 
+# bank the kernel/serving/A-B perf evidence on a healthy chip into
+# artifacts/perf_evidence.json (operator tool, ~10-20 min of compiles)
+perf-evidence:
+	$(PYTHON) tools/bench_artifacts.py
+
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench dryrun images kind-e2e clean
+.PHONY: all native test bench engine-bench dryrun images kind-e2e perf-evidence clean

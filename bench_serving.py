@@ -33,6 +33,13 @@ sys.path.insert(0, REPO)
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+# chip-free smoke route (see bench.py): the axon plugin force-selects
+# itself, so a CPU run must override via jax.config, not env alone
+if os.environ.get("KUBESHARE_BENCH_PLATFORM"):
+    jax.config.update(
+        "jax_platforms", os.environ["KUBESHARE_BENCH_PLATFORM"]
+    )
+
 from bench_common import p99, run_threads, start_arbiter as _start, stop_arbiter  # noqa: E402
 from kubeshare_tpu.models import LlamaConfig, init_llama  # noqa: E402
 from kubeshare_tpu.models.llama import init_kv_cache, llama_apply_cached  # noqa: E402
@@ -102,7 +109,9 @@ def start_arbiter(tmpdir):
     )
 
 
-def main():
+def run() -> dict:
+    """The full serving bench; returns the result doc (main() prints
+    it; tools/bench_artifacts.py folds it into the evidence file)."""
     log(f"serving bench platform: {jax.devices()[0].platform} "
         f"({jax.devices()[0]})")
     rng = jax.random.PRNGKey(7)
@@ -188,14 +197,20 @@ def main():
             if gate is not None:
                 gate.close()
 
-    print(json.dumps({
+    return {
         "metric": "aggregate decode tokens/sec, 4 co-located 0.25-chip "
                   "KV-cache Llama pods vs whole-chip allocation",
         "value": round(mid["gated"], 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mid["ratio"], 3),
+        "p99_token_latency_ms_min": round(min(pod_p99s), 2),
+        "p99_token_latency_ms_max": round(max(pod_p99s), 2),
         "isolated": arbiter is not None,
-    }))
+    }
+
+
+def main():
+    print(json.dumps(run()))
 
 
 if __name__ == "__main__":
