@@ -365,12 +365,15 @@ def run_all(log=print, budget_s: float = None) -> dict:
     # highest-value first: the flash advantage grows with T (XLA's
     # O(T^2) intermediates start thrashing HBM around 8k), so if the
     # budget truncates, the short-T parity numbers are what drop.
-    # T=16k only under a generous budget (bench_artifacts): its
-    # multi-minute compile would blow bench.py's wall cap, and the
-    # parent kills the child before the end-of-run JSON prints —
-    # losing the ALREADY-finished 8k number, not just the 16k one
-    seqs = (8192, 16384, 4096, 2048) if budget_s >= 600 else (
-        8192, 4096, 2048
+    # T=16k only when the CALLER opts in (bench_artifacts sets the
+    # flag): keying on budget size would let a generous driver budget
+    # pull the multi-minute 16k compile into bench.py's wall-capped
+    # path, where an overrun kills the child before the end-of-run
+    # JSON prints — losing the ALREADY-finished 8k number too
+    seqs = (
+        (8192, 16384, 4096, 2048)
+        if os.environ.get("KUBESHARE_BENCH_FLASH_16K") == "1"
+        else (8192, 4096, 2048)
     )
     for seq in seqs:
         if over():

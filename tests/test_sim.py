@@ -304,6 +304,52 @@ class TestSimDefrag:
         assert max(base.wait_times) > 30.0
         assert max(frag.wait_times) < 15.0
 
+    def test_pod_slice_scale_soak_with_defrag_and_faults(self):
+        """Everything this round added, at pod-slice scale, at once:
+        512 nodes / 2048 chips with sampled filtering, defrag with
+        leaf-scoped holds, node flap + pod kill faults, 4k-event
+        trace. The engine's own reserve/reclaim asserts catch any
+        double-booking; here we assert the ledger identity (every
+        submission ends exactly one of completed / unschedulable /
+        killed-and-resubmitted) and sane utilization."""
+        from kubeshare_tpu.sim.simulator import FaultEvent
+
+        n = 512
+        topo = {
+            "cell_types": {
+                "v5e-node": {
+                    "child_cell_type": "tpu-v5e",
+                    "child_cell_number": 4,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+            },
+            "cells": [
+                {"cell_type": "v5e-node", "cell_id": f"node-{i:03d}"}
+                for i in range(n)
+            ],
+        }
+        events = generate_trace(count=4000, seed=11)
+        faults = [
+            FaultEvent(100.0, "node_down", "node-007"),
+            FaultEvent(200.0, "node_up", "node-007"),
+            FaultEvent(300.0, "pod_kill", ""),
+            FaultEvent(400.0, "node_down", "node-123"),
+            FaultEvent(500.0, "node_up", "node-123"),
+        ]
+        sim = Simulator(
+            topo, {f"node-{i:03d}": 4 for i in range(n)},
+            seed=11, defrag=True,
+        )
+        report = sim.run(events, faults=faults)
+        assert report.submitted >= 4000
+        assert (
+            report.completed + report.unschedulable + report.killed
+            + report.defrag_evicted == report.submitted
+        ), report.to_dict()
+        assert 0 < report.utilization <= 1.0
+        assert report.faults == len(faults)
+
     def test_horizon_with_eviction_keeps_utilization_sane(self):
         """A job credited a horizon-capped amount at bind and then
         evicted must refund at most what was credited (utilization

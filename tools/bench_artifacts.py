@@ -73,22 +73,37 @@ def main() -> int:
     log(f"chip: {probe['device']} ({probe.get('device_kind', '?')})")
 
     # partial re-runs (and chip-free smokes): comma list of sections.
-    # Existing artifact rows for skipped sections are preserved.
-    sections = set(
-        os.environ.get(
+    # Existing artifact rows for skipped sections are preserved WITH
+    # their own provenance stamps — re-running one section on a
+    # different day/chip must not re-attribute the others.
+    all_sections = {"kernels", "ab", "serving"}
+    sections = {
+        s.strip()
+        for s in os.environ.get(
             "KUBESHARE_EVIDENCE_SECTIONS", "kernels,ab,serving"
         ).split(",")
-    )
+        if s.strip()
+    }
+    unknown = sections - all_sections - {"none"}
+    if unknown:
+        log(f"ABORT: unknown sections {sorted(unknown)} "
+            f"(valid: {sorted(all_sections)})")
+        return 1
     doc = {}
-    if os.path.exists(OUT) and sections != {"kernels", "ab", "serving"}:
+    if os.path.exists(OUT) and sections != all_sections:
         with open(OUT) as f:
             doc = json.load(f)
-    doc.update({
-        "generated_by": "tools/bench_artifacts.py",
-        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "platform": probe["platform"],
+    stamp = {
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "device": probe["device"],
         "device_kind": probe.get("device_kind", ""),
+    }
+    doc.update({
+        "generated_by": "tools/bench_artifacts.py",
+        # top-level stamp = last write; per-section stamps are the
+        # provenance of record for each row
+        "last_run": stamp,
+        "platform": probe["platform"],
     })
 
     import bench_kernels
@@ -97,15 +112,18 @@ def main() -> int:
         log("== kernel ratios + MFU (budget "
             + os.environ.get("KUBESHARE_BENCH_KERNEL_BUDGET", "900") + "s)")
         os.environ.setdefault("KUBESHARE_BENCH_KERNEL_BUDGET", "900")
-        doc["kernels"] = bench_kernels.run_all(log)
+        os.environ.setdefault("KUBESHARE_BENCH_FLASH_16K", "1")
+        doc["kernels"] = dict(bench_kernels.run_all(log), **stamp)
 
     if "ab" in sections:
         log("== capability A/B: flash vs XLA at T=32k")
-        doc["flash_longcontext_ab"] = bench_kernels.flash_longcontext_ab()
+        doc["flash_longcontext_ab"] = dict(
+            bench_kernels.flash_longcontext_ab(), **stamp
+        )
         log(f"   {doc['flash_longcontext_ab']}")
 
         log("== capability A/B: fused xent vs dense at 64k rows")
-        doc["xent_oom_ab"] = bench_kernels.xent_oom_ab()
+        doc["xent_oom_ab"] = dict(bench_kernels.xent_oom_ab(), **stamp)
         log(f"   {doc['xent_oom_ab']}")
 
     if "serving" in sections:
@@ -121,22 +139,23 @@ def main() -> int:
             for line in proc.stderr.decode(errors="replace").splitlines():
                 log(line)
             if proc.returncode == 0:
-                doc["serving"] = json.loads(
+                doc["serving"] = dict(json.loads(
                     proc.stdout.decode().strip().splitlines()[-1]
-                )
+                ), **stamp)
             else:
-                doc["serving"] = {"error": f"exit {proc.returncode}"}
+                doc["serving"] = {"error": f"exit {proc.returncode}",
+                                  **stamp}
         except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
-            doc["serving"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            doc["serving"] = {"error": f"{type(e).__name__}: {e}"[:200],
+                              **stamp}
 
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")
     log(f"wrote {OUT}")
-    print(json.dumps({"artifact": os.path.relpath(OUT, REPO), **{
-        k: doc[k] for k in ("timestamp", "device")
-    }}))
+    print(json.dumps({"artifact": os.path.relpath(OUT, REPO),
+                      **doc["last_run"]}))
     return 0
 
 
