@@ -86,6 +86,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="eviction cap per defrag attempt",
     )
     parser.add_argument(
+        "--defrag-hold-ttl", type=float, default=45.0,
+        help="seconds the leaves an eviction freed stay reserved for "
+             "the guarantee pod that triggered it (0 disables holds)",
+    )
+    parser.add_argument(
+        "--percentage-of-nodes-to-score", type=int, default=0,
+        help="stop filtering once this %% of nodes yielded feasible "
+             "candidates (kube-scheduler analog); 0 = adaptive",
+    )
+    parser.add_argument(
+        "--min-feasible-nodes", type=int, default=64,
+        help="clusters at or under this size are always fully scanned; "
+             "also the sampling floor above it",
+    )
+    parser.add_argument(
         "--leader-elect", action="store_true",
         help="--kube mode: run Lease-based leader election "
              "(coordination.k8s.io); non-leaders stand by, so multiple "
@@ -372,6 +387,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         tracer=tracer,
         defrag=args.defrag,
         defrag_max_victims=args.defrag_max_victims,
+        defrag_hold_ttl=args.defrag_hold_ttl,
+        percentage_of_nodes_to_score=args.percentage_of_nodes_to_score,
+        min_feasible_nodes=args.min_feasible_nodes,
     )
     elector = None
     if args.leader_elect:

@@ -486,5 +486,11 @@ class TestDefragCli:
             "--topology", str(topo),
             "--cluster-state", str(state),
             "--defrag", "--defrag-max-victims", "3",
+            "--defrag-hold-ttl", "10",
+            "--percentage-of-nodes-to-score", "30",
+            "--min-feasible-nodes", "32",
         ])
         assert args.defrag and args.defrag_max_victims == 3
+        assert args.defrag_hold_ttl == 10.0
+        assert args.percentage_of_nodes_to_score == 30
+        assert args.min_feasible_nodes == 32
