@@ -135,6 +135,8 @@ class TpuShareScheduler:
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.min_feasible_nodes = min_feasible_nodes
         self._filter_cursor = 0
+        self.filter_scans = 0     # nodes examined across all attempts
+        self.filter_attempts = 0  # scheduling attempts that filtered
 
         cluster.on_pod_event(self._on_pod_add, self._on_pod_delete)
         cluster.on_node_event(self._on_node_update)
@@ -535,7 +537,10 @@ class TpuShareScheduler:
             target = self._feasible_target(len(names))
             anchor_nodes = {l.node for l in anchors if l.node}
             start = self._filter_cursor % max(1, len(names))
+            self.filter_attempts += 1
+            scans = 0
             for name in sorted(anchor_nodes & set(names)):
+                scans += 1
                 fit, reason = self.filter(pod, req, name)
                 if fit:
                     feasible.append(name)
@@ -551,6 +556,7 @@ class TpuShareScheduler:
                     consumed += 1
                     if name in anchor_nodes:
                         continue  # examined above
+                    scans += 1
                     fit, reason = self.filter(pod, req, name)
                     if fit:
                         feasible.append(name)
@@ -559,6 +565,7 @@ class TpuShareScheduler:
                     elif reason:
                         reasons.append(reason)
             self._filter_cursor = (start + consumed) % max(1, len(names))
+            self.filter_scans += scans
         if not feasible:
             evicted = self._maybe_defrag(pod, req, nodes)
             if evicted:
@@ -753,7 +760,30 @@ class TpuShareScheduler:
             expfmt.Sample(
                 "tpu_scheduler_defrag_evictions_total", {},
                 self.defrag_evictions,
-            )
+            ),
+            # live holds: LEAVES currently reserved for defrag
+            # beneficiaries. Expiry is lazy (checked on the filter
+            # path), so prune here too or a hold on a quiet node would
+            # read as stuck forever
+            expfmt.Sample(
+                "tpu_scheduler_defrag_held_leaves", {},
+                sum(
+                    len(leaves)
+                    for _, until, leaves in self._defrag_holds.values()
+                    if until > self.clock()
+                ),
+            ),
+            # sampling effectiveness: scans/attempt near the cluster
+            # size means sampling is off or feasibility is sparse;
+            # near min_feasible_nodes means it is doing its job
+            expfmt.Sample(
+                "tpu_scheduler_filter_scans_total", {},
+                self.filter_scans,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_filter_attempts_total", {},
+                self.filter_attempts,
+            ),
         ]
         for node in self.tree.nodes():
             # non-caching read: this runs on the metrics HTTP thread,

@@ -443,6 +443,13 @@ class TestDefragHold:
         d_small = engine.schedule_one(small)
         assert d_small.status == "unschedulable"
         assert "defrag-held" in d_small.message
+        # the observability gauge counts the HELD LEAVES (2 cleared +
+        # 2 whole-free the plan counts on), excluding expired holds
+        from kubeshare_tpu.utils import expfmt
+        [g] = expfmt.select(
+            engine.utilization_samples(), "tpu_scheduler_defrag_held_leaves"
+        )
+        assert g.value == 4
         d = engine.schedule_one(hero)
         assert d.status == "bound", d.message
 
@@ -459,6 +466,12 @@ class TestDefragHold:
         now["t"] = 46.0  # past the TTL: a crashed beneficiary must not
         d = engine.schedule_one(opp)  # pin capacity forever
         assert d.status == "bound", d.message
+        # and the gauge prunes the expired hold even on a quiet node
+        from kubeshare_tpu.utils import expfmt
+        [g] = expfmt.select(
+            engine.utilization_samples(), "tpu_scheduler_defrag_held_leaves"
+        )
+        assert g.value == 0
 
     def test_hold_dropped_when_beneficiary_deleted(self):
         cluster, engine = make_env()

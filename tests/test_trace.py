@@ -194,6 +194,11 @@ class TestSchedulerIntegration:
         full = get("tpu_scheduler_node_full_memory_bytes")
         free = get("tpu_scheduler_node_free_memory_bytes")
         assert full == 64 * GIB and free == full - 8 * GIB
+        # round-3 gauges: sampling scan accounting + live defrag holds
+        flat = lambda n: expfmt.select(samples, n)[0].value
+        assert flat("tpu_scheduler_filter_attempts_total") == 1
+        assert flat("tpu_scheduler_filter_scans_total") == 1  # 1 node
+        assert flat("tpu_scheduler_defrag_held_leaves") == 0
 
     def test_untraced_engine_unaffected(self):
         cluster, sched = self._env(None)
