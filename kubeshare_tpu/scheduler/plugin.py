@@ -739,6 +739,14 @@ class TpuShareScheduler:
         """Expire gang barriers. Returns keys of rejected pods (they
         re-enter the queue)."""
         now = self.clock()
+        # sweep expired defrag holds HERE (the scheduling thread is the
+        # dict's only mutator): expiry is otherwise lazy per-node on
+        # the filter path, and a hold on a node nothing filters against
+        # would linger in the dict forever
+        for node in [
+            n for n, hold in self._defrag_holds.items() if hold[1] <= now
+        ]:
+            self._defrag_holds.pop(node, None)
         rejected: List[str] = []
         for group_key, waiters in list(self._waiting.items()):
             if not waiters:
@@ -756,21 +764,24 @@ class TpuShareScheduler:
         the pod-manager port pool headroom. The reference exposes no
         view of its cell tree at all — fragmentation was only
         observable by reading scheduler logs."""
+        now = self.clock()
         samples: List[expfmt.Sample] = [
             expfmt.Sample(
                 "tpu_scheduler_defrag_evictions_total", {},
                 self.defrag_evictions,
             ),
             # live holds: LEAVES currently reserved for defrag
-            # beneficiaries. Expiry is lazy (checked on the filter
-            # path), so prune here too or a hold on a quiet node would
-            # read as stuck forever
+            # beneficiaries. This runs on the metrics HTTP thread while
+            # the scheduling thread mutates the dict: snapshot with
+            # list() (a size change mid-iteration raises) and only
+            # EXCLUDE expired entries — popping here would make a
+            # second mutator thread; tick() does the actual sweep
             expfmt.Sample(
                 "tpu_scheduler_defrag_held_leaves", {},
                 sum(
                     len(leaves)
-                    for _, until, leaves in self._defrag_holds.values()
-                    if until > self.clock()
+                    for _, until, leaves in list(self._defrag_holds.values())
+                    if until > now
                 ),
             ),
             # sampling effectiveness: scans/attempt near the cluster

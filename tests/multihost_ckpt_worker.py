@@ -1,0 +1,84 @@
+"""Worker for the two-process DISTRIBUTED checkpoint/resume e2e.
+
+Same gang bootstrap as multihost_worker.py (webhook-shaped env,
+hostname-ordinal process id), then, depending on MULTIHOST_PHASE:
+
+- ``save``: train 3 steps on a hybrid dp-over-processes x tp-local
+  mesh, checkpoint (params + opt_state + step) with every process
+  participating — the sharded-array path orbax coordinates across
+  processes — then KEEP TRAINING 2 more steps and record those losses
+  as the expected continuation.
+- ``restore``: fresh processes restore the checkpoint against sharded
+  templates and train 2 steps; bit-identical losses to the save
+  phase's continuation prove the restored (params, opt_state) triple
+  is the same distributed state, not a near miss.
+
+The reference leaves all of this to app containers (TorchElastic);
+here checkpoint/resume of sharded training state is framework API
+(kubeshare_tpu.models.checkpoint) and this is its multi-process
+proof.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    out_path = os.environ["MULTIHOST_OUT"]
+    hostname = os.environ["MULTIHOST_HOSTNAME"]
+    phase = os.environ["MULTIHOST_PHASE"]
+    ckpt_dir = os.environ["MULTIHOST_CKPT_DIR"]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from kubeshare_tpu.parallel.multihost import maybe_initialize
+
+    spec = maybe_initialize(hostname=hostname)
+    assert spec is not None
+
+    from multihost_common import build_training
+
+    from kubeshare_tpu.models.checkpoint import (
+        restore_checkpoint, save_checkpoint,
+    )
+
+    _, step, params, opt_state, batch = build_training(spec)
+
+    if phase == "save":
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, batch)
+        # every process participates: orbax writes each process's
+        # addressable shards and coordinates the atomic finalize
+        save_checkpoint(ckpt_dir, 3, params, opt_state)
+        continuation = []
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, batch)
+            continuation.append(float(loss))
+        doc = {"continuation": continuation}
+    elif phase == "restore":
+        got = restore_checkpoint(
+            ckpt_dir, params_template=params, opt_state_template=opt_state
+        )
+        assert got is not None, "no checkpoint found"
+        restored_step, params, opt_state = got
+        assert restored_step == 3
+        losses = []
+        for _ in range(2):
+            params, opt_state, loss = step(params, opt_state, batch)
+            losses.append(float(loss))
+        doc = {"restored_step": restored_step, "losses": losses}
+    else:
+        raise SystemExit(f"unknown phase {phase!r}")
+
+    doc["process_id"] = spec.process_id
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Shared training setup for the multihost worker subprocesses.
+
+Both multihost_worker.py (bootstrap + hybrid train e2e) and
+multihost_ckpt_worker.py (two-generation checkpoint/resume e2e) need
+the IDENTICAL model, optimizer, and global batch — the checkpoint
+test's bit-identical-loss assertion is only meaningful if the restore
+generation runs exactly the computation the save generation would
+have continued. One definition here keeps them from drifting apart.
+
+Import only from a process where ``maybe_initialize`` already ran
+(the mesh spans all processes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeshare_tpu.parallel.mesh import MeshPlan
+from kubeshare_tpu.parallel.multihost import hybrid_mesh
+from kubeshare_tpu.parallel.train import make_sharded_train_step
+
+GLOBAL_BATCH = 8
+
+
+def loss_fn(params, batch):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"])
+    logits = h @ params["w2"]
+    return jnp.mean((logits - y) ** 2)
+
+
+def build_training(spec):
+    """(mesh, step, params, opt_state, batch) on a hybrid
+    dp-over-processes x tp-local mesh; identical params on every
+    process (same seed) and the global batch sharded over dp via the
+    public global-array API."""
+    mesh = hybrid_mesh(MeshPlan(tp=jax.local_device_count()))
+
+    rng = jax.random.PRNGKey(7)
+    k1, k2 = jax.random.split(rng)
+    params = {
+        "w1": jax.random.normal(k1, (16, 32), jnp.float32) * 0.1,
+        "w2": jax.random.normal(k2, (32, 4), jnp.float32) * 0.1,
+    }
+    step, params, opt_state = make_sharded_train_step(
+        loss_fn, params, mesh, learning_rate=1e-2,
+        # tiny test params: no use sharding 16x32 over fsdp
+        fsdp=False,
+    )
+
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    g = np.random.RandomState(123)  # same on both: global batch defined once
+    full_x = g.randn(GLOBAL_BATCH, 16).astype(np.float32)
+    full_y = g.randn(GLOBAL_BATCH, 4).astype(np.float32)
+    share = GLOBAL_BATCH // spec.num_processes
+    lo = spec.process_id * share
+    x = jax.make_array_from_process_local_data(
+        batch_sharding, full_x[lo:lo + share],
+        global_shape=(GLOBAL_BATCH, 16),
+    )
+    y = jax.make_array_from_process_local_data(
+        batch_sharding, full_y[lo:lo + share],
+        global_shape=(GLOBAL_BATCH, 4),
+    )
+    return mesh, step, params, opt_state, (x, y)

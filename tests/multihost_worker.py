@@ -34,7 +34,6 @@ def main() -> None:
 
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     assert jax.process_count() == spec.num_processes
     assert jax.process_index() == spec.process_id
@@ -46,55 +45,18 @@ def main() -> None:
         jnp.array([float(spec.process_id)])
     )
 
-    # 2. hybrid mesh: dp spans the two processes (DCN), tp stays local
-    from kubeshare_tpu.parallel.mesh import MeshPlan
-    from kubeshare_tpu.parallel.multihost import hybrid_mesh
-    from kubeshare_tpu.parallel.train import make_sharded_train_step
+    # 2. hybrid mesh + sharded train step + dp-sharded global batch:
+    # one definition shared with the checkpoint worker
+    # (multihost_common.build_training)
+    from multihost_common import build_training
 
-    n_local = jax.local_device_count()
-    mesh = hybrid_mesh(MeshPlan(tp=n_local))
+    mesh, step, params, opt_state, batch = build_training(spec)
     assert mesh.shape["dp"] == spec.num_processes
-    assert mesh.shape["tp"] == n_local
-
-    # identical params on every process (same seed)
-    rng = jax.random.PRNGKey(7)
-    k1, k2 = jax.random.split(rng)
-    params = {
-        "w1": jax.random.normal(k1, (16, 32), jnp.float32) * 0.1,
-        "w2": jax.random.normal(k2, (32, 4), jnp.float32) * 0.1,
-    }
-
-    def loss_fn(params, batch):
-        x, y = batch
-        h = jnp.tanh(x @ params["w1"])
-        logits = h @ params["w2"]
-        return jnp.mean((logits - y) ** 2)
-
-    step, params, opt_state = make_sharded_train_step(
-        loss_fn, params, mesh, learning_rate=1e-2,
-        # tiny test params: no use sharding 16x32 over fsdp
-        fsdp=False,
-    )
-
-    # global batch of 8 rows sharded over dp: each process contributes
-    # its local half, built with the public global-array API
-    batch_sharding = NamedSharding(mesh, P("dp"))
-    g = np.random.RandomState(123)  # same on both: global batch defined once
-    full_x = g.randn(8, 16).astype(np.float32)
-    full_y = g.randn(8, 4).astype(np.float32)
-    half = 8 // spec.num_processes
-    lo = spec.process_id * half
-    x = jax.make_array_from_process_local_data(
-        batch_sharding, full_x[lo:lo + half], global_shape=(8, 16)
-    )
-    y = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("dp")), full_y[lo:lo + half],
-        global_shape=(8, 4),
-    )
+    assert mesh.shape["tp"] == jax.local_device_count()
 
     losses = []
     for _ in range(3):
-        params, opt_state, loss = step(params, opt_state, (x, y))
+        params, opt_state, loss = step(params, opt_state, batch)
         losses.append(float(loss))
 
     with open(out_path, "w") as f:

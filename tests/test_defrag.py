@@ -466,7 +466,8 @@ class TestDefragHold:
         now["t"] = 46.0  # past the TTL: a crashed beneficiary must not
         d = engine.schedule_one(opp)  # pin capacity forever
         assert d.status == "bound", d.message
-        # and the gauge prunes the expired hold even on a quiet node
+        # and the gauge excludes the expired hold even on a quiet node
+        # (tick() does the actual dict sweep on the scheduling thread)
         from kubeshare_tpu.utils import expfmt
         [g] = expfmt.select(
             engine.utilization_samples(), "tpu_scheduler_defrag_held_leaves"
