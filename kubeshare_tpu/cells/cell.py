@@ -220,11 +220,14 @@ class CellTree:
         self.free_list: Dict[str, Dict[int, List[Cell]]] = {}
         self.leaf_cells: Dict[str, Cell] = {}  # chip uuid -> leaf
         self._leaves_by_node: Dict[str, List[Cell]] = {}
-        # node -> (bound leaves in tree order, {model: bound leaves});
-        # invalidated on bind/unbind. leaves_on_node sits in the
-        # filter/score per-(pod,node) hot loop — recomputing the state
-        # filter there dominates large-cluster scheduling profiles.
-        self._bound_cache: Dict[str, Tuple[List[Cell], Dict[str, List[Cell]]]] = {}
+        # node -> (bound leaves in tree order, {model: bound leaves},
+        # sorted model names); invalidated on bind/unbind.
+        # leaves_on_node sits in the filter/score per-(pod,node) hot
+        # loop — recomputing the state filter (or re-sorting models)
+        # there dominates large-cluster scheduling profiles.
+        self._bound_cache: Dict[
+            str, Tuple[List[Cell], Dict[str, List[Cell]], List[str]]
+        ] = {}
         self.roots: List[Cell] = []
         for spec in cfg.cells:
             root = self._build_tree(spec)
@@ -469,7 +472,9 @@ class CellTree:
 
     # -- queries -------------------------------------------------------
 
-    def _bound_on_node(self, node: str) -> Tuple[List[Cell], Dict[str, List[Cell]]]:
+    def _bound_on_node(
+        self, node: str
+    ) -> Tuple[List[Cell], Dict[str, List[Cell]], List[str]]:
         cached = self._bound_cache.get(node)
         if cached is None:
             bound = [
@@ -480,14 +485,26 @@ class CellTree:
             by_model: Dict[str, List[Cell]] = {}
             for l in bound:
                 by_model.setdefault(l.leaf_cell_type, []).append(l)
-            cached = self._bound_cache[node] = (bound, by_model)
+            cached = self._bound_cache[node] = (
+                bound, by_model, sorted(by_model)
+            )
         return cached
 
     def leaves_on_node(self, node: str, model: Optional[str] = None) -> List[Cell]:
-        bound, by_model = self._bound_on_node(node)
+        bound, by_model, _ = self._bound_on_node(node)
         if model is not None:
             return list(by_model.get(model, ()))
         return list(bound)
+
+    def leaves_view(self, node: str, model: Optional[str] = None):
+        """Zero-copy read of the cached bound-leaf list for the
+        filter/score hot loop. Scheduling-thread only (it shares
+        ``_bound_cache``), and callers MUST NOT mutate the returned
+        sequence — use ``leaves_on_node`` for an owned copy."""
+        bound, by_model, _ = self._bound_on_node(node)
+        if model is not None:
+            return by_model.get(model, ())
+        return bound
 
     def scan_bound_leaves(self, node: str) -> List[Cell]:
         """Non-caching bound-leaf read for observer threads (the
@@ -504,4 +521,6 @@ class CellTree:
         return sorted(n for n in self._leaves_by_node if n)
 
     def models_on_node(self, node: str) -> List[str]:
-        return sorted(self._bound_on_node(node)[1])
+        # the cached sorted list; callers treat it as read-only (the
+        # per-call sort used to show up in 512-node profiles)
+        return self._bound_on_node(node)[2]

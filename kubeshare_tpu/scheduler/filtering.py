@@ -28,7 +28,7 @@ def shared_fit(
 ) -> bool:
     """A fractional pod fits if one healthy bound leaf has capacity.
     ``exclude`` leaves (defrag holds) are invisible to this pod."""
-    for leaf in tree.leaves_on_node(node, model):
+    for leaf in tree.leaves_view(node, model):
         if exclude and leaf.uuid in exclude:
             continue
         if leaf.healthy and fge(leaf.available, request) and leaf.free_memory >= memory:
@@ -38,7 +38,7 @@ def shared_fit(
 
 def _node_level_cells(tree: CellTree, node: str, model: str) -> List[Cell]:
     cells = {}
-    for leaf in tree.leaves_on_node(node, model):
+    for leaf in tree.leaves_view(node, model):
         cell: Optional[Cell] = leaf
         while cell is not None and not cell.is_node:
             cell = cell.parent
@@ -62,7 +62,7 @@ def multi_chip_fit(
                 return True
         return False
     groups: dict = {}
-    for leaf in tree.leaves_on_node(node, model):
+    for leaf in tree.leaves_view(node, model):
         cell: Optional[Cell] = leaf
         while cell is not None and not cell.is_node:
             cell = cell.parent

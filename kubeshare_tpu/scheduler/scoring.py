@@ -35,7 +35,7 @@ Anchor = Union[Cell, str]
 
 
 def regular_pod_node_score(tree: CellTree, node: str) -> float:
-    return 0.0 if tree.leaves_on_node(node) else 100.0
+    return 0.0 if tree.leaves_view(node) else 100.0
 
 
 def _usage_points(leaf: Cell) -> float:
@@ -92,7 +92,7 @@ def score_node(
 ) -> float:
     if req.kind == PodKind.REGULAR:
         return regular_pod_node_score(tree, node)
-    leaves = tree.leaves_on_node(node, req.model or None)
+    leaves = tree.leaves_view(node, req.model or None)
     if req.is_guarantee:
         return guarantee_node_score(leaves, anchors)
     return opportunistic_node_score(leaves)
@@ -132,7 +132,7 @@ def select_leaves(
     (divergence: the reference scores picks independently and can
     scatter a multi-chip pod across the fabric)."""
     leaves = [
-        l for l in tree.leaves_on_node(node, req.model or None)
+        l for l in tree.leaves_view(node, req.model or None)
         if l.healthy and (not exclude or l.uuid not in exclude)
     ]
     if req.kind == PodKind.MULTI_CHIP:
