@@ -17,6 +17,10 @@ bench: native
 engine-bench:
 	$(PYTHON) tools/engine_bench.py
 
+# defrag A/B over the 989-row reference-format trace -> SIM_REPLAY.json
+sim-replay:
+	$(PYTHON) tools/sim_replay.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -38,4 +42,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench dryrun images kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay dryrun images kind-e2e perf-evidence clean

@@ -300,9 +300,12 @@ class TestSimDefrag:
         assert frag.defrag_evicted == 2       # one victim per leaf
         assert frag.completed == base.completed == 5  # nothing lost
         # without defrag the guarantee pod waits ~35s for the leaves to
-        # drain; with it, it binds within the requeue backoff
-        assert max(base.wait_times) > 30.0
-        assert max(frag.wait_times) < 15.0
+        # drain; with it, it binds within the requeue backoff. Victims'
+        # resubmitted clones keep their ORIGINAL arrival time, so use
+        # the per-class split: their longer waits are the documented
+        # cost, not a regression of the guarantee win.
+        assert max(base.guarantee_waits) > 30.0
+        assert max(frag.guarantee_waits) < 15.0
 
     def test_pod_slice_scale_soak_with_defrag_and_faults(self):
         """Everything this round added, at pod-slice scale, at once:

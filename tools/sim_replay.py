@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Replay the reference-format workload trace through the engine and
+bank the defrag A/B as a committed artifact (SIM_REPLAY.json).
+
+The reference evaluated its scheduler by replaying a 989-arrival trace
+of sleep containers against a live cluster (its test/simulator). Here
+the same-shape trace (workloads/trace.txt, 989 rows, 57% fractional)
+runs through the REAL engine — PreFilter→Filter→Score→Reserve→bind,
+feasible-node sampling, gang/priority semantics, defrag with
+leaf-scoped holds — under the virtual clock, with and without
+--defrag, at a saturating scale (8 nodes / 32 chips) and a moderate
+one (16 nodes / 64 chips). No chip or cluster needed: this is the
+cluster-scale scheduling-policy evidence that stays bankable when the
+TPU tunnel is down.
+
+tests/test_sim_replay.py pins the committed artifact's invariants:
+defrag never loses completions, cuts guarantee-pod wait >= 3x at both
+scales, and its goodput cost at saturation stays on the books
+(utilization alone would flatter it — it counts evicted victims'
+discarded partial runs as busy time).
+
+Regenerate: ``make sim-replay`` (or python tools/sim_replay.py).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from kubeshare_tpu.sim.simulator import Simulator  # noqa: E402
+from kubeshare_tpu.sim.trace import load_trace  # noqa: E402
+
+CHIPS_PER_NODE = 4
+OUT = os.path.join(REPO, "SIM_REPLAY.json")
+
+
+def topology(n_nodes: int) -> dict:
+    return {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": CHIPS_PER_NODE,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": f"n{i:02d}"}
+            for i in range(n_nodes)
+        ],
+    }
+
+
+def replay(n_nodes: int, defrag: bool, events, seed: int = 7) -> dict:
+    sim = Simulator(
+        topology(n_nodes),
+        {f"n{i:02d}": CHIPS_PER_NODE for i in range(n_nodes)},
+        seed=seed,
+        defrag=defrag,
+    )
+    t0 = time.perf_counter()
+    report = sim.run(events)
+    doc = report.to_dict()
+    doc.update({
+        "nodes": n_nodes,
+        "chips": n_nodes * CHIPS_PER_NODE,
+        "defrag": defrag,
+        "wall_seconds": round(time.perf_counter() - t0, 2),
+    })
+    return doc
+
+
+def main() -> None:
+    events = load_trace(os.path.join(REPO, "workloads", "trace.txt"))
+    rows = []
+    for n_nodes in (8, 16):
+        for defrag in (False, True):
+            row = replay(n_nodes, defrag, events)
+            rows.append(row)
+            print(
+                f"{n_nodes:3d} nodes defrag={int(defrag)}: "
+                f"completed {row['completed']}/{row['submitted']}, "
+                f"utilization {row['utilization']:.4f}, "
+                f"mean wait {row['mean_wait_s']}s, "
+                f"evictions {row['defrag_evicted']}",
+                file=sys.stderr,
+            )
+    doc = {
+        "generated_by": "tools/sim_replay.py",
+        "trace": "workloads/trace.txt",
+        "trace_rows": len(events),
+        "note": "989-arrival reference-format trace through the real "
+                "engine under the virtual clock; defrag A/B per scale. "
+                "Invariants pinned by tests/test_sim_replay.py.",
+        "results": rows,
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {OUT}", file=sys.stderr)
+    print(json.dumps({"artifact": os.path.relpath(OUT, REPO),
+                      "rows": len(rows)}))
+
+
+if __name__ == "__main__":
+    main()
