@@ -91,6 +91,15 @@ def build_parser() -> argparse.ArgumentParser:
              "the guarantee pod that triggered it (0 disables holds)",
     )
     parser.add_argument(
+        "--defrag-eviction-rate", type=float, default=0.0,
+        help="cluster-wide defrag eviction budget per minute (0 = "
+             "unlimited). Bounds worst-case disruption under a steady "
+             "guarantee-pod stream; guarantee pods past the budget "
+             "wait as if defrag were off. SIM_REPLAY.json's trace "
+             "shows the trade: fewer evictions, longer guarantee "
+             "waits, goodput NOT recovered",
+    )
+    parser.add_argument(
         "--percentage-of-nodes-to-score", type=int, default=0,
         help="stop filtering once this %% of nodes yielded feasible "
              "candidates (kube-scheduler analog); 0 = adaptive",
@@ -388,6 +397,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         defrag=args.defrag,
         defrag_max_victims=args.defrag_max_victims,
         defrag_hold_ttl=args.defrag_hold_ttl,
+        defrag_eviction_rate=args.defrag_eviction_rate,
         percentage_of_nodes_to_score=args.percentage_of_nodes_to_score,
         min_feasible_nodes=args.min_feasible_nodes,
     )

@@ -79,6 +79,7 @@ class TpuShareScheduler:
         defrag_max_victims: int = 2,
         defrag_cooldown: float = 30.0,
         defrag_hold_ttl: float = 45.0,
+        defrag_eviction_rate: float = 0.0,
         percentage_of_nodes_to_score: int = 0,
         min_feasible_nodes: int = 64,
     ):
@@ -123,6 +124,22 @@ class TpuShareScheduler:
         # node -> (beneficiary, until, frozenset(leaf uuids)).
         self.defrag_hold_ttl = defrag_hold_ttl
         self._defrag_holds: Dict[str, tuple] = {}
+        # Global eviction budget (evictions/minute, 0 = unlimited): the
+        # per-pod cooldown bounds how often ONE pod evicts, but under a
+        # steady guarantee-pod stream each newcomer evicts once and the
+        # cluster-wide churn (goodput lost to discarded partial runs —
+        # measured in SIM_REPLAY.json) is unbounded. A sliding-window
+        # budget caps it; guarantee pods past the budget simply wait
+        # like they would without defrag.
+        if 0 < defrag_eviction_rate < 1:
+            # the window is one minute; a sub-1 budget would silently
+            # behave as 1/min (len >= rate can't trigger below one)
+            raise ValueError(
+                "defrag_eviction_rate must be 0 (unlimited) or >= 1 "
+                f"eviction/minute, got {defrag_eviction_rate}"
+            )
+        self.defrag_eviction_rate = defrag_eviction_rate
+        self._defrag_evict_times: List[float] = []
 
         # Feasible-node sampling (kube-scheduler percentageOfNodesToScore
         # analog): on big clusters, stop filtering once enough feasible
@@ -661,6 +678,19 @@ class TpuShareScheduler:
         last = self._defrag_last.get(pod.key)
         if last is not None and now - last < self.defrag_cooldown:
             return []  # this pod already cost evictions recently
+        max_victims = self.defrag_max_victims
+        if self.defrag_eviction_rate > 0:
+            self._defrag_evict_times = [
+                t for t in self._defrag_evict_times if t > now - 60.0
+            ]
+            remaining = int(
+                self.defrag_eviction_rate - len(self._defrag_evict_times)
+            )
+            if remaining <= 0:
+                return []  # cluster-wide budget spent this minute
+            # a multi-victim plan must fit the REMAINING budget or the
+            # realized rate overshoots the documented bound
+            max_victims = min(max_victims, remaining)
         from .defrag import find_plan
 
         excluded = set(self._defrag_inflight)
@@ -673,7 +703,7 @@ class TpuShareScheduler:
             }
         plan = find_plan(
             self.tree, self.status, [n.name for n in nodes], req,
-            max_victims=self.defrag_max_victims, excluded=excluded,
+            max_victims=max_victims, excluded=excluded,
         )
         if plan is None:
             return []
@@ -699,6 +729,10 @@ class TpuShareScheduler:
             # the guarantee pod before that would double-book HBM.
             # (kube-scheduler preemption waits the same way.)
             self.defrag_evictions += 1
+            if self.defrag_eviction_rate > 0:
+                # only track when budgeted: at rate=0 nothing prunes
+                # this list and it would grow for the process lifetime
+                self._defrag_evict_times.append(now)
             self._defrag_inflight.add(victim)
             evicted.append(victim)
             post = getattr(self.cluster, "post_event", None)

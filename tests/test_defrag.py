@@ -453,6 +453,47 @@ class TestDefragHold:
         d = engine.schedule_one(hero)
         assert d.status == "bound", d.message
 
+    def test_global_eviction_rate_budget(self):
+        """The cluster-wide budget caps evictions per sliding minute:
+        a second guarantee pod arriving with the budget spent waits as
+        if defrag were off, and the budget refills as the window
+        slides."""
+        now = {"t": 0.0}
+        cluster, engine = make_env(clock=lambda: now["t"],
+                                   defrag_eviction_rate=1.0)
+        fragment(cluster, engine)
+        h1 = cluster.create_pod(mk_pod("h1", 0.8, priority=50))
+        d = engine.schedule_one(h1)
+        assert "defrag" in d.message and len(cluster.evictions) == 1
+        assert engine.schedule_one(h1).status == "bound"
+        # budget spent: the next guarantee pod gets NO eviction
+        h2 = cluster.create_pod(mk_pod("h2", 0.8, priority=50))
+        d2 = engine.schedule_one(h2)
+        assert d2.status == "unschedulable"
+        assert len(cluster.evictions) == 1
+        # window slides: the budget refills
+        now["t"] = 61.0
+        d2 = engine.schedule_one(h2)
+        assert "defrag" in d2.message and len(cluster.evictions) == 2
+
+    def test_rate_budget_caps_multi_victim_plans(self):
+        """A plan larger than the REMAINING budget must not run: with
+        rate=1 a 2-victim multi-chip plan is refused outright (partial
+        eviction would be pointless, overshooting would break the
+        bound)."""
+        cluster, engine = make_env(defrag_eviction_rate=1.0)
+        fragment(cluster, engine)
+        hero = cluster.create_pod(mk_pod("hero", 2.0, 2.0, priority=50))
+        d = engine.schedule_one(hero)
+        assert d.status == "unschedulable"
+        assert cluster.evictions == []  # 2-victim plan > 1 budget
+
+    def test_fractional_rate_rejected(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="eviction"):
+            make_env(defrag_eviction_rate=0.5)
+
     def test_hold_expires_if_beneficiary_never_returns(self):
         now = {"t": 0.0}
         cluster, engine = make_env(clock=lambda: now["t"],
