@@ -33,26 +33,42 @@ def stack_stage_params(per_stage: Sequence) -> object:
 
 
 def _local_pipeline(stage_fn: Callable, params_local, x_mb):
-    """Runs inside shard_map: this device is stage ``i`` of ``P``.
+    """Runs inside shard_map: this device is ring position ``i`` of
+    ``P``, holding the CONTIGUOUS block of ``v`` consecutive global
+    stages ``i*v .. (i+1)*v - 1`` (v = the leaves' leading dim; v=1 is
+    plain GPipe). Each pipeline step applies the whole local chain,
+    then the activation hops one device forward — one pass around the
+    ring regardless of v, and the block layout is exactly what a
+    ``P("pp")`` sharding of the [S, ...] stacked params produces (no
+    resharding at dispatch).
 
-    params_local leaves: [1, ...] (this stage's slice); x_mb: [M, mb, ...]
-    (every device sees the full microbatch stream; only stage 0 feeds it).
-    Returns [M, mb, ...] (valid on every device after the final psum).
+    x_mb: [M, mb, ...] (every device sees the full microbatch stream;
+    only position 0 feeds it). Returns [M, mb, ...] (valid on every
+    device after the final psum).
     """
     num_stages = lax.axis_size("pp")
     stage_idx = lax.axis_index("pp")
-    params_here = jax.tree.map(lambda leaf: leaf[0], params_local)
+    v = jax.tree.leaves(params_local)[0].shape[0]
     num_mb = x_mb.shape[0]
     steps = num_mb + num_stages - 1
 
+    def chain(x):
+        # the device's v consecutive stages, in global order (static
+        # unroll: v is a trace-time constant)
+        for r in range(v):
+            x = stage_fn(
+                jax.tree.map(lambda leaf: leaf[r], params_local), x
+            )
+        return x
+
     def body(carry, t):
         incoming, outputs = carry
-        # stage 0 consumes microbatch t (clamped; masked past the end),
-        # later stages consume what the previous stage sent last step
+        # position 0 consumes microbatch t (clamped; masked past the
+        # end), later positions consume the previous hop's output
         feed = x_mb[jnp.clip(t, 0, num_mb - 1)]
         x_in = jnp.where(stage_idx == 0, feed, incoming)
-        y = stage_fn(params_here, x_in)
-        # the last stage emits microbatch t-(P-1)'s result
+        y = chain(x_in)
+        # the last position emits microbatch t-(P-1)'s result
         out_idx = t - (num_stages - 1)
         write = jnp.logical_and(stage_idx == num_stages - 1, out_idx >= 0)
         outputs = lax.dynamic_update_index_in_dim(
@@ -76,7 +92,7 @@ def _local_pipeline(stage_fn: Callable, params_local, x_mb):
         jnp.zeros((num_mb,) + x_mb.shape[1:], x_mb.dtype),
     )
     (_, outputs), _ = lax.scan(body, init, jnp.arange(steps))
-    # only the last stage holds real outputs; share them with every stage
+    # only the last position holds real outputs; share with every device
     outputs = jnp.where(stage_idx == num_stages - 1, outputs, 0.0)
     return lax.psum(outputs, "pp")
 
@@ -88,19 +104,30 @@ def pipeline_apply(
     num_microbatches: int,
     mesh: Mesh,
 ):
-    """Run ``stage_fn`` as a P-stage pipeline over mesh axis ``pp``.
+    """Run ``stage_fn`` as an S-stage pipeline over mesh axis ``pp``.
 
-    stacked_params: leaves [P, ...] (see stack_stage_params), sharded on
-    the pp axis. x: [B, ...] with B divisible by num_microbatches.
-    Returns [B, ...].
+    stacked_params: leaves [S, ...] (see stack_stage_params), S a
+    multiple of the mesh's pp size P. S == P is the plain GPipe
+    schedule. S > P keeps the SAME single ring pass: device i holds
+    the contiguous block of v = S/P consecutive stages and applies its
+    whole chain each step — deep models memory-balance over few
+    devices with no extra collectives, and the layout matches what
+    ``shard_stacked_params``'s plain ``P("pp")`` placement produces.
+    Bubble fraction stays (P-1)/(M+P-1); reducing it further would
+    need a fwd/bwd-interleaved 1F1B schedule.
+    x: [B, ...] with B divisible by num_microbatches. Returns [B, ...].
     """
-    num_stages = mesh.shape["pp"]
+    num_devices = mesh.shape["pp"]
     leading = {leaf.shape[0] for leaf in jax.tree.leaves(stacked_params)}
-    if leading != {num_stages}:
+    if len(leading) != 1:
         raise ValueError(
-            f"stacked params have leading dims {sorted(leading)}, "
-            f"mesh pp axis is {num_stages} — each leaf must stack exactly "
-            "one slice per stage"
+            f"stacked params have mixed leading dims {sorted(leading)}"
+        )
+    [num_stages] = leading
+    if num_stages % num_devices:
+        raise ValueError(
+            f"{num_stages} stages not divisible over the mesh's "
+            f"pp={num_devices} devices"
         )
     batch = x.shape[0]
     if batch % num_microbatches:
@@ -125,7 +152,10 @@ def pipeline_apply(
 
 
 def shard_stacked_params(stacked_params, mesh: Mesh):
-    """Place stacked stage params so stage i's slice lives on pp=i."""
+    """Place stacked stage params on the pp axis: device i holds the
+    contiguous block of S/P consecutive stages — exactly the layout
+    ``pipeline_apply`` consumes, for S == P (one stage each) and
+    S > P (local chains) alike."""
     return jax.tree.map(
         lambda leaf: jax.device_put(
             leaf,

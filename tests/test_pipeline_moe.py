@@ -69,6 +69,55 @@ class TestPipeline:
             float(jnp.abs(g).sum()) > 0 for g in flat
         )
 
+    @pytest.mark.parametrize("num_mb", [2, 4])
+    def test_circular_more_stages_than_devices(self, num_mb):
+        """S=8 stages over pp=2 devices: the circular schedule makes
+        S/P=4 passes around the ring; device i holds stages i, P+i,
+        ... and the result must match sequential application exactly."""
+        dim, batch, stages, devices = 16, 8, 8, 2
+        per_stage = _make_stages(stages, dim)
+        x = jax.random.normal(jax.random.PRNGKey(3), (batch, dim))
+
+        expected = x
+        for p in per_stage:
+            expected = _dense_stage(p, expected)
+
+        mesh = make_mesh(MeshPlan(pp=devices, dp=4))
+        # through shard_stacked_params: the committed placement (device
+        # i holds the contiguous block of S/P stages) must be exactly
+        # the layout pipeline_apply consumes — no dispatch resharding
+        stacked = shard_stacked_params(stack_stage_params(per_stage), mesh)
+        got = pipeline_apply(_dense_stage, stacked, x, num_mb, mesh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_circular_grads_finite(self):
+        dim, batch, stages, devices = 8, 8, 4, 2
+        per_stage = _make_stages(stages, dim)
+        mesh = make_mesh(MeshPlan(pp=devices, dp=4))
+        stacked = stack_stage_params(per_stage)
+        x = jax.random.normal(jax.random.PRNGKey(4), (batch, dim))
+
+        @jax.jit
+        def loss(params, x):
+            y = pipeline_apply(_dense_stage, params, x, 4, mesh)
+            return jnp.mean(y ** 2)
+
+        val, grads = jax.value_and_grad(loss)(stacked, x)
+        assert np.isfinite(float(val))
+        assert all(
+            np.isfinite(np.asarray(g)).all() for g in jax.tree.leaves(grads)
+        )
+
+    def test_indivisible_stage_count_rejected(self):
+        mesh = make_mesh(MeshPlan(pp=2, dp=4))
+        per_stage = _make_stages(3, 8)  # 3 stages over pp=2
+        x = jnp.zeros((8, 8))
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_apply(
+                _dense_stage, stack_stage_params(per_stage), x, 4, mesh
+            )
+
     def test_batch_divisibility_enforced(self):
         mesh = make_mesh(MeshPlan(pp=2, dp=4))
         per_stage = _make_stages(2, 4)
@@ -77,10 +126,11 @@ class TestPipeline:
             pipeline_apply(_dense_stage, stacked,
                            jnp.zeros((6, 4)), 4, mesh)
 
-    def test_stage_count_mismatch_rejected(self):
+    def test_mixed_leading_dims_rejected(self):
         mesh = make_mesh(MeshPlan(pp=2, dp=4))
-        stacked = stack_stage_params(_make_stages(4, 4))  # 4 stages, pp=2
-        with pytest.raises(ValueError, match="one slice per stage"):
+        stacked = stack_stage_params(_make_stages(2, 4))
+        stacked = dict(stacked, extra=jnp.zeros((3, 4)))  # stray leaf
+        with pytest.raises(ValueError, match="mixed leading"):
             pipeline_apply(_dense_stage, stacked, jnp.zeros((8, 4)), 4, mesh)
 
 
