@@ -116,32 +116,95 @@ def llama_hidden(
     (everything but the lm_head matmul — the chunked loss fuses that
     matmul into its online softmax, ops/xent.py)."""
     dtype = jnp.dtype(cfg.dtype)
-    batch, seq = tokens.shape
-    hd = cfg.dim // cfg.num_heads
+    seq = tokens.shape[1]
     if positions is None:
         positions = jnp.arange(seq)
     x = params["embed"]["table"].astype(dtype)[tokens]
     for i in range(cfg.layers):
-        layer = params[f"layer{i}"]
-        h = rmsnorm(layer["attn_norm"], x)
-        q = _matmul(h, layer["wq"], dtype).reshape(batch, seq, cfg.num_heads, hd)
-        k = _matmul(h, layer["wk"], dtype).reshape(batch, seq, cfg.num_kv_heads, hd)
-        v = _matmul(h, layer["wv"], dtype).reshape(batch, seq, cfg.num_kv_heads, hd)
-        q = jnp.swapaxes(q, 1, 2)   # [B, H, T, D]
-        k = jnp.swapaxes(k, 1, 2)
-        v = jnp.swapaxes(v, 1, 2)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        out = mha(q, k, v, causal=True, use_flash=use_flash)
-        out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)
-        x = x + _matmul(out, layer["wo"], dtype)
-
-        h = rmsnorm(layer["mlp_norm"], x)
-        gate = jax.nn.silu(_matmul(h, layer["w_gate"], dtype))
-        up = _matmul(h, layer["w_up"], dtype)
-        x = x + _matmul(gate * up, layer["w_down"], dtype)
+        x = llama_block(params[f"layer{i}"], x, positions, cfg, use_flash)
     x = rmsnorm(params["final_norm"], x)
     return x
+
+
+def llama_block(
+    layer: Dict,
+    x: jnp.ndarray,
+    positions: jnp.ndarray,
+    cfg: LlamaConfig,
+    use_flash: Optional[bool] = None,
+) -> jnp.ndarray:
+    """One pre-norm transformer block: [B, T, dim] -> [B, T, dim].
+    Shared by the sequential trunk (llama_hidden) and the
+    pipeline-parallel trunk (llama_pipeline_hidden) so the two can
+    never compute different math."""
+    dtype = jnp.dtype(cfg.dtype)
+    batch, seq = x.shape[0], x.shape[1]
+    hd = cfg.dim // cfg.num_heads
+    h = rmsnorm(layer["attn_norm"], x)
+    q = _matmul(h, layer["wq"], dtype).reshape(batch, seq, cfg.num_heads, hd)
+    k = _matmul(h, layer["wk"], dtype).reshape(batch, seq, cfg.num_kv_heads, hd)
+    v = _matmul(h, layer["wv"], dtype).reshape(batch, seq, cfg.num_kv_heads, hd)
+    q = jnp.swapaxes(q, 1, 2)   # [B, H, T, D]
+    k = jnp.swapaxes(k, 1, 2)
+    v = jnp.swapaxes(v, 1, 2)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    out = mha(q, k, v, causal=True, use_flash=use_flash)
+    out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)
+    x = x + _matmul(out, layer["wo"], dtype)
+
+    h = rmsnorm(layer["mlp_norm"], x)
+    gate = jax.nn.silu(_matmul(h, layer["w_gate"], dtype))
+    up = _matmul(h, layer["w_up"], dtype)
+    return x + _matmul(gate * up, layer["w_down"], dtype)
+
+
+def llama_stack_layers(params: Dict, cfg: LlamaConfig):
+    """Stack the trunk's per-layer weights into pipeline stages
+    ([layers, ...] leaves). Do this ONCE at setup (and place with
+    parallel.shard_stacked_params) — stacking inside the step function
+    would copy and reshard every trunk weight on every call."""
+    from ..parallel.pipeline import stack_stage_params
+
+    return stack_stage_params(
+        [params[f"layer{i}"] for i in range(cfg.layers)]
+    )
+
+
+def llama_pipeline_hidden(
+    params: Dict,
+    tokens: jnp.ndarray,
+    cfg: LlamaConfig,
+    mesh,
+    num_microbatches: int,
+    use_flash: Optional[bool] = None,
+    stacked_layers=None,
+) -> jnp.ndarray:
+    """The trunk with its transformer blocks run as a pipeline over
+    the mesh's ``pp`` axis (parallel/pipeline.py): layers stack into
+    stages (cfg.layers may exceed the pp size — each device then
+    chains a contiguous block of layers), microbatching over the
+    batch dim. Embedding, final norm, and the loss stay outside the
+    pipeline. Same math as llama_hidden by construction (llama_block
+    is shared).
+
+    Pass ``stacked_layers`` (llama_stack_layers at setup, placed via
+    shard_stacked_params) in training loops; leaving it None stacks
+    per call, which is convenient but copies the trunk each step."""
+    from ..parallel.pipeline import pipeline_apply
+
+    dtype = jnp.dtype(cfg.dtype)
+    seq = tokens.shape[1]
+    positions = jnp.arange(seq)
+    x = params["embed"]["table"].astype(dtype)[tokens]
+    if stacked_layers is None:
+        stacked_layers = llama_stack_layers(params, cfg)
+
+    def stage(layer, xb):
+        return llama_block(layer, xb, positions, cfg, use_flash)
+
+    x = pipeline_apply(stage, stacked_layers, x, num_microbatches, mesh)
+    return rmsnorm(params["final_norm"], x)
 
 
 def llama_loss(

@@ -134,6 +134,43 @@ class TestPipeline:
             pipeline_apply(_dense_stage, stacked, jnp.zeros((8, 4)), 4, mesh)
 
 
+class TestLlamaPipeline:
+    def test_flagship_trunk_matches_sequential(self):
+        """The flagship model's blocks through the real pipeline:
+        8 layers chained 2-per-device over pp=4 must equal the
+        sequential trunk exactly (llama_block is shared, so only the
+        schedule can diverge — and it must not)."""
+        from kubeshare_tpu.models import LlamaConfig, init_llama
+        from kubeshare_tpu.models.llama import (
+            llama_hidden, llama_pipeline_hidden,
+        )
+        from kubeshare_tpu.parallel import MeshPlan, make_mesh
+
+        cfg = LlamaConfig(
+            vocab=128, dim=32, layers=8, num_heads=4, num_kv_heads=2,
+            mlp_dim=64, max_seq_len=16, dtype="float32",
+        )
+        params = init_llama(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (4, 16), 0, cfg.vocab, dtype=jnp.int32
+        )
+        ref = llama_hidden(params, tokens, cfg)
+        mesh = make_mesh(MeshPlan(pp=4, dp=2))
+        # the training-loop pattern: stack + place ONCE at setup
+        from kubeshare_tpu.models.llama import llama_stack_layers
+        from kubeshare_tpu.parallel import shard_stacked_params
+
+        stacked = shard_stacked_params(llama_stack_layers(params, cfg), mesh)
+        got = jax.jit(
+            lambda p, s, t: llama_pipeline_hidden(
+                p, t, cfg, mesh, 2, stacked_layers=s
+            )
+        )(params, stacked, tokens)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
 class TestMoe:
     def test_shapes_and_aux(self):
         cfg = MoeConfig(dim=32, mlp_dim=64, experts=4, top_k=2)
