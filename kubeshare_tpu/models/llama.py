@@ -179,6 +179,7 @@ def llama_pipeline_hidden(
     num_microbatches: int,
     use_flash: Optional[bool] = None,
     stacked_layers=None,
+    remat: bool = True,
 ) -> jnp.ndarray:
     """The trunk with its transformer blocks run as a pipeline over
     the mesh's ``pp`` axis (parallel/pipeline.py): layers stack into
@@ -203,7 +204,8 @@ def llama_pipeline_hidden(
     def stage(layer, xb):
         return llama_block(layer, xb, positions, cfg, use_flash)
 
-    x = pipeline_apply(stage, stacked_layers, x, num_microbatches, mesh)
+    x = pipeline_apply(stage, stacked_layers, x, num_microbatches, mesh,
+                       remat=remat)
     return rmsnorm(params["final_norm"], x)
 
 
