@@ -125,6 +125,63 @@ def _build(model: str, batch: int, rng):
     return params, loss_fn, make_batch
 
 
+def _distribute(spec, params, loss_fn, make_batch, args, log):
+    """Turn the single-process training pieces into the dp-sharded
+    multi-process setup the distribute corpus describes: mesh dp =
+    processes x local devices, gradients all-reduced by the sharded
+    step, and each process contributing its OWN shard of the global
+    batch (fold the ordinal into the data key). Returns
+    (params, opt_state, step, make_batch) drop-ins — the training
+    loop, gate, and checkpointing do not change (checkpoint saves are
+    cooperative: every process writes its shards, models/checkpoint)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import MeshPlan
+    from ..parallel.multihost import granule_device_count, hybrid_mesh
+    from ..parallel.train import make_sharded_train_step
+
+    # per-GRANULE dp (a granule is a pod slice on multi-slice
+    # topologies, a process elsewhere — local_device_count would be
+    # wrong whenever one slice spans several hosts)
+    per_granule = granule_device_count()
+    if args.batch % max(jax.local_device_count(), 1):
+        raise SystemExit(
+            f"--batch {args.batch} must divide over "
+            f"{jax.local_device_count()} local devices"
+        )
+    mesh = hybrid_mesh(MeshPlan(dp=per_granule))
+    sharding = NamedSharding(mesh, P("dp"))
+    step_fn, params, opt_state = make_sharded_train_step(
+        lambda p, b: loss_fn(p, *b), params, mesh,
+        learning_rate=args.lr, fsdp=False,
+        # dim-0-only batch spec: workload batches mix ranks (images
+        # rank 4, labels rank 1) and the default rank-2 spec rejects
+        # the labels
+        batch_spec=sharding,
+    )
+    world = spec.num_processes
+
+    def make_global(key):
+        local = make_batch(jax.random.fold_in(key, spec.process_id))
+        return tuple(
+            jax.make_array_from_process_local_data(
+                sharding, arr,
+                global_shape=(arr.shape[0] * world,) + tuple(arr.shape[1:]),
+            )
+            for arr in local
+        )
+
+    def step(params, opt_state, *batch):
+        return step_fn(params, opt_state, batch)
+
+    log.info(
+        "distributed dp: process %d/%d, %d devices per granule (mesh %s)",
+        spec.process_id, world, per_granule, dict(mesh.shape),
+    )
+    return params, opt_state, step, make_global
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     log = component_logger("workload", args)
@@ -135,14 +192,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     gate = install_gate()
 
+    import os
+
     import jax
+
+    # honor JAX_PLATFORMS explicitly: on hosts where a site plugin
+    # force-selects itself at interpreter startup (the axon
+    # sitecustomize), the env var alone is trampled and only the
+    # config route wins — a user asking for cpu must get cpu
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    # gang pods bootstrap jax.distributed BEFORE any device touch: the
+    # webhook injects the headcount, the workload spec carries the
+    # coordinator address, the hostname/JOB_COMPLETION_INDEX carries
+    # the ordinal (workloads/distribute corpus; parallel/multihost.py)
+    from ..parallel.multihost import maybe_initialize
+
+    spec = maybe_initialize()
 
     from ..models.train import make_train_step
 
     rng = jax.random.PRNGKey(args.seed)
     params, loss_fn, make_batch = _build(args.model, args.batch, rng)
-    opt, step = make_train_step(loss_fn, learning_rate=args.lr)
-    opt_state = opt.init(params)
+    if spec is not None:
+        params, opt_state, step, make_batch = _distribute(
+            spec, params, loss_fn, make_batch, args, log
+        )
+    else:
+        opt, step = make_train_step(loss_fn, learning_rate=args.lr)
+        opt_state = opt.init(params)
 
     start_step = 0
     if args.checkpoint_dir:
@@ -156,12 +235,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # warmup compile outside the gated loop; outputs are discarded so a
     # restored (params, opt_state) enters the loop exactly as saved —
     # keeping them would apply a phantom update the step counter never
-    # records, making resumed runs diverge from uninterrupted ones
+    # records, making resumed runs diverge from uninterrupted ones.
+    # The DISTRIBUTED step donates its input buffers, so that path
+    # warms up on copies; the single-process step does not donate, and
+    # copying there would transiently double params+opt state HBM on
+    # exactly the fractional pods this framework carves out
     key = jax.random.PRNGKey(args.seed + 1)
     batch = make_batch(key)
-    _warm_params, _warm_opt, loss = step(params, opt_state, *batch)
+    if spec is not None:
+        import jax.numpy as jnp
+
+        warm_p = jax.tree.map(jnp.copy, params)
+        warm_o = jax.tree.map(jnp.copy, opt_state)
+    else:
+        warm_p, warm_o = params, opt_state
+    _warm_params, _warm_opt, loss = step(warm_p, warm_o, *batch)
     jax.block_until_ready(loss)
-    del _warm_params, _warm_opt
+    del _warm_params, _warm_opt, warm_p, warm_o
 
     log.info("workload %s batch=%d starting", args.model, args.batch)
     if args.profile_dir:
@@ -221,8 +311,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "model": args.model,
         "steps": steps_done,
         "batch": args.batch,
+        "processes": spec.num_processes if spec is not None else 1,
+        "global_batch": args.batch * (
+            spec.num_processes if spec is not None else 1
+        ),
         "seconds": round(elapsed, 3),
-        "samples_per_s": round(steps_done * args.batch / max(elapsed, 1e-9), 1),
+        # GLOBAL throughput: in a dp gang every process contributes
+        # its shard to each step, so one worker's line must not
+        # understate the gang by its world size
+        "samples_per_s": round(
+            steps_done * args.batch
+            * (spec.num_processes if spec is not None else 1)
+            / max(elapsed, 1e-9), 1,
+        ),
         "final_loss": float(loss),
         "tokens_acquired": gate.tokens_acquired,
         "compute_ms": round(gate.compute_ms, 1),

@@ -111,6 +111,31 @@ def maybe_initialize(
     return spec
 
 
+def _granules():
+    """(by_slice, count, devices_per_granule). A granule is the
+    ICI-connected unit the dp/DCN axis spans: TPU pod slices carry a
+    slice_index and one slice can span processes (a per-granule plan
+    then describes a whole SLICE); CPU simulation and single-host
+    slices don't, so the granule is the process there."""
+    slice_ids = {
+        s for s in (
+            getattr(d, "slice_index", None) for d in jax.devices()
+        ) if s is not None
+    }
+    by_slice = len(slice_ids) > 1
+    n_granules = len(slice_ids) if by_slice else jax.process_count()
+    return by_slice, n_granules, jax.device_count() // max(n_granules, 1)
+
+
+def granule_device_count() -> int:
+    """Devices in one ICI granule — what a per-granule MeshPlan's axes
+    must multiply to (e.g. ``MeshPlan(dp=granule_device_count())`` for
+    pure data parallelism over every device). ``local_device_count``
+    is WRONG for this on multi-slice topologies whose slices span
+    several hosts."""
+    return _granules()[2]
+
+
 def hybrid_mesh(plan: Optional[MeshPlan] = None) -> Mesh:
     """Mesh whose dp axis spans ICI granules (over DCN) and whose
     remaining axes stay within each granule's ICI domain.
@@ -121,20 +146,8 @@ def hybrid_mesh(plan: Optional[MeshPlan] = None) -> Mesh:
     values (multi-slice training), else a process (CPU simulation,
     single-slice). With one process this is exactly ``make_mesh(plan)``.
     """
-    n_local = jax.local_device_count()
     n_hosts = jax.process_count()
-    # granule = the ICI-connected unit the dp/DCN axis spans. TPU pod
-    # slices carry a slice_index and one slice can span processes (the
-    # plan then describes a whole SLICE); CPU simulation and
-    # single-host slices don't, so the granule is the process there.
-    slice_ids = {
-        s for s in (
-            getattr(d, "slice_index", None) for d in jax.devices()
-        ) if s is not None
-    }
-    by_slice = len(slice_ids) > 1
-    n_granules = len(slice_ids) if by_slice else n_hosts
-    per_granule = jax.device_count() // max(n_granules, 1)
+    by_slice, n_granules, per_granule = _granules()
     if plan is None:
         plan = MeshPlan(tp=per_granule) if per_granule > 1 else MeshPlan()
     if plan.total != per_granule:
