@@ -268,7 +268,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         while True:
             if args.steps and steps_done >= args.steps:
                 break
-            if args.duration and time.perf_counter() - started >= args.duration:
+            stop = bool(
+                args.duration
+                and time.perf_counter() - started >= args.duration
+            )
+            if spec is not None and args.duration:
+                # the stop decision must be COLLECTIVE in a gang: the
+                # step is a cross-process all-reduce, so one worker
+                # breaking on its local clock while a peer dispatches
+                # the next step deadlocks the peer (and makes the final
+                # cooperative checkpoint save hang). Any worker past
+                # its deadline stops everyone, before anyone dispatches.
+                from jax.experimental import multihost_utils
+
+                import jax.numpy as jnp
+
+                stop = bool(multihost_utils.process_allgather(
+                    jnp.array([stop])
+                ).any())
+            if stop:
                 break
             key, sub = jax.random.split(key)
             batch = make_batch(sub)
@@ -307,22 +325,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             log.info("profiler trace written to %s", args.profile_dir)
     elapsed = time.perf_counter() - started
     gate.close()
+    world = spec.num_processes if spec is not None else 1
     print(json.dumps({
         "model": args.model,
         "steps": steps_done,
         "batch": args.batch,
-        "processes": spec.num_processes if spec is not None else 1,
-        "global_batch": args.batch * (
-            spec.num_processes if spec is not None else 1
-        ),
+        "processes": world,
+        "global_batch": args.batch * world,
         "seconds": round(elapsed, 3),
         # GLOBAL throughput: in a dp gang every process contributes
         # its shard to each step, so one worker's line must not
         # understate the gang by its world size
         "samples_per_s": round(
-            steps_done * args.batch
-            * (spec.num_processes if spec is not None else 1)
-            / max(elapsed, 1e-9), 1,
+            steps_done * args.batch * world / max(elapsed, 1e-9), 1,
         ),
         "final_loss": float(loss),
         "tokens_acquired": gate.tokens_acquired,
