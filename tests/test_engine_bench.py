@@ -27,7 +27,7 @@ class TestCommittedArtifact:
         doc = json.load(open(ARTIFACT))
         assert doc["generated_by"] == "tools/engine_bench.py"
         by_nodes = {r["nodes"]: r for r in doc["results"]}
-        assert set(by_nodes) == {32, 128, 512}
+        assert set(by_nodes) == {32, 128, 512, 1024}
         for r in doc["results"]:
             assert r["placements_per_sec"] > 0
             assert r["bound"] > 0
@@ -49,6 +49,14 @@ class TestCommittedArtifact:
             "committed 512-node engine bench fell below the floor; "
             "investigate before regenerating ENGINE_BENCH.json"
         )
+
+    def test_recorded_floor_1024_nodes(self):
+        """Sampling bounds per-pod cost, so the rate must stay
+        near-flat from 512 to 1024 nodes (4096 chips) — an O(nodes)
+        regression would halve it instead."""
+        doc = json.load(open(ARTIFACT))
+        [r1k] = [r for r in doc["results"] if r["nodes"] == 1024]
+        assert r1k["placements_per_sec"] >= 1000
 
 
 class TestFreshRunFloor:

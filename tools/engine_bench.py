@@ -3,8 +3,8 @@
 
 Runs the virtual-clock simulator (no JAX, no chips, pure engine hot
 path: PreFilter -> Filter over all nodes -> Score -> Reserve -> bind)
-over a synthetic Poisson trace at 32, 128, and 512 nodes (2048 chips —
-pod-slice scale) and writes ENGINE_BENCH.json at the repo root.
+over a synthetic Poisson trace at 32, 128, 512, and 1024 nodes (4096
+chips) and writes ENGINE_BENCH.json at the repo root.
 tests/test_engine_bench.py asserts a regression floor against a fresh
 in-process run, and that this artifact stays in sync with the tool.
 
@@ -77,7 +77,7 @@ def run(n_nodes: int, events: int = EVENTS, seed: int = 0) -> dict:
 
 
 def main() -> None:
-    results = [run(32), run(128), run(512)]
+    results = [run(32), run(128), run(512), run(1024)]
     doc = {
         "generated_by": "tools/engine_bench.py",
         "note": "virtual-clock simulator; engine hot path only "
