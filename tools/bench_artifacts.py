@@ -41,27 +41,10 @@ def log(msg: str) -> None:
 
 
 def chip_probe(wall: float = 60.0) -> dict:
-    code = (
-        "import json,os,sys,time\n"
-        "t0=time.time()\n"
-        "import jax, jax.numpy as jnp\n"
-        "p=os.environ.get('KUBESHARE_BENCH_PLATFORM')\n"
-        "p and jax.config.update('jax_platforms', p)\n"
-        "d=jax.devices()[0]\n"
-        "y=float((jnp.ones((128,128),jnp.float32)@"
-        "jnp.ones((128,128),jnp.float32)).sum())\n"
-        "print(json.dumps({'ok': y==128.0**3, 'platform': d.platform,"
-        " 'device': str(d), 'device_kind': d.device_kind,"
-        " 'probe_s': round(time.time()-t0,1)}))\n"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True, timeout=wall, env=dict(os.environ),
-        )
-        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
-    except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
-        return {"ok": False, "error": f"chip probe failed: {e}"}
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from chip_probe import probe  # the shared watchdogged probe
+
+    return probe(wall)
 
 
 def main() -> int:

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Watchdogged chip-reachability probe: prints one JSON line and never
+hangs.
+
+On this platform a dead tunnel makes plain ``jax.devices()`` hang
+indefinitely (>120s measured) — no in-process timeout can interrupt
+it, so the touch happens in a killable subprocess. Exit 0 = chip
+answered (device + timing in the JSON); exit 1 = unreachable (reason
+in the JSON). Used standalone before chip-dependent work
+(``make perf-evidence``, real-plugin smoke) and as the pattern inside
+bench.py / tools/bench_artifacts.py.
+
+Usage: python tools/chip_probe.py [wall_seconds=45]
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+CODE = (
+    "import json,os,sys,time\n"
+    "t0=time.time()\n"
+    "import jax, jax.numpy as jnp\n"
+    "p=os.environ.get('KUBESHARE_BENCH_PLATFORM')\n"
+    "p and jax.config.update('jax_platforms', p)\n"
+    "d=jax.devices()[0]\n"
+    "y=float((jnp.ones((128,128),jnp.float32)@"
+    "jnp.ones((128,128),jnp.float32)).sum())\n"
+    "print(json.dumps({'ok': y==128.0**3, 'platform': d.platform,"
+    " 'device': str(d), 'device_kind': d.device_kind,"
+    " 'probe_s': round(time.time()-t0,1)}))\n"
+)
+
+
+def probe(wall: float = 45.0) -> dict:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", CODE],
+            capture_output=True, timeout=wall, env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False,
+                "error": f"no answer in {wall:.0f}s "
+                         "(tunnel unreachable or backend hung)"}
+    if proc.returncode != 0:
+        tail = proc.stderr.decode(errors="replace").strip().splitlines()
+        return {"ok": False,
+                "error": "probe exit %d: %s"
+                         % (proc.returncode, tail[-1] if tail else "")}
+    try:
+        return json.loads(proc.stdout.decode().strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"ok": False, "error": f"bad probe output: {e}"}
+
+
+if __name__ == "__main__":
+    wall = float(sys.argv[1]) if len(sys.argv) > 1 else 45.0
+    doc = probe(wall)
+    print(json.dumps(doc))
+    sys.exit(0 if doc.get("ok") else 1)
