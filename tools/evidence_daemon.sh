@@ -1,0 +1,125 @@
+#!/usr/bin/env bash
+# Standing evidence trigger (VERDICT r3 #1): probe the chip tunnel on a
+# loop; on the FIRST healthy probe, bank the three perf-evidence
+# artifacts the project has been missing since round 1 and commit them:
+#   1. bench.py headline            -> artifacts/bench_headline.json
+#   2. tools/bench_artifacts.py     -> artifacts/perf_evidence.json
+#   3. tests/test_interposer_real.py-> REAL_PJRT_SMOKE.json
+# Each step is wall-capped (`timeout`) and the tunnel is re-probed
+# between steps, so a tunnel that comes up briefly banks whatever its
+# window allows; partial results are committed too. Exits 0 once all
+# three artifacts exist (possibly across invocations), else keeps
+# probing until killed.
+#
+# Run:  nohup tools/evidence_daemon.sh >> artifacts/evidence_daemon.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+
+PROBE_WALL="${KS_EVIDENCE_PROBE_WALL:-45}"
+SLEEP_S="${KS_EVIDENCE_SLEEP_S:-180}"
+
+log() { echo "$(date -u +%FT%TZ) $*"; }
+
+probe_ok() {
+    python tools/chip_probe.py "$PROBE_WALL" > artifacts/last_probe.json 2>/dev/null
+}
+
+commit_artifacts() {
+    local msg="$1"; shift
+    local paths=()
+    for p in "$@"; do [ -e "$p" ] && paths+=("$p"); done
+    [ "${#paths[@]}" -eq 0 ] && return 0
+    # retry: the interactive session may hold .git/index.lock briefly
+    for _ in 1 2 3 4 5 6; do
+        if git add "${paths[@]}" 2>/dev/null \
+           && git commit -m "$msg" -m "No-Verification-Needed: artifact-only evidence banking commit" \
+                  --only "${paths[@]}" >/dev/null 2>&1; then
+            log "committed: $msg"
+            return 0
+        fi
+        sleep 10
+    done
+    log "WARN: could not commit ${paths[*]} (lock contention?)"
+    return 1
+}
+
+bank() {
+    local chip_id
+    chip_id=$(python -c "import json;d=json.load(open('artifacts/last_probe.json'));print(d.get('device','?'),d.get('device_kind',''))" 2>/dev/null || echo "?")
+    log "tunnel healthy ($chip_id) — banking evidence"
+
+    if [ ! -s artifacts/bench_headline.json ]; then
+        log "step 1/3: bench.py headline"
+        if timeout 300 python bench.py > artifacts/bench_headline.raw 2> artifacts/bench_headline.log; then
+            tail -n 1 artifacts/bench_headline.raw > artifacts/bench_headline.json
+            python - <<'EOF'
+import json, time
+p = "artifacts/bench_headline.json"
+d = json.load(open(p))
+pr = json.load(open("artifacts/last_probe.json"))
+d["banked_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+d["chip"] = {k: pr.get(k) for k in ("device", "device_kind", "platform")}
+json.dump(d, open(p, "w"), indent=1)
+EOF
+            # value 0.0 means the bench emitted only a diagnostic — don't
+            # bank that as headline evidence
+            if python -c "import json,sys;sys.exit(0 if json.load(open('artifacts/bench_headline.json')).get('value',0)>0 else 1)"; then
+                commit_artifacts "Bank live-chip bench headline artifact" \
+                    artifacts/bench_headline.json
+            else
+                log "headline came back value=0 (diagnostic) — not banking"
+                rm -f artifacts/bench_headline.json
+            fi
+        else
+            log "bench.py failed/timed out (see artifacts/bench_headline.log)"
+        fi
+        rm -f artifacts/bench_headline.raw
+    fi
+
+    probe_ok || { log "tunnel dropped after step 1 — back to probe loop"; return 1; }
+
+    if [ ! -s artifacts/perf_evidence.json ]; then
+        log "step 2/3: perf evidence (kernels/MFU/serving; can take ~20 min)"
+        if timeout 2400 python tools/bench_artifacts.py >> artifacts/perf_evidence.log 2>&1; then
+            commit_artifacts "Bank kernel/MFU/serving perf evidence artifact" \
+                artifacts/perf_evidence.json
+        else
+            log "bench_artifacts failed/timed out (see artifacts/perf_evidence.log)"
+            # partial sections may still have been written+stamped
+            [ -s artifacts/perf_evidence.json ] && commit_artifacts \
+                "Bank partial perf evidence artifact" artifacts/perf_evidence.json
+        fi
+    fi
+
+    probe_ok || { log "tunnel dropped after step 2 — back to probe loop"; return 1; }
+
+    if [ ! -s REAL_PJRT_SMOKE.json ]; then
+        log "step 3/3: real-plugin interposer smoke"
+        if timeout 600 python -m pytest tests/test_interposer_real.py -q \
+               >> artifacts/real_smoke.log 2>&1 && [ -s REAL_PJRT_SMOKE.json ]; then
+            commit_artifacts "Bank real-PJRT-plugin interposer smoke artifact" \
+                REAL_PJRT_SMOKE.json
+        else
+            log "real smoke did not go green (see artifacts/real_smoke.log)"
+        fi
+    fi
+    return 0
+}
+
+log "evidence daemon up (probe ${PROBE_WALL}s every ${SLEEP_S}s)"
+attempt=0
+while :; do
+    if [ -s artifacts/bench_headline.json ] && [ -s artifacts/perf_evidence.json ] \
+       && [ -s REAL_PJRT_SMOKE.json ]; then
+        log "all three artifacts banked — daemon done"
+        exit 0
+    fi
+    attempt=$((attempt + 1))
+    if probe_ok; then
+        bank || true
+    else
+        [ $((attempt % 10)) -eq 1 ] && log "probe $attempt: tunnel still unreachable"
+    fi
+    sleep "$SLEEP_S"
+done
