@@ -22,7 +22,13 @@ line and exit 0 within KUBESHARE_BENCH_TOTAL_WALL seconds, no matter
 what the chip or tunnel does. Four defenses, in order:
   1. a chip-reachability probe in a WATCHDOGGED SUBPROCESS — on this
      platform a dead tunnel makes plain ``jax.devices()`` hang >120s,
-     which no in-process timeout can interrupt;
+     which no in-process timeout can interrupt. The probe RETRIES on a
+     backoff loop (round-4: BENCH_r03 burned one 45s attempt and left
+     ~195s of budget on the table while the documented failure mode is
+     a *transient* tunnel blip) until the remaining budget can no
+     longer fit a minimum headline — one round, kernels skipped —
+     and the headline phase shrinks with lateness so a probe that
+     succeeds late still banks a ratio;
   2. a daemon watchdog thread in THIS process that force-emits
      whatever results exist and ``os._exit(0)``s just before the wall
      budget — so even a hung jax call after a healthy probe cannot
@@ -95,6 +101,15 @@ SAFETY_S = 8.0              # watchdog fires this early
 PROBE_WALL = float(os.environ.get("KUBESHARE_BENCH_PROBE_WALL", "45"))
 KERNEL_MIN_WALL = 50.0      # don't start the kernel phase with less
 KERNEL_RESERVE = 70.0       # headline stops adding rounds to leave this
+# the cheapest headline that still banks a ratio: import+compile+
+# calibrate (~35s on the tunnel chip) plus one solo/ungated/gated
+# round at the floor phase length. The probe retry loop keeps hunting
+# for the chip until this no longer fits.
+MIN_HEADLINE_WALL = 60.0
+MIN_PROBE_WALL = 8.0
+# contract-test hook: force the first N probe attempts to fail without
+# spawning a subprocess, so the retry loop is testable on any box
+PROBE_FAIL_N = int(os.environ.get("KUBESHARE_BENCH_PROBE_FAIL_N", "0"))
 _T0 = time.monotonic()
 
 _state = {"doc": None, "final": False, "child": None, "arbiter": None}
@@ -156,11 +171,15 @@ def _watchdog() -> None:
     os._exit(0)
 
 
-def chip_probe() -> dict:
+def chip_probe(attempt: int = 1) -> dict:
     """Touch the chip from a subprocess with its own watchdog: import,
     device enumeration, one tiny matmul with a host fetch. A dead
     tunnel hangs ``jax.devices()`` indefinitely (measured >120s); only
     a kill from outside the process is a reliable timeout."""
+    if attempt <= PROBE_FAIL_N:
+        return {"ok": False,
+                "error": f"chip probe: injected failure {attempt}/"
+                         f"{PROBE_FAIL_N} (contract test)"}
     code = (
         "import json,os,sys,time\n"
         "t0=time.time()\n"
@@ -173,7 +192,10 @@ def chip_probe() -> dict:
         "print(json.dumps({'ok': y==128.0**3, 'platform': d.platform,"
         " 'device': str(d), 'probe_s': round(time.time()-t0,1)}))\n"
     )
-    wall = min(PROBE_WALL, max(5.0, remaining() - 20))
+    # leave enough budget after this attempt for a minimum headline
+    wall = min(PROBE_WALL,
+               max(MIN_PROBE_WALL,
+                   remaining() - MIN_HEADLINE_WALL - 2 * SAFETY_S))
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
@@ -192,6 +214,33 @@ def chip_probe() -> dict:
         return json.loads(proc.stdout.decode().strip().splitlines()[-1])
     except (ValueError, IndexError) as e:
         return {"ok": False, "error": f"chip probe: bad output: {e}"}
+
+
+def chip_probe_with_retry() -> dict:
+    """Hunt for the chip with the WHOLE wall budget, not one attempt
+    (BENCH_r03 gave up after 45s of a 240s wall — a transient tunnel
+    blip, the documented failure mode here, read identically to a dead
+    tunnel). Retries on a capped exponential backoff until another
+    attempt plus a minimum headline (one round, kernels skipped) can
+    no longer fit. The returned doc always carries ``probe_attempts``
+    so the banked JSON shows how hard the hunt was."""
+    attempts = 0
+    backoff = 2.0
+    while True:
+        attempts += 1
+        doc = chip_probe(attempts)
+        doc["probe_attempts"] = attempts
+        if doc.get("ok"):
+            return doc
+        log(f"probe attempt {attempts} failed: {doc.get('error')}")
+        floor = MIN_HEADLINE_WALL + MIN_PROBE_WALL + 2 * SAFETY_S
+        if remaining() - backoff < floor:
+            log(f"probe: giving up after {attempts} attempts "
+                f"({remaining():.0f}s left < {floor + backoff:.0f}s for "
+                "another attempt + minimum headline)")
+            return doc
+        time.sleep(backoff)
+        backoff = min(backoff * 1.6, 30.0)
 
 
 def run_stream(step, params, images, labels, seconds, stall_s, gate=None,
@@ -248,6 +297,15 @@ def run_headline(probe: dict) -> dict:
     _apply_platform_override()
     import jax
     import jax.numpy as jnp
+
+    # degrade with lateness: a probe that hunted for most of the wall
+    # leaves less room, so shrink the per-phase seconds down to a floor
+    # that still measures a real ratio (~55s covers import + compile +
+    # calibration on the tunnel chip; one round is 3 phases + probes)
+    phase_s = max(1.5, min(PHASE_SECONDS, (remaining() - 55.0) / 3.0))
+    if phase_s < PHASE_SECONDS:
+        log(f"headline: late start ({remaining():.0f}s left) — phase "
+            f"shrunk {PHASE_SECONDS:.0f}s -> {phase_s:.1f}s")
 
     from bench_common import p99, start_arbiter as _start, stop_arbiter
     from kubeshare_tpu.models import (
@@ -371,16 +429,16 @@ def run_headline(probe: dict) -> dict:
             pre_step_s = next_pre_step_s
             burst_steps, stall_s = calibrate(pre_step_s)
             steps = run_stream(step, params_per_pod[0], images, labels,
-                               PHASE_SECONDS, stall_s,
+                               phase_s, stall_s,
                                burst_steps=burst_steps)
-            solo_r = steps * BATCH / PHASE_SECONDS
+            solo_r = steps * BATCH / phase_s
             raw_r, _, _, _ = run_colocated(
                 step, params_per_pod, (images, labels), stall_s,
-                [None] * PODS, PHASE_SECONDS, burst_steps=burst_steps,
+                [None] * PODS, phase_s, burst_steps=burst_steps,
             )
             gated_r, results, elapsed, lats = run_colocated(
                 step, params_per_pod, (images, labels), stall_s, gates,
-                PHASE_SECONDS, burst_steps=burst_steps,
+                phase_s, burst_steps=burst_steps,
             )
             post_step_s = probe_step_s()
             next_pre_step_s = post_step_s
@@ -445,6 +503,7 @@ def run_headline(probe: dict) -> dict:
         "worst_round_gated_vs_ungated": round(worst["gated_vs_ungated"], 3),
         "worst_round_chip_drifted": worst["drifted"],
         "device": probe.get("device", ""),
+        "probe_attempts": probe.get("probe_attempts", 1),
     })
     return doc
 
@@ -494,15 +553,17 @@ def run_kernel_bench_subprocess(wall_s: float) -> dict:
 def main() -> None:
     threading.Thread(target=_watchdog, daemon=True).start()
 
-    probe = chip_probe()
+    probe = chip_probe_with_retry()
     if not probe.get("ok"):
         doc = _base_doc()
         doc["error"] = probe.get("error", "chip probe failed")
+        doc["probe_attempts"] = probe.get("probe_attempts", 1)
         doc["elapsed_s"] = round(time.monotonic() - _T0, 1)
         log(f"FATAL: {doc['error']} — emitting diagnostic and exiting")
         emit(doc, final=True)
         return
-    log(f"chip probe ok in {probe.get('probe_s')}s: {probe.get('device')}")
+    log(f"chip probe ok in {probe.get('probe_s')}s after "
+        f"{probe.get('probe_attempts')} attempt(s): {probe.get('device')}")
 
     # a fast-failing exception (tunnel drops mid-round -> XlaRuntimeError)
     # must degrade to a diagnostic JSON line + exit 0, same as a hang:
