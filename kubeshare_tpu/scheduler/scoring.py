@@ -89,10 +89,18 @@ def score_node(
     node: str,
     req: PodRequirements,
     anchors: Sequence[Anchor] = (),
+    exclude: frozenset = frozenset(),
 ) -> float:
+    """``exclude`` — leaf uuids this pod may not take (live defrag
+    holds). Without it an opportunistic pod is steered toward a node
+    whose apparent free capacity is mostly held leaves it cannot use;
+    filter/reserve stay correct either way, so this only shapes
+    placement quality during a hold (advisor r3)."""
     if req.kind == PodKind.REGULAR:
         return regular_pod_node_score(tree, node)
     leaves = tree.leaves_view(node, req.model or None)
+    if exclude:
+        leaves = [l for l in leaves if l.uuid not in exclude]
     if req.is_guarantee:
         return guarantee_node_score(leaves, anchors)
     return opportunistic_node_score(leaves)

@@ -494,6 +494,44 @@ class TestDefragHold:
         with _pytest.raises(ValueError, match="eviction"):
             make_env(defrag_eviction_rate=0.5)
 
+    def test_concurrent_holds_on_one_node_do_not_overwrite(self):
+        """Two guarantee pods defragging the SAME node keep independent
+        holds (advisor r3: node-keyed holds let the second overwrite
+        the first, silently dropping its reservation). Evictions here
+        take a grace period — as over a real apiserver — so both plans
+        are drawn up before either victim frees its leaf."""
+        cluster, engine = make_env()
+        fragment(cluster, engine)
+        real_delete = cluster.delete_pod
+        cluster.evict = lambda key: cluster.evictions.append(key)
+        h1 = cluster.create_pod(mk_pod("h1", 0.8, priority=50))
+        assert "defrag" in engine.schedule_one(h1).message
+        # h1's victim is still terminating, so h2 cannot fit anywhere
+        # and plans around the in-flight eviction: a second, disjoint
+        # hold on the same node
+        h2 = cluster.create_pod(mk_pod("h2", 0.8, priority=50))
+        assert "defrag" in engine.schedule_one(h2).message
+        assert sorted(cluster.evictions) == [
+            "default/opp-1", "default/opp-2"
+        ]
+        for victim in list(cluster.evictions):
+            real_delete(victim)
+        # BOTH holds are live: an opportunistic pod may take neither
+        # freed leaf (node-keyed holds would have dropped h1's and let
+        # it bind into h1's space, restarting the refill churn)
+        opp = cluster.create_pod(mk_pod("opp-3", 0.6))
+        d = engine.schedule_one(opp)
+        assert d.status == "unschedulable", d.message
+        assert "defrag-held" in d.message
+        from kubeshare_tpu.utils import expfmt
+        [g] = expfmt.select(
+            engine.utilization_samples(), "tpu_scheduler_defrag_held_leaves"
+        )
+        assert g.value == 2
+        # each beneficiary binds into its own held space
+        assert engine.schedule_one(h1).status == "bound"
+        assert engine.schedule_one(h2).status == "bound"
+
     def test_hold_expires_if_beneficiary_never_returns(self):
         now = {"t": 0.0}
         cluster, engine = make_env(clock=lambda: now["t"],
