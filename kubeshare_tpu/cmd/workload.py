@@ -264,6 +264,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     steps_done = 0
     last_saved = -1
     result = None
+    # collective stop checks fire at agreed step indices, not every
+    # step: the allgather is a host sync that serializes dispatch
+    # across the gang and depresses steady-state throughput (advisor
+    # r3). The interval is derived from the COLLECTIVE max elapsed
+    # time, so every worker computes the same schedule — an interval
+    # computed from a local clock could diverge across workers and
+    # deadlock the next allgather.
+    check_next = 0
     try:
         while True:
             if args.steps and steps_done >= args.steps:
@@ -278,14 +286,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 # breaking on its local clock while a peer dispatches
                 # the next step deadlocks the peer (and makes the final
                 # cooperative checkpoint save hang). Any worker past
-                # its deadline stops everyone, before anyone dispatches.
-                from jax.experimental import multihost_utils
+                # its deadline stops everyone — at a sync point every
+                # worker reaches before dispatching past it.
+                if steps_done >= check_next:
+                    from jax.experimental import multihost_utils
 
-                import jax.numpy as jnp
+                    import jax.numpy as jnp
 
-                stop = bool(multihost_utils.process_allgather(
-                    jnp.array([stop])
-                ).any())
+                    agg = multihost_utils.process_allgather(jnp.array([
+                        1.0 if stop else 0.0,
+                        time.perf_counter() - started,
+                    ]))
+                    stop = bool(agg[..., 0].any())
+                    if steps_done == 0:
+                        check_next = 1  # no step time measured yet
+                    else:
+                        # one check per ~0.5s of steady state, bounding
+                        # the deadline overshoot to about that; the max
+                        # across workers keeps the schedule conservative
+                        avg_step = (
+                            float(agg[..., 1].max()) / steps_done
+                        )
+                        check_next = steps_done + max(1, min(
+                            64, int(0.5 / max(avg_step, 1e-6))
+                        ))
+                else:
+                    stop = False  # wait for the gang at the sync point
             if stop:
                 break
             key, sub = jax.random.split(key)

@@ -263,6 +263,49 @@ def test_workload_cli_distributed_dp(tmp_path):
     assert results[0]["final_loss"] == results[1]["final_loss"]
 
 
+def test_workload_cli_distributed_duration_stop_is_collective(tmp_path):
+    """Duration mode in a gang: the stop decision is collective but
+    AMORTIZED (advisor r3: a per-step process_allgather host sync
+    serialized dispatch across the gang). Both workers must exit
+    cleanly at the SAME step count — proof the agreed sync-point
+    schedule held and nobody broke the gang mid-allreduce."""
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "KUBESHARE_GROUP_HEADCOUNT": "2",
+            "KUBESHARE_PROCESS_ID": str(rank),
+        }
+        env.pop("KUBESHARE_NUM_PROCESSES", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kubeshare_tpu", "workload",
+             "--model", "mnist", "--batch", "8", "--duration", "3",
+             "--seed", "3"],
+            env=env, cwd=os.path.dirname(HERE),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        ))
+    results = []
+    for rank, proc in enumerate(procs):
+        try:
+            stdout, stderr = proc.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            raise
+        assert proc.returncode == 0, (
+            f"worker {rank} failed:\n{stderr.decode()[-2000:]}"
+        )
+        results.append(json.loads(stdout.decode().strip().splitlines()[-1]))
+    assert results[0]["steps"] == results[1]["steps"]
+    assert results[0]["steps"] > 0
+    for r in results:
+        assert r["processes"] == 2
+
+
 def test_two_process_gang_bootstrap_and_hybrid_train(tmp_path):
     port = _free_port()
     procs = []
