@@ -267,14 +267,17 @@ class TopologyWatcher:
             return False
         self._mtime = mtime
         try:
-            self.engine.reload_topology(self.path)
+            dropped = self.engine.reload_topology(self.path)
         except Exception as e:
             self.log.error(
                 "topology %s changed but failed to load, keeping old: %s",
                 self.path, e,
             )
             return False
-        self.log.info("topology %s reloaded", self.path)
+        self.log.info(
+            "topology %s reloaded (%d in-flight reservations requeued)",
+            self.path, len(dropped),
+        )
         return True
 
 
