@@ -140,6 +140,7 @@ class Simulator:
         seed: int = 0,
         tracer=None,
         defrag: bool = False,
+        defrag_eviction_rate: float = 0.0,
     ):
         import random
 
@@ -156,6 +157,7 @@ class Simulator:
         self.engine = TpuShareScheduler(
             topology, self.cluster, clock=lambda: self.clock_now,
             tracer=tracer, defrag=defrag,
+            defrag_eviction_rate=defrag_eviction_rate,
         )
         self.total_chips = sum(nodes.values())
         self.priority_ratio = priority_ratio
