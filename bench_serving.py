@@ -162,31 +162,50 @@ def run() -> dict:
             solo = run_stream(decodes[0], PHASE_SECONDS, stall_s, burst)
             solo_rate = solo * BATCH / PHASE_SECONDS
 
-            results = [0] * PODS
-            lats = [[] for _ in range(PODS)]
+            def colocated(use_gates):
+                results = [0] * PODS
+                lats = [[] for _ in range(PODS)]
 
-            def worker(i):
-                def run():
-                    results[i] = run_stream(
-                        decodes[i], PHASE_SECONDS, stall_s, burst,
-                        gate=gates[i], latencies=lats[i],
-                    )
-                return run
+                def worker(i):
+                    def run():
+                        results[i] = run_stream(
+                            decodes[i], PHASE_SECONDS, stall_s, burst,
+                            gate=use_gates[i], latencies=lats[i],
+                        )
+                    return run
 
-            elapsed = run_threads([worker(i) for i in range(PODS)])
-            gated_rate = sum(results) * BATCH / elapsed
+                elapsed = run_threads([worker(i) for i in range(PODS)])
+                rates = [n * BATCH / elapsed for n in results]
+                return sum(rates), rates, lats
+
+            # ungated co-located phase: the compute-honest isolation
+            # overhead is gated-vs-ungated under the SAME workload in
+            # the SAME host-fetch regime (VERDICT r3 weak #2 — the
+            # headline bench's overhead number is dispatch-regime)
+            raw_rate, _, _ = colocated([None] * PODS)
+            gated_rate, pod_rates, lats = colocated(gates)
             rounds.append({
-                "solo": solo_rate, "gated": gated_rate,
-                "ratio": gated_rate / solo_rate, "lats": lats,
+                "solo": solo_rate, "ungated": raw_rate,
+                "gated": gated_rate,
+                "ratio": gated_rate / solo_rate,
+                "overhead": max(0.0, 1.0 - gated_rate / raw_rate),
+                "pod_rates": pod_rates, "lats": lats,
             })
-            log(f"round {r}: solo {solo_rate:,.0f} | co-located gated "
-                f"{gated_rate:,.0f} tokens/s ({gated_rate / solo_rate:.2f}x)")
+            log(f"round {r}: solo {solo_rate:,.0f} | ungated "
+                f"{raw_rate:,.0f} | gated {gated_rate:,.0f} tokens/s "
+                f"({gated_rate / solo_rate:.2f}x, isolation overhead "
+                f"{rounds[-1]['overhead']:.1%})")
 
         mid = sorted(rounds, key=lambda x: x["ratio"])[len(rounds) // 2]
         pod_p99s = [p99(l) * 1e3 for l in mid["lats"] if l]
+        worst_overhead = max(r["overhead"] for r in rounds)
+        per_pod_vs_solo = [r / mid["solo"] for r in mid["pod_rates"]]
         log(f"median round {mid['gated']:,.0f} tokens/s "
-            f"({mid['ratio']:.2f}x); per-pod p99 token latency (ms): "
-            f"min {min(pod_p99s):.2f} max {max(pod_p99s):.2f}")
+            f"({mid['ratio']:.2f}x); isolation overhead "
+            f"{mid['overhead']:.1%} (worst round {worst_overhead:.1%}); "
+            f"per-pod vs solo {min(per_pod_vs_solo):.2f}.."
+            f"{max(per_pod_vs_solo):.2f}; per-pod p99 token latency "
+            f"(ms): min {min(pod_p99s):.2f} max {max(pod_p99s):.2f}")
         if arbiter is not None:
             with TokenClient("127.0.0.1", ARBITER_PORT, pod="probe") as c:
                 log(f"arbiter window usage (ms): "
@@ -203,6 +222,16 @@ def run() -> dict:
         "value": round(mid["gated"], 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mid["ratio"], 3),
+        # host-fetch-regime isolation overhead: gated vs ungated
+        # co-location of the SAME workload — the compute-honest number
+        # the <10% north-star target is judged on
+        "ungated_value": round(mid["ungated"], 1),
+        "isolation_overhead": round(mid["overhead"], 4),
+        "isolation_overhead_worst_round": round(worst_overhead, 4),
+        # each pod's gated rate vs the solo run: 1.0 = sharing cost
+        # this pod nothing (duty cycle 28%, 4 pods -> ~1.12x demand)
+        "per_pod_vs_solo_min": round(min(per_pod_vs_solo), 3),
+        "per_pod_vs_solo_max": round(max(per_pod_vs_solo), 3),
         "p99_token_latency_ms_min": round(min(pod_p99s), 2),
         "p99_token_latency_ms_max": round(max(pod_p99s), 2),
         "isolated": arbiter is not None,

@@ -59,11 +59,11 @@ def main() -> int:
     # Existing artifact rows for skipped sections are preserved WITH
     # their own provenance stamps — re-running one section on a
     # different day/chip must not re-attribute the others.
-    all_sections = {"kernels", "ab", "serving"}
+    all_sections = {"kernels", "ab", "serving", "overhead"}
     sections = {
         s.strip()
         for s in os.environ.get(
-            "KUBESHARE_EVIDENCE_SECTIONS", "kernels,ab,serving"
+            "KUBESHARE_EVIDENCE_SECTIONS", "kernels,ab,serving,overhead"
         ).split(",")
         if s.strip()
     }
@@ -108,6 +108,19 @@ def main() -> int:
         log("== capability A/B: fused xent vs dense at 64k rows")
         doc["xent_oom_ab"] = dict(bench_kernels.xent_oom_ab(), **stamp)
         log(f"   {doc['xent_oom_ab']}")
+
+    if "overhead" in sections:
+        log("== compute-honest gate overhead (gated vs ungated train "
+            "step, host-fetch regime)")
+        try:
+            doc["train_gate_overhead"] = dict(
+                bench_kernels.train_gate_overhead(log=log), **stamp
+            )
+        except Exception as e:  # noqa: BLE001 — bank the other sections
+            doc["train_gate_overhead"] = {
+                "error": f"{type(e).__name__}: {e}"[:200], **stamp
+            }
+        log(f"   {doc['train_gate_overhead']}")
 
     if "serving" in sections:
         log("== serving (4x0.25 KV-cache decode), own process for a "
