@@ -25,9 +25,32 @@ dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+# image tag: short git sha, overridable (reference Makefile versions
+# its images the same way; `latest` is also tagged for the manifests)
+VERSION ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo dev)
+REGISTRY ?= kubeshare-tpu
+
 images:
-	docker build -f docker/scheduler/Dockerfile -t kubeshare-tpu/scheduler:latest .
-	docker build -f docker/node/Dockerfile -t kubeshare-tpu/node:latest .
+	docker build -f docker/scheduler/Dockerfile \
+		-t $(REGISTRY)/scheduler:$(VERSION) -t $(REGISTRY)/scheduler:latest .
+	docker build -f docker/node/Dockerfile \
+		-t $(REGISTRY)/node:$(VERSION) -t $(REGISTRY)/node:latest .
+
+# push to $(REGISTRY) (reference Makefile:45-51 docker push targets)
+push: images
+	docker push $(REGISTRY)/scheduler:$(VERSION)
+	docker push $(REGISTRY)/scheduler:latest
+	docker push $(REGISTRY)/node:$(VERSION)
+	docker push $(REGISTRY)/node:latest
+
+# save images as tarballs for air-gapped nodes (reference Makefile:53-57
+# docker save targets)
+save: images
+	mkdir -p artifacts
+	docker save -o artifacts/kubeshare-tpu-scheduler-$(VERSION).tar \
+		$(REGISTRY)/scheduler:$(VERSION)
+	docker save -o artifacts/kubeshare-tpu-node-$(VERSION).tar \
+		$(REGISTRY)/node:$(VERSION)
 
 # full control plane on a kind cluster with the fake chip backend;
 # requires docker + kind + kubectl (exits 2 = skip when absent)
@@ -42,4 +65,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay dryrun images kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay dryrun images push save kind-e2e perf-evidence clean

@@ -72,8 +72,10 @@ class TestPipeline:
     @pytest.mark.parametrize("num_mb", [2, 4])
     def test_circular_more_stages_than_devices(self, num_mb):
         """S=8 stages over pp=2 devices: the circular schedule makes
-        S/P=4 passes around the ring; device i holds stages i, P+i,
-        ... and the result must match sequential application exactly."""
+        S/P=4 passes around the ring; device i holds the contiguous
+        block of S/P consecutive stages (i*4..i*4+3, matching
+        _local_pipeline and shard_stacked_params) and the result must
+        match sequential application exactly."""
         dim, batch, stages, devices = 16, 8, 8, 2
         per_stage = _make_stages(stages, dim)
         x = jax.random.normal(jax.random.PRNGKey(3), (batch, dim))
