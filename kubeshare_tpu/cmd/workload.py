@@ -44,10 +44,28 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--checkpoint-every", type=int, default=50,
                         help="steps between checkpoints")
+    parser.add_argument("--seq-len", type=int, default=256,
+                        help="llama sequence length")
+    parser.add_argument(
+        "--sp", type=int, default=0,
+        help="shard the llama sequence over N local devices (ring "
+             "attention inside the trunk; 0 = off). Single-process "
+             "only — combine dp across the gang by NOT setting this",
+    )
+    parser.add_argument(
+        "--sp-impl", choices=["ring", "ulysses"], default="ring",
+        help="sequence-parallel attention strategy",
+    )
+    parser.add_argument(
+        "--sp-flash", action="store_true",
+        help="run each SP attention block with the Pallas flash kernel "
+             "(needs per-device sequence in multiples of 128)",
+    )
     return parser
 
 
-def _build(model: str, batch: int, rng):
+def _build(model: str, batch: int, rng, seq_len: int = 256, sp: int = 0,
+           sp_impl: str = "ring", sp_flash: bool = False):
     """(params, loss_fn, batch_maker): model-specific pieces."""
     import jax
     import jax.numpy as jnp
@@ -55,17 +73,47 @@ def _build(model: str, batch: int, rng):
 
     from .. import models as M
 
+    if sp > 0 and model != "llama":
+        # refusing beats silently training unsharded with the flags
+        # ignored — the long-context path is the llama trunk
+        raise SystemExit(f"--sp applies to --model llama, not {model}")
+
     if model == "llama":
         cfg = M.LlamaConfig(vocab=2048, dim=256, layers=4, num_heads=8,
-                            num_kv_heads=4, mlp_dim=512, max_seq_len=256)
+                            num_kv_heads=4, mlp_dim=512,
+                            max_seq_len=seq_len)
         params = M.init_llama(rng, cfg)
-        from ..models.llama import llama_loss
+        if sp > 0:
+            # long-context: sequence sharded over sp local devices,
+            # ring attention inside the trunk (make_llama_sp_loss)
+            if seq_len % sp:
+                raise SystemExit(
+                    f"--seq-len {seq_len} must divide over --sp {sp}"
+                )
+            if len(jax.devices()) < sp:
+                raise SystemExit(
+                    f"--sp {sp} needs {sp} devices, have "
+                    f"{len(jax.devices())}"
+                )
+            from ..models.llama import make_llama_sp_loss
+            from ..parallel import MeshPlan, make_mesh
 
-        def loss_fn(p, tokens):
-            return llama_loss(p, tokens, cfg)
+            mesh = make_mesh(MeshPlan(sp=sp), devices=jax.devices()[:sp])
+            loss_fn = make_llama_sp_loss(cfg, mesh, impl=sp_impl,
+                                         use_flash=sp_flash)
+            # the loss trains on tokens[:, :-1], so the sharded hidden
+            # length is len-1: feed seq_len+1 tokens to shard evenly
+            tok_len = seq_len + 1
+        else:
+            from ..models.llama import llama_loss
+
+            def loss_fn(p, tokens):
+                return llama_loss(p, tokens, cfg)
+
+            tok_len = seq_len
 
         def make_batch(key):
-            return (jax.random.randint(key, (batch, 256), 0, cfg.vocab,
+            return (jax.random.randint(key, (batch, tok_len), 0, cfg.vocab,
                                        dtype=jnp.int32),)
 
         return params, loss_fn, make_batch
@@ -214,8 +262,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     from ..models.train import make_train_step
 
     rng = jax.random.PRNGKey(args.seed)
-    params, loss_fn, make_batch = _build(args.model, args.batch, rng)
+    params, loss_fn, make_batch = _build(args.model, args.batch, rng,
+                                         args.seq_len, args.sp,
+                                         args.sp_impl, args.sp_flash)
     if spec is not None:
+        if args.sp:
+            raise SystemExit(
+                "--sp is single-process (local sequence sharding); in a "
+                "gang, leave it off and let dp span the processes"
+            )
         params, opt_state, step, make_batch = _distribute(
             spec, params, loss_fn, make_batch, args, log
         )

@@ -13,7 +13,7 @@ from .cifar import CifarConfig, init_cifar, cifar_apply
 from .lstm import LstmConfig, init_lstm, lstm_apply
 from .resnet import ResNetConfig, init_resnet, resnet_apply
 from .vgg import VggConfig, init_vgg, vgg_apply, vgg16
-from .llama import LlamaConfig, init_llama, llama_apply
+from .llama import LlamaConfig, init_llama, llama_apply, make_llama_sp_loss
 from .moe import MoeConfig, init_moe_ffn, moe_ffn_apply, moe_param_spec
 from .train import make_train_step, synthetic_batches
 
@@ -23,7 +23,7 @@ __all__ = [
     "LstmConfig", "init_lstm", "lstm_apply",
     "ResNetConfig", "init_resnet", "resnet_apply",
     "VggConfig", "init_vgg", "vgg_apply", "vgg16",
-    "LlamaConfig", "init_llama", "llama_apply",
+    "LlamaConfig", "init_llama", "llama_apply", "make_llama_sp_loss",
     "MoeConfig", "init_moe_ffn", "moe_ffn_apply", "moe_param_spec",
     "make_train_step", "synthetic_batches",
 ]

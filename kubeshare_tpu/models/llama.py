@@ -97,9 +97,10 @@ def llama_apply(
     cfg: LlamaConfig = LlamaConfig(),
     positions: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """tokens [B, T] int32 -> logits [B, T, vocab]."""
-    x = llama_hidden(params, tokens, cfg, positions, use_flash)
+    x = llama_hidden(params, tokens, cfg, positions, use_flash, attn_fn)
     return _matmul(x, params["lm_head"], jnp.dtype(cfg.dtype)).astype(
         jnp.float32
     )
@@ -111,6 +112,7 @@ def llama_hidden(
     cfg: LlamaConfig = LlamaConfig(),
     positions: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """The trunk: tokens [B, T] -> final-norm hidden [B, T, dim]
     (everything but the lm_head matmul — the chunked loss fuses that
@@ -121,7 +123,8 @@ def llama_hidden(
         positions = jnp.arange(seq)
     x = params["embed"]["table"].astype(dtype)[tokens]
     for i in range(cfg.layers):
-        x = llama_block(params[f"layer{i}"], x, positions, cfg, use_flash)
+        x = llama_block(params[f"layer{i}"], x, positions, cfg, use_flash,
+                        attn_fn)
     x = rmsnorm(params["final_norm"], x)
     return x
 
@@ -132,11 +135,18 @@ def llama_block(
     positions: jnp.ndarray,
     cfg: LlamaConfig,
     use_flash: Optional[bool] = None,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """One pre-norm transformer block: [B, T, dim] -> [B, T, dim].
     Shared by the sequential trunk (llama_hidden) and the
     pipeline-parallel trunk (llama_pipeline_hidden) so the two can
-    never compute different math."""
+    never compute different math.
+
+    ``attn_fn`` overrides the causal attention core: a callable
+    ``(q [B,H,T,D], k, v [B,Hkv,T,D]) -> [B,H,T,D]`` with causality
+    baked in — the hook sequence-parallel trunks use to swap in
+    ring/Ulysses attention (make_llama_sp_loss) without forking the
+    block math."""
     dtype = jnp.dtype(cfg.dtype)
     batch, seq = x.shape[0], x.shape[1]
     hd = cfg.dim // cfg.num_heads
@@ -149,7 +159,10 @@ def llama_block(
     v = jnp.swapaxes(v, 1, 2)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    out = mha(q, k, v, causal=True, use_flash=use_flash)
+    if attn_fn is not None:
+        out = attn_fn(q, k, v)
+    else:
+        out = mha(q, k, v, causal=True, use_flash=use_flash)
     out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)
     x = x + _matmul(out, layer["wo"], dtype)
 
@@ -210,20 +223,21 @@ def llama_pipeline_hidden(
 
 
 def llama_loss(
-    params, tokens, cfg: LlamaConfig, vocab_chunk: int = 0
+    params, tokens, cfg: LlamaConfig, vocab_chunk: int = 0, attn_fn=None
 ) -> jnp.ndarray:
     """Next-token LM loss on a [B, T] batch.
 
     ``vocab_chunk > 0`` routes through the fused chunked
     linear-cross-entropy (ops/xent.py): the [B, T, vocab] logit tensor
     is never materialized — the memory saver for long-context training
-    with large vocabularies.
+    with large vocabularies. ``attn_fn`` swaps the attention core
+    (llama_block) — see make_llama_sp_loss.
     """
     if vocab_chunk > 0:
         from ..ops.xent import chunked_linear_xent
 
         dtype = jnp.dtype(cfg.dtype)
-        hidden = llama_hidden(params, tokens[:, :-1], cfg)
+        hidden = llama_hidden(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
         n = hidden.shape[0] * hidden.shape[1]
         # tile matmuls run in cfg.dtype (f32 accumulation inside), same
         # operand dtypes as the dense path's _matmul
@@ -233,8 +247,47 @@ def llama_loss(
             tokens[:, 1:].reshape(n),
             vocab_chunk,
         )
-    logits = llama_apply(params, tokens[:, :-1], cfg)
+    logits = llama_apply(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
     return cross_entropy_loss(logits, tokens[:, 1:])
+
+
+def make_llama_sp_loss(
+    cfg: LlamaConfig,
+    mesh,
+    axis_name: str = "sp",
+    impl: str = "ring",
+    use_flash: bool = False,
+    vocab_chunk: int = 0,
+):
+    """Sequence-parallel flagship training loss: ``(params, tokens) ->
+    scalar`` with the trunk's activations sharded along T over the
+    mesh's ``axis_name`` and the attention core running as ring
+    (ppermute K/V hops) or Ulysses (all_to_all head scatter) —
+    long-context training as a first-class path, not a standalone op.
+
+    Same math as llama_loss by construction (llama_block is shared;
+    the SP attention ops are exact). Feed tokens of length n*sp + 1
+    (the shifted [B, T] training slice must shard evenly); shard the
+    tokens P(None, axis_name) — or just pass replicated tokens and let
+    GSPMD reshard at the trunk boundary. Combines with dp: a mesh of
+    (dp, sp) shards batch and sequence independently."""
+    if impl == "ring":
+        from ..parallel.ring_attention import make_ring_attention
+
+        attn = make_ring_attention(mesh, axis_name, causal=True,
+                                   use_flash=use_flash)
+    elif impl == "ulysses":
+        from ..parallel.ulysses import make_ulysses_attention
+
+        attn = make_ulysses_attention(mesh, axis_name, causal=True,
+                                      use_flash=use_flash)
+    else:
+        raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
+
+    def loss(params, tokens):
+        return llama_loss(params, tokens, cfg, vocab_chunk, attn_fn=attn)
+
+    return loss
 
 
 # ---- KV-cache inference (BASELINE config 5: fractional-chip serving) ----

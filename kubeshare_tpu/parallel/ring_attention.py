@@ -27,27 +27,34 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _sp_varying(x, axis_name: str):
-    """Mark an accumulator as varying over the ring axis (its contents
-    depend on axis_index), so scan accepts it as a carry."""
+def _sp_varying(x, axis_names):
+    """Mark an accumulator as varying over the given manual axes (its
+    merged contents depend on axis_index / axis-sharded inputs), so
+    scan accepts it as a carry."""
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
-        return pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name)  # older jax
+        return pcast(x, axis_names, to="varying")
+    return jax.lax.pvary(x, axis_names)  # older jax
 
 
-def _ring_merge_loop(q, k, v, axis_name: str, hop_fn: Callable):
+def _ring_merge_loop(q, k, v, axis_name: str, hop_fn: Callable,
+                     varying_axes=None):
     """Shared ring scaffolding: rotate K/V around the ring and merge
     each hop's normalized ``(out_h [B,H,T,D], lse_h [B,H,T,1])`` with
     max-shifted accumulators. ``hop_fn(kv_idx, my_idx, k_cur, v_cur)``
     computes one hop's contribution; a fully-masked hop signals itself
     with ``lse_h = -inf`` rows (their weight becomes exactly 0).
+    ``varying_axes`` — every manual mesh axis the inputs vary over
+    (the ring axis plus e.g. a batch axis): the accumulator constants
+    must be marked varying over all of them or scan rejects the carry.
 
     Hop 0 is always the diagonal block, whose causal rows each see at
     least their own position — m_run is finite after the first merge,
     so the -inf arithmetic below never produces NaNs.
     """
     batch, heads, t_local, head_dim = q.shape
+    if varying_axes is None:
+        varying_axes = (axis_name,)
     n = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     perm = [(j, (j + 1) % n) for j in range(n)]
@@ -67,13 +74,15 @@ def _ring_merge_loop(q, k, v, axis_name: str, hop_fn: Callable):
         return (acc_new, m_new, l_new, k_next, v_next), None
 
     acc0 = _sp_varying(
-        jnp.zeros((batch, heads, t_local, head_dim), jnp.float32), axis_name
+        jnp.zeros((batch, heads, t_local, head_dim), jnp.float32),
+        varying_axes,
     )
     m0 = _sp_varying(
-        jnp.full((batch, heads, t_local, 1), -jnp.inf, jnp.float32), axis_name
+        jnp.full((batch, heads, t_local, 1), -jnp.inf, jnp.float32),
+        varying_axes,
     )
     l0 = _sp_varying(
-        jnp.zeros((batch, heads, t_local, 1), jnp.float32), axis_name
+        jnp.zeros((batch, heads, t_local, 1), jnp.float32), varying_axes
     )
     (acc, _, l, _, _), _ = jax.lax.scan(
         step, (acc0, m0, l0, k, v), jnp.arange(n)
@@ -83,8 +92,10 @@ def _ring_merge_loop(q, k, v, axis_name: str, hop_fn: Callable):
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                    scale: Optional[float] = None,
-                   use_flash: bool = False):
-    """Per-shard bodies: q/k/v [B, H, T_local, D] (already sharded on T).
+                   use_flash: bool = False, varying_axes=None):
+    """Per-shard bodies: q [B, H, T_local, D], k/v [B, Hkv, T_local, D]
+    (already sharded on T; GQA when Hkv < H — the ring rotates the
+    small Hkv tensors and the dense hop repeats them on the fly).
 
     Must be called inside shard_map over ``axis_name``.
     ``use_flash=True`` computes each hop's block with the Pallas flash
@@ -129,12 +140,19 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                 branch = jnp.ones((), jnp.int32)  # every hop fully visible
             return jax.lax.switch(branch, [diag, full, skip], None)
 
-        return _ring_merge_loop(q, k, v, axis_name, hop_fn)
+        return _ring_merge_loop(q, k, v, axis_name, hop_fn, varying_axes)
 
     q32 = q.astype(jnp.float32) * scale
     q_pos = jnp.arange(t_local)
 
     def hop_fn(kv_idx, my_idx, k_cur, v_cur):
+        # GQA: repeat INSIDE the hop so the ring's ppermute carries the
+        # small [B, Hkv, T/n, D] tensors, not the repeated ones (the
+        # repeat helper is the flash kernel's reference mapping —
+        # ops/attention._repeat_kv — so the grouping can never diverge)
+        from ..ops.attention import _repeat_kv
+
+        k_cur, v_cur = _repeat_kv(k_cur, v_cur, heads)
         scores = jnp.einsum(
             "bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
@@ -159,14 +177,30 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
         )
         return out_h, lse_h
 
-    return _ring_merge_loop(q, k, v, axis_name, hop_fn)
+    return _ring_merge_loop(q, k, v, axis_name, hop_fn, varying_axes)
+
+
+def _batch_shard_axis(mesh: Mesh, batch_axis: Optional[str]):
+    """The mesh axis the SP wrappers shard the batch dim over — present
+    and non-trivial, else None. Without this, a (dp, sp) mesh would
+    REPLICATE the batch over dp inside the shard_map and every dp
+    group would redo the whole batch's attention."""
+    if batch_axis and batch_axis in mesh.axis_names \
+            and mesh.shape[batch_axis] > 1:
+        return batch_axis
+    return None
 
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
-                        causal: bool = True, use_flash: bool = False):
+                        causal: bool = True, use_flash: bool = False,
+                        batch_axis: Optional[str] = "dp"):
     """Shard_mapped ring attention over full arrays [B, H, T, D] with T
-    sharded on ``axis_name``."""
-    spec = P(None, None, axis_name, None)
+    sharded on ``axis_name`` — and the batch dim sharded over
+    ``batch_axis`` when the mesh has it (pass None to replicate batch;
+    B must divide by the axis size otherwise)."""
+    b_ax = _batch_shard_axis(mesh, batch_axis)
+    spec = P(b_ax, None, axis_name, None)
+    varying = (axis_name,) + ((b_ax,) if b_ax else ())
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
@@ -179,6 +213,6 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
     )
     def sharded(q, k, v):
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
-                              use_flash=use_flash)
+                              use_flash=use_flash, varying_axes=varying)
 
     return sharded

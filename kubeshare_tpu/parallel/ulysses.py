@@ -75,12 +75,23 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
             x, axis_name, split_axis=1, concat_axis=2, tiled=True
         )
 
+    # GQA: when Hkv divides by the axis, kv shuffles as-is and q's head
+    # slice i lands exactly on kv slice i (contiguous grouping maps
+    # [i*H/n, (i+1)*H/n) onto [i*Hkv/n, (i+1)*Hkv/n)); otherwise the
+    # small kv must materialize full heads before the split. The repeat
+    # helper is the flash kernel's reference mapping
+    # (ops/attention._repeat_kv) so the grouping can never diverge.
+    from ..ops.attention import _repeat_kv
+
+    if k.shape[1] % n:
+        k, v = _repeat_kv(k, v, heads)
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     if use_flash:
         from ..ops.attention import flash_attention
 
-        out = flash_attention(qh, kh, vh, causal, scale)
+        out = flash_attention(qh, kh, vh, causal, scale)  # GQA-native
     else:
+        kh, vh = _repeat_kv(kh, vh, qh.shape[1])
         out = _dense_attention(qh, kh, vh, causal=causal, scale=scale)
     # [B, H/n, T, D] -> [B, H, T/n, D]
     del heads, n
@@ -90,10 +101,16 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 
 
 def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
-                           causal: bool = True, use_flash: bool = False):
+                           causal: bool = True, use_flash: bool = False,
+                           batch_axis: Optional[str] = "dp"):
     """Shard_mapped Ulysses attention over full arrays [B, H, T, D] with
-    T sharded on ``axis_name``."""
-    spec = P(None, None, axis_name, None)
+    T sharded on ``axis_name`` — and the batch dim sharded over
+    ``batch_axis`` when the mesh has it (pass None to replicate batch;
+    B must divide by the axis size otherwise)."""
+    from .ring_attention import _batch_shard_axis
+
+    b_ax = _batch_shard_axis(mesh, batch_axis)
+    spec = P(b_ax, None, axis_name, None)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
