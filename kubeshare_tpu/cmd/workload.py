@@ -61,11 +61,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="run each SP attention block with the Pallas flash kernel "
              "(needs per-device sequence in multiples of 128)",
     )
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize each llama block in the backward "
+             "(jax.checkpoint, dots-saveable policy) — trade FLOPs for "
+             "HBM on long-context batches",
+    )
     return parser
 
 
 def _build(model: str, batch: int, rng, seq_len: int = 256, sp: int = 0,
-           sp_impl: str = "ring", sp_flash: bool = False):
+           sp_impl: str = "ring", sp_flash: bool = False,
+           remat: bool = False):
     """(params, loss_fn, batch_maker): model-specific pieces."""
     import jax
     import jax.numpy as jnp
@@ -77,6 +84,8 @@ def _build(model: str, batch: int, rng, seq_len: int = 256, sp: int = 0,
         # refusing beats silently training unsharded with the flags
         # ignored — the long-context path is the llama trunk
         raise SystemExit(f"--sp applies to --model llama, not {model}")
+    if remat and model != "llama":
+        raise SystemExit(f"--remat applies to --model llama, not {model}")
 
     if model == "llama":
         cfg = M.LlamaConfig(vocab=2048, dim=256, layers=4, num_heads=8,
@@ -100,7 +109,7 @@ def _build(model: str, batch: int, rng, seq_len: int = 256, sp: int = 0,
 
             mesh = make_mesh(MeshPlan(sp=sp), devices=jax.devices()[:sp])
             loss_fn = make_llama_sp_loss(cfg, mesh, impl=sp_impl,
-                                         use_flash=sp_flash)
+                                         use_flash=sp_flash, remat=remat)
             # the loss trains on tokens[:, :-1], so the sharded hidden
             # length is len-1: feed seq_len+1 tokens to shard evenly
             tok_len = seq_len + 1
@@ -108,7 +117,7 @@ def _build(model: str, batch: int, rng, seq_len: int = 256, sp: int = 0,
             from ..models.llama import llama_loss
 
             def loss_fn(p, tokens):
-                return llama_loss(p, tokens, cfg)
+                return llama_loss(p, tokens, cfg, remat=remat)
 
             tok_len = seq_len
 
@@ -264,7 +273,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     rng = jax.random.PRNGKey(args.seed)
     params, loss_fn, make_batch = _build(args.model, args.batch, rng,
                                          args.seq_len, args.sp,
-                                         args.sp_impl, args.sp_flash)
+                                         args.sp_impl, args.sp_flash,
+                                         args.remat)
     if spec is not None:
         if args.sp:
             raise SystemExit(

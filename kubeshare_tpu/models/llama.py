@@ -98,9 +98,11 @@ def llama_apply(
     positions: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
     attn_fn=None,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """tokens [B, T] int32 -> logits [B, T, vocab]."""
-    x = llama_hidden(params, tokens, cfg, positions, use_flash, attn_fn)
+    x = llama_hidden(params, tokens, cfg, positions, use_flash, attn_fn,
+                     remat)
     return _matmul(x, params["lm_head"], jnp.dtype(cfg.dtype)).astype(
         jnp.float32
     )
@@ -113,18 +115,35 @@ def llama_hidden(
     positions: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
     attn_fn=None,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """The trunk: tokens [B, T] -> final-norm hidden [B, T, dim]
     (everything but the lm_head matmul — the chunked loss fuses that
-    matmul into its online softmax, ops/xent.py)."""
+    matmul into its online softmax, ops/xent.py).
+
+    ``remat=True`` wraps each block in ``jax.checkpoint`` with the
+    dots-saveable policy: the backward keeps each layer's matmul
+    outputs and recomputes the elementwise rest — the standard HBM ↔
+    FLOPs trade for fitting long-context batches (the pipeline trunk
+    has the same knob; the math is bit-identical either way — pinned
+    in tests. The realized saving is shape- and backend-dependent:
+    it matters at real model scale on TPU, not at CPU test shapes)."""
     dtype = jnp.dtype(cfg.dtype)
     seq = tokens.shape[1]
     if positions is None:
         positions = jnp.arange(seq)
     x = params["embed"]["table"].astype(dtype)[tokens]
+
+    def blk(layer, xb):
+        return llama_block(layer, xb, positions, cfg, use_flash, attn_fn)
+
+    if remat:
+        blk = jax.checkpoint(
+            blk,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
     for i in range(cfg.layers):
-        x = llama_block(params[f"layer{i}"], x, positions, cfg, use_flash,
-                        attn_fn)
+        x = blk(params[f"layer{i}"], x)
     x = rmsnorm(params["final_norm"], x)
     return x
 
@@ -223,7 +242,8 @@ def llama_pipeline_hidden(
 
 
 def llama_loss(
-    params, tokens, cfg: LlamaConfig, vocab_chunk: int = 0, attn_fn=None
+    params, tokens, cfg: LlamaConfig, vocab_chunk: int = 0, attn_fn=None,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """Next-token LM loss on a [B, T] batch.
 
@@ -231,13 +251,15 @@ def llama_loss(
     linear-cross-entropy (ops/xent.py): the [B, T, vocab] logit tensor
     is never materialized — the memory saver for long-context training
     with large vocabularies. ``attn_fn`` swaps the attention core
-    (llama_block) — see make_llama_sp_loss.
+    (llama_block) — see make_llama_sp_loss. ``remat`` rematerializes
+    each block in the backward (llama_hidden).
     """
     if vocab_chunk > 0:
         from ..ops.xent import chunked_linear_xent
 
         dtype = jnp.dtype(cfg.dtype)
-        hidden = llama_hidden(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
+        hidden = llama_hidden(params, tokens[:, :-1], cfg, attn_fn=attn_fn,
+                              remat=remat)
         n = hidden.shape[0] * hidden.shape[1]
         # tile matmuls run in cfg.dtype (f32 accumulation inside), same
         # operand dtypes as the dense path's _matmul
@@ -247,7 +269,8 @@ def llama_loss(
             tokens[:, 1:].reshape(n),
             vocab_chunk,
         )
-    logits = llama_apply(params, tokens[:, :-1], cfg, attn_fn=attn_fn)
+    logits = llama_apply(params, tokens[:, :-1], cfg, attn_fn=attn_fn,
+                         remat=remat)
     return cross_entropy_loss(logits, tokens[:, 1:])
 
 
@@ -258,6 +281,7 @@ def make_llama_sp_loss(
     impl: str = "ring",
     use_flash: bool = False,
     vocab_chunk: int = 0,
+    remat: bool = False,
 ):
     """Sequence-parallel flagship training loss: ``(params, tokens) ->
     scalar`` with the trunk's activations sharded along T over the
@@ -285,7 +309,8 @@ def make_llama_sp_loss(
         raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
 
     def loss(params, tokens):
-        return llama_loss(params, tokens, cfg, vocab_chunk, attn_fn=attn)
+        return llama_loss(params, tokens, cfg, vocab_chunk, attn_fn=attn,
+                          remat=remat)
 
     return loss
 
