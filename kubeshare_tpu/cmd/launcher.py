@@ -45,8 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--poll-interval", type=float, default=0.5)
     parser.add_argument(
         "--metrics-port", type=int, default=0,
-        help="serve per-pod arbiter usage (tpu_pod_window_usage_ms) on "
-             "this port (0 = off)",
+        help="serve per-pod arbiter state on this port (0 = off): "
+             "tpu_pod_window_usage_ms, tpu_pod_hbm_used_bytes, "
+             "tpu_pod_hbm_cap_bytes, tpu_chip_arbiter_up",
     )
     return parser
 

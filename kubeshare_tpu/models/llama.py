@@ -44,6 +44,27 @@ def llama3_8b() -> LlamaConfig:
     )
 
 
+def llama_param_count(cfg: LlamaConfig) -> int:
+    """Analytic parameter count for a config — what ``init_llama``
+    would allocate, computable without allocating it (the 8B flagship
+    cannot init on a CPU test box; tests pin the formula against a
+    real init at small scale and the 8B total against its name)."""
+    hd = cfg.dim // cfg.num_heads
+    per_layer = (
+        2 * cfg.dim                               # attn + mlp rmsnorm
+        + cfg.dim * cfg.num_heads * hd            # wq
+        + 2 * cfg.dim * cfg.num_kv_heads * hd     # wk, wv
+        + cfg.num_heads * hd * cfg.dim            # wo
+        + 3 * cfg.dim * cfg.mlp_dim               # w_gate, w_up, w_down
+    )
+    return (
+        cfg.vocab * cfg.dim                       # embed
+        + cfg.layers * per_layer
+        + cfg.dim                                 # final norm
+        + cfg.dim * cfg.vocab                     # lm_head
+    )
+
+
 def _linear_init(rng, in_dim: int, out_dim: int):
     std = in_dim ** -0.5
     return jax.random.normal(rng, (in_dim, out_dim), jnp.float32) * std

@@ -182,11 +182,14 @@ class NodeLauncher:
         proc.wait()
 
     def usage_samples(self):
-        """Per-pod device-time usage straight from the live arbiters:
+        """Per-pod isolation state straight from the live arbiters:
         ``tpu_pod_window_usage_ms{chip,pod}`` (ms of compute-token hold
-        inside the arbiter's sliding window) plus an up gauge per chip.
-        The reference's Gemini exposes nothing — its per-pod usage was
-        only visible in debug logs."""
+        inside the arbiter's sliding window),
+        ``tpu_pod_hbm_used_bytes`` / ``tpu_pod_hbm_cap_bytes`` (the
+        interposer-charged memory ledger vs the pod's cap; cap 0 =
+        uncapped), plus an up gauge per chip. The reference's Gemini
+        exposes nothing — its per-pod usage was only visible in debug
+        logs."""
         from ..utils import expfmt
         from .client import TokenClient
 
@@ -204,10 +207,18 @@ class NodeLauncher:
                     timeout=2.0,
                 ) as client:
                     for stat in client.stats():
+                        labels = {"chip": chip.uuid, "pod": stat.pod}
                         samples.append(expfmt.Sample(
-                            "tpu_pod_window_usage_ms",
-                            {"chip": chip.uuid, "pod": stat.pod},
+                            "tpu_pod_window_usage_ms", labels,
                             stat.window_usage_ms,
+                        ))
+                        samples.append(expfmt.Sample(
+                            "tpu_pod_hbm_used_bytes", labels,
+                            float(stat.mem_used),
+                        ))
+                        samples.append(expfmt.Sample(
+                            "tpu_pod_hbm_cap_bytes", labels,
+                            float(stat.mem_cap),
                         ))
                 up = 1.0
             except Exception:

@@ -106,6 +106,18 @@ class TestModels:
         # random-init loss close to uniform ln(128)
         assert abs(float(loss) - np.log(128)) < 1.0
 
+    def test_llama_param_count_formula_and_8b_preset(self):
+        """The analytic count matches a real init at test scale, and
+        the llama3_8b preset really is ~8B dense params."""
+        from kubeshare_tpu.models.llama import llama3_8b, llama_param_count
+
+        cfg = LlamaConfig(vocab=128, dim=32, layers=2, num_heads=4,
+                          num_kv_heads=2, mlp_dim=64, max_seq_len=64)
+        params = init_llama(RNG, cfg)
+        real = sum(x.size for x in jax.tree.leaves(params))
+        assert llama_param_count(cfg) == real
+        assert 7.5e9 < llama_param_count(llama3_8b()) < 8.6e9
+
     def test_llama_remat_bit_identical(self):
         """Per-block rematerialization (jax.checkpoint, dots-saveable)
         must not change the math: loss and every gradient leaf

@@ -321,14 +321,18 @@ class TestLauncherUsageMetrics:
             launcher.start_arbiters()
             chip = launcher.chips["chip-0"]
             wait_for_port(chip.port)
-            # burn some device time as pod x
+            # burn device time + charge HBM as pod x; the connection
+            # stays open so the ledger charge is live at scrape time
+            # (disconnect refunds it)
             with TokenClient("127.0.0.1", chip.port, pod="default/x") as c:
                 c.acquire()
                 c.release(12.5)
-            server = launcher.serve_metrics(host="127.0.0.1")
-            text = urllib.request.urlopen(
-                f"http://127.0.0.1:{server.port}/metrics", timeout=5
-            ).read().decode()
+                ok, _, _ = c.request_memory(4096)
+                assert ok
+                server = launcher.serve_metrics(host="127.0.0.1")
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/metrics", timeout=5
+                ).read().decode()
             assert 'tpu_chip_arbiter_up{chip="chip-0"} 1' in text
             assert 'tpu_pod_window_usage_ms{chip="chip-0",pod="default/x"}' in text
             from kubeshare_tpu.utils import expfmt
@@ -337,6 +341,15 @@ class TestLauncherUsageMetrics:
                 expfmt.parse(text), "tpu_pod_window_usage_ms", pod="default/x"
             )
             assert usage.value >= 12.5
+            # the interposer-charged HBM ledger is on the wire too
+            [mem] = expfmt.select(
+                expfmt.parse(text), "tpu_pod_hbm_used_bytes", pod="default/x"
+            )
+            assert mem.value == 4096
+            [cap] = expfmt.select(
+                expfmt.parse(text), "tpu_pod_hbm_cap_bytes", pod="default/x"
+            )
+            assert cap.value == 0  # uncapped entry
             # dead arbiter -> up 0, no usage rows, endpoint still serves
             chip.scheduler_proc.kill()
             chip.scheduler_proc.wait()
