@@ -32,7 +32,10 @@ from . import constants as C
 from .filtering import node_fits
 from .labels import LabelError, PodKind, PodRequirements, parse_pod
 from .podgroup import PodGroupRegistry
-from .scoring import normalize_scores, score_node, select_leaves, _resolved_memory
+from .scoring import (
+    normalize_scores, score_node, seed_eligible, select_leaves,
+    _resolved_memory,
+)
 from .state import PodState, PodStatus, PodStatusStore
 
 
@@ -426,15 +429,19 @@ class TpuShareScheduler:
         req: PodRequirements,
         node_name: str,
         anchors: Optional[List[Cell]] = None,
+        seed_frees: Optional[List[Cell]] = None,
     ) -> float:
         """``anchors`` — the gang's already-placed leaves — may be
-        passed in to amortize the group lookup over a many-node loop."""
+        passed in to amortize the group lookup over a many-node loop;
+        ``seed_frees`` likewise amortizes the anchorless-gang seeding
+        set (_gang_seed_frees)."""
         if anchors is None:
             anchors = self.status.group_placed_leaves(
                 self.groups.get_or_create(pod, req.gang).key
             )
         return score_node(self.tree, node_name, req, anchors,
-                          self._held_leaves(pod, req, node_name))
+                          self._held_leaves(pod, req, node_name),
+                          seed_frees)
 
     def reserve(self, pod: Pod, req: PodRequirements, node_name: str) -> PodStatus:
         group = self.groups.get_or_create(pod, req.gang)
@@ -631,8 +638,12 @@ class TpuShareScheduler:
             )
 
         with maybe_span(self.tracer, "score", pod=pod.key):
+            seed_frees = (
+                self._gang_seed_frees(req, feasible) if not anchors else None
+            )
             scores = {
-                name: self.score(pod, req, name, anchors) for name in feasible
+                name: self.score(pod, req, name, anchors, seed_frees)
+                for name in feasible
             }
             normalized = normalize_scores(scores)
             best = max(feasible, key=lambda n: (normalized[n], n))
@@ -690,6 +701,28 @@ class TpuShareScheduler:
                 continue
             held.update(leaves)
         return frozenset(held)
+
+    def _gang_seed_frees(self, req, feasible) -> Optional[List[Cell]]:
+        """Eligible-free-leaf set for anchorless gang seeding
+        (scoring.gang_seed_bonus), drawn from the FEASIBLE candidate
+        nodes. Returns None — no seeding — for everything except the
+        first guarantee member of a multi-member gang; later members
+        anchor to placed leaves instead. Bounded work: feasible is
+        already capped by the sampling target, so this never touches
+        the whole cluster on the hot path."""
+        if (
+            req.gang is None
+            or req.gang.headcount <= 1
+            or not req.is_guarantee
+            or req.kind == PodKind.REGULAR
+        ):
+            return None
+        frees: List[Cell] = []
+        for name in feasible:
+            for leaf in self.tree.leaves_view(name, req.model or None):
+                if seed_eligible(leaf, req):
+                    frees.append(leaf)
+        return frees
 
     def _feasible_target(self, n_nodes: int) -> int:
         """How many feasible nodes to find before scoring (kube's

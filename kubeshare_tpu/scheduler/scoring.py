@@ -29,6 +29,15 @@ from .labels import PodKind, PodRequirements
 # — dominating, as cross-node placement should for a gang.
 LOCALITY_WEIGHT = 10.0
 
+# Anchorless gang seeding: free leaves within SEED_RADIUS hops credit
+# (SEED_RADIUS - d) each toward a node's seed bonus, scaled by
+# SEED_WEIGHT. Magnitudes chosen as a tie-breaker: the max bonus for a
+# 4-member gang is 2.0 * 3 * 2 = 12 points — enough to split nodes the
+# priority/usage terms score equally, small next to a 100-point usage
+# or priority swing.
+SEED_RADIUS = 3.0
+SEED_WEIGHT = 2.0
+
 # Placement anchors: live leaf cells, or bare cell-id strings recovered
 # from annotations when the chip itself is gone.
 Anchor = Union[Cell, str]
@@ -84,25 +93,88 @@ def guarantee_node_score(
     return score / n
 
 
+def seed_eligible(leaf: Cell, req: PodRequirements) -> bool:
+    """Could this leaf host one member of the gang being seeded?"""
+    if not leaf.healthy:
+        return False
+    if req.kind == PodKind.MULTI_CHIP:
+        return leaf.is_whole_free
+    return fge(leaf.available, req.request)
+
+
+def gang_seed_bonus(
+    node_leaves: Sequence[Cell],
+    free_leaves: Sequence[Cell],
+    req: PodRequirements,
+) -> float:
+    """Tie-breaker for the FIRST (anchorless) guarantee member of a
+    gang: prefer the node whose eligible leaves sit in the densest
+    free neighborhood, so the remaining members can anchor
+    torus-adjacent. Without this the seed placement is locality-blind
+    — the anchors list is empty until something is placed — and the
+    gang clusters around an arbitrary node (the reference has the
+    identical blindness: score.go:85-112 weighs *placed* cells only).
+
+    Each nearby free leaf credits ``SEED_RADIUS - hops`` (a hop-1
+    neighbor is worth 2; >= SEED_RADIUS hops nothing). Only what the
+    REST of the gang can actually use counts: the seed member itself
+    consumes ``chip_count - 1`` further leaves (select_leaves anchors
+    them nearest-first), so that many top credits are skipped as
+    self-consumed, and the remaining members need
+    ``(headcount-1) * chip_count`` leaves — crediting a node whose
+    dense neighborhood the seed pod would swallow whole used to
+    strand member 2 cross-fabric."""
+    if req.gang is None:
+        return 0.0
+    eligible = [l for l in node_leaves if seed_eligible(l, req)]
+    if not eligible:
+        return 0.0
+    per_member = (
+        max(1, req.chip_count) if req.kind == PodKind.MULTI_CHIP else 1
+    )
+    self_consumed = per_member - 1
+    need = max(1, req.gang.headcount - 1) * per_member
+    best = 0.0
+    for leaf in eligible:
+        credits = []
+        for other in free_leaves:
+            if other is leaf:
+                continue
+            d = ici_distance(leaf, other)
+            if d < SEED_RADIUS:
+                credits.append(SEED_RADIUS - d)
+        credits.sort(reverse=True)
+        best = max(
+            best, sum(credits[self_consumed:self_consumed + need])
+        )
+    return SEED_WEIGHT * best
+
+
 def score_node(
     tree: CellTree,
     node: str,
     req: PodRequirements,
     anchors: Sequence[Anchor] = (),
     exclude: frozenset = frozenset(),
+    seed_frees: Optional[Sequence[Cell]] = None,
 ) -> float:
     """``exclude`` — leaf uuids this pod may not take (live defrag
     holds). Without it an opportunistic pod is steered toward a node
     whose apparent free capacity is mostly held leaves it cannot use;
     filter/reserve stay correct either way, so this only shapes
-    placement quality during a hold (advisor r3)."""
+    placement quality during a hold (advisor r3). ``seed_frees`` —
+    the cluster-wide eligible-free-leaf set, passed only when seeding
+    an anchorless gang (gang_seed_bonus)."""
     if req.kind == PodKind.REGULAR:
         return regular_pod_node_score(tree, node)
     leaves = tree.leaves_view(node, req.model or None)
     if exclude:
         leaves = [l for l in leaves if l.uuid not in exclude]
     if req.is_guarantee:
-        return guarantee_node_score(leaves, anchors)
+        score = guarantee_node_score(leaves, anchors)
+        if seed_frees is not None:
+            score += gang_seed_bonus(leaves, seed_frees, req)
+        return score
     return opportunistic_node_score(leaves)
 
 

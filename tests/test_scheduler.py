@@ -312,6 +312,160 @@ class TestLifecycle:
         assert sched2.status.get("default/p9").port not in old_ports
 
 
+class TestGangSeeding:
+    """The FIRST member of a guarantee gang has no anchors, so plain
+    locality scoring is blind for it; the seed bonus steers it toward
+    the densest free neighborhood so the rest of the gang can land
+    torus-adjacent."""
+
+    def _ring_env(self, occupied):
+        """8 hosts x 1 chip on an 8-ring torus; ``occupied`` hosts get
+        a whole-chip filler pod."""
+        topo = {
+            "cell_types": {
+                "v5e-host": {
+                    "child_cell_type": "tpu-v5e",
+                    "child_cell_number": 1,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+                "ring-8": {
+                    "child_cell_type": "v5e-host",
+                    "child_cell_number": 8,
+                    "torus": [8],
+                },
+            },
+            "cells": [{
+                "cell_type": "ring-8",
+                "cell_children": [{"cell_id": f"h{i}"} for i in range(8)],
+            }],
+        }
+        cluster = FakeCluster()
+        for i in range(8):
+            cluster.add_node(
+                f"h{i}", [ChipInfo(f"h{i}-c0", "tpu-v5e", 16 * GIB, 0)]
+            )
+        sched = TpuShareScheduler(topo, cluster, clock=FakeClock())
+        for i in occupied:
+            d = sched.schedule_one(cluster.create_pod(
+                tpu_pod(f"fill{i}", 1.0, limit=1.0)
+            ))
+            assert d.status == "bound"
+        # packing order is an implementation detail: callers map
+        # filler -> host via status lookups, never by index
+        return cluster, sched
+
+    def test_first_member_seeds_into_dense_free_neighborhood(self):
+        """Free chips at ring positions {0, 1} (adjacent) and {5}
+        (isolated): the no-seed tie-break picks the isolated h5
+        (lexicographically-last equal score), stranding the gang 3
+        hops apart; the seed bonus lands it on the adjacent pair —
+        1 hop. (Verified to FAIL with SEED_WEIGHT=0.)"""
+        from kubeshare_tpu.cells.topology import ici_distance
+
+        cluster, sched = self._ring_env(range(8))
+        by_node = {
+            sched.status.get(f"default/fill{i}").node_name: f"default/fill{i}"
+            for i in range(8)
+        }
+        for host in ("h0", "h1", "h5"):
+            cluster.delete_pod(by_node[host])
+        g0 = cluster.create_pod(
+            tpu_pod("g0", 1.0, limit=1.0, priority=60,
+                    group="g", headcount=2, threshold=1.0)
+        )
+        g1 = cluster.create_pod(
+            tpu_pod("g1", 1.0, limit=1.0, priority=60,
+                    group="g", headcount=2, threshold=1.0)
+        )
+        sched.schedule_one(g0)
+        sched.schedule_one(g1)
+        s0, s1 = sched.status.get("default/g0"), sched.status.get("default/g1")
+        assert s0.state == PodState.BOUND and s1.state == PodState.BOUND
+        assert {s0.node_name, s1.node_name} == {"h0", "h1"}
+        assert ici_distance(s0.leaves[0], s1.leaves[0]) == 1.0
+
+    def test_multichip_seed_discounts_self_consumed_chips(self):
+        """A 2-chip seed member consumes its node's own free pair, so
+        that pair must NOT count as neighborhood for the rest of the
+        gang. Frees on a [2, 8] torus: hosts h0+h4 form an adjacent
+        4-chip cluster; h6's pair is >= 3 hops from everything else
+        and sorts lexicographically last. Crediting self-consumed
+        chips used to score isolated h6 level
+        with the cluster (each 'sees' its own pair) and the
+        lexicographic tie-break stranded the gang; discounting them,
+        the cluster wins outright."""
+        from kubeshare_tpu.cells.topology import ici_distance
+
+        hosts = 8
+        topo = {
+            "cell_types": {
+                "host2": {
+                    "child_cell_type": "tpu-v5e",
+                    "child_cell_number": 2,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+                "slice-16": {
+                    "child_cell_type": "host2",
+                    "child_cell_number": hosts,
+                    "torus": [2, 8],
+                },
+            },
+            "cells": [{
+                "cell_type": "slice-16",
+                "cell_children": [
+                    {"cell_id": f"h{i}"} for i in range(hosts)
+                ],
+            }],
+        }
+        cluster = FakeCluster()
+        for i in range(hosts):
+            cluster.add_node(f"h{i}", chips(f"h{i}", n=2))
+        sched = TpuShareScheduler(topo, cluster, clock=FakeClock())
+        # occupy every chip, then free hosts 0, 4, 6
+        fills = [
+            cluster.create_pod(tpu_pod(f"fill{i}", 2.0, limit=2.0))
+            for i in range(hosts)
+        ]
+        for p in fills:
+            assert sched.schedule_one(p).status == "bound"
+        by_node = {
+            sched.status.get(p.key).node_name: p.key for p in fills
+        }
+        for host in ("h0", "h4", "h6"):
+            cluster.delete_pod(by_node[host])
+        g0 = cluster.create_pod(
+            tpu_pod("g0", 2.0, limit=2.0, priority=60,
+                    group="mg", headcount=2, threshold=1.0)
+        )
+        g1 = cluster.create_pod(
+            tpu_pod("g1", 2.0, limit=2.0, priority=60,
+                    group="mg", headcount=2, threshold=1.0)
+        )
+        sched.schedule_one(g0)
+        sched.schedule_one(g1)
+        s0, s1 = sched.status.get("default/g0"), sched.status.get("default/g1")
+        assert s0.state == PodState.BOUND and s1.state == PodState.BOUND
+        assert {s0.node_name, s1.node_name} == {"h0", "h4"}, (
+            s0.node_name, s1.node_name
+        )
+        cross = [
+            ici_distance(a, b) for a in s0.leaves for b in s1.leaves
+        ]
+        assert max(cross) <= 2.0
+
+    def test_non_gang_scores_unchanged_by_seeding_path(self):
+        """A solo guarantee pod must score identically whether or not
+        the seeding machinery exists (seed set is None for it)."""
+        cluster, sched = self._ring_env(())
+        pod = cluster.create_pod(tpu_pod("solo", 1.0, limit=1.0, priority=60))
+        req = sched.pre_filter(pod)
+        assert sched._gang_seed_frees(req, [f"h{i}" for i in range(8)]) is None
+        base = sched.score(pod, req, "h0")
+        assert sched.score(pod, req, "h0", seed_frees=None) == base
+
+
 class TestTopologyReload:
     def test_reload_keeps_bound_reservations(self, env):
         cluster, sched, _ = env

@@ -145,9 +145,12 @@ def gang_locality_ab(gangs: int = 6, seed: int = 13) -> list:
                  for i in range(4)],
             )
         engine = TpuShareScheduler(topo, cluster)
-        saved = scoring.LOCALITY_WEIGHT
+        saved = (scoring.LOCALITY_WEIGHT, scoring.SEED_WEIGHT)
         if not locality_on:
-            scoring.LOCALITY_WEIGHT = 0.0  # experiment control
+            # experiment control: the OFF arm is the reference's
+            # behavior — no anchor locality AND no anchorless seeding
+            scoring.LOCALITY_WEIGHT = 0.0
+            scoring.SEED_WEIGHT = 0.0
         hop_means = []
         try:
             n = 0
@@ -204,7 +207,7 @@ def gang_locality_ab(gangs: int = 6, seed: int = 13) -> list:
                 for p in members + fillers:
                     cluster.delete_pod(p.key)
         finally:
-            scoring.LOCALITY_WEIGHT = saved
+            scoring.LOCALITY_WEIGHT, scoring.SEED_WEIGHT = saved
         return {
             "locality": locality_on,
             "gangs": gangs,
