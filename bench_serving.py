@@ -111,14 +111,27 @@ def start_arbiter(tmpdir):
 
 def run() -> dict:
     """The full serving bench; returns the result doc (main() prints
-    it; tools/bench_artifacts.py folds it into the evidence file)."""
+    it; tools/bench_artifacts.py folds it into the evidence file).
+
+    KUBESHARE_BENCH_QUANT=1 serves weight-only int8 pods
+    (models/quant.py) — decode re-reads the full weight set per token,
+    so the half-width weights are the HBM-bandwidth A/B the artifact's
+    serving_int8 row records."""
+    quant = os.environ.get("KUBESHARE_BENCH_QUANT") == "1"
     log(f"serving bench platform: {jax.devices()[0].platform} "
-        f"({jax.devices()[0]})")
+        f"({jax.devices()[0]})"
+        + (" [weight-only int8]" if quant else ""))
     rng = jax.random.PRNGKey(7)
-    decodes = [
-        make_decode(init_llama(jax.random.fold_in(rng, i), CFG))
-        for i in range(PODS)
-    ]
+
+    def pod_params(i):
+        params = init_llama(jax.random.fold_in(rng, i), CFG)
+        if quant:
+            from kubeshare_tpu.models.quant import quantize_llama
+
+            params = quantize_llama(params)
+        return params
+
+    decodes = [make_decode(pod_params(i)) for i in range(PODS)]
     # warm EVERY pod's decode fn (separate jit caches) + calibrate
     token = jnp.zeros((BATCH,), jnp.int32)
     for decode in decodes:
@@ -218,7 +231,9 @@ def run() -> dict:
 
     return {
         "metric": "aggregate decode tokens/sec, 4 co-located 0.25-chip "
-                  "KV-cache Llama pods vs whole-chip allocation",
+                  "KV-cache Llama pods vs whole-chip allocation"
+                  + (" (weight-only int8)" if quant else ""),
+        "weights": "int8" if quant else CFG.dtype,
         "value": round(mid["gated"], 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mid["ratio"], 3),

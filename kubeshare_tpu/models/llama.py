@@ -107,6 +107,16 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
 
 
 def _matmul(x, w, dtype):
+    if isinstance(w, dict) and "w_q" in w:
+        # weight-only int8 (models/quant.py): the int8->dtype convert
+        # fuses into the dot's operand read, so the weight crosses HBM
+        # at one byte per element; the per-output-channel scale applies
+        # to the f32 accumulator — exact for column-wise scales
+        y = jnp.dot(
+            x.astype(dtype), w["w_q"].astype(dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return (y * w["scale"]).astype(dtype)
     return jnp.dot(
         x.astype(dtype), w.astype(dtype), preferred_element_type=jnp.float32
     ).astype(dtype)

@@ -1,0 +1,78 @@
+"""Weight-only int8 quantization for inference.
+
+Decode-time serving on TPU is HBM-bandwidth-bound: every generated
+token re-reads the full weight set, so halving the bytes at rest is
+the first-order lever on tokens/sec — and it doubles how many
+fractional-chip serving pods fit one chip's HBM, which is this
+framework's whole premise (BASELINE config 5 packs 4 x 0.25-chip
+Llama decoders). Symmetric per-output-channel int8: ``w_q[in, out]``
+int8 plus ``scale[out]`` f32, dequantized INSIDE the matmul read
+(the convert fuses into the dot on XLA; the scale applies to the
+f32 accumulator afterwards — mathematically exact for per-column
+scales). Activations stay bf16: weight-only is the standard
+memory-bandwidth play and needs no calibration data.
+
+Norms (1D) and the embedding table are kept unquantized — they are a
+rounding error of the footprint and the embed gather's output feeds
+rmsnorm directly. Training paths reject quantized params by
+construction (optax would try to differentiate int8 leaves).
+
+No reference analog: the reference schedules containers and never
+touches model internals. This is TPU-serving completeness the same
+way ops/attention.py is TPU-training completeness.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+# every 2D matmul weight in a llama layer + the lm head
+_LAYER_MATS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_linear(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """[in, out] float weights -> {"w_q": int8, "scale": f32[out]}."""
+    if w.ndim != 2:
+        raise ValueError(f"expected a 2D weight, got shape {w.shape}")
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    w_q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return {"w_q": w_q, "scale": scale}
+
+
+def dequantize_linear(q: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """Materialize the f32 weight (tests/debug only — the runtime
+    path never does this; the dequant rides inside the matmul)."""
+    return q["w_q"].astype(jnp.float32) * q["scale"]
+
+
+def is_quantized(w) -> bool:
+    return isinstance(w, dict) and "w_q" in w
+
+
+def quantize_llama(params: Dict) -> Dict:
+    """Quantize every matmul weight of a llama param tree for
+    inference (llama_apply / llama_apply_cached / llama_generate
+    consume the result transparently). Embed + norms stay float."""
+    out: Dict = {"embed": params["embed"]}
+    for name, value in params.items():
+        if name.startswith("layer"):
+            layer = dict(value)
+            for mat in _LAYER_MATS:
+                layer[mat] = quantize_linear(value[mat])
+            out[name] = layer
+        elif name == "lm_head":
+            out[name] = quantize_linear(value)
+        elif name != "embed":
+            out[name] = value
+    return out
+
+
+def param_bytes(params: Dict) -> int:
+    """Total bytes at rest of a (possibly quantized) param tree."""
+    return sum(x.nbytes for x in jax.tree.leaves(params))

@@ -94,12 +94,18 @@ def guarantee_node_score(
 
 
 def seed_eligible(leaf: Cell, req: PodRequirements) -> bool:
-    """Could this leaf host one member of the gang being seeded?"""
+    """Could this leaf host one member of the gang being seeded? Must
+    mirror the reserve-time checks (select_leaves): compute fraction
+    AND free HBM — crediting a memory-exhausted chip as neighborhood
+    would seed the gang next to capacity the rest of it cannot take."""
     if not leaf.healthy:
         return False
     if req.kind == PodKind.MULTI_CHIP:
         return leaf.is_whole_free
-    return fge(leaf.available, req.request)
+    return (
+        fge(leaf.available, req.request)
+        and leaf.free_memory >= _resolved_memory(leaf, req)
+    )
 
 
 def gang_seed_bonus(

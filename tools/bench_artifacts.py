@@ -123,27 +123,36 @@ def main() -> int:
         log(f"   {doc['train_gate_overhead']}")
 
     if "serving" in sections:
-        log("== serving (4x0.25 KV-cache decode), own process for a "
-            "fresh tunnel session")
-        # a serving failure must never discard the kernel/A-B sections
+        # each serving variant runs in its own process for a fresh
+        # tunnel session; a failure must never discard the sections
         # already banked above — record the error and write the file
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.join(REPO, "bench_serving.py")],
-                capture_output=True, timeout=600, env=dict(os.environ),
-            )
-            for line in proc.stderr.decode(errors="replace").splitlines():
-                log(line)
-            if proc.returncode == 0:
-                doc["serving"] = dict(json.loads(
-                    proc.stdout.decode().strip().splitlines()[-1]
-                ), **stamp)
-            else:
-                doc["serving"] = {"error": f"exit {proc.returncode}",
-                                  **stamp}
-        except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
-            doc["serving"] = {"error": f"{type(e).__name__}: {e}"[:200],
-                              **stamp}
+        def serving_run(row: str, extra_env: dict) -> None:
+            log(f"== serving (4x0.25 KV-cache decode) [{row}], own "
+                "process for a fresh tunnel session")
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "bench_serving.py")],
+                    capture_output=True, timeout=600,
+                    env={**os.environ, **extra_env},
+                )
+                for line in proc.stderr.decode(errors="replace").splitlines():
+                    log(line)
+                if proc.returncode == 0:
+                    doc[row] = dict(json.loads(
+                        proc.stdout.decode().strip().splitlines()[-1]
+                    ), **stamp)
+                else:
+                    doc[row] = {"error": f"exit {proc.returncode}", **stamp}
+            except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
+                doc[row] = {"error": f"{type(e).__name__}: {e}"[:200],
+                            **stamp}
+
+        # pin the baseline's quant flag OFF explicitly: an inherited
+        # KUBESHARE_BENCH_QUANT=1 would silently turn the A/B into
+        # int8-vs-int8 with the baseline mislabeled bf16
+        serving_run("serving", {"KUBESHARE_BENCH_QUANT": "0"})
+        # the HBM-bandwidth A/B: same pods with weight-only int8
+        serving_run("serving_int8", {"KUBESHARE_BENCH_QUANT": "1"})
 
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:
