@@ -35,6 +35,11 @@ class LlamaConfig:
     max_seq_len: int = 2048
     rope_theta: float = 500000.0
     dtype: str = "bfloat16"
+    # > 0 = Mistral-style sliding-window attention: each position sees
+    # only the last ``window`` positions (ops/attention.py handles it
+    # in both the XLA and Pallas paths; the KV-cache decode masks the
+    # same band)
+    window: int = 0
 
 
 def llama3_8b() -> LlamaConfig:
@@ -107,7 +112,9 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
 
 
 def _matmul(x, w, dtype):
-    if isinstance(w, dict) and "w_q" in w:
+    from .quant import is_quantized
+
+    if is_quantized(w):
         # weight-only int8 (models/quant.py): the int8->dtype convert
         # fuses into the dot's operand read, so the weight crosses HBM
         # at one byte per element; the per-output-channel scale applies
@@ -210,9 +217,15 @@ def llama_block(
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if attn_fn is not None:
+        if cfg.window > 0:
+            raise ValueError(
+                "sliding-window attention is not supported through an "
+                "attn_fn override (ring/Ulysses SP) yet"
+            )
         out = attn_fn(q, k, v)
     else:
-        out = mha(q, k, v, causal=True, use_flash=use_flash)
+        out = mha(q, k, v, causal=True, use_flash=use_flash,
+                  window=cfg.window)
     out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)
     x = x + _matmul(out, layer["wo"], dtype)
 
@@ -285,6 +298,14 @@ def llama_loss(
     (llama_block) — see make_llama_sp_loss. ``remat`` rematerializes
     each block in the backward (llama_hidden).
     """
+    from .quant import is_quantized
+
+    if is_quantized(params.get("lm_head")):
+        raise ValueError(
+            "quantized params are inference-only (models/quant.py): "
+            "int8 weights cannot be trained — keep the float tree for "
+            "llama_loss"
+        )
     if vocab_chunk > 0:
         from ..ops.xent import chunked_linear_xent
 
@@ -326,6 +347,11 @@ def make_llama_sp_loss(
     tokens P(None, axis_name) — or just pass replicated tokens and let
     GSPMD reshard at the trunk boundary. Combines with dp: a mesh of
     (dp, sp) shards batch and sequence independently."""
+    if cfg.window > 0:
+        raise ValueError(
+            "sliding-window attention does not compose with the SP "
+            "attention cores yet — use the sequential trunk"
+        )
     if impl == "ring":
         from ..parallel.ring_attention import make_ring_attention
 
@@ -365,9 +391,11 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, dtype=None):
     }
 
 
-def _attend_cached(q, k_cache, v_cache, length, num_heads, num_kv_heads):
+def _attend_cached(q, k_cache, v_cache, length, num_heads, num_kv_heads,
+                   window: int = 0):
     """q [B, H, Tq, D] against cache [B, KvH, S, D] masked to < length
-    (+ causal within the new Tq block)."""
+    (+ causal within the new Tq block; ``window > 0`` additionally
+    masks positions older than the sliding window)."""
     groups = num_heads // num_kv_heads
     batch, _, tq, hd = q.shape
     max_s = k_cache.shape[2]
@@ -384,6 +412,8 @@ def _attend_cached(q, k_cache, v_cache, length, num_heads, num_kv_heads):
     positions = jnp.arange(max_s)[None, None, None, None, :]
     q_abs = (length - tq + jnp.arange(tq))[None, None, None, :, None]
     mask = positions <= q_abs
+    if window > 0:
+        mask &= positions > q_abs - window
     scores = jnp.where(mask, scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
@@ -432,7 +462,8 @@ def llama_apply_cached(
         new_k.append(k_cache)
         new_v.append(v_cache)
         out = _attend_cached(
-            q, k_cache, v_cache, start + seq, cfg.num_heads, cfg.num_kv_heads
+            q, k_cache, v_cache, start + seq, cfg.num_heads,
+            cfg.num_kv_heads, cfg.window,
         ).astype(dtype)
         out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)
         x = x + _matmul(out, layer["wo"], dtype)

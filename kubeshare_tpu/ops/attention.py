@@ -43,9 +43,16 @@ def _repeat_kv(k, v, num_heads: int):
     return k, v
 
 
-def attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
-    """Reference attention. q [B,H,Tq,D], k/v [B,Hkv,Tk,D] -> [B,H,Tq,D]."""
+def attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+              window: int = 0):
+    """Reference attention. q [B,H,Tq,D], k/v [B,Hkv,Tk,D] -> [B,H,Tq,D].
+
+    ``window > 0`` adds Mistral-style sliding-window masking on top of
+    causal: query i sees keys j with ``i - window < j <= i`` (requires
+    ``causal=True``)."""
     *_, num_heads, t_q, head_dim = q.shape
+    if window > 0 and not causal:
+        raise ValueError("window requires causal attention")
     k, v = _repeat_kv(k, v, num_heads)
     t_k = k.shape[2]
     scale = scale if scale is not None else head_dim ** -0.5
@@ -55,7 +62,10 @@ def attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
     if causal:
         q_pos = jnp.arange(t_q)[:, None] + (t_k - t_q)
         k_pos = jnp.arange(t_k)[None, :]
-        scores = jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+        visible = k_pos <= q_pos
+        if window > 0:
+            visible &= k_pos > q_pos - window
+        scores = jnp.where(visible, scores, _NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum(
         "bhqk,bhkd->bhqd", weights.astype(v.dtype), v,
@@ -66,9 +76,11 @@ def attention(q, k, v, causal: bool = True, scale: Optional[float] = None):
 # ---- Pallas flash forward ------------------------------------------
 
 
-def _causal_mask(scores, q_offset, k_offset):
-    """Mask positions where k_pos > q_pos to -inf (shared by all three
-    kernels — one place for the position arithmetic)."""
+def _causal_mask(scores, q_offset, k_offset, window: int = 0):
+    """Mask positions where k_pos > q_pos — and, with ``window > 0``,
+    where k_pos <= q_pos - window — to -inf (shared by all three
+    kernels: one place for the position arithmetic). The diagonal is
+    always visible, so no row can end up fully masked."""
     block_q, block_k = scores.shape
     q_pos = q_offset + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
@@ -76,7 +88,26 @@ def _causal_mask(scores, q_offset, k_offset):
     k_pos = k_offset + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return jnp.where(k_pos <= q_pos, scores, _NEG_INF)
+    visible = k_pos <= q_pos
+    if window > 0:
+        visible &= k_pos > q_pos - window
+    return jnp.where(visible, scores, _NEG_INF)
+
+
+def _block_live(q_offset, block_q, k_offset, block_k, causal: bool,
+                window: int) -> bool:
+    """Whether any (q, k) pair in this tile survives the mask — a
+    Python/trace-time predicate over block offsets (pl.when skips the
+    COMPUTE of dead tiles; their DMA still runs, index maps being
+    shape-static). Dead above the diagonal (causal) and, with a
+    window, below the band: the newest k in the block must be newer
+    than the oldest q's horizon."""
+    live = (not causal) or (k_offset <= q_offset + block_q - 1)
+    if causal and window > 0:
+        live = jnp.logical_and(
+            live, k_offset + block_k - 1 > q_offset - window
+        )
+    return live
 
 
 def _resolve_defaults(q, scale, interpret):
@@ -89,7 +120,7 @@ def _resolve_defaults(q, scale, interpret):
 
 def _flash_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref,
                   acc_ref, m_ref, l_ref, *, num_k_blocks: int,
-                  causal: bool, scale: float):
+                  causal: bool, scale: float, window: int = 0):
     """One (batch*head, q-block, K-BLOCK) program: the K/V sequence
     streams through the GRID (innermost axis), never resident whole —
     a [1, Tk, D] block was 4MB/operand at T=16k and blew the ~16MB
@@ -123,8 +154,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # fully-masked block: every k position is beyond every q position
-    live = (not causal) or (k_offset <= q_offset + block_q - 1)
+    # fully-masked block: beyond the causal diagonal or the window band
+    live = _block_live(q_offset, block_q, k_offset, block_k, causal, window)
 
     @pl.when(live)
     def _body():
@@ -134,7 +165,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref,
             q, k_blk.T, preferred_element_type=jnp.float32
         ) * scale
         if causal:
-            scores = _causal_mask(scores, q_offset, k_offset)
+            scores = _causal_mask(scores, q_offset, k_offset, window)
         m_prev, l_prev = m_ref[...], l_ref[...]
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -187,13 +218,15 @@ def flash_shapes_ok(q_shape, k_shape, causal: bool,
 
 
 def _flash_forward(q, k, v, causal: bool, scale: float,
-                   block_q, block_k, interpret: bool):
+                   block_q, block_k, interpret: bool, window: int = 0):
     batch, num_heads, t_q, head_dim = q.shape
     h_kv = k.shape[1]
     reps = num_heads // h_kv
     t_k = k.shape[2]
     block_q = _pick_block(t_q, block_q)
     block_k = _pick_block(t_k, block_k)
+    if window > 0 and not causal:
+        raise ValueError("window requires causal attention")
     if not flash_shapes_ok(q.shape, k.shape, causal, block_q, block_k):
         raise ValueError(
             f"flash tiling violated: t_q={t_q} t_k={t_k} blocks=({block_q},"
@@ -215,7 +248,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
     num_k_blocks = t_k // block_k
     kernel = functools.partial(
         _flash_kernel, num_k_blocks=num_k_blocks, causal=causal,
-        scale=scale,
+        scale=scale, window=window,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -266,7 +299,7 @@ def _flash_forward(q, k, v, causal: bool, scale: float,
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, acc_ref, *, num_k_blocks: int,
-                         causal: bool, scale: float):
+                         causal: bool, scale: float, window: int = 0):
     """One (batch*head, q-block, K-BLOCK) program — K/V stream through
     the grid like the forward (whole-sequence VMEM residency fails to
     compile at long T); dq accumulates in f32 scratch across the k
@@ -290,7 +323,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    live = (not causal) or (k_offset <= q_offset + block_q - 1)
+    live = _block_live(q_offset, block_q, k_offset, block_k, causal, window)
 
     @pl.when(live)
     def _body():
@@ -298,7 +331,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v_blk = v_ref[0]
         s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, q_offset, k_offset)
+            s = _causal_mask(s, q_offset, k_offset, window)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
@@ -314,7 +347,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, t_q: int, causal: bool,
-                          scale: float):
+                          scale: float, window: int = 0):
     """One (batch*kv-head, k-block, row-block) program. The row axis is
     the kv head's WHOLE GROUP (its q heads concatenated, reps*Tq rows),
     tiled into [1, BQ, D] VMEM blocks by the grid rather than resident
@@ -334,30 +367,38 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_ref[0] = jnp.zeros_like(dk_ref[0])
         dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    q_blk = q_ref[0]
-    do_blk = do_ref[0]
-    lse_blk = lse_ref[0]
-    delta_blk = delta_ref[0]
-    s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
-    if causal:
-        # position within this block's own head (rows wrap per head;
-        # t_q % block_q == 0 so blocks never straddle heads)
-        s = _causal_mask(s, (qb * block_q) % t_q, k_offset)
-    p = jnp.exp(s - lse_blk)
-    dv_ref[0] += jnp.dot(
-        p.T.astype(do_blk.dtype), do_blk,
-        preferred_element_type=jnp.float32,
-    )
-    dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_blk)
-    dk_ref[0] += scale * jnp.dot(
-        ds.T.astype(q_blk.dtype), q_blk,
-        preferred_element_type=jnp.float32,
-    )
+    # skip fully-masked tiles (above the causal diagonal / outside the
+    # window band): their contribution is exactly zero and the init
+    # above runs regardless, so skipping only saves the compute
+    live = _block_live((qb * block_q) % t_q, block_q, k_offset,
+                       k.shape[0], causal, window)
+
+    @pl.when(live)
+    def _body():
+        q_blk = q_ref[0]
+        do_blk = do_ref[0]
+        lse_blk = lse_ref[0]
+        delta_blk = delta_ref[0]
+        s = jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            # position within this block's own head (rows wrap per
+            # head; t_q % block_q == 0 so blocks never straddle heads)
+            s = _causal_mask(s, (qb * block_q) % t_q, k_offset, window)
+        p = jnp.exp(s - lse_blk)
+        dv_ref[0] += jnp.dot(
+            p.T.astype(do_blk.dtype), do_blk,
+            preferred_element_type=jnp.float32,
+        )
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        dk_ref[0] += scale * jnp.dot(
+            ds.T.astype(q_blk.dtype), q_blk,
+            preferred_element_type=jnp.float32,
+        )
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret, g_lse=None):
+                    interpret, g_lse=None, window: int = 0):
     batch, num_heads, t_q, head_dim = q.shape
     h_kv = k.shape[1]
     reps = num_heads // h_kv
@@ -403,7 +444,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, num_k_blocks=num_k_blocks,
-            causal=causal, scale=scale,
+            causal=causal, scale=scale, window=window,
         ),
         grid=(batch * num_heads, t_q // block_q, num_k_blocks),
         in_specs=[q_spec, kv_by_q, kv_by_q, q_spec, row_spec, row_spec],
@@ -436,6 +477,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, t_q=t_q, causal=causal, scale=scale,
+            window=window,
         ),
         grid=(batch * h_kv, t_k // block_k, (reps * t_q) // block_q),
         in_specs=[row_blk, kv_spec, kv_spec, row_blk, row_blk1, row_blk1],
@@ -459,73 +501,84 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    window: int = 0):
     scale, interpret = _resolve_defaults(q, scale, interpret)
-    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret, window)
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret, window):
     scale, interpret = _resolve_defaults(q, scale, interpret)
     out, lse = _flash_forward(
-        q, k, v, causal, scale, block_q, block_k, interpret
+        q, k, v, causal, scale, block_q, block_k, interpret, window
     )
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
+               residuals, g):
     q, k, v, out, lse = residuals
     scale, interpret = _resolve_defaults(q, scale, interpret)
     return _flash_backward(
-        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret,
+        window=window,
     )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention_with_lse(q, k, v, causal: bool = True,
                              scale: Optional[float] = None,
                              block_q: Optional[int] = None,
                              block_k: Optional[int] = None,
-                             interpret: Optional[bool] = None):
+                             interpret: Optional[bool] = None,
+                             window: int = 0):
     """Flash attention that also returns the row log-sum-exp
     [B, H, Tq, 1] — the ingredient block-merging callers (ring
     attention) need. Differentiable in BOTH outputs: the lse cotangent
     folds into the backward kernels' shared row term."""
     scale, interpret = _resolve_defaults(q, scale, interpret)
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret, window)
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   window):
     scale, interpret = _resolve_defaults(q, scale, interpret)
     out, lse = _flash_forward(
-        q, k, v, causal, scale, block_q, block_k, interpret
+        q, k, v, causal, scale, block_q, block_k, interpret, window
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, residuals, g):
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, window,
+                   residuals, g):
     q, k, v, out, lse = residuals
     g_out, g_lse = g
     scale, interpret = _resolve_defaults(q, scale, interpret)
     return _flash_backward(
         q, k, v, out, lse, g_out, causal, scale, block_q, block_k,
-        interpret, g_lse=g_lse,
+        interpret, g_lse=g_lse, window=window,
     )
 
 
 flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def mha(q, k, v, causal: bool = True, use_flash: Optional[bool] = None):
-    """Dispatch: flash on TPU when shapes tile, reference otherwise."""
+def mha(q, k, v, causal: bool = True, use_flash: Optional[bool] = None,
+        window: int = 0):
+    """Dispatch: flash on TPU when shapes tile, reference otherwise.
+    ``window > 0`` = Mistral-style sliding-window attention (causal
+    only; both paths honor it)."""
     if use_flash is None:
         use_flash = (
             jax.default_backend() == "tpu"
@@ -533,5 +586,5 @@ def mha(q, k, v, causal: bool = True, use_flash: Optional[bool] = None):
             and flash_shapes_ok(q.shape, k.shape, causal)
         )
     if use_flash:
-        return flash_attention(q, k, v, causal)
-    return attention(q, k, v, causal)
+        return flash_attention(q, k, v, causal, window=window)
+    return attention(q, k, v, causal, window=window)

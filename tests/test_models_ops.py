@@ -382,6 +382,123 @@ class TestAttention:
 
 
 class TestReviewRegressions:
+    def test_sliding_window_reference_semantics(self):
+        """window=W: query i sees exactly keys (i-W, i]."""
+        import numpy as np
+
+        from kubeshare_tpu.ops.attention import attention
+
+        rng = jax.random.PRNGKey(5)
+        kq, kk, kv = jax.random.split(rng, 3)
+        b, h, t, d, w = 1, 2, 16, 8, 4
+        q = jax.random.normal(kq, (b, h, t, d), jnp.float32)
+        k = jax.random.normal(kk, (b, h, t, d), jnp.float32)
+        v = jax.random.normal(kv, (b, h, t, d), jnp.float32)
+        got = attention(q, k, v, causal=True, window=w)
+        # manual band-masked softmax
+        scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+        qi = np.arange(t)[:, None]
+        kj = np.arange(t)[None, :]
+        band = (kj <= qi) & (kj > qi - w)
+        scores = np.where(band, scores, -1e30)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bhkd->bhqd", p, np.asarray(v))
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5,
+                                   rtol=1e-5)
+        with pytest.raises(ValueError, match="causal"):
+            attention(q, k, v, causal=False, window=w)
+
+    @pytest.mark.parametrize("window", [128, 300])
+    def test_flash_sliding_window_matches_reference(self, window):
+        """Pallas SWA forward vs the reference band mask, multiblock
+        (T=512 over 128-blocks) and GQA, including a window that does
+        not align to block edges (300)."""
+        import numpy as np
+
+        from kubeshare_tpu.ops.attention import attention, flash_attention
+
+        rng = jax.random.PRNGKey(6)
+        kq, kk, kv = jax.random.split(rng, 3)
+        b, h, hkv, t, d = 1, 4, 2, 512, 32
+        q = jax.random.normal(kq, (b, h, t, d), jnp.float32)
+        k = jax.random.normal(kk, (b, hkv, t, d), jnp.float32)
+        v = jax.random.normal(kv, (b, hkv, t, d), jnp.float32)
+        got = flash_attention(q, k, v, True, None, 128, 128, True, window)
+        want = attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_flash_sliding_window_gradients(self):
+        """Fused SWA backward (dq + dk/dv kernels) vs reference
+        autodiff, GQA shapes."""
+        import numpy as np
+
+        from kubeshare_tpu.ops.attention import attention, flash_attention
+
+        rng = jax.random.PRNGKey(7)
+        kq, kk, kv, kg = jax.random.split(rng, 4)
+        b, h, hkv, t, d, w = 1, 4, 2, 256, 32, 160
+        q = jax.random.normal(kq, (b, h, t, d), jnp.float32)
+        k = jax.random.normal(kk, (b, hkv, t, d), jnp.float32)
+        v = jax.random.normal(kv, (b, hkv, t, d), jnp.float32)
+        g = jax.random.normal(kg, (b, h, t, d), jnp.float32)
+
+        gf = jax.grad(
+            lambda q, k, v: jnp.vdot(
+                flash_attention(q, k, v, True, None, 128, 128, True, w), g
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.vdot(
+                attention(q, k, v, causal=True, window=w), g
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for name, a, b_ in zip("qkv", gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), atol=5e-4, rtol=5e-4,
+                err_msg=f"SWA d{name} mismatch",
+            )
+
+    def test_llama_sliding_window_property(self):
+        """With window=W, logits at position i must not depend on
+        tokens older than i-W+1 — and must still depend on tokens
+        inside the window."""
+        import numpy as np
+
+        cfg = LlamaConfig(vocab=64, dim=32, layers=1, num_heads=4,
+                          num_kv_heads=4, mlp_dim=64, max_seq_len=32,
+                          dtype="float32", window=4)
+        params = init_llama(RNG, cfg)
+        t1 = jnp.zeros((1, 12), jnp.int32)
+        # change token 0: positions >= window are out of its reach
+        t2 = t1.at[0, 0].set(7)
+        l1 = llama_apply(params, t1, cfg, use_flash=False)
+        l2 = llama_apply(params, t2, cfg, use_flash=False)
+        np.testing.assert_allclose(
+            np.asarray(l1[0, 4:]), np.asarray(l2[0, 4:]), atol=1e-5
+        )
+        assert not np.allclose(l1[0, 1], l2[0, 1])  # inside the window
+
+        # KV-cache decode masks the same band: cached == full forward
+        from kubeshare_tpu.models.llama import init_kv_cache, llama_apply_cached
+
+        tokens = jax.random.randint(RNG, (2, 12), 0, cfg.vocab)
+        full = llama_apply(params, tokens, cfg, use_flash=False)
+        cache = init_kv_cache(cfg, 2)
+        prefill, cache = llama_apply_cached(params, tokens[:, :8], cache, cfg)
+        np.testing.assert_allclose(
+            np.asarray(prefill), np.asarray(full[:, :8]),
+            atol=2e-5, rtol=2e-3,
+        )
+        step, _ = llama_apply_cached(params, tokens[:, 8:9], cache, cfg)
+        np.testing.assert_allclose(
+            np.asarray(step[:, 0]), np.asarray(full[:, 8]),
+            atol=2e-5, rtol=2e-3,
+        )
+
     def test_mha_falls_back_on_untiled_shapes(self):
         # t=2047 does not tile by 128: must not crash regardless of backend
         from kubeshare_tpu.ops.attention import flash_shapes_ok, mha
