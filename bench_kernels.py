@@ -306,6 +306,53 @@ def _make_dense_xent_fwd_bwd(labels):
     return dense
 
 
+def flash_swa_speedup(seq: int = 8192, window: int = 1024, batch: int = 2,
+                      heads: int = 8, kv_heads: int = 4,
+                      head_dim: int = 128, rounds: int = 4):
+    """Sliding-window vs full-causal flash, same shapes: the band skips
+    fully-out-of-band K/V tiles in all three kernels, so fwd+bwd time
+    should approach window/seq of the causal cost (plus the unskipped
+    DMA — index maps are shape-static)."""
+    from kubeshare_tpu.ops.attention import flash_attention
+
+    rng = jax.random.PRNGKey(5)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (batch, heads, seq, head_dim), jnp.bfloat16)
+    k = jax.random.normal(kk, (batch, kv_heads, seq, head_dim), jnp.bfloat16)
+    v = jax.random.normal(kv, (batch, kv_heads, seq, head_dim), jnp.bfloat16)
+
+    def make(window):
+        @jax.jit
+        def fwd_bwd(q, k, v):
+            def loss(q, k, v):
+                return jnp.sum(flash_attention(
+                    q, k, v, True, None, None, None, None, window
+                ).astype(jnp.float32))
+            _, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return jnp.sum(grads[0].astype(jnp.float32))
+        return fwd_bwd
+
+    swa, full = make(window), make(0)
+    float(swa(q, k, v))  # compile; fetch = completion barrier
+    float(full(q, k, v))
+
+    def chain(state, out):
+        q, k, v = state
+        return (q + (out * 1e-6).astype(q.dtype), k, v)
+
+    ratios = []
+    state_s = state_f = (q, k, v)
+    for _ in range(rounds):
+        t_s, state_s = _timed_window(lambda s: swa(*s), state_s, chain, 3)
+        t_f, state_f = _timed_window(lambda s: full(*s), state_f, chain, 3)
+        ratios.append(t_f / t_s)
+    return {
+        f"flash_swa_speedup_t{seq}_w{window}": round(
+            statistics.median(ratios), 3
+        ),
+    }
+
+
 def xent_vs_naive(seq: int, batch: int = 2, dim: int = 1024,
                   vocab: int = 32000, rounds: int = 4):
     """Fused chunked linear-cross-entropy (never materializes logits)
@@ -480,6 +527,13 @@ def run_all(log=print, budget_s: float = None) -> dict:
         log(f"kernel bench: chunked xent T={seq} ...")
         out.update(xent_vs_naive(seq))
         log(f"  speedup {out[f'xent_speedup_t{seq}']}x vs naive dense loss")
+    if over():
+        out["kernel_bench_truncated"] = True
+        log("kernel bench: budget exhausted, skipping SWA + MFU")
+        return out
+    log("kernel bench: sliding-window flash T=8192 W=1024 ...")
+    out.update(flash_swa_speedup())
+    log(f"  speedup {out['flash_swa_speedup_t8192_w1024']}x vs full causal")
     if over():
         out["kernel_bench_truncated"] = True
         log("kernel bench: budget exhausted, skipping MFU")

@@ -67,12 +67,17 @@ def build_parser() -> argparse.ArgumentParser:
              "(jax.checkpoint, dots-saveable policy) — trade FLOPs for "
              "HBM on long-context batches",
     )
+    parser.add_argument(
+        "--window", type=int, default=0,
+        help="llama sliding-window attention width (0 = full causal; "
+             "Mistral-style, ops/attention.py)",
+    )
     return parser
 
 
 def _build(model: str, batch: int, rng, seq_len: int = 256, sp: int = 0,
            sp_impl: str = "ring", sp_flash: bool = False,
-           remat: bool = False):
+           remat: bool = False, window: int = 0):
     """(params, loss_fn, batch_maker): model-specific pieces."""
     import jax
     import jax.numpy as jnp
@@ -86,11 +91,15 @@ def _build(model: str, batch: int, rng, seq_len: int = 256, sp: int = 0,
         raise SystemExit(f"--sp applies to --model llama, not {model}")
     if remat and model != "llama":
         raise SystemExit(f"--remat applies to --model llama, not {model}")
+    if window and model != "llama":
+        raise SystemExit(f"--window applies to --model llama, not {model}")
+    if window and sp:
+        raise SystemExit("--window does not compose with --sp yet")
 
     if model == "llama":
         cfg = M.LlamaConfig(vocab=2048, dim=256, layers=4, num_heads=8,
                             num_kv_heads=4, mlp_dim=512,
-                            max_seq_len=seq_len)
+                            max_seq_len=seq_len, window=window)
         params = M.init_llama(rng, cfg)
         if sp > 0:
             # long-context: sequence sharded over sp local devices,
@@ -274,7 +283,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     params, loss_fn, make_batch = _build(args.model, args.batch, rng,
                                          args.seq_len, args.sp,
                                          args.sp_impl, args.sp_flash,
-                                         args.remat)
+                                         args.remat, args.window)
     if spec is not None:
         if args.sp:
             raise SystemExit(

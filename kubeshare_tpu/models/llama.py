@@ -37,8 +37,10 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     # > 0 = Mistral-style sliding-window attention: each position sees
     # only the last ``window`` positions (ops/attention.py handles it
-    # in both the XLA and Pallas paths; the KV-cache decode masks the
-    # same band)
+    # in both the XLA and Pallas paths). The KV-cache decode masks the
+    # same band for EXACT parity but still allocates and scores the
+    # full max_seq_len cache — a rolling O(window) cache is a
+    # follow-up, so window buys decode no compute/memory yet
     window: int = 0
 
 
@@ -482,14 +484,38 @@ def llama_apply_cached(
     return logits, updated
 
 
+def _sample_token(logits, key, temperature: float, top_k: int):
+    """One sampling decision over [B, vocab] logits. temperature <= 0
+    = greedy (key unused); ``top_k > 0`` restricts to the k highest
+    logits before the categorical draw."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1)
+    if top_k < 0 or top_k > logits.shape[-1]:
+        raise ValueError(
+            f"top_k={top_k} out of range for vocab {logits.shape[-1]}"
+        )
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        # lax.top_k, not a full sort: this runs inside the decode scan
+        # on every token, and the vocab is large (128k for llama3)
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 def llama_generate(
     params: Dict,
     prompt: jnp.ndarray,
     steps: int,
     cfg: LlamaConfig = LlamaConfig(),
+    temperature: float = 0.0,
+    top_k: int = 0,
+    rng=None,
 ) -> jnp.ndarray:
-    """Greedy decode ``steps`` tokens after [B, T] prompt (one compiled
-    prefill + one compiled decode step iterated via lax.scan)."""
+    """Decode ``steps`` tokens after [B, T] prompt (one compiled
+    prefill + one compiled decode step iterated via lax.scan).
+    Greedy by default; ``temperature > 0`` samples (optionally
+    top-k-truncated) with ``rng`` (defaults to PRNGKey(0))."""
     batch, prompt_len = prompt.shape
     if prompt_len + steps > cfg.max_seq_len:
         raise ValueError(
@@ -498,19 +524,27 @@ def llama_generate(
         )
     if steps <= 0:
         return jnp.zeros((batch, 0), prompt.dtype)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, batch)
     logits, cache = llama_apply_cached(params, prompt, cache, cfg)
-    first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+    rng, sub = jax.random.split(rng)
+    first = _sample_token(
+        logits[:, -1], sub, temperature, top_k
+    ).astype(prompt.dtype)
     if steps == 1:
         return first[:, None]
 
-    def body(carry, _):
+    def body(carry, key):
         token, cache = carry
         logits, cache = llama_apply_cached(params, token[:, None], cache, cfg)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(token.dtype)
+        nxt = _sample_token(
+            logits[:, -1], key, temperature, top_k
+        ).astype(token.dtype)
         return (nxt, cache), nxt
 
-    (_, _), generated = jax.lax.scan(body, (first, cache), None,
-                                     length=steps - 1)
+    (_, _), generated = jax.lax.scan(
+        body, (first, cache), jax.random.split(rng, steps - 1)
+    )
     out = jnp.concatenate([first[None], generated], axis=0)
     return jnp.swapaxes(out, 0, 1)  # [B, steps]

@@ -499,6 +499,45 @@ class TestReviewRegressions:
             atol=2e-5, rtol=2e-3,
         )
 
+    def test_llama_sampling_decode(self):
+        """temperature/top-k sampling: shapes and vocab bounds hold,
+        temperature=0 reproduces greedy exactly, different seeds
+        diverge, and top_k=1 degenerates to greedy."""
+        import numpy as np
+
+        cfg = LlamaConfig(vocab=64, dim=32, layers=1, num_heads=4,
+                          num_kv_heads=2, mlp_dim=64, max_seq_len=32,
+                          dtype="float32")
+        params = init_llama(RNG, cfg)
+        from kubeshare_tpu.models.llama import llama_generate
+
+        prompt = jax.random.randint(RNG, (2, 4), 0, cfg.vocab)
+        greedy = np.asarray(llama_generate(params, prompt, 8, cfg))
+        zero_t = np.asarray(llama_generate(params, prompt, 8, cfg,
+                                           temperature=0.0))
+        np.testing.assert_array_equal(greedy, zero_t)
+        k1 = np.asarray(llama_generate(params, prompt, 8, cfg,
+                                       temperature=1.0, top_k=1))
+        np.testing.assert_array_equal(greedy, k1)
+        s1 = np.asarray(llama_generate(params, prompt, 16, cfg,
+                                       temperature=5.0,
+                                       rng=jax.random.PRNGKey(1)))
+        s2 = np.asarray(llama_generate(params, prompt, 16, cfg,
+                                       temperature=5.0,
+                                       rng=jax.random.PRNGKey(2)))
+        assert s1.shape == s2.shape == (2, 16)
+        assert (s1 >= 0).all() and (s1 < cfg.vocab).all()
+        assert not np.array_equal(s1, s2)  # a real draw, not argmax
+        topk = np.asarray(llama_generate(params, prompt, 8, cfg,
+                                         temperature=1.0, top_k=3,
+                                         rng=jax.random.PRNGKey(3)))
+        # every sampled token is within the per-step top-3 — checked
+        # loosely via greedy membership of the first step
+        logits = llama_apply(params, prompt, cfg, use_flash=False)
+        top3 = np.argsort(np.asarray(logits[:, -1]), axis=-1)[:, -3:]
+        for b in range(2):
+            assert topk[b, 0] in top3[b]
+
     def test_mha_falls_back_on_untiled_shapes(self):
         # t=2047 does not tile by 128: must not crash regardless of backend
         from kubeshare_tpu.ops.attention import flash_shapes_ok, mha
