@@ -37,10 +37,9 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     # > 0 = Mistral-style sliding-window attention: each position sees
     # only the last ``window`` positions (ops/attention.py handles it
-    # in both the XLA and Pallas paths). The KV-cache decode masks the
-    # same band for EXACT parity but still allocates and scores the
-    # full max_seq_len cache — a rolling O(window) cache is a
-    # follow-up, so window buys decode no compute/memory yet
+    # in both the XLA and Pallas paths). KV-cache decode uses a
+    # ROLLING ring of window slots — O(window) score work and
+    # max_seq/window less cache HBM per serving pod (init_kv_cache)
     window: int = 0
 
 
@@ -377,15 +376,27 @@ def make_llama_sp_loss(
 # ---- KV-cache inference (BASELINE config 5: fractional-chip serving) ----
 #
 # Static-shaped cache so the decode step compiles once: [layers, B, KvH,
-# max_seq, head_dim] k/v buffers plus a scalar length. Prefill writes the
-# prompt's keys/values in one batched pass; decode_step appends one
-# position via dynamic_update_slice and masks attention to cache[:len].
+# S, head_dim] k/v buffers plus a scalar length. For full-causal models
+# S = max_seq_len and positions write at their absolute index; for
+# sliding-window models (cfg.window > 0) the cache is a ROLLING ring of
+# S = min(window, max_seq_len) slots — position p lives in slot p % S —
+# so decode scores O(window) slots instead of O(max_seq_len) and the
+# cache memory shrinks by max_seq/window (more serving pods per chip).
+# Prefill writes the prompt's keys/values in one pass (a scatter when
+# the ring wraps); decode appends one position via dynamic_update_slice.
+
+
+def cache_slots(cfg: LlamaConfig) -> int:
+    """Ring size: full history, or the window for SWA models."""
+    if cfg.window > 0:
+        return min(cfg.window, cfg.max_seq_len)
+    return cfg.max_seq_len
 
 
 def init_kv_cache(cfg: LlamaConfig, batch: int, dtype=None):
     dtype = jnp.dtype(dtype or cfg.dtype)
     hd = cfg.dim // cfg.num_heads
-    shape = (cfg.layers, batch, cfg.num_kv_heads, cfg.max_seq_len, hd)
+    shape = (cfg.layers, batch, cfg.num_kv_heads, cache_slots(cfg), hd)
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
@@ -393,35 +404,83 @@ def init_kv_cache(cfg: LlamaConfig, batch: int, dtype=None):
     }
 
 
-def _attend_cached(q, k_cache, v_cache, length, num_heads, num_kv_heads,
-                   window: int = 0):
-    """q [B, H, Tq, D] against cache [B, KvH, S, D] masked to < length
-    (+ causal within the new Tq block; ``window > 0`` additionally
-    masks positions older than the sliding window)."""
-    groups = num_heads // num_kv_heads
-    batch, _, tq, hd = q.shape
-    max_s = k_cache.shape[2]
-    qg = q.reshape(batch, num_kv_heads, groups, tq, hd)
+def _ring_positions(length, slots: int):
+    """Absolute position held by each ring slot once ``length``
+    positions have been written: the newest p ≡ i (mod slots) with
+    p < length; untouched slots come out negative. For the
+    full-history cache (no wrap while length <= slots) this reduces
+    to p_i = i for written slots."""
+    i = jnp.arange(slots)
+    return (length - 1) - ((length - 1 - i) % slots)
+
+
+def _masked_attend(qg, k_all, v_all, p, q_abs, window: int):
+    """Grouped-query attention over position-tagged K/V: visibility
+    is ``0 <= p <= q_abs`` and, with ``window > 0``,
+    ``p > q_abs - window`` — one mask formula for every cache layout.
+    qg [B, KvH, G, Tq, D]; k_all/v_all [B, KvH, S, D]; p [S];
+    q_abs [Tq]."""
+    hd = qg.shape[-1]
     # matmul operands stay bf16 (f32 accumulation via
     # preferred_element_type) — an f32 upcast would halve the MXU rate
     # in the decode hot path; softmax math is f32
     scores = jnp.einsum(
-        "bkgtd,bksd->bkgts", qg, k_cache.astype(qg.dtype),
+        "bkgtd,bksd->bkgts", qg, k_all.astype(qg.dtype),
         preferred_element_type=jnp.float32,
     ) / (hd ** 0.5)
-    # position s is visible to query t (absolute pos length-tq+t) iff
-    # s <= that absolute position and s < length
-    positions = jnp.arange(max_s)[None, None, None, None, :]
-    q_abs = (length - tq + jnp.arange(tq))[None, None, None, :, None]
-    mask = positions <= q_abs
+    p = p[None, None, None, None, :]
+    q_abs = q_abs[None, None, None, :, None]
+    mask = (p >= 0) & (p <= q_abs)
     if window > 0:
-        mask &= positions > q_abs - window
+        mask &= p > q_abs - window
     scores = jnp.where(mask, scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum(
-        "bkgts,bksd->bkgtd", weights.astype(v_cache.dtype), v_cache,
+    return jnp.einsum(
+        "bkgts,bksd->bkgtd", weights.astype(v_all.dtype), v_all,
         preferred_element_type=jnp.float32,
     )
+
+
+def _attend_cached(q, k_ring, v_ring, k_new, v_new, length_before,
+                   num_heads, num_kv_heads, window: int = 0):
+    """q [B, H, Tq, D] against [old ring cache ; current chunk].
+
+    The current chunk's K/V ride ALONGSIDE the ring, not through it:
+    writing the chunk first would let a wrapping prefill evict in-band
+    keys its own earlier queries still need (ring size == window has
+    no slack). Used for multi-token prefill chunks; the seq == 1
+    decode hot path stores first and attends over the ring alone
+    (_attend_ring) to avoid a cache-sized concat copy per layer per
+    token."""
+    groups = num_heads // num_kv_heads
+    batch, _, tq, hd = q.shape
+    slots = k_ring.shape[2]
+    qg = q.reshape(batch, num_kv_heads, groups, tq, hd)
+    k_all = jnp.concatenate([k_ring.astype(qg.dtype),
+                             k_new.astype(qg.dtype)], axis=2)
+    v_all = jnp.concatenate([v_ring, v_new.astype(v_ring.dtype)], axis=2)
+    p = jnp.concatenate([
+        _ring_positions(length_before, slots),
+        length_before + jnp.arange(tq),
+    ])
+    q_abs = length_before + jnp.arange(tq)
+    out = _masked_attend(qg, k_all, v_all, p, q_abs, window)
+    return out.reshape(batch, num_heads, tq, hd)
+
+
+def _attend_ring(q, k_ring, v_ring, length_after, num_heads,
+                 num_kv_heads, window: int = 0):
+    """Decode hot path: the single new position is already stored, so
+    attend over the ring alone — no concat, the cache buffers stream
+    straight from HBM. Safe for seq == 1 because the slot the write
+    evicted held position q_abs - slots, which is already out of the
+    window band (slots >= window) or nonexistent (full history)."""
+    groups = num_heads // num_kv_heads
+    batch, _, tq, hd = q.shape
+    qg = q.reshape(batch, num_kv_heads, groups, tq, hd)
+    p = _ring_positions(length_after, k_ring.shape[2])
+    q_abs = length_after - tq + jnp.arange(tq)
+    out = _masked_attend(qg, k_ring, v_ring, p, q_abs, window)
     return out.reshape(batch, num_heads, tq, hd)
 
 
@@ -440,8 +499,31 @@ def llama_apply_cached(
     dtype = jnp.dtype(cfg.dtype)
     batch, seq = tokens.shape
     hd = cfg.dim // cfg.num_heads
+    slots = cache["k"].shape[3]
+    if seq > slots:
+        raise ValueError(
+            f"cannot write {seq} positions into a {slots}-slot cache "
+            "in one call (chunk the prefill to the window size)"
+        )
     start = cache["length"]
     positions = start + jnp.arange(seq)
+    # ring write: position p -> slot p % slots. Decode (seq == 1) and
+    # full-history prefill use dynamic_update_slice; a multi-token
+    # prefill into a ROLLING cache takes the scatter path regardless
+    # of wrapping — whether it wraps depends on the traced start, so
+    # there is no static non-wrap branch to take
+    write_idx = positions % slots
+
+    def _store(buf, new):
+        new = new.astype(buf.dtype)
+        if seq == 1:
+            return jax.lax.dynamic_update_slice(
+                buf, new, (0, 0, write_idx[0], 0)
+            )
+        if slots == cfg.max_seq_len:
+            return jax.lax.dynamic_update_slice(buf, new, (0, 0, start, 0))
+        return buf.at[:, :, write_idx, :].set(new)
+
     x = params["embed"]["table"].astype(dtype)[tokens]
     new_k, new_v = [], []
     for i in range(cfg.layers):
@@ -455,18 +537,27 @@ def llama_apply_cached(
         v = jnp.swapaxes(v, 1, 2)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        k_cache = jax.lax.dynamic_update_slice(
-            cache["k"][i], k.astype(cache["k"].dtype), (0, 0, start, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            cache["v"][i], v.astype(cache["v"].dtype), (0, 0, start, 0)
-        )
-        new_k.append(k_cache)
-        new_v.append(v_cache)
-        out = _attend_cached(
-            q, k_cache, v_cache, start + seq, cfg.num_heads,
-            cfg.num_kv_heads, cfg.window,
-        ).astype(dtype)
+        if seq == 1:
+            # decode hot path: store first, attend over the ring alone
+            # (no concat copy; the evicted slot was out of band)
+            k_cache = _store(cache["k"][i], k)
+            v_cache = _store(cache["v"][i], v)
+            out = _attend_ring(
+                q, k_cache, v_cache, start + 1,
+                cfg.num_heads, cfg.num_kv_heads, cfg.window,
+            ).astype(dtype)
+            new_k.append(k_cache)
+            new_v.append(v_cache)
+        else:
+            out = _attend_cached(
+                q, cache["k"][i], cache["v"][i], k, v, start,
+                cfg.num_heads, cfg.num_kv_heads, cfg.window,
+            ).astype(dtype)
+            # stored AFTER attention: the chunk attends over [old
+            # ring ; its own k/v], so a wrapping write cannot evict
+            # in-band keys its own early queries still need
+            new_k.append(_store(cache["k"][i], k))
+            new_v.append(_store(cache["v"][i], v))
         out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)
         x = x + _matmul(out, layer["wo"], dtype)
 
@@ -527,7 +618,14 @@ def llama_generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
     cache = init_kv_cache(cfg, batch)
-    logits, cache = llama_apply_cached(params, prompt, cache, cfg)
+    # prompts longer than the rolling ring prefill in ring-sized
+    # chunks (the headline SWA serving case: prompt >> window)
+    slots = cache_slots(cfg)
+    logits = None
+    for lo in range(0, prompt_len, slots):
+        logits, cache = llama_apply_cached(
+            params, prompt[:, lo:lo + slots], cache, cfg
+        )
     rng, sub = jax.random.split(rng)
     first = _sample_token(
         logits[:, -1], sub, temperature, top_k

@@ -483,20 +483,79 @@ class TestReviewRegressions:
         assert not np.allclose(l1[0, 1], l2[0, 1])  # inside the window
 
         # KV-cache decode masks the same band: cached == full forward
+        # (prefill chunked to the ring size — the rolling cache holds
+        # only `window` slots)
         from kubeshare_tpu.models.llama import init_kv_cache, llama_apply_cached
 
         tokens = jax.random.randint(RNG, (2, 12), 0, cfg.vocab)
         full = llama_apply(params, tokens, cfg, use_flash=False)
         cache = init_kv_cache(cfg, 2)
-        prefill, cache = llama_apply_cached(params, tokens[:, :8], cache, cfg)
+        chunks = []
+        for lo in (0, 4):
+            out, cache = llama_apply_cached(
+                params, tokens[:, lo:lo + 4], cache, cfg
+            )
+            chunks.append(np.asarray(out))
         np.testing.assert_allclose(
-            np.asarray(prefill), np.asarray(full[:, :8]),
+            np.concatenate(chunks, axis=1), np.asarray(full[:, :8]),
             atol=2e-5, rtol=2e-3,
         )
         step, _ = llama_apply_cached(params, tokens[:, 8:9], cache, cfg)
         np.testing.assert_allclose(
             np.asarray(step[:, 0]), np.asarray(full[:, 8]),
             atol=2e-5, rtol=2e-3,
+        )
+
+    def test_llama_rolling_window_cache(self):
+        """SWA decode uses a ring of window slots: the cache allocates
+        O(window) not O(max_seq_len), and decoding far past the wrap
+        boundary still reproduces the full (uncached) forward's logits
+        at every step."""
+        import numpy as np
+
+        from kubeshare_tpu.models.llama import (
+            init_kv_cache, llama_apply_cached,
+        )
+
+        cfg = LlamaConfig(vocab=64, dim=32, layers=2, num_heads=4,
+                          num_kv_heads=2, mlp_dim=64, max_seq_len=32,
+                          dtype="float32", window=8)
+        params = init_llama(RNG, cfg)
+        cache = init_kv_cache(cfg, 2)
+        assert cache["k"].shape[3] == 8  # ring = window, not max_seq
+
+        tokens = jax.random.randint(RNG, (2, 28), 0, cfg.vocab)
+        cache_logits = []
+        # prefill 6, then decode one-by-one through 3+ ring wraps
+        out, cache = llama_apply_cached(params, tokens[:, :6], cache, cfg)
+        cache_logits.append(np.asarray(out))
+        for t in range(6, 28):
+            out, cache = llama_apply_cached(
+                params, tokens[:, t:t + 1], cache, cfg
+            )
+            cache_logits.append(np.asarray(out))
+        got = np.concatenate(cache_logits, axis=1)
+        want = np.asarray(llama_apply(params, tokens, cfg, use_flash=False))
+        np.testing.assert_allclose(got, want, atol=3e-5, rtol=3e-3)
+
+        # a prefill longer than the ring must refuse, not overwrite
+        with pytest.raises(ValueError, match="slot"):
+            llama_apply_cached(
+                params, tokens[:, :12], init_kv_cache(cfg, 2), cfg
+            )
+
+        # llama_generate chunks long prompts itself (prompt >> window,
+        # the headline SWA serving shape): its first sampled token is
+        # the full forward's argmax at the prompt end
+        from kubeshare_tpu.models.llama import llama_generate
+
+        gen = np.asarray(llama_generate(params, tokens[:, :20], 4, cfg))
+        assert gen.shape == (2, 4)
+        np.testing.assert_array_equal(
+            gen[:, 0],
+            np.argmax(np.asarray(
+                llama_apply(params, tokens[:, :20], cfg, use_flash=False)
+            )[:, -1], axis=-1),
         )
 
     def test_llama_sampling_decode(self):
