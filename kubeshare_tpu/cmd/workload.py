@@ -93,8 +93,12 @@ def _build(model: str, batch: int, rng, seq_len: int = 256, sp: int = 0,
         raise SystemExit(f"--remat applies to --model llama, not {model}")
     if window and model != "llama":
         raise SystemExit(f"--window applies to --model llama, not {model}")
-    if window and sp:
-        raise SystemExit("--window does not compose with --sp yet")
+    if window and sp and sp_impl == "ring" and sp_flash:
+        raise SystemExit(
+            "--window composes with --sp except for ring + --sp-flash "
+            "(flash hop bodies lack a query-offset input); drop "
+            "--sp-flash or use --sp-impl ulysses"
+        )
 
     if model == "llama":
         cfg = M.LlamaConfig(vocab=2048, dim=256, layers=4, num_heads=8,

@@ -218,10 +218,18 @@ def llama_block(
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     if attn_fn is not None:
-        if cfg.window > 0:
+        # contract: attn_fn bakes causality AND cfg.window itself.
+        # make_llama_sp_loss marks its cores with the window they
+        # bake; refusing a mismatch here is what keeps a window
+        # config from silently running un-windowed through a core
+        # built without one
+        if cfg.window > 0 and getattr(
+            attn_fn, "window", 0
+        ) != cfg.window:
             raise ValueError(
-                "sliding-window attention is not supported through an "
-                "attn_fn override (ring/Ulysses SP) yet"
+                f"cfg.window={cfg.window} but attn_fn bakes window="
+                f"{getattr(attn_fn, 'window', 0)} — build the SP core "
+                "with the model's window (make_llama_sp_loss does)"
             )
         out = attn_fn(q, k, v)
     else:
@@ -348,21 +356,21 @@ def make_llama_sp_loss(
     tokens P(None, axis_name) — or just pass replicated tokens and let
     GSPMD reshard at the trunk boundary. Combines with dp: a mesh of
     (dp, sp) shards batch and sequence independently."""
-    if cfg.window > 0:
-        raise ValueError(
-            "sliding-window attention does not compose with the SP "
-            "attention cores yet — use the sequential trunk"
-        )
     if impl == "ring":
         from ..parallel.ring_attention import make_ring_attention
 
+        # ring+flash+window is the one unsupported combo (the flash
+        # hop body lacks a query-offset input); make_ring_attention
+        # raises a specific error for it
         attn = make_ring_attention(mesh, axis_name, causal=True,
-                                   use_flash=use_flash)
+                                   use_flash=use_flash,
+                                   window=cfg.window)
     elif impl == "ulysses":
         from ..parallel.ulysses import make_ulysses_attention
 
         attn = make_ulysses_attention(mesh, axis_name, causal=True,
-                                      use_flash=use_flash)
+                                      use_flash=use_flash,
+                                      window=cfg.window)
     else:
         raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
 
@@ -537,25 +545,27 @@ def llama_apply_cached(
         v = jnp.swapaxes(v, 1, 2)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        if seq == 1:
-            # decode hot path: store first, attend over the ring alone
-            # (no concat copy; the evicted slot was out of band)
+        if seq == 1 or slots == cfg.max_seq_len:
+            # store first, attend over the ring alone (no concat
+            # copy): safe whenever the write cannot evict in-band
+            # keys — decode's single evicted slot is out of band, and
+            # a full-history cache never evicts at all
             k_cache = _store(cache["k"][i], k)
             v_cache = _store(cache["v"][i], v)
             out = _attend_ring(
-                q, k_cache, v_cache, start + 1,
+                q, k_cache, v_cache, start + seq,
                 cfg.num_heads, cfg.num_kv_heads, cfg.window,
             ).astype(dtype)
             new_k.append(k_cache)
             new_v.append(v_cache)
         else:
+            # wrapping-capable prefill chunk: attend over [old ring ;
+            # its own k/v] BEFORE storing, so the write cannot evict
+            # in-band keys its own early queries still need
             out = _attend_cached(
                 q, cache["k"][i], cache["v"][i], k, v, start,
                 cfg.num_heads, cfg.num_kv_heads, cfg.window,
             ).astype(dtype)
-            # stored AFTER attention: the chunk attends over [old
-            # ring ; its own k/v], so a wrapping write cannot evict
-            # in-band keys its own early queries still need
             new_k.append(_store(cache["k"][i], k))
             new_v.append(_store(cache["v"][i], v))
         out = jnp.swapaxes(out, 1, 2).reshape(batch, seq, cfg.num_heads * hd)

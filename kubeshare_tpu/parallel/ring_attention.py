@@ -92,7 +92,8 @@ def _ring_merge_loop(q, k, v, axis_name: str, hop_fn: Callable,
 
 def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                    scale: Optional[float] = None,
-                   use_flash: bool = False, varying_axes=None):
+                   use_flash: bool = False, varying_axes=None,
+                   window: int = 0):
     """Per-shard bodies: q [B, H, T_local, D], k/v [B, Hkv, T_local, D]
     (already sharded on T; GQA when Hkv < H — the ring rotates the
     small Hkv tensors and the dense hop repeats them on the fly).
@@ -103,8 +104,17 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     the per-hop O(T_local^2) score tile never touches HBM either; needs
     T_local to tile by 128 (callers building the shard_map must also
     pass ``check_vma=False``, see ``make_ring_attention``).
+    ``window > 0`` = sliding-window banding over GLOBAL positions
+    (dense path only — the flash hop body has no q-offset input yet;
+    hops entirely beyond the band contribute lse=-inf rows, which the
+    merge zeroes exactly).
     """
     batch, heads, t_local, head_dim = q.shape
+    if window > 0 and (use_flash or not causal):
+        raise ValueError(
+            "ring window support is dense+causal only (flash hop "
+            "bodies lack a query-offset input)"
+        )
     if scale is None:
         scale = head_dim ** -0.5
 
@@ -161,6 +171,8 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
             gq = my_idx * t_local + q_pos
             gk = kv_idx * t_local + q_pos
             mask = gk[None, :] <= gq[:, None]
+            if window > 0:
+                mask &= gk[None, :] > gq[:, None] - window
             scores = jnp.where(mask[None, None], scores, _NEG_INF)
         m_h = jnp.max(scores, axis=-1, keepdims=True)
         p = jnp.exp(scores - m_h)
@@ -193,11 +205,19 @@ def _batch_shard_axis(mesh: Mesh, batch_axis: Optional[str]):
 
 def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
                         causal: bool = True, use_flash: bool = False,
-                        batch_axis: Optional[str] = "dp"):
+                        batch_axis: Optional[str] = "dp",
+                        window: int = 0):
     """Shard_mapped ring attention over full arrays [B, H, T, D] with T
     sharded on ``axis_name`` — and the batch dim sharded over
     ``batch_axis`` when the mesh has it (pass None to replicate batch;
-    B must divide by the axis size otherwise)."""
+    B must divide by the axis size otherwise). ``window`` — see
+    ring_attention (dense path only)."""
+    if window > 0 and (use_flash or not causal):
+        # fail at BUILD time, not first trace inside shard_map
+        raise ValueError(
+            "ring window support is dense+causal only (flash hop "
+            "bodies lack a query-offset input)"
+        )
     b_ax = _batch_shard_axis(mesh, batch_axis)
     spec = P(b_ax, None, axis_name, None)
     varying = (axis_name,) + ((b_ax,) if b_ax else ())
@@ -213,6 +233,8 @@ def make_ring_attention(mesh: Mesh, axis_name: str = "sp",
     )
     def sharded(q, k, v):
         return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
-                              use_flash=use_flash, varying_axes=varying)
+                              use_flash=use_flash, varying_axes=varying,
+                              window=window)
 
+    sharded.window = window  # llama_block checks the baked window
     return sharded

@@ -33,7 +33,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _dense_attention(q, k, v, causal: bool, scale: float):
+def _dense_attention(q, k, v, causal: bool, scale: float,
+                     window: int = 0):
     """fp32-accumulated softmax attention on full-sequence shards."""
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
@@ -41,7 +42,11 @@ def _dense_attention(q, k, v, causal: bool, scale: float):
     )
     if causal:
         t = q.shape[2]
-        mask = jnp.arange(t)[None, :] <= jnp.arange(t)[:, None]
+        kj = jnp.arange(t)[None, :]
+        qi = jnp.arange(t)[:, None]
+        mask = kj <= qi
+        if window > 0:
+            mask &= kj > qi - window
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
@@ -53,7 +58,7 @@ def _dense_attention(q, k, v, causal: bool, scale: float):
 
 def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
                       scale: Optional[float] = None,
-                      use_flash: bool = False):
+                      use_flash: bool = False, window: int = 0):
     """Per-shard bodies: q/k/v [B, H, T_local, D] (sharded on T).
 
     Must be called inside shard_map over ``axis_name``; H must divide
@@ -61,8 +66,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     FULL sequences for its head slice, so ``use_flash=True`` drops the
     whole-sequence O(T^2) score tensor straight into the Pallas kernel
     (forward + fused backward); needs T to tile by 128 and
-    ``check_vma=False`` on the enclosing shard_map.
+    ``check_vma=False`` on the enclosing shard_map. ``window > 0`` =
+    sliding-window banding — trivial here since each head slice holds
+    the full sequence (the flash kernel takes it natively).
     """
+    if window > 0 and not causal:
+        raise ValueError("window requires causal attention")
     heads = q.shape[1]
     head_dim = q.shape[3]
     n = jax.lax.psum(1, axis_name)
@@ -89,10 +98,12 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     if use_flash:
         from ..ops.attention import flash_attention
 
-        out = flash_attention(qh, kh, vh, causal, scale)  # GQA-native
+        out = flash_attention(qh, kh, vh, causal, scale,  # GQA-native
+                              window=window)
     else:
         kh, vh = _repeat_kv(kh, vh, qh.shape[1])
-        out = _dense_attention(qh, kh, vh, causal=causal, scale=scale)
+        out = _dense_attention(qh, kh, vh, causal=causal, scale=scale,
+                               window=window)
     # [B, H/n, T, D] -> [B, H, T/n, D]
     del heads, n
     return jax.lax.all_to_all(
@@ -102,13 +113,18 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
 
 def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
                            causal: bool = True, use_flash: bool = False,
-                           batch_axis: Optional[str] = "dp"):
+                           batch_axis: Optional[str] = "dp",
+                           window: int = 0):
     """Shard_mapped Ulysses attention over full arrays [B, H, T, D] with
     T sharded on ``axis_name`` — and the batch dim sharded over
     ``batch_axis`` when the mesh has it (pass None to replicate batch;
-    B must divide by the axis size otherwise)."""
+    B must divide by the axis size otherwise). ``window`` — see
+    ulysses_attention."""
     from .ring_attention import _batch_shard_axis
 
+    if window > 0 and not causal:
+        # fail at BUILD time, not first trace inside shard_map
+        raise ValueError("window requires causal attention")
     b_ax = _batch_shard_axis(mesh, batch_axis)
     spec = P(b_ax, None, axis_name, None)
 
@@ -120,6 +136,8 @@ def make_ulysses_attention(mesh: Mesh, axis_name: str = "sp",
     )
     def sharded(q, k, v):
         return ulysses_attention(q, k, v, axis_name=axis_name,
-                                 causal=causal, use_flash=use_flash)
+                                 causal=causal, use_flash=use_flash,
+                                 window=window)
 
+    sharded.window = window  # llama_block checks the baked window
     return sharded

@@ -138,6 +138,25 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.parametrize("window", [8, 24])
+    def test_sliding_window_matches_reference(self, window):
+        """Global-position banding across ring hops: a window smaller
+        than one shard (8 < T_local=16) and one spanning shards (24)."""
+        mesh = make_mesh(MeshPlan(sp=4), devices=jax.devices()[:4])
+        keys = jax.random.split(RNG, 3)
+        b, h, hkv, t, d = 1, 4, 2, 64, 16
+        q = jax.random.normal(keys[0], (b, h, t, d), jnp.float32)
+        k = jax.random.normal(keys[1], (b, hkv, t, d), jnp.float32)
+        v = jax.random.normal(keys[2], (b, hkv, t, d), jnp.float32)
+        ring = make_ring_attention(mesh, causal=True, window=window)
+        out = jax.jit(ring)(q, k, v)
+        ref = attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+        with pytest.raises(ValueError, match="dense"):
+            make_ring_attention(mesh, causal=True, window=window,
+                                use_flash=True)
+
     def test_flash_ring_gradients(self):
         # grads flow through the fused backward INCLUDING the lse
         # cotangent the hop merge introduces
@@ -267,6 +286,27 @@ class TestUlyssesAttention:
         out = jax.jit(uly)(q, q, q)
         assert out.sharding.spec == P(None, None, "sp", None)
 
+    @pytest.mark.parametrize("use_flash", [False, True])
+    def test_sliding_window_matches_reference(self, use_flash):
+        """Window banding through the all-to-all (dense local mask and
+        the Pallas kernel's native window path)."""
+        from kubeshare_tpu.parallel.ulysses import make_ulysses_attention
+
+        mesh = make_mesh(MeshPlan(sp=4), devices=jax.devices()[:4])
+        keys = jax.random.split(RNG, 3)
+        t = 256 if use_flash else 64  # flash needs T to tile by 128
+        b, h, d, w = 1, 4, 16, t // 4
+        q = jax.random.normal(keys[0], (b, h, t, d), jnp.float32)
+        k = jax.random.normal(keys[1], (b, h, t, d), jnp.float32)
+        v = jax.random.normal(keys[2], (b, h, t, d), jnp.float32)
+        uly = make_ulysses_attention(mesh, causal=True,
+                                     use_flash=use_flash, window=w)
+        out = jax.jit(uly)(q, k, v)
+        ref = attention(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3 if use_flash else 2e-4,
+                                   rtol=2e-3 if use_flash else 2e-4)
+
     @pytest.mark.parametrize("hkv", [2, 4])
     def test_gqa_matches_reference(self, hkv):
         """GQA through the all-to-all: Hkv % sp == 0 shuffles the small
@@ -365,6 +405,28 @@ class TestSequenceParallelLlama:
         sp_loss = make_llama_sp_loss(cfg, mesh, vocab_chunk=32)
         got = float(jax.jit(sp_loss)(params, tokens))
         want = float(llama_loss(params, tokens, cfg, vocab_chunk=32))
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_sp_loss_with_window_matches_single_device(self, impl):
+        """SWA composes with sequence parallelism: the sp trunk with a
+        window matches the sequential windowed llama exactly."""
+        from kubeshare_tpu.models.llama import llama_loss, make_llama_sp_loss
+
+        from kubeshare_tpu.models.llama import LlamaConfig, init_llama
+
+        cfg = LlamaConfig(
+            vocab=64, dim=32, layers=2, num_heads=8, num_kv_heads=4,
+            mlp_dim=64, max_seq_len=64, dtype="float32", window=12,
+        )
+        params = init_llama(jax.random.PRNGKey(21), cfg)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(22), (2, 65), 0, cfg.vocab, dtype=jnp.int32
+        )
+        mesh = make_mesh(MeshPlan(sp=8))
+        sp_loss = make_llama_sp_loss(cfg, mesh, impl=impl)
+        got = float(jax.jit(sp_loss)(params, tokens))
+        want = float(llama_loss(params, tokens, cfg))
         np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
 
     def test_workload_cli_sp(self, capsys):
