@@ -14,6 +14,7 @@ from .lstm import LstmConfig, init_lstm, lstm_apply
 from .resnet import ResNetConfig, init_resnet, resnet_apply
 from .vgg import VggConfig, init_vgg, vgg_apply, vgg16
 from .llama import LlamaConfig, init_llama, llama_apply, make_llama_sp_loss
+from .data import Prefetcher, prefetch_to_device
 from .quant import param_bytes, quantize_llama
 from .moe import MoeConfig, init_moe_ffn, moe_ffn_apply, moe_param_spec
 from .train import make_train_step, synthetic_batches
@@ -26,6 +27,7 @@ __all__ = [
     "VggConfig", "init_vgg", "vgg_apply", "vgg16",
     "LlamaConfig", "init_llama", "llama_apply", "make_llama_sp_loss",
     "param_bytes", "quantize_llama",
+    "Prefetcher", "prefetch_to_device",
     "MoeConfig", "init_moe_ffn", "moe_ffn_apply", "moe_param_spec",
     "make_train_step", "synthetic_batches",
 ]

@@ -1,0 +1,114 @@
+"""Host->device input prefetch.
+
+The whole co-location thesis (bench.py's headline) is that real
+training is INPUT-BOUND — the device idles while the host prepares
+the next batch. The first-order fix on the workload side is to
+overlap them: a background thread pulls batches from the caller's
+iterator and stages them onto the device ahead of the training loop,
+so the host pipeline runs while the chip crunches the previous step
+(the standard double-buffering flax's prefetch_to_device does for
+datasets; here it is framework API with bounded depth and clean
+shutdown).
+
+No reference analog: the reference schedules containers and leaves
+input pipelines entirely to them (SURVEY §2.8).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, Optional
+
+import jax
+
+_SENTINEL = object()
+
+
+class Prefetcher:
+    """Iterate ``source`` on a background thread, applying ``transfer``
+    (default ``jax.device_put``) to each item before queueing it —
+    bounded to ``size`` staged batches so a slow consumer cannot pile
+    up device memory. Iterable; exceptions from the source or the
+    transfer re-raise in the consumer. ``close()`` (or exhausting the
+    source) stops the thread; abandoning mid-stream without close()
+    leaks at most ``size`` staged batches until GC."""
+
+    def __init__(self, source: Iterator, size: int = 2,
+                 transfer: Optional[Callable] = jax.device_put):
+        if size < 1:
+            raise ValueError(f"size must be >= 1, got {size}")
+        self._queue: "queue.Queue" = queue.Queue(maxsize=size)
+        self._stop = threading.Event()
+        self._transfer = transfer
+
+        def worker():
+            try:
+                for item in source:
+                    if self._stop.is_set():
+                        return
+                    if self._transfer is not None:
+                        item = self._transfer(item)
+                    while not self._stop.is_set():
+                        try:
+                            self._queue.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+                self._queue.put(_SENTINEL)
+            except BaseException as e:  # re-raised at the consumer
+                self._queue.put(e)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._queue.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # the worker may have enqueued final items, the
+                    # sentinel, or its EXCEPTION in the window between
+                    # our timeout and its exit — drain before quitting
+                    # or a pipeline error would be swallowed
+                    try:
+                        item = self._queue.get_nowait()
+                        break
+                    except queue.Empty:
+                        raise StopIteration
+        if item is _SENTINEL:
+            raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Stop the background thread and drop staged batches."""
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def prefetch_to_device(source: Iterator, size: int = 2,
+                       transfer: Optional[Callable] = jax.device_put):
+    """Convenience constructor (see Prefetcher)."""
+    return Prefetcher(source, size=size, transfer=transfer)

@@ -29,11 +29,22 @@ def make_sharded_train_step(
     fsdp: bool = True,
     param_specs: Optional[Dict] = None,
     batch_spec: Optional[NamedSharding] = None,
+    accum_steps: int = 1,
 ):
     """Returns (step_fn, sharded_params, opt_state). ``step_fn(params,
     opt_state, batch) -> (params, opt_state, loss)``; shardings flow
     from the committed (returned) params/opt_state, and params +
-    opt_state buffers are donated."""
+    opt_state buffers are donated.
+
+    ``accum_steps > 1`` = gradient accumulation: the batch splits into
+    that many microbatches folded through a ``lax.scan`` — the live
+    activation footprint is one microbatch's, so an effective batch
+    that OOMs in one pass trains in N. Exact for mean-style losses
+    over equal microbatches (accumulated grads are averaged); the
+    batch's leading dim must divide by accum_steps. One optimizer
+    update per call either way."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     if param_specs is None:
         param_specs = build_param_specs(params, fsdp)
     if batch_spec is None:
@@ -50,17 +61,58 @@ def make_sharded_train_step(
     )
     opt_state = jax.jit(optimizer.init)(sharded_params)
 
+    def _grads(params, batch):
+        if accum_steps == 1:
+            return jax.value_and_grad(loss_fn)(params, batch)
+
+        def micro(carry, mb):
+            loss_sum, grad_sum = carry
+            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+            return (
+                loss_sum + loss,
+                jax.tree.map(lambda a, g: a + g, grad_sum, grads),
+            ), None
+
+        micros = jax.tree.map(
+            lambda x: x.reshape(
+                (accum_steps, x.shape[0] // accum_steps) + x.shape[1:]
+            ),
+            batch,
+        )
+        zero = jax.tree.map(
+            lambda p: jax.numpy.zeros(p.shape, jax.numpy.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            micro, (jax.numpy.zeros((), jax.numpy.float32), zero), micros
+        )
+        scale = 1.0 / accum_steps
+        # accumulate in f32, hand the optimizer grads in the PARAM
+        # dtype like the single-pass path — a dtype mismatch would
+        # promote adamw's mu/nu and re-jit on the second step
+        return loss_sum * scale, jax.tree.map(
+            lambda g, p: (g * scale).astype(p.dtype), grad_sum, params
+        )
+
     # donate params+opt_state: the update writes in place, halving peak
     # HBM — the difference between fitting a model and OOMing at half
     # its size on 16GB v5e chips
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        loss, grads = _grads(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     def run(params, opt_state, batch):
+        if accum_steps > 1:
+            leading = {
+                x.shape[0] % accum_steps for x in jax.tree.leaves(batch)
+            }
+            if leading != {0}:
+                raise ValueError(
+                    "batch leading dim must be divisible by "
+                    f"accum_steps={accum_steps}"
+                )
         batch = jax.device_put(batch, batch_spec)
         return step(params, opt_state, batch)
 

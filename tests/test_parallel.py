@@ -75,6 +75,84 @@ class TestSharding:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
 
+    def test_gradient_accumulation_matches_single_pass(self):
+        """accum_steps=4: one optimizer update from 4 scanned
+        microbatches must match the single-pass step on the same
+        effective batch to float tolerance — and the loop still
+        learns."""
+        cfg = LlamaConfig(vocab=64, dim=32, layers=2, num_heads=4,
+                          num_kv_heads=2, mlp_dim=64, dtype="float32")
+        mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
+        batch = jax.random.randint(RNG, (8, 16), 0, 64, dtype=jnp.int32)
+
+        def run(accum):
+            # fresh init per run: device_put aliases already-committed
+            # buffers and the step donates them, so runs cannot share
+            # one params tree
+            step, params, opt_state = make_sharded_train_step(
+                lambda p, b: llama_loss(p, b, cfg),
+                init_llama(RNG, cfg), mesh, learning_rate=5e-3,
+                accum_steps=accum,
+            )
+            params, opt_state, loss = step(params, opt_state, batch)
+            return float(loss), params
+
+        loss1, p1 = run(1)
+        loss4, p4 = run(4)
+        np.testing.assert_allclose(loss1, loss4, rtol=1e-5)
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+            )
+        # indivisible batch refused before device_put
+        step, params, opt_state = make_sharded_train_step(
+            lambda p, b: llama_loss(p, b, cfg),
+            init_llama(RNG, cfg), mesh, accum_steps=3,
+        )
+        with pytest.raises(ValueError, match="accum"):
+            step(params, opt_state, batch)
+
+    def test_prefetcher_overlaps_and_preserves_order(self):
+        import time as _time
+
+        from kubeshare_tpu.models.data import prefetch_to_device
+
+        produced = []
+
+        def source():
+            for i in range(6):
+                produced.append(i)
+                yield jnp.full((4,), i)
+
+        got = [int(x[0]) for x in prefetch_to_device(source(), size=2)]
+        assert got == list(range(6))
+
+        # bounded depth: a stalled consumer stages at most size+1
+        # batches (one in the transfer slot)
+        slow = prefetch_to_device(iter(jnp.zeros((1,)) for _ in range(100)),
+                                  size=2)
+        _time.sleep(0.5)
+        qsize = slow._queue.qsize()
+        slow.close()
+        assert qsize <= 3
+
+        # exceptions surface at the consumer
+        def broken():
+            yield jnp.zeros((1,))
+            raise RuntimeError("input pipeline died")
+
+        it = prefetch_to_device(broken(), size=2)
+        next(it)
+        with pytest.raises(RuntimeError, match="pipeline died"):
+            next(it)
+
+        # close() mid-stream terminates the worker
+        with prefetch_to_device(
+            iter(jnp.zeros((1,)) for _ in range(1000)), size=2
+        ) as p:
+            next(p)
+        assert not p._thread.is_alive()
+
     def test_batch_sharding_spec(self):
         mesh = make_mesh(MeshPlan(dp=2, fsdp=2, tp=2))
         sharding = batch_sharding(mesh)
