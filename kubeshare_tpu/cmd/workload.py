@@ -72,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="llama sliding-window attention width (0 = full causal; "
              "Mistral-style, ops/attention.py)",
     )
+    parser.add_argument(
+        "--accum", type=int, default=1,
+        help="gradient-accumulation microbatches per optimizer update "
+             "(batch must divide; trades steps/s for fitting a larger "
+             "effective batch)",
+    )
     return parser
 
 
@@ -229,6 +235,7 @@ def _distribute(spec, params, loss_fn, make_batch, args, log):
         # rank 4, labels rank 1) and the default rank-2 spec rejects
         # the labels
         batch_spec=sharding,
+        accum_steps=args.accum,
     )
     world = spec.num_processes
 
@@ -298,7 +305,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             spec, params, loss_fn, make_batch, args, log
         )
     else:
-        opt, step = make_train_step(loss_fn, learning_rate=args.lr)
+        opt, step = make_train_step(loss_fn, learning_rate=args.lr,
+                                    accum_steps=args.accum)
         opt_state = opt.init(params)
 
     start_step = 0

@@ -18,11 +18,37 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
+import weakref
 from typing import Callable, Iterator, Optional
 
 import jax
 
 _SENTINEL = object()
+
+
+def _worker(source, q, stop, transfer):
+    """Module-level on purpose: the thread must NOT capture the
+    Prefetcher, or the running closure would keep it alive forever
+    and the GC finalizer that stops an abandoned prefetcher could
+    never fire."""
+    try:
+        for item in source:
+            if stop.is_set():
+                return
+            if transfer is not None:
+                item = transfer(item)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if stop.is_set():
+                return
+        q.put(_SENTINEL)
+    except BaseException as e:  # re-raised at the consumer
+        q.put(e)
 
 
 class Prefetcher:
@@ -31,8 +57,8 @@ class Prefetcher:
     bounded to ``size`` staged batches so a slow consumer cannot pile
     up device memory. Iterable; exceptions from the source or the
     transfer re-raise in the consumer. ``close()`` (or exhausting the
-    source) stops the thread; abandoning mid-stream without close()
-    leaks at most ``size`` staged batches until GC."""
+    source) stops the thread; an abandoned handle is stopped by a GC
+    finalizer (the worker holds no reference back to it)."""
 
     def __init__(self, source: Iterator, size: int = 2,
                  transfer: Optional[Callable] = jax.device_put):
@@ -40,29 +66,14 @@ class Prefetcher:
             raise ValueError(f"size must be >= 1, got {size}")
         self._queue: "queue.Queue" = queue.Queue(maxsize=size)
         self._stop = threading.Event()
-        self._transfer = transfer
-
-        def worker():
-            try:
-                for item in source:
-                    if self._stop.is_set():
-                        return
-                    if self._transfer is not None:
-                        item = self._transfer(item)
-                    while not self._stop.is_set():
-                        try:
-                            self._queue.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-                    if self._stop.is_set():
-                        return
-                self._queue.put(_SENTINEL)
-            except BaseException as e:  # re-raised at the consumer
-                self._queue.put(e)
-
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(
+            target=_worker,
+            args=(source, self._queue, self._stop, transfer),
+            daemon=True,
+        )
         self._thread.start()
+        # dropping the handle without close() stops the thread too
+        self._finalizer = weakref.finalize(self, self._stop.set)
 
     def __iter__(self):
         return self
@@ -100,6 +111,15 @@ class Prefetcher:
         except queue.Empty:
             pass
         self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            # a wedged transfer (e.g. a dead device tunnel mid-
+            # device_put) can outlive the join — make it observable
+            # instead of returning as if shutdown completed
+            warnings.warn(
+                "Prefetcher worker did not stop within 5s (transfer "
+                "blocked?); thread remains daemon-alive",
+                RuntimeWarning,
+            )
 
     def __enter__(self):
         return self

@@ -9,20 +9,92 @@ import jax.numpy as jnp
 import optax
 
 
+def accumulated_value_and_grad(loss_fn: Callable, accum_steps: int):
+    """``(params, batch_pytree) -> (loss, grads)`` with gradient
+    accumulation: the batch's leading dims split into ``accum_steps``
+    microbatches folded through a ``lax.scan`` — activations live one
+    microbatch at a time, gradients accumulate in f32 and come back
+    in the param dtype. Exact for mean-style losses over equal
+    microbatches. ``accum_steps == 1`` is the plain value_and_grad.
+    Shared by the single-chip and sharded step factories so the two
+    can never diverge."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    vg = jax.value_and_grad(loss_fn)
+    if accum_steps == 1:
+        return vg
+
+    def run(params, batch):
+        def micro(carry, mb):
+            loss_sum, grad_sum = carry
+            loss, grads = vg(params, mb)
+            return (
+                loss_sum + loss,
+                jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32), grad_sum, grads
+                ),
+            ), None
+
+        micros = jax.tree.map(
+            lambda x: x.reshape(
+                (accum_steps, x.shape[0] // accum_steps) + x.shape[1:]
+            ),
+            batch,
+        )
+        zero = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = jax.lax.scan(
+            micro, (jnp.zeros((), jnp.float32), zero), micros
+        )
+        scale = 1.0 / accum_steps
+        # grads back in the PARAM dtype like the unaccumulated path —
+        # a dtype mismatch would promote the optimizer state and
+        # re-jit on the second step
+        return loss_sum * scale, jax.tree.map(
+            lambda g, p: (g * scale).astype(p.dtype), grad_sum, params
+        )
+
+    return run
+
+
+def check_accum_batch(batch, accum_steps: int) -> None:
+    """Refuse (host-side, pre-transfer) a batch whose leading dims do
+    not divide into the microbatch count."""
+    if accum_steps > 1:
+        leading = {
+            x.shape[0] % accum_steps for x in jax.tree.leaves(batch)
+        }
+        if leading != {0}:
+            raise ValueError(
+                "batch leading dim must be divisible by "
+                f"accum_steps={accum_steps}"
+            )
+
+
 def make_train_step(loss_fn: Callable, learning_rate: float = 1e-3,
-                    optimizer: Optional[optax.GradientTransformation] = None):
+                    optimizer: Optional[optax.GradientTransformation] = None,
+                    accum_steps: int = 1):
     """Jitted optax step: (params, opt_state, *batch) ->
-    (params, opt_state, loss). ``loss_fn(params, *batch) -> scalar``."""
+    (params, opt_state, loss). ``loss_fn(params, *batch) -> scalar``.
+    ``accum_steps`` — see accumulated_value_and_grad."""
     opt = optimizer or optax.adam(learning_rate)
+    vg = accumulated_value_and_grad(
+        lambda p, b: loss_fn(p, *b), accum_steps
+    )
 
     @jax.jit
     def step(params, opt_state, *batch):
-        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        loss, grads = vg(params, tuple(batch))
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return opt, step
+    def run(params, opt_state, *batch):
+        check_accum_batch(batch, accum_steps)
+        return step(params, opt_state, *batch)
+
+    return opt, run
 
 
 def synthetic_batches(rng, shape, vocab: Optional[int] = None, count: int = 0):

@@ -61,58 +61,22 @@ def make_sharded_train_step(
     )
     opt_state = jax.jit(optimizer.init)(sharded_params)
 
-    def _grads(params, batch):
-        if accum_steps == 1:
-            return jax.value_and_grad(loss_fn)(params, batch)
+    from ..models.train import accumulated_value_and_grad, check_accum_batch
 
-        def micro(carry, mb):
-            loss_sum, grad_sum = carry
-            loss, grads = jax.value_and_grad(loss_fn)(params, mb)
-            return (
-                loss_sum + loss,
-                jax.tree.map(lambda a, g: a + g, grad_sum, grads),
-            ), None
-
-        micros = jax.tree.map(
-            lambda x: x.reshape(
-                (accum_steps, x.shape[0] // accum_steps) + x.shape[1:]
-            ),
-            batch,
-        )
-        zero = jax.tree.map(
-            lambda p: jax.numpy.zeros(p.shape, jax.numpy.float32), params
-        )
-        (loss_sum, grad_sum), _ = jax.lax.scan(
-            micro, (jax.numpy.zeros((), jax.numpy.float32), zero), micros
-        )
-        scale = 1.0 / accum_steps
-        # accumulate in f32, hand the optimizer grads in the PARAM
-        # dtype like the single-pass path — a dtype mismatch would
-        # promote adamw's mu/nu and re-jit on the second step
-        return loss_sum * scale, jax.tree.map(
-            lambda g, p: (g * scale).astype(p.dtype), grad_sum, params
-        )
+    vg = accumulated_value_and_grad(loss_fn, accum_steps)
 
     # donate params+opt_state: the update writes in place, halving peak
     # HBM — the difference between fitting a model and OOMing at half
     # its size on 16GB v5e chips
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, batch):
-        loss, grads = _grads(params, batch)
+        loss, grads = vg(params, batch)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     def run(params, opt_state, batch):
-        if accum_steps > 1:
-            leading = {
-                x.shape[0] % accum_steps for x in jax.tree.leaves(batch)
-            }
-            if leading != {0}:
-                raise ValueError(
-                    "batch leading dim must be divisible by "
-                    f"accum_steps={accum_steps}"
-                )
+        check_accum_batch(batch, accum_steps)
         batch = jax.device_put(batch, batch_spec)
         return step(params, opt_state, batch)
 
