@@ -78,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
              "(batch must divide; trades steps/s for fitting a larger "
              "effective batch)",
     )
+    parser.add_argument(
+        "--prefetch", type=int, default=0,
+        help="stage N batches ahead on a background thread "
+             "(models/data.py Prefetcher) — overlaps the input "
+             "pipeline with device compute; single-process only",
+    )
     return parser
 
 
@@ -286,6 +292,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # the ordinal (workloads/distribute corpus; parallel/multihost.py)
     from ..parallel.multihost import maybe_initialize
 
+    if args.prefetch > 0:
+        # refuse BEFORE jax.distributed connects: the gang path builds
+        # its global batch arrays per step (a guard after init would
+        # first block on the coordinator)
+        from ..parallel.multihost import spec_from_env
+
+        if spec_from_env() is not None:
+            raise SystemExit(
+                "--prefetch is single-process (the gang path builds "
+                "global arrays per step)"
+            )
     spec = maybe_initialize()
 
     from ..models.train import make_train_step
@@ -358,6 +375,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # computed from a local clock could diverge across workers and
     # deadlock the next allgather.
     check_next = 0
+    feed = None
+    if args.prefetch > 0:
+        from ..models.data import prefetch_to_device
+
+        def batch_stream(k):
+            while True:
+                k, sub = jax.random.split(k)
+                yield make_batch(sub)
+
+        # batches are produced by on-device jax.random ops, so no
+        # extra transfer: the win is the producer running AHEAD of
+        # the consuming step dispatches
+        feed = prefetch_to_device(batch_stream(key), size=args.prefetch,
+                                  transfer=None)
     try:
         while True:
             if args.steps and steps_done >= args.steps:
@@ -400,8 +431,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     stop = False  # wait for the gang at the sync point
             if stop:
                 break
-            key, sub = jax.random.split(key)
-            batch = make_batch(sub)
+            if feed is not None:
+                batch = next(feed)
+            else:
+                key, sub = jax.random.split(key)
+                batch = make_batch(sub)
             gate.begin()
             params, opt_state, loss = step(params, opt_state, *batch)
             result = gate.maybe_release(loss)
@@ -431,11 +465,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 args.checkpoint_dir, start_step + steps_done, params, opt_state
             )
         jax.block_until_ready(loss)  # async dispatch must not inflate throughput
+        # measured INSIDE the try, before the feed teardown: close()'s
+        # drain+join is shutdown cost, not training time, and must not
+        # deflate the reported samples_per_s
+        elapsed = time.perf_counter() - started
     finally:
+        if feed is not None:
+            feed.close()
         if args.profile_dir:
             jax.profiler.stop_trace()
             log.info("profiler trace written to %s", args.profile_dir)
-    elapsed = time.perf_counter() - started
     gate.close()
     world = spec.num_processes if spec is not None else 1
     print(json.dumps({

@@ -533,6 +533,32 @@ class TestSequenceParallelLlama:
                 "--batch", "2", "--steps", "1",
             ])
 
+    def test_workload_cli_prefetch(self, capsys, tmp_path):
+        """--prefetch N runs the loop off the background-staged feed
+        in both steps and checkpoint configurations (the mid-loop
+        save drains the gate while the producer keeps staging)."""
+        import json as _json
+
+        from kubeshare_tpu.cmd import workload as workload_cmd
+        from kubeshare_tpu.models.checkpoint import latest_checkpoint
+
+        rc = workload_cmd.main([
+            "--model", "mnist", "--batch", "16", "--steps", "3",
+            "--prefetch", "2",
+        ])
+        assert rc == 0
+        doc = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert doc["steps"] == 3
+
+        ckpt = str(tmp_path / "ck")
+        rc = workload_cmd.main([
+            "--model", "mnist", "--batch", "16", "--steps", "4",
+            "--prefetch", "2", "--checkpoint-dir", ckpt,
+            "--checkpoint-every", "2",
+        ])
+        assert rc == 0
+        assert latest_checkpoint(ckpt) == 4
+
     def test_workload_cli_sp_rejects_non_llama(self):
         """--sp on a non-llama model must refuse, not silently train
         unsharded with the flag ignored."""
