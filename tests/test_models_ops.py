@@ -506,21 +506,21 @@ class TestReviewRegressions:
             atol=2e-5, rtol=2e-3,
         )
 
-    def test_llama_rolling_window_cache(self):
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_llama_rolling_window_cache(self, quantized):
         """SWA decode uses a ring of window slots: the cache allocates
         O(window) not O(max_seq_len), and decoding far past the wrap
         boundary still reproduces the full (uncached) forward's logits
-        at every step."""
-        import numpy as np
-
-        from kubeshare_tpu.models.llama import (
-            init_kv_cache, llama_apply_cached,
-        )
-
+        at every step — float weights and (the serving cross-product)
+        int8-quantized both."""
         cfg = LlamaConfig(vocab=64, dim=32, layers=2, num_heads=4,
                           num_kv_heads=2, mlp_dim=64, max_seq_len=32,
                           dtype="float32", window=8)
         params = init_llama(RNG, cfg)
+        if quantized:
+            from kubeshare_tpu.models.quant import quantize_llama
+
+            params = quantize_llama(params)
         cache = init_kv_cache(cfg, 2)
         assert cache["k"].shape[3] == 8  # ring = window, not max_seq
 
