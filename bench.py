@@ -88,9 +88,9 @@ BENCH_PLATFORM = os.environ.get("KUBESHARE_BENCH_PLATFORM", "")
 
 def _apply_platform_override() -> None:
     if BENCH_PLATFORM:
-        import jax
+        from kubeshare_tpu.utils.platform import apply_platform_override
 
-        jax.config.update("jax_platforms", BENCH_PLATFORM)
+        apply_platform_override(BENCH_PLATFORM)
 
 
 # --- wall-budget accounting -----------------------------------------

@@ -41,9 +41,9 @@ import jax.numpy as jnp
 # chip-free smoke route (see bench.py): the axon plugin force-selects
 # itself, so a CPU run must override via jax.config, not env alone
 if os.environ.get("KUBESHARE_BENCH_PLATFORM"):
-    jax.config.update(
-        "jax_platforms", os.environ["KUBESHARE_BENCH_PLATFORM"]
-    )
+    from kubeshare_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override(os.environ["KUBESHARE_BENCH_PLATFORM"])
 
 # bf16 peak FLOPs by device kind (dense MXU). The tunnel chip reports
 # "TPU v5 lite" = v5e: 197 TFLOP/s.

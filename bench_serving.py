@@ -36,9 +36,9 @@ import jax.numpy as jnp  # noqa: E402
 # chip-free smoke route (see bench.py): the axon plugin force-selects
 # itself, so a CPU run must override via jax.config, not env alone
 if os.environ.get("KUBESHARE_BENCH_PLATFORM"):
-    jax.config.update(
-        "jax_platforms", os.environ["KUBESHARE_BENCH_PLATFORM"]
-    )
+    from kubeshare_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override(os.environ["KUBESHARE_BENCH_PLATFORM"])
 
 from bench_common import p99, run_threads, start_arbiter as _start, stop_arbiter  # noqa: E402
 from kubeshare_tpu.models import LlamaConfig, init_llama  # noqa: E402

@@ -280,11 +280,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     import jax
 
     # honor JAX_PLATFORMS explicitly: on hosts where a site plugin
-    # force-selects itself at interpreter startup (the axon
-    # sitecustomize), the env var alone is trampled and only the
-    # config route wins — a user asking for cpu must get cpu
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # force-selects itself at interpreter startup, the env var alone
+    # is trampled — a user asking for cpu must get cpu
+    from ..utils.platform import apply_platform_override
+
+    apply_platform_override()
 
     # gang pods bootstrap jax.distributed BEFORE any device touch: the
     # webhook injects the headcount, the workload spec carries the

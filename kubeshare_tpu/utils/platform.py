@@ -1,0 +1,34 @@
+"""Site-proof JAX platform selection.
+
+On hosts whose PJRT plugin force-selects itself at interpreter
+startup (the axon sitecustomize), the ``JAX_PLATFORMS`` env var is
+trampled and only the config route wins — and with the device tunnel
+down, any device touch on the trampled platform hangs indefinitely.
+Every entry point that honors a platform override must go through
+here; hand-rolled copies drift (one honored only "cpu", another
+forgot clear_backends) and each drifted copy is a future hang.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def apply_platform_override(platform: Optional[str] = None,
+                            clear: bool = False) -> None:
+    """Make ``platform`` (default: the JAX_PLATFORMS env var; no-op
+    when neither is set) authoritative via ``jax.config``. Pass
+    ``clear=True`` when devices may already have been touched — the
+    initialized backend must be dropped or the override is ignored."""
+    platform = platform or os.environ.get("JAX_PLATFORMS", "")
+    if not platform:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    if clear:
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception:
+            pass
