@@ -116,7 +116,12 @@ class TestModels:
         params = init_llama(RNG, cfg)
         real = sum(x.size for x in jax.tree.leaves(params))
         assert llama_param_count(cfg) == real
-        assert 7.5e9 < llama_param_count(llama3_8b()) < 8.6e9
+        n8b = llama_param_count(llama3_8b())
+        assert 7.5e9 < n8b < 8.6e9
+        # the serving math doc/serving.md teaches: the 8B flagship's
+        # int8 weights (~8GB at 1 byte/param) fit a single 16GB v5e
+        # with room for cache; bf16 (~16GB) does not
+        assert n8b < 16 * (1 << 30) < 2 * n8b
 
     def test_llama_remat_bit_identical(self):
         """Per-block rematerialization (jax.checkpoint, dots-saveable)
