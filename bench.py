@@ -504,6 +504,11 @@ def run_headline(probe: dict) -> dict:
         "worst_round_chip_drifted": worst["drifted"],
         "device": probe.get("device", ""),
         "probe_attempts": probe.get("probe_attempts", 1),
+        # measurement provenance: a late probe shrinks the per-phase
+        # wall down to 1.5s, and a 1.5s-phase headline is statistically
+        # weaker than a full 6s one — the banked artifact must say
+        # which it was
+        "phase_s": round(phase_s, 1),
     })
     return doc
 

@@ -32,23 +32,31 @@ def _worker(source, q, stop, transfer):
     Prefetcher, or the running closure would keep it alive forever
     and the GC finalizer that stops an abandoned prefetcher could
     never fire."""
+
+    def put_or_stop(obj) -> bool:
+        # every put must stay interruptible by ``stop`` — including
+        # the sentinel and a terminal exception — or an abandoned
+        # prefetcher with a full queue strands this daemon thread
+        # forever (the GC finalizer can only set the event)
+        while not stop.is_set():
+            try:
+                q.put(obj, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     try:
         for item in source:
             if stop.is_set():
                 return
             if transfer is not None:
                 item = transfer(item)
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
-            if stop.is_set():
+            if not put_or_stop(item):
                 return
-        q.put(_SENTINEL)
+        put_or_stop(_SENTINEL)
     except BaseException as e:  # re-raised at the consumer
-        q.put(e)
+        put_or_stop(e)
 
 
 class Prefetcher:

@@ -220,12 +220,12 @@ def llama_block(
     if attn_fn is not None:
         # contract: attn_fn bakes causality AND cfg.window itself.
         # make_llama_sp_loss marks its cores with the window they
-        # bake; refusing a mismatch here is what keeps a window
-        # config from silently running un-windowed through a core
-        # built without one
-        if cfg.window > 0 and getattr(
-            attn_fn, "window", 0
-        ) != cfg.window:
+        # bake; refusing a mismatch here — in BOTH directions — is
+        # what keeps a window config from silently running
+        # un-windowed through a core built without one, and a
+        # windowed core from silently windowing a model whose config
+        # claims full causal attention
+        if getattr(attn_fn, "window", 0) != cfg.window:
             raise ValueError(
                 f"cfg.window={cfg.window} but attn_fn bakes window="
                 f"{getattr(attn_fn, 'window', 0)} — build the SP core "

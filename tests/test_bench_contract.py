@@ -106,3 +106,7 @@ class TestBenchContract:
         assert first["vs_baseline"] > 0
         assert last["vs_baseline"] == first["vs_baseline"]
         assert last["value"] == first["value"]
+        # measurement provenance (ADVICE r4): the banked doc must say
+        # how long each phase actually ran, so a 1.5s degraded-budget
+        # headline is distinguishable from a full-length one
+        assert 1.5 <= last["phase_s"] <= 10.0, last

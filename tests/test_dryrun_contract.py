@@ -1,0 +1,76 @@
+"""Pin the multichip-artifact honesty contract (VERDICT r4 weak #1):
+the cross-process leg gets exactly one retry, the artifact tail always
+carries a machine-parsable ``crossproc=ok|failed|skipped`` token, and a
+forced failure of the leg CANNOT produce a clean-looking artifact —
+after printing the tail, dryrun raises so the driver records ok:false.
+
+The policy lives in ``_crossproc_status`` / ``_enforce_crossproc`` so
+these tests run in milliseconds instead of re-compiling the full
+nine-proof dryrun; ``make dryrun`` exercises the real path end-to-end.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as graft
+
+
+class TestCrossprocHonesty:
+    def test_double_failure_is_failed_after_exactly_one_retry(
+        self, monkeypatch
+    ):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise OSError("port race")
+
+        monkeypatch.delenv("KUBESHARE_DRYRUN_CROSSPROC", raising=False)
+        monkeypatch.setattr(graft, "_crossprocess_leg", boom)
+        status, detail = graft._crossproc_status()
+        assert status == "failed"
+        assert len(calls) == 2  # one retry, not zero, not unbounded
+        assert "OSError" in detail
+
+    def test_failed_status_raises_so_driver_rc_goes_nonzero(self):
+        with pytest.raises(RuntimeError, match="cross-process leg failed"):
+            graft._enforce_crossproc("failed", "attempt 2: OSError: x")
+
+    def test_transient_failure_recovers_on_retry(self, monkeypatch):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("transient")
+            return "dp=2xtp=4 over jax.distributed: allgather [0.0, 1.0]"
+
+        monkeypatch.delenv("KUBESHARE_DRYRUN_CROSSPROC", raising=False)
+        monkeypatch.setattr(graft, "_crossprocess_leg", flaky)
+        status, detail = graft._crossproc_status()
+        assert status == "ok"
+        assert len(calls) == 2
+        assert "allgather" in detail
+        graft._enforce_crossproc(status, detail)  # must not raise
+
+    def test_env_skip_yields_skipped_token_and_no_raise(self, monkeypatch):
+        monkeypatch.setenv("KUBESHARE_DRYRUN_CROSSPROC", "0")
+        status, detail = graft._crossproc_status()
+        assert status == "skipped"
+        graft._enforce_crossproc(status, detail)  # must not raise
+
+    def test_forced_failure_env_hook_reaches_the_real_leg(self, monkeypatch):
+        """The KS_DRYRUN_FORCE_CROSSPROC_FAIL hook fails the REAL leg
+        (not a monkeypatch), so the full retry+enforce pipeline over
+        the genuine subprocess-spawning code path ends in failed."""
+        monkeypatch.delenv("KUBESHARE_DRYRUN_CROSSPROC", raising=False)
+        monkeypatch.setenv("KS_DRYRUN_FORCE_CROSSPROC_FAIL", "1")
+        status, detail = graft._crossproc_status()
+        assert status == "failed"
+        assert "forced failure" in detail
+        with pytest.raises(RuntimeError):
+            graft._enforce_crossproc(status, detail)

@@ -514,6 +514,36 @@ class TestReviewRegressions:
             atol=2e-5, rtol=2e-3,
         )
 
+    def test_llama_block_window_attn_fn_mismatch_both_directions(self):
+        """The window/attn_fn contract check is bidirectional (ADVICE
+        r4): a windowed config refuses an un-windowed core AND a
+        windowed core refuses a full-causal config — either silent
+        combination computes different math than the config claims."""
+        from kubeshare_tpu.models.llama import llama_block
+
+        def make_core(window):
+            def core(q, k, v):
+                return q
+
+            core.window = window
+            return core
+
+        def run(cfg_window, core_window):
+            cfg = LlamaConfig(vocab=64, dim=32, layers=1, num_heads=4,
+                              num_kv_heads=4, mlp_dim=64, max_seq_len=16,
+                              dtype="float32", window=cfg_window)
+            params = init_llama(RNG, cfg)
+            x = jnp.zeros((1, 8, 32), jnp.float32)
+            pos = jnp.arange(8)
+            llama_block(params["layer0"], x, pos, cfg,
+                        attn_fn=make_core(core_window))
+
+        with pytest.raises(ValueError, match="bakes window"):
+            run(cfg_window=4, core_window=0)   # windowed cfg, causal core
+        with pytest.raises(ValueError, match="bakes window"):
+            run(cfg_window=0, core_window=4)   # causal cfg, windowed core
+        run(cfg_window=4, core_window=4)       # matched: fine
+
     @pytest.mark.parametrize("quantized", [False, True])
     def test_llama_rolling_window_cache(self, quantized):
         """SWA decode uses a ring of window slots: the cache allocates
