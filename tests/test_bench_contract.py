@@ -110,3 +110,42 @@ class TestBenchContract:
         # how long each phase actually ran, so a 1.5s degraded-budget
         # headline is distinguishable from a full-length one
         assert 1.5 <= last["phase_s"] <= 10.0, last
+
+
+class TestKernelRowResilience:
+    def test_run_all_banks_surviving_rows_past_failures(self, monkeypatch):
+        """A row that dies (the r4 artifact run was killed whole by the
+        first T=16k XLA OOM) must be recorded as `<row>_error` while
+        every later row still banks."""
+        import bench_kernels as bk
+
+        monkeypatch.setenv("KUBESHARE_BENCH_FLASH_16K", "1")
+
+        def fake_flash(seq, rounds=6):
+            if seq == 16384:
+                raise RuntimeError("RESOURCE_EXHAUSTED: 17.18G > 15.7G")
+            return {f"flash_attn_speedup_t{seq}": 2.0}
+
+        monkeypatch.setattr(bk, "flash_vs_xla", fake_flash)
+        monkeypatch.setattr(
+            bk, "xent_vs_naive",
+            lambda seq, **kw: {f"xent_speedup_t{seq}": 3.0})
+        monkeypatch.setattr(
+            bk, "flash_swa_speedup",
+            lambda **kw: (_ for _ in ()).throw(ValueError("boom")))
+        monkeypatch.setattr(
+            bk, "llama_train_mfu",
+            lambda **kw: {"llama_params_millions": 200.0,
+                          "llama_step_ms": 100.0,
+                          "llama_tokens_per_sec": 1,
+                          "llama_batch_x_seq": "4x2048",
+                          "mfu": 0.4})
+        out = bk.run_all(log=lambda *a: None, budget_s=60.0)
+        # the two failures are recorded, not fatal
+        assert "RESOURCE_EXHAUSTED" in out["flash_attn_t16384_error"]
+        assert "boom" in out["flash_swa_error"]
+        # every row after a failure still banked
+        assert out["flash_attn_speedup_t8192"] == 2.0
+        assert out["flash_attn_speedup_t4096"] == 2.0
+        assert out["xent_speedup_t2048"] == 3.0
+        assert out["mfu"] == 0.4
