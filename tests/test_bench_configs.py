@@ -1,0 +1,70 @@
+"""Contract tests for bench_configs.py (BASELINE configs 3 + 4):
+CPU-degradable, one JSON line, required keys — testable tunnel-down
+exactly like the headline/serving bench contracts (VERDICT r4 #3)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(which: str, wall: float = 420.0):
+    env = dict(os.environ)
+    env.update({
+        "KUBESHARE_BENCH_PLATFORM": "cpu",
+        "KS_BENCH_CFG_PHASE_S": "1.0",
+        "KS_BENCH_CFG_ROUNDS": "1",
+        # a port distinct from the benches' defaults so a stray live
+        # arbiter from another bench can't cross-talk
+        "KS_BENCH_CFG_PORT": "45941",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_configs.py"), which],
+        capture_output=True, timeout=wall, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-1500:]
+    lines = [json.loads(l) for l in proc.stdout.decode().splitlines() if l]
+    assert len(lines) == 1, proc.stdout
+    return lines[0]
+
+
+class TestLstmGangContract:
+    def test_config3_row_shape(self):
+        doc = _run("lstm")
+        assert doc["unit"] == "samples/sec"
+        assert doc["value"] > 0
+        assert doc["vs_baseline"] > 0
+        # 5 pods at 20% duty share one chip: co-location must beat the
+        # whole-chip serial baseline even on the 1-core CPU smoke
+        assert doc["vs_baseline"] > 1.0
+        assert doc["gang"] == {"headcount": 5, "threshold": 0.2}
+        assert 0.0 <= doc["isolation_overhead"] <= 1.0
+        assert doc["p99_step_latency_ms_max"] >= \
+            doc["p99_step_latency_ms_min"] > 0
+        assert "config 3" in doc["metric"]
+
+
+class TestResnetDpContract:
+    def test_config4_row_shape(self):
+        doc = _run("resnet")
+        assert doc["unit"] == "samples/sec"
+        assert doc["value"] > 0
+        assert doc["p99_step_latency_ms"] > 0
+        assert doc["dp_pods"] == 8
+        assert "config 4" in doc["metric"]
+        # the dp8-sharded step's numerics must agree with the
+        # single-device step from the same init + data
+        assert doc["dp8_host_mesh_loss_matches"] is True
+        assert doc["dp8_vs_single_loss_rel_err"] < 2e-4
+
+
+def test_unknown_config_fails_loudly():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench_configs.py"), "nope"],
+        capture_output=True, timeout=120,
+        env={**os.environ, "KUBESHARE_BENCH_PLATFORM": "cpu"}, cwd=REPO,
+    )
+    assert proc.returncode == 2
+    assert b"usage" in proc.stderr

@@ -12,7 +12,11 @@ tool produces that artifact: ``artifacts/perf_evidence.json`` with
   while the XLA einsum fails, and the 64k-row fused xent trains while
   the dense [N, vocab] loss fails (bench_kernels.*_ab);
 - serving: 4x0.25-chip KV-cache decode aggregate + p99 through the
-  live arbiter (bench_serving.run).
+  live arbiter (bench_serving.run);
+- configs: BASELINE configs 3 + 4 — the 5x0.2-chip LSTM gang
+  aggregate + p99 and the DP ResNet unit-pod throughput + p99 with
+  the dp8 host-mesh numerics proof (bench_configs.py) — so all five
+  BASELINE configs resolve to artifact rows.
 
 Unlike bench.py (driver-budgeted, must never hang), this is an
 OPERATOR tool: it assumes a healthy chip and takes as long as the
@@ -59,11 +63,12 @@ def main() -> int:
     # Existing artifact rows for skipped sections are preserved WITH
     # their own provenance stamps — re-running one section on a
     # different day/chip must not re-attribute the others.
-    all_sections = {"kernels", "ab", "serving", "overhead"}
+    all_sections = {"kernels", "ab", "serving", "overhead", "configs"}
     sections = {
         s.strip()
         for s in os.environ.get(
-            "KUBESHARE_EVIDENCE_SECTIONS", "kernels,ab,serving,overhead"
+            "KUBESHARE_EVIDENCE_SECTIONS",
+            "kernels,ab,serving,overhead,configs",
         ).split(",")
         if s.strip()
     }
@@ -122,37 +127,50 @@ def main() -> int:
             }
         log(f"   {doc['train_gate_overhead']}")
 
-    if "serving" in sections:
-        # each serving variant runs in its own process for a fresh
-        # tunnel session; a failure must never discard the sections
-        # already banked above — record the error and write the file
-        def serving_run(row: str, extra_env: dict) -> None:
-            log(f"== serving (4x0.25 KV-cache decode) [{row}], own "
-                "process for a fresh tunnel session")
-            try:
-                proc = subprocess.run(
-                    [sys.executable, os.path.join(REPO, "bench_serving.py")],
-                    capture_output=True, timeout=600,
-                    env={**os.environ, **extra_env},
-                )
-                for line in proc.stderr.decode(errors="replace").splitlines():
-                    log(line)
-                if proc.returncode == 0:
-                    doc[row] = dict(json.loads(
-                        proc.stdout.decode().strip().splitlines()[-1]
-                    ), **stamp)
-                else:
-                    doc[row] = {"error": f"exit {proc.returncode}", **stamp}
-            except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
-                doc[row] = {"error": f"{type(e).__name__}: {e}"[:200],
-                            **stamp}
+    # each bench binary runs in its own process for a fresh tunnel
+    # session; a failure must never discard the sections already
+    # banked above — record the error and write the file
+    def bench_run(row: str, script: str, argv=(), extra_env=None,
+                  label: str = "") -> None:
+        log(f"== {label or row} [{row}], own process for a fresh "
+            "tunnel session")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, script), *argv],
+                capture_output=True, timeout=600,
+                env={**os.environ, **(extra_env or {})},
+            )
+            for line in proc.stderr.decode(errors="replace").splitlines():
+                log(line)
+            if proc.returncode == 0:
+                doc[row] = dict(json.loads(
+                    proc.stdout.decode().strip().splitlines()[-1]
+                ), **stamp)
+            else:
+                doc[row] = {"error": f"exit {proc.returncode}", **stamp}
+        except (subprocess.TimeoutExpired, ValueError, IndexError) as e:
+            doc[row] = {"error": f"{type(e).__name__}: {e}"[:200],
+                        **stamp}
 
+    if "serving" in sections:
         # pin the baseline's quant flag OFF explicitly: an inherited
         # KUBESHARE_BENCH_QUANT=1 would silently turn the A/B into
         # int8-vs-int8 with the baseline mislabeled bf16
-        serving_run("serving", {"KUBESHARE_BENCH_QUANT": "0"})
+        bench_run("serving", "bench_serving.py",
+                  extra_env={"KUBESHARE_BENCH_QUANT": "0"},
+                  label="serving (4x0.25 KV-cache decode)")
         # the HBM-bandwidth A/B: same pods with weight-only int8
-        serving_run("serving_int8", {"KUBESHARE_BENCH_QUANT": "1"})
+        bench_run("serving_int8", "bench_serving.py",
+                  extra_env={"KUBESHARE_BENCH_QUANT": "1"},
+                  label="serving int8 (4x0.25 KV-cache decode)")
+
+    if "configs" in sections:
+        # BASELINE configs 3 + 4 (VERDICT r4 #3: five configs, five
+        # rows — configs 1/2 are bench.py's headline, 5 is serving)
+        bench_run("lstm_gang", "bench_configs.py", argv=["lstm"],
+                  label="config 3: 5x0.2 LSTM gang")
+        bench_run("resnet_dp", "bench_configs.py", argv=["resnet"],
+                  label="config 4: DP ResNet unit pod")
 
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:
