@@ -68,6 +68,10 @@ class SimReport:
     resubmitted: int = 0       # fault-killed pods requeued
     faults: int = 0            # fault events applied
     defrag_evicted: int = 0    # evict-to-fit victims (resubmitted too)
+    # per-gang mean pairwise ICI hops over all members' leaves,
+    # captured at the tick the gang's Permit barrier released — the
+    # trace-scale evidence for the locality/seeding score terms
+    gang_hops: List[float] = field(default_factory=list)
 
     @property
     def mean_wait(self) -> float:
@@ -114,6 +118,12 @@ class SimReport:
             "faults": self.faults,
             "killed": self.killed,
             "resubmitted": self.resubmitted,
+            "gangs_bound": len(self.gang_hops),
+            "mean_gang_ici_hops": round(
+                sum(self.gang_hops) / len(self.gang_hops), 3
+            ) if self.gang_hops else None,
+            "worst_gang_ici_hops": round(max(self.gang_hops), 3)
+            if self.gang_hops else None,
         }
 
 
@@ -163,7 +173,8 @@ class Simulator:
         self.priority_ratio = priority_ratio
         self._rng = random.Random(seed)
 
-    def _pod_for(self, event: TraceEvent, idx: int) -> Pod:
+    def _pod_for(self, event: TraceEvent, idx: int,
+                 member: int = 0) -> Pod:
         chips = event.chips
         labels = {}
         if chips < 1.0:
@@ -177,11 +188,38 @@ class Simulator:
                 labels[C.LABEL_PRIORITY] = str(event.priority)
         elif self._rng.random() < self.priority_ratio:
             labels[C.LABEL_PRIORITY] = str(self._rng.randint(1, 100))
+        name = f"sim-{idx}"
+        if event.gang > 1:
+            # one PodGroup per trace row: all-or-nothing co-scheduling
+            # through the engine's real Permit barrier
+            labels[C.LABEL_GROUP_NAME] = f"simgang-{idx}"
+            labels[C.LABEL_GROUP_HEADCOUNT] = str(event.gang)
+            labels[C.LABEL_GROUP_THRESHOLD] = "1.0"
+            name = f"sim-{idx}-m{member}"
         return Pod(
-            name=f"sim-{idx}",
+            name=name,
             labels=labels,
             scheduler_name=C.SCHEDULER_NAME,
         )
+
+    def _record_gang_hops(self, keys, report: SimReport) -> None:
+        """Mean pairwise ICI hops over every leaf the gang's members
+        hold, captured at the Permit release — the per-gang locality
+        number the score terms exist to minimize."""
+        import itertools
+
+        from ..cells.topology import ici_distance
+
+        leaves = []
+        for key in keys:
+            status = self.engine.status.get(key)
+            if status is not None and status.leaves:
+                leaves.extend(status.leaves)
+        pairs = list(itertools.combinations(leaves, 2))
+        if pairs:
+            report.gang_hops.append(
+                sum(ici_distance(a, b) for a, b in pairs) / len(pairs)
+            )
 
     def _uncredit(self, job: "_Job", report: SimReport) -> None:
         """A bound job leaving early (fault kill / defrag eviction)
@@ -303,15 +341,19 @@ class Simulator:
                 self._apply_fault(fault_queue[fi], jobs, pending, report)
                 fi += 1
 
-            # arrivals at this tick
+            # arrivals at this tick (a gang row expands into its
+            # members — one PodGroup arriving together, like a Job
+            # controller creating all replicas at once)
             while i < len(arrivals) and arrivals[i].start <= self.clock_now:
                 event = arrivals[i]
-                pod = self._pod_for(event, i)
-                self.cluster.create_pod(pod)
-                job = _Job(pod=pod, event=event, submitted_at=event.start)
-                jobs[pod.key] = job
-                pending.append(job)
-                report.submitted += 1
+                for m in range(event.gang):
+                    pod = self._pod_for(event, i, m)
+                    self.cluster.create_pod(pod)
+                    job = _Job(pod=pod, event=event,
+                               submitted_at=event.start)
+                    jobs[pod.key] = job
+                    pending.append(job)
+                    report.submitted += 1
                 i += 1
 
             # one scheduling pass over the queue (queue-sorted)
@@ -320,7 +362,35 @@ class Simulator:
             evictions_seen = evictions_at_pass_start = len(
                 self.cluster.evictions
             )
+            gang_bound: set = set()  # keys bound via a sibling's Permit
+
+            def mark_bound(job: _Job) -> None:
+                job.bound_at = self.clock_now
+                report.bound += 1
+                wait = self.clock_now - job.submitted_at
+                report.wait_times.append(wait)
+                # the engine's own rule decides the class — an inline
+                # reimplementation would silently diverge from what
+                # was actually scheduled
+                from ..scheduler.labels import parse_priority
+
+                (report.guarantee_waits
+                 if parse_priority(job.pod) > 0
+                 else report.opportunistic_waits).append(wait)
+                heapq.heappush(
+                    finishes,
+                    (self.clock_now + job.event.runtime, job.pod.key),
+                )
+                # credit only work inside the horizon so utilization
+                # stays <= 1 on cut-off runs
+                job.credited = job.event.chips * min(
+                    job.event.runtime, max(0.0, end - self.clock_now)
+                )
+                report.chip_seconds_used += job.credited
+
             for job in pending:
+                if job.pod.key in gang_bound:
+                    continue  # bound this pass via a sibling's Permit
                 decision = self.engine.schedule_one(job.pod)
                 # defrag victims: the engine evicted them through the
                 # cluster (FakeCluster deletes synchronously); their
@@ -350,28 +420,18 @@ class Simulator:
                     report.resubmitted += 1
                     report.submitted += 1
                 if decision.status == "bound":
-                    job.bound_at = self.clock_now
-                    report.bound += 1
-                    wait = self.clock_now - job.submitted_at
-                    report.wait_times.append(wait)
-                    # the engine's own rule decides the class — an
-                    # inline reimplementation would silently diverge
-                    # from what was actually scheduled
-                    from ..scheduler.labels import parse_priority
-
-                    (report.guarantee_waits
-                     if parse_priority(job.pod) > 0
-                     else report.opportunistic_waits).append(wait)
-                    heapq.heappush(
-                        finishes,
-                        (self.clock_now + job.event.runtime, job.pod.key),
-                    )
-                    # credit only work inside the horizon so utilization
-                    # stays <= 1 on cut-off runs
-                    job.credited = job.event.chips * min(
-                        job.event.runtime, max(0.0, end - self.clock_now)
-                    )
-                    report.chip_seconds_used += job.credited
+                    mark_bound(job)
+                    # a non-empty bound_with is the Permit barrier
+                    # releasing: every sibling binds at this tick too
+                    for key in decision.bound_with:
+                        sibling = jobs.get(key)
+                        if sibling is not None and sibling.bound_at is None:
+                            mark_bound(sibling)
+                            gang_bound.add(key)
+                    if decision.bound_with:
+                        self._record_gang_hops(
+                            [job.pod.key, *decision.bound_with], report
+                        )
                 elif decision.status == "unschedulable" and not decision.retryable:
                     # malformed spec: permanent reject
                     self.cluster.delete_pod(job.pod.key)
@@ -379,7 +439,11 @@ class Simulator:
                     report.unschedulable += 1
                 else:
                     still_pending.append(job)  # capacity: retry next tick
-            pending = still_pending
+            # drop members that a LATER sibling's Permit release bound
+            # after they were already parked in still_pending this pass
+            pending = [
+                j for j in still_pending if j.pod.key not in gang_bound
+            ]
             if evictions_seen > evictions_at_pass_start and pending:
                 retry_at = self.clock_now + 1.0  # requeue-on-delete
             report.peak_pending = max(report.peak_pending, len(pending))
