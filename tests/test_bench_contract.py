@@ -54,9 +54,13 @@ class TestBenchContract:
             "KUBESHARE_BENCH_PLATFORM": "cpu",
             "KUBESHARE_BENCH_BATCH": "64",
             "KUBESHARE_BENCH_PROBE_FAIL_N": "2",
-            "KUBESHARE_BENCH_TOTAL_WALL": "120",
+            # 150s, not 120: on this 1-core box a concurrently-running
+            # live bench can stretch the compile+calibrate prologue
+            # past what 120s leaves after the injected probe backoffs
+            # (observed flaking under full-suite load, 2026-07-31)
+            "KUBESHARE_BENCH_TOTAL_WALL": "150",
             "KUBESHARE_BENCH_KERNELS": "0",
-        }, wall=200)
+        }, wall=230)
         assert proc.returncode == 0, proc.stderr[-1500:]
         assert lines[-1]["value"] > 0, proc.stdout
         assert lines[-1]["vs_baseline"] > 0

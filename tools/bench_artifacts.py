@@ -63,7 +63,11 @@ def main() -> int:
     # Existing artifact rows for skipped sections are preserved WITH
     # their own provenance stamps — re-running one section on a
     # different day/chip must not re-attribute the others.
-    all_sections = {"kernels", "ab", "serving", "overhead", "configs"}
+    # "serving" = both decode variants; the chip's tens-of-seconds
+    # drift can contaminate one variant's window and not the other's,
+    # so each is also addressable alone for surgical re-banking
+    all_sections = {"kernels", "ab", "serving", "serving-bf16",
+                    "serving-int8", "overhead", "configs"}
     sections = {
         s.strip()
         for s in os.environ.get(
@@ -78,7 +82,14 @@ def main() -> int:
             f"(valid: {sorted(all_sections)})")
         return 1
     doc = {}
-    if os.path.exists(OUT) and sections != all_sections:
+    # freshness guard compares EFFECTIVE coverage (variant aliases
+    # normalized to their parent), so the documented full run still
+    # rewrites the artifact clean rather than merging stale rows
+    full = {"kernels", "ab", "serving", "overhead", "configs"}
+    effective = {
+        "serving" if s.startswith("serving-") else s for s in sections
+    }
+    if os.path.exists(OUT) and effective != full:
         with open(OUT) as f:
             doc = json.load(f)
     stamp = {
@@ -152,13 +163,14 @@ def main() -> int:
             doc[row] = {"error": f"{type(e).__name__}: {e}"[:200],
                         **stamp}
 
-    if "serving" in sections:
+    if sections & {"serving", "serving-bf16"}:
         # pin the baseline's quant flag OFF explicitly: an inherited
         # KUBESHARE_BENCH_QUANT=1 would silently turn the A/B into
         # int8-vs-int8 with the baseline mislabeled bf16
         bench_run("serving", "bench_serving.py",
                   extra_env={"KUBESHARE_BENCH_QUANT": "0"},
                   label="serving (4x0.25 KV-cache decode)")
+    if sections & {"serving", "serving-int8"}:
         # the HBM-bandwidth A/B: same pods with weight-only int8
         bench_run("serving_int8", "bench_serving.py",
                   extra_env={"KUBESHARE_BENCH_QUANT": "1"},

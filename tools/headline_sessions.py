@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""Summarize artifacts/headline_history.jsonl (written by
+tools/headline_sessions.sh): per-capture vs_baseline ratios and the
+cross-session median/min/max — the numbers a README drift-range claim
+resolves to. Prints one JSON line."""
+
+import json
+import os
+import statistics
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HIST = os.path.join(REPO, "artifacts", "headline_history.jsonl")
+
+
+def summarize(path: str = HIST) -> dict:
+    rows = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    rows = [r for r in rows if r.get("vs_baseline")]
+    if not rows:
+        return {"captures": 0, "error": "no healthy captures"}
+    ratios = [r["vs_baseline"] for r in rows]
+    overheads = [
+        r["isolation_overhead"] for r in rows
+        if "isolation_overhead" in r
+    ]
+    return {
+        "captures": len(rows),
+        "vs_baseline_median": round(statistics.median(ratios), 3),
+        "vs_baseline_min": round(min(ratios), 3),
+        "vs_baseline_max": round(max(ratios), 3),
+        "all_ge_2x": all(r >= 2.0 for r in ratios),
+        "isolation_overhead_max": round(max(overheads), 4)
+        if overheads else None,
+        "first_captured_at": rows[0].get(
+            "captured_at", rows[0].get("banked_at", "")
+        ),
+        "last_captured_at": rows[-1].get(
+            "captured_at", rows[-1].get("banked_at", "")
+        ),
+        "devices": sorted({r.get("device", "?") for r in rows}),
+    }
+
+
+if __name__ == "__main__":
+    path = sys.argv[1] if len(sys.argv) > 1 else HIST
+    if not os.path.exists(path):
+        print(json.dumps({"captures": 0, "error": "no history file"}))
+        sys.exit(1)
+    print(json.dumps(summarize(path)))

