@@ -106,32 +106,7 @@ def gang_locality_ab(gangs: int = 6, seed: int = 13) -> list:
     from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
 
     hosts = 8
-    topo = {
-        "cell_types": {
-            "v5e-tray": {
-                "child_cell_type": "tpu-v5e",
-                "child_cell_number": 4,
-                "child_cell_priority": 100,
-            },
-            "v5e-host": {
-                "child_cell_type": "v5e-tray",
-                "child_cell_number": 1,
-                "is_node_level": True,
-                "torus": [2, 2],
-            },
-            "v5e-slice-32": {
-                "child_cell_type": "v5e-host",
-                "child_cell_number": hosts,
-                "torus": [4, 8],
-            },
-        },
-        "cells": [{
-            "cell_type": "v5e-slice-32",
-            "cell_children": [
-                {"cell_id": f"tpu-host-{h}"} for h in range(hosts)
-            ],
-        }],
-    }
+    topo = _slice32_topology()
 
     def run(locality_on: bool) -> dict:
         from kubeshare_tpu.cells.topology import ici_distance
