@@ -1,9 +1,14 @@
-from .trace import TraceEvent, generate_trace, load_trace, save_trace
+from .trace import (
+    TraceEvent, generate_gang_trace, generate_sec_trace, generate_trace,
+    load_trace, save_trace,
+)
 from .simulator import FaultEvent, SimReport, Simulator
 
 __all__ = [
     "TraceEvent",
     "generate_trace",
+    "generate_gang_trace",
+    "generate_sec_trace",
     "load_trace",
     "save_trace",
     "SimReport",
