@@ -295,7 +295,7 @@ def sec_trace_rows() -> list:
 def chaos_rows() -> list:
     """Failure-recovery at trace scale (SURVEY §5 fault injection,
     artifact-level): the 989-arrival trace on 16 nodes with a rolling
-    chaos schedule — every ~20 virtual minutes a node goes down for 5
+    chaos schedule — every 15 virtual minutes a node goes down for 5
     minutes (running pods killed + resubmitted), plus a pod_kill of
     the longest-running pod between flaps. Invariant: every submitted
     job still completes (the resubmit path loses no work), and the
