@@ -16,6 +16,7 @@ from .vgg import VggConfig, init_vgg, vgg_apply, vgg16
 from .llama import LlamaConfig, init_llama, llama_apply, make_llama_sp_loss
 from .data import Prefetcher, prefetch_to_device
 from .quant import param_bytes, quantize_llama
+from .serving import DecodeServer
 from .moe import MoeConfig, init_moe_ffn, moe_ffn_apply, moe_param_spec
 from .train import make_train_step, synthetic_batches
 
@@ -27,6 +28,7 @@ __all__ = [
     "VggConfig", "init_vgg", "vgg_apply", "vgg16",
     "LlamaConfig", "init_llama", "llama_apply", "make_llama_sp_loss",
     "param_bytes", "quantize_llama",
+    "DecodeServer",
     "Prefetcher", "prefetch_to_device",
     "MoeConfig", "init_moe_ffn", "moe_ffn_apply", "moe_param_spec",
     "make_train_step", "synthetic_batches",

@@ -99,14 +99,19 @@ def init_llama(rng, cfg: LlamaConfig = LlamaConfig()) -> Dict:
 
 
 def _rope(x: jnp.ndarray, positions: jnp.ndarray, theta: float) -> jnp.ndarray:
-    """Rotary embedding. x [B, H, T, D], positions [T]."""
+    """Rotary embedding. x [B, H, T, D]; positions [T], or [B, T] when
+    sequences sit at different absolute positions (per-slot serving)."""
     head_dim = x.shape[-1]
     freqs = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
-    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, D/2]
-    cos = jnp.cos(angles)[None, None, :, :]
-    sin = jnp.sin(angles)[None, None, :, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [..., T, D/2]
+    if positions.ndim == 2:
+        cos = jnp.cos(angles)[:, None, :, :]   # [B, 1, T, D/2]
+        sin = jnp.sin(angles)[:, None, :, :]
+    else:
+        cos = jnp.cos(angles)[None, None, :, :]
+        sin = jnp.sin(angles)[None, None, :, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rotated = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return rotated.astype(x.dtype)
@@ -401,14 +406,20 @@ def cache_slots(cfg: LlamaConfig) -> int:
     return cfg.max_seq_len
 
 
-def init_kv_cache(cfg: LlamaConfig, batch: int, dtype=None):
+def init_kv_cache(cfg: LlamaConfig, batch: int, dtype=None,
+                  per_slot: bool = False):
+    """``per_slot=True`` gives each batch row its own length counter —
+    the continuous-batching layout (models/serving.py DecodeServer)
+    where sequences at different absolute positions share one decode
+    batch. Scalar length (the default) keeps the whole batch in
+    lockstep, as the request-batched serving bench uses."""
     dtype = jnp.dtype(dtype or cfg.dtype)
     hd = cfg.dim // cfg.num_heads
     shape = (cfg.layers, batch, cfg.num_kv_heads, cache_slots(cfg), hd)
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
-        "length": jnp.zeros((), jnp.int32),
+        "length": jnp.zeros((batch,) if per_slot else (), jnp.int32),
     }
 
 
@@ -417,8 +428,12 @@ def _ring_positions(length, slots: int):
     positions have been written: the newest p ≡ i (mod slots) with
     p < length; untouched slots come out negative. For the
     full-history cache (no wrap while length <= slots) this reduces
-    to p_i = i for written slots."""
+    to p_i = i for written slots. ``length`` scalar -> [S]; vector
+    [B] -> [B, S] (per-slot serving)."""
     i = jnp.arange(slots)
+    length = jnp.asarray(length)
+    if length.ndim == 1:
+        length = length[:, None]
     return (length - 1) - ((length - 1 - i) % slots)
 
 
@@ -426,8 +441,8 @@ def _masked_attend(qg, k_all, v_all, p, q_abs, window: int):
     """Grouped-query attention over position-tagged K/V: visibility
     is ``0 <= p <= q_abs`` and, with ``window > 0``,
     ``p > q_abs - window`` — one mask formula for every cache layout.
-    qg [B, KvH, G, Tq, D]; k_all/v_all [B, KvH, S, D]; p [S];
-    q_abs [Tq]."""
+    qg [B, KvH, G, Tq, D]; k_all/v_all [B, KvH, S, D]; p [S] (or
+    [B, S] per-slot); q_abs [Tq] (or [B, Tq] per-slot)."""
     hd = qg.shape[-1]
     # matmul operands stay bf16 (f32 accumulation via
     # preferred_element_type) — an f32 upcast would halve the MXU rate
@@ -436,8 +451,10 @@ def _masked_attend(qg, k_all, v_all, p, q_abs, window: int):
         "bkgtd,bksd->bkgts", qg, k_all.astype(qg.dtype),
         preferred_element_type=jnp.float32,
     ) / (hd ** 0.5)
-    p = p[None, None, None, None, :]
-    q_abs = q_abs[None, None, None, :, None]
+    p = (p[:, None, None, None, :] if p.ndim == 2
+         else p[None, None, None, None, :])
+    q_abs = (q_abs[:, None, None, :, None] if q_abs.ndim == 2
+             else q_abs[None, None, None, :, None])
     mask = (p >= 0) & (p <= q_abs)
     if window > 0:
         mask &= p > q_abs - window
@@ -487,7 +504,11 @@ def _attend_ring(q, k_ring, v_ring, length_after, num_heads,
     batch, _, tq, hd = q.shape
     qg = q.reshape(batch, num_kv_heads, groups, tq, hd)
     p = _ring_positions(length_after, k_ring.shape[2])
-    q_abs = length_after - tq + jnp.arange(tq)
+    length_after = jnp.asarray(length_after)
+    if length_after.ndim == 1:  # per-slot lengths -> [B, Tq] query abs
+        q_abs = length_after[:, None] - tq + jnp.arange(tq)
+    else:
+        q_abs = length_after - tq + jnp.arange(tq)
     out = _masked_attend(qg, k_ring, v_ring, p, q_abs, window)
     return out.reshape(batch, num_heads, tq, hd)
 
@@ -514,16 +535,35 @@ def llama_apply_cached(
             "in one call (chunk the prefill to the window size)"
         )
     start = cache["length"]
-    positions = start + jnp.arange(seq)
-    # ring write: position p -> slot p % slots. Decode (seq == 1) and
-    # full-history prefill use dynamic_update_slice; a multi-token
-    # prefill into a ROLLING cache takes the scatter path regardless
-    # of wrapping — whether it wraps depends on the traced start, so
-    # there is no static non-wrap branch to take
-    write_idx = positions % slots
+    per_slot = getattr(start, "ndim", 0) == 1
+    if per_slot and seq != 1:
+        # per-slot admission prefills one sequence at a time through
+        # prefill_slot (which reuses this function's scalar path on a
+        # single-row view); the shared batch only ever decodes
+        raise ValueError(
+            "per-slot cache accepts seq == 1 only; admit prompts via "
+            "prefill_slot"
+        )
+    if per_slot:
+        positions = start[:, None] + jnp.arange(seq)    # [B, 1]
+        write_idx = positions[:, 0] % slots             # [B]
+    else:
+        positions = start + jnp.arange(seq)
+        # ring write: position p -> slot p % slots. Decode (seq == 1)
+        # and full-history prefill use dynamic_update_slice; a
+        # multi-token prefill into a ROLLING cache takes the scatter
+        # path regardless of wrapping — whether it wraps depends on
+        # the traced start, so there is no static non-wrap branch
+        write_idx = positions % slots
 
     def _store(buf, new):
         new = new.astype(buf.dtype)
+        if per_slot:
+            # each sequence writes its own slot: batched scatter over
+            # (batch row, ring slot) index pairs
+            return buf.at[jnp.arange(batch), :, write_idx, :].set(
+                new[:, :, 0, :]
+            )
         if seq == 1:
             return jax.lax.dynamic_update_slice(
                 buf, new, (0, 0, write_idx[0], 0)
@@ -583,6 +623,42 @@ def llama_apply_cached(
         "length": start + seq,
     }
     return logits, updated
+
+
+def prefill_slot(params, tokens, cache, slot, cfg: LlamaConfig):
+    """Admit one sequence into batch row ``slot`` of a per-slot cache:
+    prefill its prompt ([1, T] tokens, T static — bucket/pad prompts
+    for compile reuse) through the proven scalar-cache path on a
+    single-row view, then write the row and its length back. Returns
+    (full prompt logits [1, T, vocab], updated cache) — under padding
+    the caller samples at its TRUE last position, not -1. The other
+    rows' keys/values and lengths are untouched, so admission composes
+    with concurrent decode state."""
+    if tokens.shape[0] != 1:
+        raise ValueError("prefill_slot admits one sequence at a time")
+    row = {
+        "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, axis=1),
+        "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, axis=1),
+        "length": jnp.zeros((), jnp.int32),
+    }
+    logits, row = llama_apply_cached(params, tokens, row, cfg)
+    updated = {
+        "k": jax.lax.dynamic_update_slice_in_dim(
+            cache["k"], row["k"], slot, axis=1
+        ),
+        "v": jax.lax.dynamic_update_slice_in_dim(
+            cache["v"], row["v"], slot, axis=1
+        ),
+        "length": cache["length"].at[slot].set(row["length"]),
+    }
+    return logits, updated
+
+
+def retire_slot(cache, slot):
+    """Free batch row ``slot``: length 0 re-masks every ring position
+    (p < 0 in _ring_positions), so stale keys can never leak into a
+    later tenant's attention — no buffer zeroing needed."""
+    return dict(cache, length=cache["length"].at[slot].set(0))
 
 
 def _sample_token(logits, key, temperature: float, top_k: int):

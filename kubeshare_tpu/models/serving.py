@@ -1,0 +1,205 @@
+"""Continuous-batching decode server: slot-based admission over one
+static-shape decode batch.
+
+The request-batched serving path (bench_serving.py, BASELINE config 5)
+decodes B sequences in lockstep: all admitted together, all at the
+same position. Real serving traffic isn't lockstep — requests arrive
+while others are mid-generation — and the reference framework has
+nothing at this layer at all (its containers run whatever the app
+does). This module is the TPU-idiomatic answer: a fixed pool of S
+batch slots compiled ONCE (static shapes; XLA never recompiles as
+tenants come and go), per-slot cache lengths
+(models/llama.py init_kv_cache(per_slot=True)), prompt admission by
+single-slot prefill (prefill_slot), retirement by length reset
+(retire_slot) — an idle slot costs its masked lane of the batched
+matmuls, not a recompile.
+
+Correctness invariants (pinned in tests/test_serving_slots.py):
+- a slot's logits are bit-identical to decoding that sequence alone
+  with a scalar-length cache, regardless of what the other slots do;
+- prompts padded up to a compile bucket leave no trace: padding keys
+  sit at ring slots the position mask can only reach AFTER decode has
+  overwritten them with real keys;
+- a retired slot's history can never leak into the next tenant
+  (length 0 re-masks every ring position).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .llama import (
+    LlamaConfig, _sample_token, init_kv_cache, llama_apply_cached,
+    prefill_slot, retire_slot,
+)
+
+
+def _bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"prompt length {n} exceeds largest bucket "
+                     f"{max(buckets)}")
+
+
+class DecodeServer:
+    """S-slot continuous-batching decoder for one llama model.
+
+    ``admit(prompt) -> (slot, first_token) | None`` (None = pool
+    full), ``step() -> {slot: token}`` decodes every active slot one
+    token, ``retire`` / auto-retire on ``eos_id`` or ``max_new`` frees
+    slots for the next admission. Exactly two compiled programs run
+    steady-state: one decode step and one prefill per prompt bucket."""
+
+    def __init__(
+        self,
+        params: Dict,
+        cfg: LlamaConfig,
+        slots: int = 8,
+        prompt_buckets: Sequence[int] = (32, 128, 512),
+        temperature: float = 0.0,
+        top_k: int = 0,
+        eos_id: Optional[int] = None,
+        max_new: int = 0,
+        seed: int = 0,
+    ):
+        from .llama import cache_slots
+
+        # a bucket must fit BOTH the context horizon (one generated
+        # token has to follow the prompt) and a single prefill write
+        # into the ring (sliding-window rings hold cache_slots(cfg)
+        # positions per call)
+        cap = min(cfg.max_seq_len - 1, cache_slots(cfg))
+        buckets = sorted(b for b in prompt_buckets if b <= cap)
+        if not buckets:
+            raise ValueError(
+                f"no prompt bucket fits (cap {cap}: max_seq_len-1 and "
+                "the cache ring)"
+            )
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.buckets = tuple(buckets)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+        self.max_new = max_new
+        self.cache = init_kv_cache(cfg, slots, per_slot=True)
+        self.key = jax.random.PRNGKey(seed)
+        self.active: List[bool] = [False] * slots
+        self.last_tok: List[int] = [0] * slots
+        self.generated: List[int] = [0] * slots
+        # one jitted admission fn; jax caches a program per prompt
+        # bucket (tokens shape), which is exactly the compile story
+        self._prefill = jax.jit(functools.partial(prefill_slot, cfg=cfg))
+
+        temperature_, top_k_ = temperature, top_k
+
+        @jax.jit
+        def _step(params, tokens, cache, active, key):
+            logits, cache = llama_apply_cached(
+                params, tokens[:, None], cache, cfg
+            )
+            key, sub = jax.random.split(key)
+            nxt = _sample_token(
+                logits[:, -1], sub, temperature_, top_k_
+            ).astype(jnp.int32)
+            # an idle lane must stay idle: length snaps back to 0 so
+            # its garbage write never becomes visible history
+            cache = dict(cache, length=jnp.where(
+                active, cache["length"], 0
+            ))
+            return jnp.where(active, nxt, 0), cache, key
+
+        self._step = _step
+
+    # ---- admission / retirement ---------------------------------
+
+    def free_slots(self) -> int:
+        return self.active.count(False)
+
+    def admit(self, prompt: Sequence[int]):
+        """Prefill ``prompt`` into a free slot. Returns ``(slot,
+        first_token)`` — the first generated token, sampled from the
+        prompt's next-token logits — or None when the pool is full;
+        subsequent tokens stream from ``step()``."""
+        if not prompt:
+            raise ValueError("empty prompt")
+        try:
+            slot = self.active.index(False)
+        except ValueError:
+            return None
+        true_len = len(prompt)
+        bucket = _bucket(true_len, self.buckets)
+        padded = list(prompt) + [0] * (bucket - true_len)
+        tokens = jnp.asarray([padded], jnp.int32)
+        logits, cache = self._prefill(
+            self.params, tokens, self.cache, slot
+        )
+        # rewind the padding: positions true_len..bucket-1 hold pad
+        # keys, but with length = true_len the mask can only see them
+        # after decode has overwritten each with the real key
+        self.cache = dict(cache, length=cache["length"].at[slot].set(
+            true_len
+        ))
+        self.key, sub = jax.random.split(self.key)
+        first = int(_sample_token(
+            logits[:, true_len - 1], sub, self.temperature, self.top_k
+        )[0])
+        self.active[slot] = True
+        self.last_tok[slot] = first
+        self.generated[slot] = 1
+        # the FIRST token is subject to the same stop rules as any
+        # step token: max_new=1 means one token total, and an eos
+        # first token must not leave the slot streaming past eos
+        if ((self.eos_id is not None and first == self.eos_id)
+                or (self.max_new and self.generated[slot] >= self.max_new)):
+            self.retire(slot)
+        return slot, first
+
+    def retire(self, slot: int) -> None:
+        self.cache = retire_slot(self.cache, slot)
+        self.active[slot] = False
+        self.last_tok[slot] = 0
+        self.generated[slot] = 0
+
+    # ---- decode ---------------------------------------------------
+
+    def step(self) -> Dict[int, int]:
+        """One decode step across every active slot: each slot's most
+        recent token is fed in (writing it into its cache row) and the
+        newly sampled successor comes back as {slot: token}.
+        Auto-retires slots that hit eos_id / max_new / the cache
+        horizon — the eos token itself is reported, then the slot
+        frees."""
+        if not any(self.active):
+            return {}
+        tokens = jnp.asarray(self.last_tok, jnp.int32)
+        active = jnp.asarray(self.active)
+        nxt, self.cache, self.key = self._step(
+            self.params, tokens, self.cache, active, self.key
+        )
+        import numpy as np
+
+        nxt = np.asarray(nxt)
+        lengths = np.asarray(self.cache["length"])
+        out: Dict[int, int] = {}
+        for s in range(self.slots):
+            if not self.active[s]:
+                continue
+            tok = int(nxt[s])
+            out[s] = tok
+            self.last_tok[s] = tok
+            self.generated[s] += 1
+            hit_eos = self.eos_id is not None and tok == self.eos_id
+            hit_max = self.max_new and self.generated[s] >= self.max_new
+            # the NEXT decode would write position ``length``, which
+            # falls past the horizon once length >= max_seq_len
+            hit_cap = int(lengths[s]) >= self.cfg.max_seq_len
+            if hit_eos or hit_max or hit_cap:
+                self.retire(s)
+        return out
