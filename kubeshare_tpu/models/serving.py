@@ -93,14 +93,19 @@ class DecodeServer:
         self.active: List[bool] = [False] * slots
         self.last_tok: List[int] = [0] * slots
         self.generated: List[int] = [0] * slots
+        # host-side mirror of cache["length"]: every transition is
+        # host-initiated (admit: true_len; step: +1 per active slot;
+        # retire: 0), so stop rules never pay a device fetch per step
+        # — on a tunneled chip that round trip costs more than the
+        # decode itself
+        self.host_len: List[int] = [0] * slots
         # one jitted admission fn; jax caches a program per prompt
         # bucket (tokens shape), which is exactly the compile story
         self._prefill = jax.jit(functools.partial(prefill_slot, cfg=cfg))
 
         temperature_, top_k_ = temperature, top_k
 
-        @jax.jit
-        def _step(params, tokens, cache, active, key):
+        def _one(params, tokens, cache, active, key):
             logits, cache = llama_apply_cached(
                 params, tokens[:, None], cache, cfg
             )
@@ -115,7 +120,26 @@ class DecodeServer:
             ))
             return jnp.where(active, nxt, 0), cache, key
 
-        self._step = _step
+        self._step = jax.jit(_one)
+
+        @functools.partial(jax.jit, static_argnames="quantum")
+        def _burst(params, tokens, cache, active, key, quantum):
+            """``quantum`` chained decode steps in ONE device call
+            (lax.scan): the host syncs once per quantum instead of per
+            token — on a tunneled chip the per-call round trip costs
+            more than the decode itself."""
+            def body(carry, _):
+                tokens, cache, key = carry
+                nxt, cache, key = _one(params, tokens, cache, active,
+                                       key)
+                return (nxt, cache, key), nxt
+
+            (_, cache, key), seq = jax.lax.scan(
+                body, (tokens, cache, key), None, length=quantum
+            )
+            return seq, cache, key  # seq [quantum, S]
+
+        self._burst = _burst
 
     # ---- admission / retirement ---------------------------------
 
@@ -153,6 +177,7 @@ class DecodeServer:
         self.active[slot] = True
         self.last_tok[slot] = first
         self.generated[slot] = 1
+        self.host_len[slot] = true_len
         # the FIRST token is subject to the same stop rules as any
         # step token: max_new=1 means one token total, and an eos
         # first token must not leave the slot streaming past eos
@@ -166,6 +191,7 @@ class DecodeServer:
         self.active[slot] = False
         self.last_tok[slot] = 0
         self.generated[slot] = 0
+        self.host_len[slot] = 0
 
     # ---- decode ---------------------------------------------------
 
@@ -186,7 +212,6 @@ class DecodeServer:
         import numpy as np
 
         nxt = np.asarray(nxt)
-        lengths = np.asarray(self.cache["length"])
         out: Dict[int, int] = {}
         for s in range(self.slots):
             if not self.active[s]:
@@ -195,11 +220,64 @@ class DecodeServer:
             out[s] = tok
             self.last_tok[s] = tok
             self.generated[s] += 1
+            self.host_len[s] += 1  # mirrors the device-side length
             hit_eos = self.eos_id is not None and tok == self.eos_id
             hit_max = self.max_new and self.generated[s] >= self.max_new
             # the NEXT decode would write position ``length``, which
             # falls past the horizon once length >= max_seq_len
-            hit_cap = int(lengths[s]) >= self.cfg.max_seq_len
+            hit_cap = self.host_len[s] >= self.cfg.max_seq_len
             if hit_eos or hit_max or hit_cap:
                 self.retire(s)
+        return out
+
+    def step_burst(self, quantum: int) -> Dict[int, List[int]]:
+        """Decode up to ``quantum`` tokens per active slot in one
+        device call; returns {slot: tokens} with each slot's stream
+        truncated by its stop rules (post-eos / post-max_new tokens
+        the device speculatively produced are discarded — the
+        standard cost of quantum scheduling). Falls back to single
+        steps when any active slot is within ``quantum`` of the
+        context horizon, so the scan can never write past it."""
+        if quantum <= 1:
+            out = self.step()
+            return {s: [t] for s, t in out.items()}
+        if not any(self.active):
+            return {}
+        if any(self.host_len[s] + quantum > self.cfg.max_seq_len
+               for s in range(self.slots) if self.active[s]):
+            out: Dict[int, List[int]] = {}
+            for _ in range(quantum):
+                for s, t in self.step().items():
+                    out.setdefault(s, []).append(t)
+                if not any(self.active):
+                    break
+            return out
+        tokens = jnp.asarray(self.last_tok, jnp.int32)
+        active = jnp.asarray(self.active)
+        seq, self.cache, self.key = self._burst(
+            self.params, tokens, self.cache, active, self.key, quantum
+        )
+        import numpy as np
+
+        seq = np.asarray(seq)  # [quantum, S] — the one host sync
+        out = {}
+        for s in range(self.slots):
+            if not self.active[s]:
+                continue
+            self.host_len[s] += quantum  # device wrote every sub-step
+            kept: List[int] = []
+            stop = False
+            for tok in (int(t) for t in seq[:, s]):
+                kept.append(tok)
+                self.generated[s] += 1
+                if ((self.eos_id is not None and tok == self.eos_id)
+                        or (self.max_new
+                            and self.generated[s] >= self.max_new)):
+                    stop = True
+                    break
+            out[s] = kept
+            if stop or self.host_len[s] >= self.cfg.max_seq_len:
+                self.retire(s)
+            else:
+                self.last_tok[s] = kept[-1]
         return out

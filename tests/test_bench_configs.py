@@ -68,3 +68,20 @@ def test_unknown_config_fails_loudly():
     )
     assert proc.returncode == 2
     assert b"usage" in proc.stderr
+
+
+class TestContbatchContract:
+    def test_contbatch_row_shape(self):
+        doc = _run("contbatch")
+        assert doc["unit"] == "tokens/sec"
+        assert doc["value"] > 0
+        assert doc["slots"] == 8
+        assert doc["admissions"] > 0
+        assert doc["decode_step_ms"] > 0
+        # calibrated ~0.9 load must actually occupy the pool
+        assert doc["mean_slot_occupancy"] > 1.0
+        # every compiled program is warmed before the timed phase, so
+        # no admission pays a compile (the p99 TTFT stays interactive)
+        assert doc["ttft_ms_p50"] > 0
+        assert doc["ttft_ms_p99"] < 1000.0
+        assert "continuous-batching" in doc["metric"]

@@ -232,3 +232,19 @@ class TestStopRules:
         # prompt wrote 3 positions; each step writes one more; the
         # horizon allows exactly max_seq_len = 8 -> 5 decode steps
         assert steps == 5
+
+    def test_host_length_mirror_stays_exact(self):
+        """The stop rules run off a host-side length mirror (no device
+        fetch per step); it must track the device value through admit,
+        steps, and retire."""
+        server = DecodeServer(PARAMS, CFG, slots=3, prompt_buckets=(8,))
+        server.admit([5, 9, 13])
+        server.admit([21, 3])
+        for _ in range(3):
+            server.step()
+        server.retire(0)
+        server.admit([7])
+        server.step()
+        assert server.host_len == list(
+            np.asarray(server.cache["length"])
+        )

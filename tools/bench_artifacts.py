@@ -183,6 +183,12 @@ def main() -> int:
                   label="config 3: 5x0.2 LSTM gang")
         bench_run("resnet_dp", "bench_configs.py", argv=["resnet"],
                   label="config 4: DP ResNet unit pod")
+        # beyond the five: the continuous-batching decode server
+        # (models/serving.py) under calibrated ~0.9-load Poisson
+        # admissions — throughput, occupancy, time-to-first-token
+        bench_run("serving_contbatch", "bench_configs.py",
+                  argv=["contbatch"],
+                  label="continuous-batching DecodeServer")
 
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:
