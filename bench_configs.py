@@ -498,8 +498,9 @@ def run_contbatch() -> dict:
     admissions = rejected = 0
     ttft = []
     occupancy = []
-    deadline = time.perf_counter() + PHASE_S * ROUNDS
-    next_arrival = time.perf_counter()
+    t_start = time.perf_counter()
+    deadline = t_start + PHASE_S * ROUNDS
+    next_arrival = t_start
     while time.perf_counter() < deadline:
         now = time.perf_counter()
         while now >= next_arrival:
@@ -518,7 +519,11 @@ def run_contbatch() -> dict:
             # idle pool: wait for the next arrival instead of
             # busy-spinning (and diluting the occupancy samples)
             time.sleep(max(0.0, min(next_arrival - now, 0.01)))
-    elapsed = PHASE_S * ROUNDS
+    # measured, not nominal: the last iteration (arrival burst +
+    # decode step) runs past the deadline, so dividing by
+    # PHASE_S*ROUNDS would count those tokens against a shorter
+    # elapsed and overstate tokens/sec
+    elapsed = time.perf_counter() - t_start
     doc = {
         "metric": "continuous-batching decode tokens/sec, 8-slot "
                   "DecodeServer under Poisson prompt arrivals "

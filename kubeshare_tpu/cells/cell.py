@@ -208,6 +208,71 @@ class Cell:
         )
 
 
+class NodeModelAgg:
+    """Per-(node, model) feasibility aggregates, rebuilt lazily when the
+    node's generation counter moves (any reserve/reclaim/bind/unbind/
+    health flip on the node). Queries are O(1): the Pareto frontier of
+    (available, free HBM) over healthy bound leaves answers shared-fit
+    exactly (a leaf fits (r, m) iff some frontier point dominates it),
+    and the cached per-node-cell whole-free counts answer multi-chip
+    fit without re-walking leaves. The exhaustive ``leaves_view`` walk
+    survives in filtering.py as the defrag-hold slow path and the
+    differential oracle (``CellTree.check_aggregates``)."""
+
+    __slots__ = ("gen", "frontier", "node_cells")
+
+    def __init__(self, gen: int, leaves: Sequence[Cell]):
+        self.gen = gen
+        # Pareto-max (available, free_memory) points over healthy bound
+        # leaves, available descending / free_memory strictly ascending.
+        pts = sorted(
+            ((l.available, l.free_memory) for l in leaves if l.healthy),
+            key=lambda p: (-p[0], -p[1]),
+        )
+        frontier: List[Tuple[float, int]] = []
+        best_mem = -1
+        for avail, mem in pts:
+            if mem > best_mem:
+                frontier.append((avail, mem))
+                best_mem = mem
+        self.frontier = frontier
+        # (node-level cell, whole-free count of THIS model's leaves
+        # under it). The count is model-scoped on purpose: a multi-chip
+        # pod of model M can only consume M leaves, so counting other
+        # models' whole-free chips (what reading the node cell's
+        # available_whole_cell alone would do) admits nodes that then
+        # fail at Reserve on mixed-model nodes.
+        groups: Dict[int, List] = {}
+        for leaf in leaves:
+            cell: Optional[Cell] = leaf
+            while cell is not None and not cell.is_node:
+                cell = cell.parent
+            if cell is not None:
+                groups.setdefault(id(cell), [cell, 0])
+                if leaf.is_whole_free:
+                    groups[id(cell)][1] += 1
+        self.node_cells: List[Tuple[Cell, int]] = [
+            (cell, whole) for cell, whole in groups.values()
+        ]
+
+    def shared_fits(self, request: float, memory: int) -> bool:
+        for avail, mem in self.frontier:
+            if not fge(avail, request):
+                return False  # frontier is available-descending
+            if mem >= memory:
+                return True
+        return False
+
+    def multi_chip_fits(self, chips: int, memory: int) -> bool:
+        # cell.healthy / cell.free_memory are read live (already O(1)
+        # aggregates maintained by the reserve/reclaim walks); only the
+        # model-scoped whole count needs this cache.
+        for cell, whole in self.node_cells:
+            if cell.healthy and whole >= chips and cell.free_memory >= memory:
+                return True
+        return False
+
+
 class CellTree:
     """All physical cell trees plus the indexes the scheduler needs."""
 
@@ -228,6 +293,24 @@ class CellTree:
         self._bound_cache: Dict[
             str, Tuple[List[Cell], Dict[str, List[Cell]], List[str]]
         ] = {}
+        # Incremental feasibility index: a per-node generation counter
+        # bumped by every state change touching the node's leaves
+        # (reserve/reclaim/bind/unbind/HBM correction/health flip), and
+        # a per-(node, model) aggregate cache keyed by it. Steady-state
+        # Filter cost per examined node is O(1); only nodes actually
+        # touched since their last examination pay an O(leaves-on-node)
+        # rebuild. Counters are exported through the scheduler's
+        # /metrics so the fast/slow split is observable.
+        self._node_gen: Dict[str, int] = {}
+        self._agg_cache: Dict[Tuple[str, str], NodeModelAgg] = {}
+        self.filter_fast_hits = 0   # O(1) aggregate answers
+        self.filter_slow_walks = 0  # exhaustive walks (defrag holds)
+        self.agg_rebuilds = 0       # aggregate recomputes (gen moved)
+        self.agg_invalidations = 0  # generation bumps
+        # Differential oracle: when True, every fast-path answer is
+        # asserted against the exhaustive walk (tests only — the point
+        # of the index is not doing the walk).
+        self.check_aggregates = False
         self.roots: List[Cell] = []
         for spec in cfg.cells:
             root = self._build_tree(spec)
@@ -322,6 +405,7 @@ class CellTree:
                                 leaf.full_memory += delta
                                 leaf.free_memory += delta
                                 self._propagate(leaf, 0.0, 0, delta, delta)
+                                self._bump_generation(leaf.node)
                             pool.pop(i)
                             break
                     self._set_health(leaf, True)
@@ -360,6 +444,32 @@ class CellTree:
                 bound += 1
         return bound
 
+    def _bump_generation(self, node: str) -> None:
+        """Invalidate ``node``'s feasibility aggregates (and any
+        external caches keyed by :meth:`node_generation`)."""
+        if node:
+            self._node_gen[node] = self._node_gen.get(node, 0) + 1
+            self.agg_invalidations += 1
+
+    def node_generation(self, node: str) -> int:
+        """Monotonic per-node state counter: moves whenever anything
+        that can change a Filter/Score outcome on ``node`` changes.
+        External memos (the scheduler's score cache) key on it."""
+        return self._node_gen.get(node, 0)
+
+    def node_model_agg(self, node: str, model: str) -> NodeModelAgg:
+        """The (node, model) feasibility aggregate, rebuilt only when
+        the node's generation moved since the cached copy."""
+        gen = self._node_gen.get(node, 0)
+        key = (node, model)
+        agg = self._agg_cache.get(key)
+        if agg is None or agg.gen != gen:
+            agg = self._agg_cache[key] = NodeModelAgg(
+                gen, self.leaves_view(node, model)
+            )
+            self.agg_rebuilds += 1
+        return agg
+
     def _bind_leaf(self, leaf: Cell, chip: ChipInfo) -> None:
         leaf.uuid = chip.uuid
         leaf.full_memory = chip.memory
@@ -373,6 +483,7 @@ class CellTree:
         # invalidate only after the state flip: a recompute racing this
         # bind must never cache the pre-bind leaf set
         self._bound_cache.pop(leaf.node, None)
+        self._bump_generation(leaf.node)
 
     def _unbind_leaf(self, leaf: Cell) -> None:
         """Withdraw a vanished chip: capacity and memory leave the tree,
@@ -394,6 +505,7 @@ class CellTree:
         leaf.state = CellState.FREE
         self._set_health(leaf, False)
         self._bound_cache.pop(leaf.node, None)
+        self._bump_generation(leaf.node)
 
     def _propagate(
         self, leaf: Cell, avail: float, whole: int, free_mem: int, full_mem: int
@@ -418,6 +530,10 @@ class CellTree:
             self._set_health(leaf, healthy)
 
     def _set_health(self, leaf: Cell, healthy: bool) -> None:
+        if leaf.healthy != healthy:
+            # only a real flip invalidates aggregates — bind_node
+            # re-asserts health on every synced leaf each sync
+            self._bump_generation(leaf.node)
         leaf.healthy = healthy
         cell = leaf.parent
         while cell is not None:
@@ -446,6 +562,7 @@ class CellTree:
         whole_delta = int(leaf.is_whole_free) - int(was_whole)
         leaf.available_whole_cell += whole_delta
         self._propagate(leaf, -request, whole_delta, -memory, 0)
+        self._bump_generation(leaf.node)
 
     def reclaim(self, leaf: Cell, request: float, memory: int) -> None:
         if leaf.level != 1:
@@ -469,6 +586,7 @@ class CellTree:
         whole_delta = int(leaf.is_whole_free) - int(was_whole)
         leaf.available_whole_cell += whole_delta
         self._propagate(leaf, request, whole_delta, memory, 0)
+        self._bump_generation(leaf.node)
 
     # -- queries -------------------------------------------------------
 

@@ -92,6 +92,12 @@ class ClusterAPI(Protocol):
     def get_pod(self, key: str) -> Optional[Pod]:
         ...
 
+    def get_node(self, name: str) -> Optional[Node]:
+        """Point lookup for one node (None if unknown). The engine's
+        lazy inventory sync uses this instead of scanning list_nodes()
+        — adapters without it fall back to the scan via getattr."""
+        ...
+
     def bind(self, pod_key: str, node_name: str) -> None:
         """Set spec.nodeName — the proper Bind verb, replacing the
         reference's delete+recreate shadow-pod hack

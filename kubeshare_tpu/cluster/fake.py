@@ -41,6 +41,9 @@ class FakeCluster:
     def get_pod(self, key: str) -> Optional[Pod]:
         return self._pods.get(key)
 
+    def get_node(self, name: str) -> Optional[Node]:
+        return self._nodes.get(name)
+
     def bind(self, pod_key: str, node_name: str) -> None:
         pod = self._pods[pod_key]
         pod.node_name = node_name

@@ -307,6 +307,14 @@ class KubeCluster:
         items, _ = self._list("/api/v1/nodes")
         return [node_from_k8s(o) for o in items]
 
+    def get_node(self, name: str) -> Optional[Node]:
+        try:
+            return node_from_k8s(
+                self._request("GET", f"/api/v1/nodes/{name}")
+            )
+        except KubeError:
+            return None
+
     def get_pod(self, key: str) -> Optional[Pod]:
         namespace, _, name = key.partition("/")
         try:

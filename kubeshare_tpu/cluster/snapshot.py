@@ -109,6 +109,9 @@ class SnapshotCluster:
     def list_nodes(self) -> List[Node]:
         return list(self._nodes.values())
 
+    def get_node(self, name: str) -> Optional[Node]:
+        return self._nodes.get(name)
+
     def get_pod(self, key: str) -> Optional[Pod]:
         return self._pods.get(key)
 

@@ -149,15 +149,27 @@ class DecodeServer:
     def admit(self, prompt: Sequence[int]):
         """Prefill ``prompt`` into a free slot. Returns ``(slot,
         first_token)`` — the first generated token, sampled from the
-        prompt's next-token logits — or None when the pool is full;
-        subsequent tokens stream from ``step()``."""
+        prompt's next-token logits — with subsequent tokens streaming
+        from ``step()``.
+
+        Rejections: returns ``None`` whenever the request cannot be
+        admitted right now or ever — the pool is full (retry after a
+        slot retires) or the prompt exceeds the largest compile bucket
+        (``self.buckets[-1]``; no amount of waiting helps — truncate
+        or shard the prompt). An empty prompt is a caller bug, not a
+        load condition, and raises ValueError."""
         if not prompt:
             raise ValueError("empty prompt")
+        true_len = len(prompt)
+        if true_len > self.buckets[-1]:
+            # oversized prompt: same None contract as pool-full — a
+            # serving loop written against "None = cannot admit" must
+            # never crash on a long request
+            return None
         try:
             slot = self.active.index(False)
         except ValueError:
             return None
-        true_len = len(prompt)
         bucket = _bucket(true_len, self.buckets)
         padded = list(prompt) + [0] * (bucket - true_len)
         tokens = jnp.asarray([padded], jnp.int32)

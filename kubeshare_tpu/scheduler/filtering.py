@@ -1,20 +1,39 @@
 """Node feasibility checks.
 
-Reads only the cell tree's O(1) aggregates (maintained by reserve/
-reclaim walks) — no I/O on the hot path, unlike the reference which
-issues a Prometheus query inside Filter (node.go:42 via
-scheduler.go:335); inventory sync happens out-of-band in the engine.
+Reads only the cell tree's O(1) aggregates — no I/O on the hot path,
+unlike the reference which issues a Prometheus query inside Filter
+(node.go:42 via scheduler.go:335); inventory sync happens out-of-band
+in the engine.
+
+Two tiers per check:
+
+- **Fast path** (no defrag holds live): one lookup into the tree's
+  incremental per-(node, model) aggregate (``CellTree.node_model_agg``)
+  — a Pareto frontier over (available, free HBM) for fractional pods,
+  per-node-cell whole-free counts for integer pods. O(1) per examined
+  node; the aggregate rebuilds only when the node's generation counter
+  moved since it was last read.
+- **Slow path** (``exclude`` non-empty, i.e. a defrag hold is live on
+  the node): the exhaustive ``leaves_view`` walk, which can subtract
+  the held leaves exactly. Holds are rare and short, so this walk is
+  off the steady-state profile.
+
+The walk functions double as the differential oracle: with
+``tree.check_aggregates`` set (tests), every fast-path answer is
+asserted against its walk.
 
 Divergence from the reference: its model-agnostic path admits a node
 when capacity *summed across chip models* covers the request
 (scheduler.go:398-404) even if no single chip/node-cell fits, which
 then fails at Reserve. Here a node passes only if some single model
-fits.
+fits — and the multi-chip whole-free count is model-scoped for the
+same reason (an all-model count admits mixed-model nodes the pod
+cannot actually use).
 """
 
 from __future__ import annotations
 
-from typing import FrozenSet, List, Optional, Tuple
+from typing import FrozenSet, Optional, Tuple
 
 from ..cells.cell import Cell, CellTree, fge
 from .labels import PodKind, PodRequirements
@@ -22,12 +41,13 @@ from .labels import PodKind, PodRequirements
 _NO_LEAVES: FrozenSet[str] = frozenset()
 
 
-def shared_fit(
+def shared_fit_walk(
     tree: CellTree, node: str, model: str, request: float, memory: int,
     exclude: FrozenSet[str] = _NO_LEAVES,
 ) -> bool:
-    """A fractional pod fits if one healthy bound leaf has capacity.
-    ``exclude`` leaves (defrag holds) are invisible to this pod."""
+    """Exhaustive oracle: a fractional pod fits if one healthy bound
+    leaf has capacity. ``exclude`` leaves (defrag holds) are invisible
+    to this pod."""
     for leaf in tree.leaves_view(node, model):
         if exclude and leaf.uuid in exclude:
             continue
@@ -36,31 +56,32 @@ def shared_fit(
     return False
 
 
-def _node_level_cells(tree: CellTree, node: str, model: str) -> List[Cell]:
-    cells = {}
-    for leaf in tree.leaves_view(node, model):
-        cell: Optional[Cell] = leaf
-        while cell is not None and not cell.is_node:
-            cell = cell.parent
-        if cell is not None:
-            cells[id(cell)] = cell
-    return list(cells.values())
+def shared_fit(
+    tree: CellTree, node: str, model: str, request: float, memory: int,
+    exclude: FrozenSet[str] = _NO_LEAVES,
+) -> bool:
+    """Fractional-pod fit; O(1) aggregate check unless a defrag hold
+    forces the exhaustive walk."""
+    if exclude:
+        tree.filter_slow_walks += 1
+        return shared_fit_walk(tree, node, model, request, memory, exclude)
+    tree.filter_fast_hits += 1
+    fit = tree.node_model_agg(node, model).shared_fits(request, memory)
+    if tree.check_aggregates:
+        assert fit == shared_fit_walk(tree, node, model, request, memory), (
+            f"shared_fit aggregate/walk divergence on {node}/{model}: "
+            f"request={request} memory={memory} fast={fit}"
+        )
+    return fit
 
 
-def multi_chip_fit(
+def multi_chip_fit_walk(
     tree: CellTree, node: str, model: str, chips: int, memory: int,
     exclude: FrozenSet[str] = _NO_LEAVES,
 ) -> bool:
-    """An integer pod fits if a node-level cell has enough whole free
-    chips (and HBM) under it. With ``exclude`` (defrag-held leaves) the
-    aggregate shortcut is corrected by walking the held leaves — the
-    slow path only runs while a hold is live, which is rare and
-    short."""
-    if not exclude:
-        for cell in _node_level_cells(tree, node, model):
-            if cell.healthy and cell.available_whole_cell >= chips and cell.free_memory >= memory:
-                return True
-        return False
+    """Exhaustive oracle: an integer pod fits if a node-level cell has
+    enough whole-free leaves of this model (and HBM) under it, with
+    ``exclude`` (defrag-held) leaves treated as nonexistent."""
     groups: dict = {}
     for leaf in tree.leaves_view(node, model):
         cell: Optional[Cell] = leaf
@@ -78,6 +99,26 @@ def multi_chip_fit(
         if usable_whole >= chips and cell.free_memory - held_mem >= memory:
             return True
     return False
+
+
+def multi_chip_fit(
+    tree: CellTree, node: str, model: str, chips: int, memory: int,
+    exclude: FrozenSet[str] = _NO_LEAVES,
+) -> bool:
+    """Integer-pod fit; O(1) aggregate check unless a defrag hold
+    forces the exhaustive walk (the slow path only runs while a hold
+    is live, which is rare and short)."""
+    if exclude:
+        tree.filter_slow_walks += 1
+        return multi_chip_fit_walk(tree, node, model, chips, memory, exclude)
+    tree.filter_fast_hits += 1
+    fit = tree.node_model_agg(node, model).multi_chip_fits(chips, memory)
+    if tree.check_aggregates:
+        assert fit == multi_chip_fit_walk(tree, node, model, chips, memory), (
+            f"multi_chip_fit aggregate/walk divergence on {node}/{model}: "
+            f"chips={chips} memory={memory} fast={fit}"
+        )
+    return fit
 
 
 def node_fits(

@@ -17,11 +17,12 @@ State is rebuilt from pod annotations after a restart (pod.go:47-78,
 
 from __future__ import annotations
 
+import bisect
 import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
-from ..cells.cell import Cell, CellTree, ChipInfo
+from ..cells.cell import _EPS, Cell, CellTree, ChipInfo
 from ..cells.spec import TopologyConfig, load_topology
 from ..cluster.api import ClusterAPI, Conflict, Node, Pod
 from ..utils import expfmt
@@ -33,8 +34,8 @@ from .filtering import node_fits
 from .labels import LabelError, PodKind, PodRequirements, parse_pod
 from .podgroup import PodGroupRegistry
 from .scoring import (
-    normalize_scores, score_node, seed_eligible, select_leaves,
-    _resolved_memory,
+    anchor_fingerprint, normalize_scores, pick_best, score_node,
+    seed_eligible, select_leaves, _resolved_memory,
 )
 from .state import PodState, PodStatus, PodStatusStore
 
@@ -105,6 +106,25 @@ class TpuShareScheduler:
         self._waiting: Dict[str, Dict[str, _Waiting]] = {}  # group_key -> pods
         self._synced_nodes: Set[str] = set()
         self._bound_queue: Dict[str, List[Pod]] = {}  # node -> pods to resync
+        # Incrementally-maintained healthy-node index: the per-cycle
+        # `sorted(n.name for n in list_nodes())` used to cost O(nodes)
+        # per pod even with feasible-node sampling — the dominant term
+        # at 1024 nodes. Node informer events (add / health change)
+        # keep the sorted list and membership set in sync instead.
+        self._node_index: List[str] = []      # sorted healthy node names
+        self._node_index_set: Set[str] = set()
+        # healthy nodes whose inventory is not yet synced: the Filter
+        # gate on _ensure_synced is `if self._unsynced` — one truthiness
+        # test steady-state instead of a per-call set lookup
+        self._unsynced: Set[str] = set()
+        # Node-score memo, two-level: req-shape/anchor fingerprint ->
+        # {node -> (node generation, score)}. A node whose generation
+        # didn't move since it was last scored for the same requirement
+        # shape skips score_node entirely. Invalidation rides the cell
+        # tree's reserve/reclaim/bind/health generation counters.
+        self._score_cache: Dict[Tuple, Dict[str, Tuple[int, float]]] = {}
+        self.score_cache_hits = 0
+        self.score_cache_misses = 0
 
         self.defrag = defrag
         self.defrag_max_victims = defrag_max_victims
@@ -206,6 +226,10 @@ class TpuShareScheduler:
         self._waiting = {}
         self._synced_nodes = set()
         self._bound_queue = {}
+        self._node_index = []
+        self._node_index_set = set()
+        self._unsynced = set()
+        self._score_cache = {}
         self._defrag_last = {}
         self._defrag_inflight = set()
         self._defrag_blocked = {}
@@ -234,10 +258,27 @@ class TpuShareScheduler:
 
     # ================= informer handlers =============================
 
+    def _index_add(self, name: str) -> None:
+        if name not in self._node_index_set:
+            self._node_index_set.add(name)
+            bisect.insort(self._node_index, name)
+        if name not in self._synced_nodes:
+            self._unsynced.add(name)
+
+    def _index_remove(self, name: str) -> None:
+        if name in self._node_index_set:
+            self._node_index_set.discard(name)
+            i = bisect.bisect_left(self._node_index, name)
+            if i < len(self._node_index) and self._node_index[i] == name:
+                self._node_index.pop(i)
+        self._unsynced.discard(name)
+
     def _on_node_update(self, node: Node) -> None:
         if not node.healthy:
+            self._index_remove(node.name)
             self.tree.set_node_health(node.name, False)
             return
+        self._index_add(node.name)
         try:
             chips = self.inventory(node.name)
         except (OSError, ValueError) as e:
@@ -253,6 +294,7 @@ class TpuShareScheduler:
         else:
             self.tree.set_node_health(node.name, True)
         self._synced_nodes.add(node.name)
+        self._unsynced.discard(node.name)
         self._node_ports(node.name)
         for pod in self._bound_queue.pop(node.name, []):
             self._restore_bound_pod(pod)
@@ -412,13 +454,16 @@ class TpuShareScheduler:
     def filter(self, pod: Pod, req: PodRequirements, node_name: str):
         """Per-node feasibility: port pool + cell-tree fit. Returns
         (fit, reason)."""
-        self._ensure_synced(node_name)
+        if self._unsynced:
+            # gate, not per-call work: once every known node has synced
+            # its inventory this is a single falsy check per Filter
+            self._ensure_synced(node_name)
         if req.kind == PodKind.REGULAR:
             # regular pods consume no TPU capacity, so a defrag hold
             # never applies to them
             return True, ""
         if req.kind == PodKind.SHARED:
-            if self._node_ports(node_name).find_next_from_current() == -1:
+            if self._node_ports(node_name).full():
                 return False, f"node {node_name}: pod-manager port pool full"
         return node_fits(self.tree, node_name, req,
                          self._held_leaves(pod, req, node_name))
@@ -579,51 +624,37 @@ class TpuShareScheduler:
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
 
-        nodes = [n for n in self.cluster.list_nodes() if n.healthy]
         # gang anchors are needed twice: anchor NODES must be examined
         # first (sampling must never hide the node the rest of the gang
         # sits on), and the leaves weight locality scoring below
         anchors = self.status.group_placed_leaves(
             self.groups.get_or_create(pod, req.gang).key
         )
-        feasible: List[str] = []
-        reasons: List[str] = []
         with maybe_span(self.tracer, "filter", pod=pod.key):
-            names = sorted(n.name for n in nodes)
-            target = self._feasible_target(len(names))
+            # the incrementally-maintained sorted index replaces the
+            # per-cycle list_nodes()+sorted() scan — per-pod cost is
+            # O(examined candidates), not O(cluster)
+            names = self._node_index
+            if self._unsynced:
+                # syncing inventory mid-scan can deliver a health flip
+                # that edits the index; iterate a snapshot until every
+                # known node has synced (steady state: zero-copy)
+                names = list(names)
+            n_names = len(names)
+            target = self._feasible_target(n_names)
             anchor_nodes = {l.node for l in anchors if l.node}
-            start = self._filter_cursor % max(1, len(names))
+            start = self._filter_cursor % n_names if n_names else 0
             self.filter_attempts += 1
-            scans = 0
-            for name in sorted(anchor_nodes & set(names)):
-                scans += 1
-                fit, reason = self.filter(pod, req, name)
-                if fit:
-                    feasible.append(name)
-                elif reason:
-                    reasons.append(reason)
-            # the cursor advances only by rotation-window progress —
-            # counting the anchor scans above would skip never-examined
-            # nodes and systematically under-sample a wedge of the
-            # cluster under steady gang traffic
-            consumed = 0
-            if len(feasible) < target:
-                for name in names[start:] + names[:start]:
-                    consumed += 1
-                    if name in anchor_nodes:
-                        continue  # examined above
-                    scans += 1
-                    fit, reason = self.filter(pod, req, name)
-                    if fit:
-                        feasible.append(name)
-                        if len(feasible) >= target:
-                            break
-                    elif reason:
-                        reasons.append(reason)
-            self._filter_cursor = (start + consumed) % max(1, len(names))
+            feasible, reasons, scans, consumed = self._filter_candidates(
+                pod, req, names, n_names, start, target, anchor_nodes
+            )
+            self._filter_cursor = (start + consumed) % max(1, n_names)
             self.filter_scans += scans
         if not feasible:
-            evicted = self._maybe_defrag(pod, req, nodes)
+            evicted = self._maybe_defrag(
+                pod, req,
+                [n for n in self.cluster.list_nodes() if n.healthy],
+            )
             if evicted:
                 return Decision(
                     "unschedulable", pod.key, retryable=True,
@@ -641,12 +672,58 @@ class TpuShareScheduler:
             seed_frees = (
                 self._gang_seed_frees(req, feasible) if not anchors else None
             )
-            scores = {
-                name: self.score(pod, req, name, anchors, seed_frees)
-                for name in feasible
-            }
-            normalized = normalize_scores(scores)
-            best = max(feasible, key=lambda n: (normalized[n], n))
+            # Node-score memo: score_node is a pure function of the
+            # node's leaf state (generation-counted), the requirement
+            # shape, and the anchor set — so an unchanged node scored
+            # for the same shape is a dict hit, not a leaf walk.
+            # Uncacheable cases: gang seeding (seed_frees couples the
+            # score to OTHER nodes' free sets) and opportunistic pods
+            # while defrag holds are live (_held_leaves varies by pod).
+            cacheable = seed_frees is None and (
+                req.is_guarantee or not self._defrag_holds
+            )
+            if cacheable:
+                # two-level memo (shape -> node -> (gen, score)): the
+                # shape tuple is hashed once per pod, not once per
+                # feasible node, and the inner loop is one string-keyed
+                # dict probe plus a generation compare
+                shape = (req.kind, req.model, req.is_guarantee,
+                         anchor_fingerprint(anchors))
+                by_shape = self._score_cache.get(shape)
+                if by_shape is None:
+                    if len(self._score_cache) >= 1024:
+                        # every gang's anchor set mints a fresh shape
+                        # key, so the OUTER dict needs a bound too or
+                        # weeks of gang churn leak it; wholesale clear
+                        # over LRU — misses just re-score
+                        self._score_cache.clear()
+                    by_shape = self._score_cache[shape] = {}
+                scores = {}
+                gens_get = self.tree._node_gen.get
+                cache_get = by_shape.get
+                hits = misses = 0
+                for name in feasible:
+                    gen = gens_get(name, 0)
+                    entry = cache_get(name)
+                    if entry is not None and entry[0] == gen:
+                        hits += 1
+                        scores[name] = entry[1]
+                    else:
+                        misses += 1
+                        value = self.score(pod, req, name, anchors,
+                                           seed_frees)
+                        if len(by_shape) > (1 << 16):
+                            by_shape.clear()  # bound the memo
+                        by_shape[name] = (gen, value)
+                        scores[name] = value
+                self.score_cache_hits += hits
+                self.score_cache_misses += misses
+            else:
+                scores = {
+                    name: self.score(pod, req, name, anchors, seed_frees)
+                    for name in feasible
+                }
+            best = pick_best(scores)
 
         if req.kind == PodKind.REGULAR:
             try:
@@ -681,6 +758,181 @@ class TpuShareScheduler:
             "waiting", pod.key, node=best,
             message=f"gang barrier, timeout {extra}s",
         )
+
+    def _filter_candidates(
+        self,
+        pod: Pod,
+        req: PodRequirements,
+        names: Sequence[str],
+        n_names: int,
+        start: int,
+        target: int,
+        anchor_nodes: Set[str],
+    ) -> Tuple[List[str], List[str], int, int]:
+        """The candidate scan: anchor nodes first (sampling must never
+        hide the node the rest of a gang sits on), then the rotation
+        window until ``target`` feasible nodes are found. Returns
+        (feasible, reasons, scans, consumed) where ``consumed`` is
+        rotation-window progress only — counting anchor scans would
+        skip never-examined nodes and systematically under-sample a
+        wedge of the cluster under steady gang traffic.
+
+        Steady state — no defrag hold that could apply to this pod —
+        the rotation loop reads the feasibility index directly: per
+        candidate that is a port-pool fullness test plus one aggregate
+        probe per model, with req fields pre-bound, counters batched,
+        and rejection strings deferred to the nobody-fit cold path.
+        The ``self.filter`` hook chain (hold resolution, node_fits
+        dispatch) gives the same answers but costs several times more
+        per call; pod-level non-steady conditions (REGULAR kind,
+        opportunistic while holds are live) fall back to it wholesale,
+        while an UNSYNCED candidate detours through it per-node (the
+        lazy inventory fetch) — one node whose inventory collector is
+        down must not disable the fast path for the other 1023.
+        Anchor nodes (few, and only present for gangs) always take
+        the hook chain."""
+        feasible: List[str] = []
+        reasons: List[str] = []
+        scans = consumed = 0
+        tree = self.tree
+        for name in sorted(anchor_nodes):
+            if name not in self._node_index_set:
+                continue  # anchor node currently unhealthy
+            scans += 1
+            fit, reason = self.filter(pod, req, name)
+            if fit:
+                feasible.append(name)
+            elif reason:
+                reasons.append(reason)
+        if len(feasible) >= target or not n_names:
+            return feasible, reasons, scans, consumed
+
+        fast = not (
+            req.kind == PodKind.REGULAR
+            or not (req.is_guarantee or not self._defrag_holds)
+        )
+        if not fast:
+            for k in range(n_names):
+                name = names[(start + k) % n_names]
+                consumed += 1
+                if name in anchor_nodes:
+                    continue  # examined above
+                scans += 1
+                fit, reason = self.filter(pod, req, name)
+                if fit:
+                    feasible.append(name)
+                    if len(feasible) >= target:
+                        break
+                elif reason:
+                    reasons.append(reason)
+            return feasible, reasons, scans, consumed
+
+        needs_port = req.kind == PodKind.SHARED
+        is_multi = req.kind == PodKind.MULTI_CHIP
+        request, memory = req.request, req.memory
+        request_floor = request - _EPS  # fge(), constant-folded
+        chips_n, rmodel = req.chip_count, req.model
+        one_model = (rmodel,)
+        ports_get = self.ports.get
+        node_model_agg = tree.node_model_agg
+        models_on_node = tree.models_on_node
+        bound_get = tree._bound_cache.get  # models_on_node, sans frames
+        agg_get = tree._agg_cache.get
+        gens_get = tree._node_gen.get
+        append = feasible.append
+        unsynced = self._unsynced  # mutated in place by lazy syncs
+        rejected: List[str] = []
+        probes = 0
+        for k in range(n_names):
+            name = names[(start + k) % n_names]
+            consumed += 1
+            if name in anchor_nodes:
+                continue  # examined above
+            scans += 1
+            if unsynced and name in unsynced:
+                # per-candidate detour, not a cluster-wide fallback:
+                # filter() runs the lazy inventory sync for THIS node
+                # while every synced candidate stays on the index
+                fit, reason = self.filter(pod, req, name)
+                if fit:
+                    append(name)
+                    if len(feasible) >= target:
+                        break
+                elif reason:
+                    reasons.append(reason)
+                continue
+            if needs_port:
+                pool = ports_get(name)
+                if pool is not None and pool.full():
+                    rejected.append(name)
+                    continue
+            if rmodel:
+                models = one_model
+            else:
+                entry = bound_get(name)
+                models = entry[2] if entry is not None else \
+                    models_on_node(name)
+            fit = False
+            for m in models:
+                probes += 1
+                agg = agg_get((name, m))
+                if agg is None or agg.gen != gens_get(name, 0):
+                    agg = node_model_agg(name, m)
+                if is_multi:
+                    if agg.multi_chip_fits(chips_n, memory):
+                        fit = True
+                        break
+                    continue
+                # inlined agg.shared_fits: the single-point frontier is
+                # the overwhelmingly common shape (a node whose free
+                # leaves are interchangeable), and this loop runs per
+                # candidate per pod
+                frontier = agg.frontier
+                if frontier:
+                    avail, mem = frontier[0]
+                    if avail >= request_floor and mem >= memory:
+                        fit = True
+                        break
+                    if len(frontier) > 1 and agg.shared_fits(
+                        request, memory
+                    ):
+                        fit = True
+                        break
+            if tree.check_aggregates:
+                # differential oracle for the INLINE loop itself, not
+                # just the aggregates it reads: every verdict must
+                # match the full filter() hook chain (port pool, hold
+                # resolution, node_fits dispatch) it shortcuts
+                ref_fit, _ = self.filter(pod, req, name)
+                assert fit == ref_fit, (
+                    f"inline Filter loop diverged from filter() on "
+                    f"{name}: kind={req.kind} model={rmodel!r} "
+                    f"inline={fit} filter={ref_fit}"
+                )
+            if fit:
+                append(name)
+                if len(feasible) >= target:
+                    break
+            else:
+                rejected.append(name)
+        tree.filter_fast_hits += probes
+        if not feasible and rejected:
+            # cold path: reconstruct the rejection strings the hot
+            # loop skipped (they only surface in the unschedulable
+            # Decision, i.e. when nothing fit)
+            for name in rejected:
+                if needs_port and self._node_ports(name).full():
+                    reasons.append(
+                        f"node {name}: pod-manager port pool full"
+                    )
+                elif rmodel and rmodel not in models_on_node(name):
+                    reasons.append(f"node {name} has no {rmodel} chips")
+                else:
+                    reasons.append(
+                        f"node {name} cannot fit request={request} "
+                        f"mem={memory}"
+                    )
+        return feasible, reasons, scans, consumed
 
     def _held_leaves(self, pod: Pod, req, node_name: str):
         """Leaves on ``node_name`` this pod must treat as nonexistent:
@@ -900,6 +1152,34 @@ class TpuShareScheduler:
                 "tpu_scheduler_filter_attempts_total", {},
                 self.filter_attempts,
             ),
+            # incremental feasibility index + score memo health: slow
+            # walks should only tick while defrag holds are live, and
+            # a cold score cache (hits ~ 0 under steady traffic) means
+            # generations are churning faster than placements
+            expfmt.Sample(
+                "tpu_scheduler_filter_fast_hits_total", {},
+                self.tree.filter_fast_hits,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_filter_slow_walks_total", {},
+                self.tree.filter_slow_walks,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_score_cache_hits_total", {},
+                self.score_cache_hits,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_score_cache_misses_total", {},
+                self.score_cache_misses,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_index_invalidations_total", {},
+                self.tree.agg_invalidations,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_index_rebuilds_total", {},
+                self.tree.agg_rebuilds,
+            ),
         ]
         for node in self.tree.nodes():
             # non-caching read: this runs on the metrics HTTP thread,
@@ -960,11 +1240,18 @@ class TpuShareScheduler:
         self._drop_defrag_holds(pod.key)
 
     def _ensure_synced(self, node_name: str) -> None:
-        if node_name not in self._synced_nodes:
-            for node in self.cluster.list_nodes():
-                if node.name == node_name:
-                    self._on_node_update(node)
-                    return
+        if node_name not in self._unsynced:
+            return
+        get_node = getattr(self.cluster, "get_node", None)
+        if get_node is not None:
+            node = get_node(node_name)
+            if node is not None:
+                self._on_node_update(node)
+            return
+        for node in self.cluster.list_nodes():
+            if node.name == node_name:
+                self._on_node_update(node)
+                return
 
     def _release(self, status: PodStatus) -> None:
         req = status.requirements

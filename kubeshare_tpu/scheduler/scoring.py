@@ -43,6 +43,17 @@ SEED_WEIGHT = 2.0
 Anchor = Union[Cell, str]
 
 
+def anchor_fingerprint(anchors: Sequence[Anchor]) -> Tuple[str, ...]:
+    """Stable identity of an anchor set, for the scheduler's node-score
+    memo: two score calls with equal fingerprints (and equal node
+    generations) are guaranteed the same locality penalties. Both
+    anchor forms fingerprint as a cell id — locality is a function of
+    cell position only (``ici_distance`` reads torus coordinates, which
+    are assigned at tree build and never move), so which physical chip
+    currently occupies the cell is irrelevant."""
+    return tuple(a if isinstance(a, str) else a.id for a in anchors)
+
+
 def regular_pod_node_score(tree: CellTree, node: str) -> float:
     return 0.0 if tree.leaves_view(node) else 100.0
 
@@ -199,6 +210,27 @@ def normalize_scores(scores: dict) -> dict:
         return {k: int(v) for k, v in scores.items()}
     span = (hi - lo) or 100.0
     return {k: int(100.0 * (v - lo) / span) for k, v in scores.items()}
+
+
+def pick_best(scores: dict) -> str:
+    """The winning node under NormalizeScore semantics, without
+    materializing the normalized dict: the int() truncation is part of
+    the contract (near-equal raw scores collapse to the same bucket
+    and the name decides), so this must stay bit-equal to
+    ``max(scores, key=lambda n: (normalize_scores(scores)[n], n))`` —
+    tests/test_scheduler_index.py pins the equivalence."""
+    values = scores.values()
+    lo, hi = min(values), max(values)
+    shift = -lo if lo < 0 else 0.0
+    hi += shift
+    lo = 0.0 if shift else lo
+    if hi <= 100:
+        return max(scores, key=lambda n: (int(scores[n] + shift), n))
+    span = (hi - lo) or 100.0
+    return max(
+        scores,
+        key=lambda n: (int(100.0 * (scores[n] + shift - lo) / span), n),
+    )
 
 
 def select_leaves(

@@ -40,20 +40,43 @@ class PodStatus:
 class PodStatusStore:
     def __init__(self):
         self._status: Dict[str, PodStatus] = {}
+        # group_key -> {pod key -> status}: gang-anchor lookups
+        # (group_placed_leaves) run once per scheduling cycle, so a
+        # scan over every live status put O(cluster pods) on the hot
+        # path; the index makes it O(group size). Maintained by
+        # put/pop — group_key is fixed at PodStatus construction.
+        self._by_group: Dict[str, Dict[str, PodStatus]] = {}
 
     def get(self, key: str) -> Optional[PodStatus]:
         return self._status.get(key)
 
     def put(self, status: PodStatus) -> None:
+        prior = self._status.get(status.key)
+        if prior is not None and prior.group_key != status.group_key:
+            self._drop_from_group(prior)
         self._status[status.key] = status
+        if status.group_key:
+            self._by_group.setdefault(status.group_key, {})[
+                status.key
+            ] = status
 
     def pop(self, key: str) -> Optional[PodStatus]:
-        return self._status.pop(key, None)
+        status = self._status.pop(key, None)
+        if status is not None:
+            self._drop_from_group(status)
+        return status
+
+    def _drop_from_group(self, status: PodStatus) -> None:
+        members = self._by_group.get(status.group_key)
+        if members is not None:
+            members.pop(status.key, None)
+            if not members:
+                self._by_group.pop(status.group_key, None)
 
     def in_group(self, group_key: str) -> List[PodStatus]:
         if not group_key:
             return []
-        return [s for s in self._status.values() if s.group_key == group_key]
+        return list(self._by_group.get(group_key, {}).values())
 
     def group_placed_leaves(self, group_key: str) -> List[Cell]:
         """Leaf cells already held by members of a gang — the locality

@@ -24,11 +24,19 @@ class Bitmap:
             raise ValueError(f"bitmap size must be positive, got {size}")
         self._size = size
         self._words = [0] * ((size + _WORD - 1) // _WORD)
+        self._set_bits = 0  # live popcount: full()/count() stay O(1)
         self._lock = threading.Lock()
 
     @property
     def size(self) -> int:
         return self._size
+
+    def full(self) -> bool:
+        """O(1): every slot taken. The scheduler's Filter asks this
+        once per examined node per pod, so the O(size) scan
+        ``find_next_from_current`` used to do the same job showed up
+        at cluster scale."""
+        return self._set_bits >= self._size
 
     def _check(self, idx: int) -> None:
         if not 0 <= idx < self._size:
@@ -41,16 +49,18 @@ class Bitmap:
     def set(self, idx: int, value: bool = True) -> None:
         self._check(idx)
         with self._lock:
+            was = bool(self._words[idx // _WORD] >> (idx % _WORD) & 1)
             if value:
                 self._words[idx // _WORD] |= 1 << (idx % _WORD)
             else:
                 self._words[idx // _WORD] &= ~(1 << (idx % _WORD))
+            self._set_bits += int(value) - int(was)
 
     def clear(self, idx: int) -> None:
         self.set(idx, False)
 
     def count(self) -> int:
-        return sum(bin(w).count("1") for w in self._words)
+        return self._set_bits
 
     def find_first_clear(self) -> int:
         """Index of the lowest unset bit, or -1 if full."""
@@ -91,6 +101,7 @@ class RRBitmap(Bitmap):
                 idx = (self._cursor + off) % self._size
                 if not self._words[idx // _WORD] >> (idx % _WORD) & 1:
                     self._words[idx // _WORD] |= 1 << (idx % _WORD)
+                    self._set_bits += 1
                     self._cursor = idx
                     return idx
             return -1

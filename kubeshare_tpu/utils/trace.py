@@ -23,9 +23,8 @@ from __future__ import annotations
 import json
 import threading
 import time
-from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterator, List, Mapping, Optional, Tuple
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
 
 from . import expfmt
 
@@ -99,6 +98,28 @@ class SpanEvent:
     args: Dict[str, str] = field(default_factory=dict)
 
 
+class _Span:
+    """Timing context handed out by :meth:`Tracer.span`."""
+
+    __slots__ = ("tracer", "name", "args", "start")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Mapping[str, str]):
+        self.tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self.start = self.tracer.clock()
+        return None
+
+    def __exit__(self, *exc):
+        tracer = self.tracer
+        tracer.record(
+            self.name, self.start, tracer.clock() - self.start, self.args
+        )
+        return False
+
+
 class Tracer:
     """Bounded span recorder + per-name latency histograms.
 
@@ -126,13 +147,12 @@ class Tracer:
 
     # -- recording ----------------------------------------------------
 
-    @contextmanager
-    def span(self, name: str, **args: str) -> Iterator[None]:
-        start = self.clock()
-        try:
-            yield
-        finally:
-            self.record(name, start, self.clock() - start, args)
+    def span(self, name: str, **args: str) -> "_Span":
+        """Context manager timing one span. A plain object, not a
+        @contextmanager generator: schedule_one enters five spans per
+        pod, and the generator protocol (create + send + throw) was
+        measurable on the engine hot path."""
+        return _Span(self, name, args)
 
     def record(
         self,
@@ -245,11 +265,25 @@ class Tracer:
         return out
 
 
-@contextmanager
+class _NoopSpan:
+    """Reusable no-op context manager: the tracer-less hot path pays
+    two attribute lookups, not a generator + contextmanager per span
+    (schedule_one enters five spans per pod)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
 def maybe_span(tracer: Optional[Tracer], name: str, **args: str):
-    """``tracer.span`` if a tracer is wired, else a no-op."""
+    """``tracer.span`` if a tracer is wired, else a shared no-op."""
     if tracer is None:
-        yield
-    else:
-        with tracer.span(name, **args):
-            yield
+        return _NOOP_SPAN
+    return tracer.span(name, **args)

@@ -2,11 +2,21 @@
 simulate --bench numbers previously lived only in commit messages).
 
 Two guards: the committed ENGINE_BENCH.json artifact must exist, be in
-the tool's shape, and record >= 3k placements/s @ 32 nodes (the round-1
-measured level); and a fresh in-process run must clear a conservative
-floor so a hot-path regression fails CI rather than silently shipping
-(floor is ~half the measured rate — CI boxes are noisy, while a real
-hot-path regression is usually 5-10x).
+the tool's shape, and clear absolute + scaling floors; and a fresh
+in-process run must clear a conservative floor so a hot-path
+regression fails CI rather than silently shipping (floor is ~half the
+measured rate — CI boxes are noisy, while a real hot-path regression
+is usually 5-10x).
+
+Floors were re-baselined for PR 1 (incremental feasibility index +
+score cache) on the PR-1 CI box, which is ~2x slower than the box that
+produced the round-1..5 artifacts (seed code idle: 2,222/s @ 32 nodes
+here vs 4,778/s committed). The number that is machine-independent is
+the SCALING RATIO — 1024-node rate / 32-node rate — which the index
+moved from 0.33 (seed, same box) to ~0.6-0.8 (run-to-run box
+variance); the committed-artifact
+assertions therefore lean on ratios, with absolute floors as a
+secondary sanity net.
 """
 
 import json
@@ -21,65 +31,129 @@ from engine_bench import run  # noqa: E402
 
 ARTIFACT = os.path.join(REPO, "ENGINE_BENCH.json")
 
+COUNTERS = (
+    "filter_fast_hits",
+    "filter_slow_walks",
+    "index_invalidations",
+    "index_rebuilds",
+    "score_cache_hits",
+    "score_cache_misses",
+)
+
 
 class TestCommittedArtifact:
     def test_exists_and_well_formed(self):
         doc = json.load(open(ARTIFACT))
         assert doc["generated_by"] == "tools/engine_bench.py"
         by_nodes = {r["nodes"]: r for r in doc["results"]}
-        assert set(by_nodes) == {32, 128, 512, 1024}
+        assert set(by_nodes) == {32, 128, 512, 1024, 2048}
         for r in doc["results"]:
             assert r["placements_per_sec"] > 0
             assert r["bound"] > 0
+            for key in COUNTERS:
+                assert key in r["counters"], (r["nodes"], key)
+        assert doc["scaling_ratio_1024_over_32"] > 0
+
+    def test_recorded_counters_prove_fast_path_engaged(self):
+        """The index must actually answer Filter: a silently-disabled
+        fast path (every query routed to the leaves_view walk) would
+        still produce plausible wall times on a small box, so the
+        counters are the artifact's proof of mechanism. Slow walks are
+        defrag-hold-only and the synthetic trace holds rarely."""
+        doc = json.load(open(ARTIFACT))
+        for r in doc["results"]:
+            c = r["counters"]
+            assert c["filter_fast_hits"] > 0, r["nodes"]
+            assert c["score_cache_hits"] > 0, r["nodes"]
+            assert c["filter_slow_walks"] <= c["filter_fast_hits"] * 0.05
+            # lazy rebuilds, not per-query: rebuilds << fast hits
+            assert c["index_rebuilds"] < c["filter_fast_hits"] * 0.5
 
     def test_recorded_floor_32_nodes(self):
         doc = json.load(open(ARTIFACT))
         [r32] = [r for r in doc["results"] if r["nodes"] == 32]
-        assert r32["placements_per_sec"] >= 3000, (
-            "committed engine bench fell below the round-1 level; "
-            "investigate before regenerating ENGINE_BENCH.json"
+        assert r32["placements_per_sec"] >= 2000, (
+            "committed engine bench fell below the PR-1 baseline "
+            "(2,5-3,5k/s measured range); investigate before regenerating "
+            "ENGINE_BENCH.json"
         )
 
     def test_recorded_floor_512_nodes(self):
-        """Pod-slice scale (2048 chips) must hold >= 1k placements/s
-        (VERDICT r2 #7); feasible-node sampling is what buys this."""
+        """Pod-slice scale (2048 chips): sampling bought >= 1k/s
+        (VERDICT r2 #7); the feasibility index roughly doubles it
+        (1,009 -> ~2,000-2,600/s seed vs PR 1, same box)."""
         doc = json.load(open(ARTIFACT))
         [r512] = [r for r in doc["results"] if r["nodes"] == 512]
-        assert r512["placements_per_sec"] >= 1000, (
+        assert r512["placements_per_sec"] >= 1500, (
             "committed 512-node engine bench fell below the floor; "
             "investigate before regenerating ENGINE_BENCH.json"
         )
 
     def test_recorded_floor_1024_nodes(self):
-        """Sampling bounds per-pod cost, so the rate must stay
-        near-flat from 512 to 1024 nodes (4096 chips): assert the
-        RELATIVE bound (an O(nodes) regression would halve the rate
-        at 2x scale, which an absolute floor could miss) plus the
-        absolute floor."""
+        """The index bounds steady-state per-pod cost by O(examined
+        candidates), so the rate must stay near-flat from 512 to 1024
+        nodes (4096 chips): assert the RELATIVE bound (an O(nodes)
+        regression would halve the rate at 2x scale, which an absolute
+        floor could miss) plus the absolute floor (~3x the seed's
+        722/s on this box)."""
         doc = json.load(open(ARTIFACT))
         [r1k] = [r for r in doc["results"] if r["nodes"] == 1024]
         [r512] = [r for r in doc["results"] if r["nodes"] == 512]
-        assert r1k["placements_per_sec"] >= 1000
+        assert r1k["placements_per_sec"] >= 1500
         assert r1k["placements_per_sec"] >= 0.6 * r512["placements_per_sec"], (
             "1024-node rate fell far below the 512-node rate — "
             "per-pod cost is growing with cluster size again"
         )
 
+    def test_recorded_scaling_ratio(self):
+        """The headline: 1024-node placements/s within 2x of the
+        32-node rate (ratio >= 0.5). Seed measured 0.33 on this box /
+        0.38 on the round-5 box; the feasibility index + score cache
+        hold ~0.6-0.8. Asserted from the row data, not the convenience
+        field — which must agree with the rows it summarizes."""
+        doc = json.load(open(ARTIFACT))
+        by_nodes = {r["nodes"]: r for r in doc["results"]}
+        ratio = (
+            by_nodes[1024]["placements_per_sec"]
+            / by_nodes[32]["placements_per_sec"]
+        )
+        assert ratio >= 0.5, (
+            f"scaling ratio {ratio:.2f}: per-pod cost is growing with "
+            "cluster size again (index bypassed or invalidation storm)"
+        )
+        assert abs(doc["scaling_ratio_1024_over_32"] - ratio) < 0.01
+
+    def test_recorded_floor_2048_nodes(self):
+        """8192 chips — the row PR 1 added: even at 2x the previous
+        max scale the engine must beat the seed's 1024-node rate
+        (722/s on this box)."""
+        doc = json.load(open(ARTIFACT))
+        [r2k] = [r for r in doc["results"] if r["nodes"] == 2048]
+        assert r2k["placements_per_sec"] >= 1000
+
 
 class TestFreshRunFloor:
     def test_live_floor_32_nodes(self):
         r = run(32, events=600)
-        assert r["placements_per_sec"] >= 2000, (
+        assert r["placements_per_sec"] >= 1200, (
             f"engine hot path regressed: {r['placements_per_sec']:.0f} "
             "placements/s @ 32 nodes (committed artifact has "
-            ">= 3000; floor leaves CI-noise margin)"
+            ">= 2000; floor leaves CI-noise margin)"
         )
 
     def test_live_floor_512_nodes(self):
-        """Catches an O(nodes)-per-pod regression (e.g. sampling
-        accidentally disabled): unsampled, this runs ~125/s."""
-        r = run(512, events=300)
-        assert r["placements_per_sec"] >= 700, (
+        """Catches an O(nodes)-per-pod regression (e.g. sampling or
+        the feasibility index accidentally disabled): unsampled this
+        runs ~125/s, and the seed's walk-per-node Filter ran ~1,000/s
+        on this box where the index holds ~2,000/s. 1000 events, not
+        300: at index speed 300 events is ~0.15s of wall — short
+        enough that one GC pause or scheduler hiccup halves the
+        measured rate (observed flaking in-suite at events=300)."""
+        r = run(512, events=1000)
+        assert r["placements_per_sec"] >= 1000, (
             f"engine hot path regressed at scale: "
             f"{r['placements_per_sec']:.0f} placements/s @ 512 nodes"
         )
+        c = r["counters"]
+        assert c["filter_fast_hits"] > 0
+        assert c["score_cache_hits"] > 0

@@ -1,0 +1,356 @@
+"""Differential tests for the incremental feasibility index.
+
+The scheduler's Filter fast path answers shared_fit/multi_chip_fit
+from per-(node, model) aggregates (cell.py NodeModelAgg) that are
+rebuilt only when the node's generation counter moves. These tests
+drive randomized reserve / reclaim / health-flip / rebind / hold
+sequences and assert, after every mutation, that the O(1) aggregate
+answer is bit-identical to the exhaustive ``leaves_view`` walk — the
+walk is the oracle the fast path must never diverge from. Seeded, no
+JAX, tier-1 fast.
+"""
+
+import random
+
+import pytest
+
+from kubeshare_tpu.cells import CellTree, ChipInfo, load_topology
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.filtering import (
+    multi_chip_fit,
+    multi_chip_fit_walk,
+    shared_fit,
+    shared_fit_walk,
+)
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+from kubeshare_tpu.scheduler.scoring import normalize_scores, pick_best
+
+GIB = 1 << 30
+
+HETERO = {
+    "cell_types": {
+        "v5e-node": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 50,
+            "is_node_level": True,
+        },
+        "v5p-node": {
+            "child_cell_type": "tpu-v5p",
+            "child_cell_number": 4,
+            "child_cell_priority": 100,
+            "is_node_level": True,
+        },
+    },
+    "cells": [
+        {"cell_type": "v5e-node", "cell_id": "lite-1"},
+        {"cell_type": "v5e-node", "cell_id": "lite-2"},
+        {"cell_type": "v5p-node", "cell_id": "perf-1"},
+    ],
+}
+
+NODES = {"lite-1": "tpu-v5e", "lite-2": "tpu-v5e", "perf-1": "tpu-v5p"}
+MODELS = ("tpu-v5e", "tpu-v5p")
+
+# probe grid: fractions straddle typical leaf availabilities, memories
+# straddle the 8/16 GiB chip sizes, chip counts straddle the 4-per-node
+REQUESTS = (0.25, 0.5, 0.75, 1.0)
+MEMORIES = (1 * GIB, 6 * GIB, 12 * GIB, 20 * GIB)
+CHIPS = (1, 2, 4, 5)
+
+
+def chips_for(node, model, n=4, mem=16 * GIB):
+    return [
+        ChipInfo(uuid=f"{node}-chip-{i}", model=model, memory=mem, index=i)
+        for i in range(n)
+    ]
+
+
+def build_tree():
+    tree = CellTree(load_topology(HETERO))
+    for node, model in NODES.items():
+        # heterogeneous HBM so free-memory and available disagree on
+        # which leaf is "best" — the case a single-max aggregate
+        # (instead of the Pareto frontier) gets wrong
+        tree.bind_node(
+            node,
+            chips_for(node, model, mem=8 * GIB)[:2]
+            + chips_for(node, model)[2:],
+        )
+    return tree
+
+
+def assert_agreement(tree, exclude=frozenset()):
+    """Every (node, model, probe) point: fast path == exhaustive walk.
+
+    With ``exclude`` empty this exercises the aggregate path (and the
+    in-tree ``check_aggregates`` assert fires on any divergence too);
+    with holds live both sides take the walk, pinning that the hold
+    slow path stays wired.
+    """
+    for node in NODES:
+        for model in MODELS:
+            for mem in MEMORIES:
+                for req in REQUESTS:
+                    assert shared_fit(
+                        tree, node, model, req, mem, exclude
+                    ) == shared_fit_walk(
+                        tree, node, model, req, mem, exclude
+                    ), (node, model, req, mem, sorted(exclude))
+                for n in CHIPS:
+                    assert multi_chip_fit(
+                        tree, node, model, n, mem, exclude
+                    ) == multi_chip_fit_walk(
+                        tree, node, model, n, mem, exclude
+                    ), (node, model, n, mem, sorted(exclude))
+
+
+class TestAggregateDifferential:
+    def test_fresh_tree_agrees(self):
+        tree = build_tree()
+        tree.check_aggregates = True
+        assert_agreement(tree)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_randomized_mutation_sequence(self, seed):
+        """200 random reserve/reclaim/health/rebind/hold ops; after
+        each, the aggregate fast path must match the walk on the full
+        probe grid. check_aggregates doubles every fast query with the
+        in-tree assert as well."""
+        rng = random.Random(seed)
+        tree = build_tree()
+        tree.check_aggregates = True
+        reservations = []  # (leaf, request, memory)
+        holds = set()      # uuids a live defrag hold excludes
+        down = set()
+        for _ in range(200):
+            op = rng.random()
+            if op < 0.35:
+                node = rng.choice(list(NODES))
+                free = [
+                    l for l in tree.leaves_on_node(node)
+                    if l.healthy and l.available > 0
+                ]
+                if free:
+                    leaf = rng.choice(free)
+                    request = rng.choice(
+                        [f for f in REQUESTS if f <= leaf.available + 1e-9]
+                    )
+                    memory = min(
+                        leaf.free_memory,
+                        rng.choice((1 * GIB, 4 * GIB, 8 * GIB)),
+                    )
+                    tree.reserve(leaf, request, memory)
+                    reservations.append((leaf, request, memory))
+            elif op < 0.60 and reservations:
+                leaf, request, memory = reservations.pop(
+                    rng.randrange(len(reservations))
+                )
+                tree.reclaim(leaf, request, memory)
+            elif op < 0.72:
+                node = rng.choice(list(NODES))
+                if node in down:
+                    tree.set_node_health(node, True)
+                    down.discard(node)
+                else:
+                    tree.set_node_health(node, False)
+                    down.add(node)
+            elif op < 0.82:
+                # rebind with an HBM correction on chip 0: exercises
+                # the bind_node delta path's generation bump
+                node = rng.choice(list(NODES))
+                if node in down or any(
+                    l.node == node for l, _, _ in reservations
+                ):
+                    continue
+                batch = chips_for(node, NODES[node])
+                batch[0] = ChipInfo(
+                    uuid=batch[0].uuid,
+                    model=batch[0].model,
+                    memory=rng.choice((8 * GIB, 16 * GIB)),
+                    index=batch[0].index,
+                )
+                tree.bind_node(node, batch)
+            elif op < 0.92:
+                node = rng.choice(list(NODES))
+                bound = tree.leaves_on_node(node)
+                if bound and rng.random() < 0.5:
+                    holds.add(rng.choice(bound).uuid)
+                elif holds:
+                    holds.discard(rng.choice(sorted(holds)))
+            else:
+                holds.clear()
+            assert_agreement(tree)
+            if holds:
+                assert_agreement(tree, frozenset(holds))
+        # fast path actually ran (not everything routed to the walk)
+        assert tree.filter_fast_hits > 0
+        if holds:
+            assert tree.filter_slow_walks > 0
+
+    def test_counters_split_fast_vs_slow(self):
+        tree = build_tree()
+        shared_fit(tree, "lite-1", "tpu-v5e", 0.5, GIB)
+        assert (tree.filter_fast_hits, tree.filter_slow_walks) == (1, 0)
+        held = frozenset({"lite-1-chip-0"})
+        shared_fit(tree, "lite-1", "tpu-v5e", 0.5, GIB, held)
+        assert (tree.filter_fast_hits, tree.filter_slow_walks) == (1, 1)
+
+    def test_rebuild_only_on_generation_move(self):
+        tree = build_tree()
+        tree.node_model_agg("lite-1", "tpu-v5e")
+        rebuilds = tree.agg_rebuilds
+        tree.node_model_agg("lite-1", "tpu-v5e")  # cached
+        assert tree.agg_rebuilds == rebuilds
+        leaf = tree.leaves_on_node("lite-1")[0]
+        tree.reserve(leaf, 0.5, GIB)
+        tree.node_model_agg("lite-1", "tpu-v5e")  # gen moved
+        assert tree.agg_rebuilds == rebuilds + 1
+        # the untouched node's aggregate is NOT invalidated
+        before = tree.agg_rebuilds
+        tree.node_model_agg("lite-2", "tpu-v5e")
+        tree.node_model_agg("lite-2", "tpu-v5e")
+        assert tree.agg_rebuilds == before + 1
+
+
+SCHED_TOPO = {
+    "cell_types": {
+        "v5e-node": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 50,
+            "is_node_level": True,
+            "torus": [2, 2],
+        },
+    },
+    "cells": [
+        {"cell_type": "v5e-node", "cell_id": f"n{i:02d}"} for i in range(8)
+    ],
+}
+
+
+def sched_pod(name, request, priority=0):
+    labels = {
+        C.LABEL_TPU_REQUEST: str(request),
+        C.LABEL_TPU_LIMIT_ALIASES[1]: str(max(request, 1.0)),
+    }
+    if priority:
+        labels[C.LABEL_PRIORITY] = str(priority)
+    return Pod(name=name, namespace="default", labels=labels,
+               scheduler_name=C.SCHEDULER_NAME)
+
+
+class TestInlineFilterOracle:
+    def test_schedule_cycle_inline_loop_matches_filter(self):
+        """The plugin's inlined fast Filter loop (_filter_candidates)
+        is a third implementation of the fit check; with
+        check_aggregates set it asserts every per-node verdict against
+        the full filter() hook chain, so driving mixed traffic +
+        churn through schedule_one exercises that oracle end-to-end —
+        a divergence raises inside this loop."""
+        cluster = FakeCluster()
+        for i in range(8):
+            cluster.add_node(f"n{i:02d}", chips_for(f"n{i:02d}", "tpu-v5e"))
+        sched = TpuShareScheduler(SCHED_TOPO, cluster, clock=lambda: 0.0)
+        sched.tree.check_aggregates = True
+        rng = random.Random(11)
+        bound, live = 0, []
+        for i in range(120):
+            if rng.random() < 0.7:
+                pod = sched_pod(f"s{i}", rng.choice((0.25, 0.5, 1.0)))
+            else:
+                pod = sched_pod(f"m{i}", rng.choice((2, 4)), priority=100)
+            p = cluster.create_pod(pod)
+            if sched.schedule_one(p).status == "bound":
+                bound += 1
+                live.append(p)
+            if live and rng.random() < 0.4:
+                cluster.delete_pod(live.pop(rng.randrange(len(live))).key)
+            if rng.random() < 0.08:
+                n = f"n{rng.randrange(8):02d}"
+                cluster.set_node_ready(n, not cluster.get_node(n).healthy)
+        assert bound > 60  # the oracle actually saw placements
+        assert sched.tree.filter_fast_hits > 0
+
+    def test_one_unsyncable_node_does_not_disable_fast_path(self):
+        """A node whose inventory collector is permanently down stays
+        in _unsynced forever; the inline loop must detour through
+        filter() for THAT candidate only — not fall back to the slow
+        hook chain for the whole cluster (the regression would erode
+        the index's entire win whenever any one collector is down)."""
+        cluster = FakeCluster()
+        for i in range(8):
+            cluster.add_node(f"n{i:02d}", chips_for(f"n{i:02d}", "tpu-v5e"))
+
+        def inventory(node):
+            if node == "n03":
+                raise OSError("collector down")
+            return cluster.chips_on_node(node)
+
+        sched = TpuShareScheduler(SCHED_TOPO, cluster, clock=lambda: 0.0,
+                                  inventory=inventory)
+        assert "n03" in sched._unsynced
+        bound = 0
+        for i in range(14):
+            d = sched.schedule_one(
+                cluster.create_pod(sched_pod(f"p{i}", 1.0))
+            )
+            bound += d.status == "bound"
+        assert bound == 14  # 7 healthy nodes x 4 chips cover this
+        assert "n03" in sched._unsynced  # still down, still pending
+        # the aggregate fast path served the synced candidates
+        assert sched.tree.filter_fast_hits > 0
+        assert sched.score_cache_hits + sched.score_cache_misses > 0
+
+    def test_score_cache_outer_dict_bounded(self):
+        """Every distinct gang anchor set mints a new shape key, so
+        the OUTER memo dict must be bounded too (the inner 1<<16 cap
+        alone leaks under weeks of gang churn)."""
+        cluster = FakeCluster()
+        cluster.add_node("n00", chips_for("n00", "tpu-v5e"))
+        sched = TpuShareScheduler(
+            {
+                "cell_types": SCHED_TOPO["cell_types"],
+                "cells": [{"cell_type": "v5e-node", "cell_id": "n00"}],
+            },
+            cluster, clock=lambda: 0.0,
+        )
+        for i in range(1024):
+            sched._score_cache[("fake", str(i), True, ())] = {}
+        sched.schedule_one(cluster.create_pod(sched_pod("p", 0.5)))
+        assert len(sched._score_cache) < 1024
+
+
+class TestPickBest:
+    """pick_best must stay bit-equal to the NormalizeScore-then-max
+    contract it replaces (scoring.py docstring pins this file)."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_matches_normalize_then_max(self, seed):
+        rng = random.Random(100 + seed)
+        for _ in range(200):
+            n = rng.randrange(1, 12)
+            scale = rng.choice((1.0, 50.0, 1000.0))
+            scores = {
+                f"node-{i:02d}": round(
+                    rng.uniform(-scale, scale), rng.choice((0, 1, 3))
+                )
+                for i in range(n)
+            }
+            normalized = normalize_scores(scores)
+            expected = max(scores, key=lambda k: (normalized[k], k))
+            assert pick_best(scores) == expected, scores
+
+    def test_tie_breaks_by_name(self):
+        assert pick_best({"b": 1.0, "a": 1.0, "c": 1.0}) == "c"
+
+    def test_near_equal_raw_scores_collapse_like_normalize(self):
+        # int() truncation makes 10.2 and 10.9 the same bucket; the
+        # name then decides — exactly what normalize_scores+max does
+        scores = {"a": 10.9, "b": 10.2}
+        normalized = normalize_scores(scores)
+        assert pick_best(scores) == max(
+            scores, key=lambda k: (normalized[k], k)
+        )

@@ -148,6 +148,21 @@ class TestDecodeServer:
         assert stream == solo_stream([7, 11, 2], 4, slots=1,
                                      buckets=(8,))
 
+    def test_oversized_prompt_returns_none_not_valueerror(self):
+        """admit()'s rejection contract: None for anything that cannot
+        be admitted — pool full OR prompt beyond the largest bucket —
+        so a serving loop written against 'None = cannot admit' never
+        crashes on a long request. Only the empty prompt (a caller
+        bug) raises."""
+        server = DecodeServer(PARAMS, CFG, slots=1, prompt_buckets=(8,))
+        assert server.admit(list(range(1, 10))) is None  # 9 > bucket 8
+        assert server.free_slots() == 1  # rejection consumed no slot
+        s, _ = server.admit([5, 9])  # pool still fully usable
+        assert s == 0
+        assert server.admit([1, 2]) is None  # pool full
+        with pytest.raises(ValueError):
+            server.admit([])
+
     def test_max_new_auto_retires(self):
         server = DecodeServer(PARAMS, CFG, slots=2,
                               prompt_buckets=(8,), max_new=3)

@@ -50,16 +50,25 @@ EOF
             # commit only when a row was actually appended; retry is
             # for index.lock contention with the build session
             if [ "$(count)" -gt "$before" ]; then
+                committed=0
                 for _ in 1 2 3 4 5; do
                     if git add "$HIST" 2>/dev/null \
                        && git commit -m "Bank headline session capture $(count)" \
                               -m "No-Verification-Needed: artifact-only evidence banking commit" \
                               --only "$HIST" >/dev/null 2>&1; then
                         log "committed capture (history now $(count) rows)"
+                        committed=1
                         break
                     fi
                     sleep 10
                 done
+                if [ "$committed" -eq 0 ]; then
+                    # unstage on retry exhaustion: a leftover staged
+                    # HIST would be silently absorbed by the concurrent
+                    # build session's next commit
+                    git reset -q -- "$HIST" 2>/dev/null || true
+                    log "commit retries exhausted; capture left uncommitted (unstaged $HIST)"
+                fi
             fi
         else
             log "bench.py failed/timed out this window"
