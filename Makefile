@@ -21,6 +21,11 @@ engine-bench:
 sim-replay:
 	$(PYTHON) tools/sim_replay.py
 
+# multi-tenant skew replay through the quota plane -> FAIRNESS.json
+# (cluster Jain index + per-tenant shares + the reclaim proof)
+fairness-sim:
+	$(PYTHON) tools/fairness_sim.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -65,4 +70,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay fairness-sim dryrun images push save kind-e2e perf-evidence clean

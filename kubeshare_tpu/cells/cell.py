@@ -303,6 +303,13 @@ class CellTree:
         # /metrics so the fast/slow split is observable.
         self._node_gen: Dict[str, int] = {}
         self._agg_cache: Dict[Tuple[str, str], NodeModelAgg] = {}
+        # Total HBM across bound leaves, maintained by the same
+        # bind/unbind/HBM-correction walks that bump generations: the
+        # quota plane's capacity denominator must be O(1) per read
+        # (it sits in queue-sort and admission hot paths), and the
+        # root full_memory aggregates are O(roots) on one-cell-per-node
+        # topologies.
+        self.total_full_memory = 0
         self.filter_fast_hits = 0   # O(1) aggregate answers
         self.filter_slow_walks = 0  # exhaustive walks (defrag holds)
         self.agg_rebuilds = 0       # aggregate recomputes (gen moved)
@@ -404,6 +411,7 @@ class CellTree:
                                 delta = chip.memory - leaf.full_memory
                                 leaf.full_memory += delta
                                 leaf.free_memory += delta
+                                self.total_full_memory += delta
                                 self._propagate(leaf, 0.0, 0, delta, delta)
                                 self._bump_generation(leaf.node)
                             pool.pop(i)
@@ -478,6 +486,7 @@ class CellTree:
         leaf.available_whole_cell = 1
         leaf.state = CellState.BOUND
         self.leaf_cells[chip.uuid] = leaf
+        self.total_full_memory += chip.memory
         self._propagate(leaf, 1.0, 1, chip.memory, chip.memory)
         self._set_health(leaf, True)
         # invalidate only after the state flip: a recompute racing this
@@ -496,6 +505,7 @@ class CellTree:
             -leaf.free_memory,
             -leaf.full_memory,
         )
+        self.total_full_memory -= leaf.full_memory
         self.leaf_cells.pop(leaf.uuid, None)
         leaf.uuid = ""
         leaf.available = 0.0

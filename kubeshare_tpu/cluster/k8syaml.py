@@ -74,6 +74,31 @@ def pods_from_manifest(doc: dict) -> List[Pod]:
     return []
 
 
+def tenant_config_from_manifest(doc: dict):
+    """Extract a tenant-quota mapping from one manifest document, or
+    None when the document carries no tenant config. Two shapes are
+    understood: a plain ``{tenants: {...}}`` mapping (offline/sim
+    configs), and a ConfigMap whose ``data.tenants`` holds the same
+    mapping as YAML text — the k8s-native delivery the scheduler
+    Deployment mounts. Validation of the specs themselves lives in
+    quota.tenant.TenantRegistry.from_config."""
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("kind", "") == "ConfigMap":
+        raw = (doc.get("data", {}) or {}).get("tenants")
+        if raw is None:
+            return None
+        parsed = yaml.safe_load(raw)
+        if not isinstance(parsed, dict):
+            raise ValueError(
+                "ConfigMap data.tenants must be a YAML mapping"
+            )
+        return parsed
+    if "tenants" in doc and not doc.get("kind"):
+        return {"tenants": doc["tenants"]}
+    return None
+
+
 def load_pods(path: str) -> List[Pod]:
     """All pods described by a (possibly multi-document) manifest file."""
     with open(path) as f:

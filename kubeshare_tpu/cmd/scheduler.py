@@ -100,6 +100,13 @@ def build_parser() -> argparse.ArgumentParser:
              "waits, goodput NOT recovered",
     )
     parser.add_argument(
+        "--tenants", default="", metavar="PATH",
+        help="tenant quota config (YAML mapping or ConfigMap manifest "
+             "with data.tenants): per-tenant fair-share weight, "
+             "guaranteed chip-fraction, borrow ceiling. Unset = every "
+             "tenant gets the permissive default (weight 1, no quota)",
+    )
+    parser.add_argument(
         "--percentage-of-nodes-to-score", type=int, default=0,
         help="stop filtering once this %% of nodes yielded feasible "
              "candidates (kube-scheduler analog); 0 = adaptive",
@@ -416,6 +423,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         defrag_eviction_rate=args.defrag_eviction_rate,
         percentage_of_nodes_to_score=args.percentage_of_nodes_to_score,
         min_feasible_nodes=args.min_feasible_nodes,
+        tenants=args.tenants or None,
     )
     elector = None
     if args.leader_elect:

@@ -1,0 +1,26 @@
+"""Cluster-level tenant quota & fair-share admission plane.
+
+The node-level arbiter already enforces weighted fair time-slicing
+within one host (runtime_native/arbiter_stress.cc holds Jain >= 0.9 at
+2:1:1); this package is its cluster-scale counterpart: Dominant
+Resource Fairness (Ghodsi et al., NSDI'11) queue ordering plus
+HiveD-style guaranteed-vs-opportunistic quota (Zhao et al., OSDI'20)
+over the fractional-TPU cell tree.
+
+- ``tenant``: who owns a pod (namespace by default, overridable via
+  the ``sharedtpu/tenant`` label) and what it is entitled to (weight,
+  guaranteed chip-fraction, borrow ceiling) — loaded from a plain
+  YAML mapping or a ConfigMap manifest.
+- ``ledger``: per-tenant usage over {chip-fraction, HBM}, fed by the
+  same reserve/reclaim/bind/unbind walks that bump the cell tree's
+  generation counters.
+- ``policy``: the QuotaPlane the scheduler talks to — weighted-DRF
+  queue ordering, the admission gate, reclaim victim preference, and
+  the per-tenant /metrics gauges.
+"""
+
+from .ledger import UsageLedger
+from .policy import QuotaPlane
+from .tenant import TenantRegistry, TenantSpec
+
+__all__ = ["QuotaPlane", "TenantRegistry", "TenantSpec", "UsageLedger"]

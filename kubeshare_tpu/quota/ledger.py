@@ -1,0 +1,98 @@
+"""Per-tenant usage ledger over {chip-fraction, HBM}.
+
+Charged and credited from the SAME plugin call sites whose
+reserve/reclaim walks bump the cell tree's per-node generation
+counters (plugin.reserve / _restore_bound_pod / _release), so the
+ledger can never drift from the tree: every accounting mutation goes
+through exactly one charge or one credit, and the charged amounts are
+stored on the PodStatus so the credit is exact even when the leaf
+state changed in between (vanished chips, HBM corrections).
+
+Guarantee-class usage (priority >= 1 pods) is tracked separately from
+total usage: guaranteed quota gates the former, the borrow ceiling
+gates the latter, and the difference between total usage and the
+tenant's guaranteed entitlement is what reclaim treats as *borrowed*.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable
+
+_EPS = 1e-9
+
+
+class UsageLedger:
+    def __init__(self):
+        self._chips: Dict[str, float] = {}      # total fractional chips
+        self._mem: Dict[str, int] = {}          # total HBM bytes
+        self._gchips: Dict[str, float] = {}     # guarantee-class chips
+        self._gmem: Dict[str, int] = {}         # guarantee-class HBM
+        self.reclaim_evictions: Dict[str, int] = {}  # beneficiary -> victims
+
+    def charge(self, tenant: str, chips: float, mem: int,
+               guarantee: bool) -> None:
+        if not tenant or (chips <= 0 and mem <= 0):
+            return
+        self._chips[tenant] = self._chips.get(tenant, 0.0) + chips
+        self._mem[tenant] = self._mem.get(tenant, 0) + mem
+        if guarantee:
+            self._gchips[tenant] = self._gchips.get(tenant, 0.0) + chips
+            self._gmem[tenant] = self._gmem.get(tenant, 0) + mem
+
+    def credit(self, tenant: str, chips: float, mem: int,
+               guarantee: bool) -> None:
+        """Exact inverse of charge, clamped at zero: float noise from
+        many fractional reservations must never leave a tenant with a
+        phantom negative balance that inflates everyone else's
+        relative share."""
+        if not tenant or (chips <= 0 and mem <= 0):
+            return
+        self._chips[tenant] = max(0.0, self._chips.get(tenant, 0.0) - chips)
+        self._mem[tenant] = max(0, self._mem.get(tenant, 0) - mem)
+        if guarantee:
+            self._gchips[tenant] = max(
+                0.0, self._gchips.get(tenant, 0.0) - chips
+            )
+            self._gmem[tenant] = max(0, self._gmem.get(tenant, 0) - mem)
+        if self._chips[tenant] <= _EPS and self._mem[tenant] == 0:
+            # drop idle tenants so the metrics surface and the
+            # queue-order terms only carry live ones
+            self._chips.pop(tenant, None)
+            self._mem.pop(tenant, None)
+            self._gchips.pop(tenant, None)
+            self._gmem.pop(tenant, None)
+
+    def note_reclaim(self, tenant: str, victims: int) -> None:
+        if victims > 0:
+            self.reclaim_evictions[tenant] = (
+                self.reclaim_evictions.get(tenant, 0) + victims
+            )
+
+    # -- reads --------------------------------------------------------
+
+    def chips_used(self, tenant: str) -> float:
+        return self._chips.get(tenant, 0.0)
+
+    def mem_used(self, tenant: str) -> int:
+        return self._mem.get(tenant, 0)
+
+    def guarantee_chips_used(self, tenant: str) -> float:
+        return self._gchips.get(tenant, 0.0)
+
+    def guarantee_mem_used(self, tenant: str) -> int:
+        return self._gmem.get(tenant, 0)
+
+    def tenants(self) -> Iterable[str]:
+        return sorted(set(self._chips) | set(self.reclaim_evictions))
+
+    def dominant_share(self, tenant: str, cap_chips: float,
+                       cap_mem: int) -> float:
+        """max over {chip-fraction, HBM-fraction} of bound capacity —
+        the DRF dominant resource share."""
+        chip_share = (
+            self._chips.get(tenant, 0.0) / cap_chips if cap_chips > 0 else 0.0
+        )
+        mem_share = (
+            self._mem.get(tenant, 0) / cap_mem if cap_mem > 0 else 0.0
+        )
+        return max(chip_share, mem_share)

@@ -1,0 +1,242 @@
+"""The quota plane the scheduler talks to.
+
+One object ties the tenant registry and the usage ledger to the live
+cell tree and answers the four questions the engine asks:
+
+- ``share_key``   — the weighted-DRF term in the queue sort: dominant
+  share divided by weight, ascending, so the most-underserved tenant
+  schedules first within each priority band (FIFO survives as the
+  timestamp tiebreak — equal-share tenants degrade to the seed's
+  priority-then-timestamp order exactly).
+- ``admit``       — the admission gate: a GUARANTEE pod whose tenant
+  would exceed its guaranteed chip-fraction waits (retryable
+  Unschedulable — quota is not a malformed spec); any pod whose
+  tenant would exceed its borrow ceiling likewise. Idle capacity
+  stays borrowable: an opportunistic pod with no configured ceiling
+  is only ever gated by physical capacity.
+- ``over_quota``  — the Permit-time re-check, after the pod's own
+  reservation is already on the ledger (gang members charged between
+  this pod's gate and its barrier can push the tenant over).
+- ``victim_rank`` — reclaim preference for the defrag planner:
+  victims from tenants currently over their guaranteed entitlement
+  (*borrowed* capacity) rank before victims from under-quota tenants,
+  so a starved guaranteed tenant claws back borrowed chips first and
+  an under-quota tenant's pods are only displaced when nothing
+  borrowed can open the fit.
+
+Capacity is read live from the tree (bound leaf count + the
+incrementally-maintained total HBM counter), so quota fractions track
+nodes joining and leaving without any refresh hook.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from ..cells.cell import CellTree
+from ..scheduler.labels import PodKind, PodRequirements
+from ..utils import expfmt
+from .ledger import UsageLedger
+from .tenant import TenantRegistry
+
+_EPS = 1e-9
+
+
+class QuotaPlane:
+    def __init__(self, registry: Optional[TenantRegistry],
+                 tree: CellTree, log=None):
+        self.registry = registry or TenantRegistry()
+        self.ledger = UsageLedger()
+        self.tree = tree
+        self.log = log
+
+    # -- capacity & demand -------------------------------------------
+
+    def capacity(self) -> Tuple[float, int]:
+        """(bound chips, bound HBM bytes) — both O(1) reads; quota
+        fractions are of what is actually schedulable, not of the
+        declared topology."""
+        return float(len(self.tree.leaf_cells)), self.tree.total_full_memory
+
+    @staticmethod
+    def demand(req: PodRequirements) -> Tuple[float, int]:
+        """Pre-reserve demand in (chips, HBM). Memory uses the
+        DECLARED cap only — the proportional default is resolved
+        against a concrete leaf at reserve time, and the ledger charges
+        that resolved value; admission gates on what the user asked
+        for."""
+        if req.kind == PodKind.MULTI_CHIP:
+            return float(req.chip_count), req.memory
+        if req.kind == PodKind.SHARED:
+            return req.request, req.memory
+        return 0.0, 0
+
+    # -- admission ----------------------------------------------------
+
+    def admit(self, req: PodRequirements) -> Tuple[bool, str]:
+        """Gate BEFORE any filtering or reserve work — and before
+        defrag: an over-quota guarantee pod must wait, never evict."""
+        chips, mem = self.demand(req)
+        if chips <= 0 and mem <= 0:
+            return True, ""
+        spec = self.registry.spec(req.tenant)
+        if spec.guaranteed is None and spec.borrow_limit is None:
+            return True, ""  # unconfigured tenant: seed behavior
+        cap_chips, cap_mem = self.capacity()
+        if req.is_guarantee and spec.guaranteed is not None:
+            quota_chips = spec.guaranteed * cap_chips
+            quota_mem = spec.guaranteed * cap_mem
+            used = self.ledger.guarantee_chips_used(req.tenant)
+            used_mem = self.ledger.guarantee_mem_used(req.tenant)
+            if (used + chips > quota_chips + _EPS
+                    or used_mem + mem > quota_mem + _EPS):
+                return False, (
+                    f"tenant {req.tenant} over guaranteed quota: "
+                    f"{used:.3f}+{chips:.3f} chips vs "
+                    f"{quota_chips:.3f} guaranteed "
+                    f"({spec.guaranteed:.0%} of {cap_chips:.0f}); waiting"
+                )
+        if spec.borrow_limit is not None:
+            ceil_chips = spec.borrow_limit * cap_chips
+            ceil_mem = spec.borrow_limit * cap_mem
+            used = self.ledger.chips_used(req.tenant)
+            used_mem = self.ledger.mem_used(req.tenant)
+            if (used + chips > ceil_chips + _EPS
+                    or used_mem + mem > ceil_mem + _EPS):
+                return False, (
+                    f"tenant {req.tenant} at borrow ceiling: "
+                    f"{used:.3f}+{chips:.3f} chips vs "
+                    f"{ceil_chips:.3f} ceiling "
+                    f"({spec.borrow_limit:.0%} of {cap_chips:.0f}); waiting"
+                )
+        return True, ""
+
+    def over_quota(self, status) -> str:
+        """Permit-time re-check with the pod's own charge already on
+        the ledger. Returns the denial reason, or "" when within
+        quota."""
+        req = status.requirements
+        spec = self.registry.spec(status.tenant)
+        if spec.guaranteed is None and spec.borrow_limit is None:
+            return ""
+        cap_chips, cap_mem = self.capacity()
+        if req.is_guarantee and spec.guaranteed is not None:
+            if (self.ledger.guarantee_chips_used(status.tenant)
+                    > spec.guaranteed * cap_chips + _EPS
+                    or self.ledger.guarantee_mem_used(status.tenant)
+                    > spec.guaranteed * cap_mem + _EPS):
+                return (
+                    f"tenant {status.tenant} over guaranteed quota at "
+                    f"Permit (concurrent reservations); requeued"
+                )
+        if spec.borrow_limit is not None:
+            if (self.ledger.chips_used(status.tenant)
+                    > spec.borrow_limit * cap_chips + _EPS
+                    or self.ledger.mem_used(status.tenant)
+                    > spec.borrow_limit * cap_mem + _EPS):
+                return (
+                    f"tenant {status.tenant} over borrow ceiling at "
+                    f"Permit (concurrent reservations); requeued"
+                )
+        return ""
+
+    # -- queue ordering ----------------------------------------------
+
+    def share_key(self, tenant: str) -> float:
+        """Weighted dominant share — the DRF queue-order term,
+        ascending (largest deficit first). Equal-usage equal-weight
+        tenants get IDENTICAL terms (same arithmetic on the same
+        values), so the sort falls through to the timestamp tiebreak
+        and the total order degrades exactly to the seed's."""
+        cap_chips, cap_mem = self.capacity()
+        share = self.ledger.dominant_share(tenant, cap_chips, cap_mem)
+        return share / self.registry.spec(tenant).weight
+
+    # -- accounting (plugin call sites) ------------------------------
+
+    def charge(self, status) -> None:
+        self.ledger.charge(
+            status.tenant, status.charged_chips, status.charged_mem,
+            status.requirements.is_guarantee,
+        )
+
+    def credit(self, status) -> None:
+        self.ledger.credit(
+            status.tenant, status.charged_chips, status.charged_mem,
+            status.requirements.is_guarantee,
+        )
+        status.charged_chips = 0.0
+        status.charged_mem = 0
+
+    # -- reclaim ------------------------------------------------------
+
+    def borrowing(self, tenant: str) -> bool:
+        """Is the tenant using more than its guaranteed entitlement?
+        An unconfigured guarantee (None) entitles nothing, so all of
+        that tenant's usage counts as borrowed — matching HiveD's
+        opportunistic tier, whose pods are reclaimable first."""
+        spec = self.registry.spec(tenant)
+        guaranteed = spec.guaranteed or 0.0
+        cap_chips, _ = self.capacity()
+        return self.ledger.chips_used(tenant) > guaranteed * cap_chips + _EPS
+
+    def victim_rank(self) -> Callable:
+        """Rank callable for defrag.find_plan: 0 = borrowed (preferred
+        victim), 1 = within entitlement. Snapshots per-tenant verdicts
+        lazily so one plan walk costs one ledger read per tenant."""
+        cache: dict = {}
+
+        def rank(status) -> int:
+            verdict = cache.get(status.tenant)
+            if verdict is None:
+                verdict = cache[status.tenant] = (
+                    0 if self.borrowing(status.tenant) else 1
+                )
+            return verdict
+
+        return rank
+
+    # -- observability ------------------------------------------------
+
+    def samples(self) -> List["expfmt.Sample"]:
+        cap_chips, cap_mem = self.capacity()
+        samples: List[expfmt.Sample] = []
+        for tenant in self.ledger.tenants():
+            labels = {"tenant": tenant}
+            spec = self.registry.spec(tenant)
+            chips = self.ledger.chips_used(tenant)
+            share = self.ledger.dominant_share(tenant, cap_chips, cap_mem)
+            guaranteed_chips = (spec.guaranteed or 0.0) * cap_chips
+            samples += [
+                expfmt.Sample(
+                    "tpu_scheduler_tenant_chips_used", labels, chips
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_tenant_dominant_share", labels, share
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_tenant_weighted_share", labels,
+                    share / spec.weight,
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_tenant_borrowed_chips", labels,
+                    max(0.0, chips - guaranteed_chips),
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_tenant_reclaim_evictions_total", labels,
+                    self.ledger.reclaim_evictions.get(tenant, 0),
+                ),
+            ]
+            if spec.guaranteed is not None:
+                samples += [
+                    expfmt.Sample(
+                        "tpu_scheduler_tenant_guarantee_quota_chips",
+                        labels, guaranteed_chips,
+                    ),
+                    expfmt.Sample(
+                        "tpu_scheduler_tenant_quota_deficit_chips", labels,
+                        max(0.0, guaranteed_chips
+                            - self.ledger.guarantee_chips_used(tenant)),
+                    ),
+                ]
+        return samples

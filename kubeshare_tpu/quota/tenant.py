@@ -1,0 +1,152 @@
+"""Tenant identity and entitlement.
+
+A *tenant* is the unit the cluster shares fairly between. Pods map to
+tenants by namespace unless they carry the ``sharedtpu/tenant`` label
+(multi-team namespaces, or one team spanning namespaces). Entitlements
+come from a config the operator ships either as a plain YAML mapping
+
+    tenants:
+      ml-research: {weight: 2.0, guaranteed: 0.5, borrow_limit: 0.9}
+      batch:       {weight: 1.0}
+
+or as a ConfigMap manifest whose ``data.tenants`` carries the same
+mapping as YAML text (the k8s-native delivery; parsed through
+``cluster/k8syaml.py``). Fields:
+
+- ``weight``    — fair-share weight for the DRF queue ordering; must
+  be > 0 (a zero weight would starve the tenant by construction, so
+  it is a config error, not a knob).
+- ``guaranteed`` — chip-fraction of bound cluster capacity reserved
+  for the tenant's GUARANTEE pods (priority >= 1). Unset = ungated
+  (the seed behavior: priority alone decides).
+- ``borrow_limit`` — ceiling on the tenant's TOTAL chip-fraction
+  (guaranteed usage + opportunistic borrowing). Unset = only physical
+  capacity gates it.
+
+Tenants absent from the config get the permissive default (weight 1,
+no quota, no ceiling): no admission gating anywhere, and queue order
+is equal-weight DRF by namespace — which equals the pre-quota
+priority-then-timestamp order whenever current usage is equal, and
+otherwise lets the least-served namespace go first (that reordering
+is the point of the plane, config or not).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    name: str
+    weight: float = 1.0
+    guaranteed: Optional[float] = None    # chip-fraction in [0, 1]
+    borrow_limit: Optional[float] = None  # chip-fraction in [0, 1]
+
+    def validate(self) -> "TenantSpec":
+        if not self.weight > 0:
+            raise ValueError(
+                f"tenant {self.name!r}: weight must be > 0 "
+                f"(got {self.weight}); a zero-weight tenant would be "
+                f"starved by construction — remove it instead"
+            )
+        for field_name, value in (
+            ("guaranteed", self.guaranteed),
+            ("borrow_limit", self.borrow_limit),
+        ):
+            if value is not None and not 0.0 <= value <= 1.0:
+                raise ValueError(
+                    f"tenant {self.name!r}: {field_name}={value} must be "
+                    f"a chip-fraction in [0, 1]"
+                )
+        if (
+            self.guaranteed is not None
+            and self.borrow_limit is not None
+            and self.borrow_limit < self.guaranteed
+        ):
+            raise ValueError(
+                f"tenant {self.name!r}: borrow_limit={self.borrow_limit} "
+                f"< guaranteed={self.guaranteed} would cap the tenant "
+                f"below its own guarantee"
+            )
+        return self
+
+
+_DEFAULT = TenantSpec(name="")
+
+
+class TenantRegistry:
+    """namespace/label -> tenant resolution + per-tenant entitlements."""
+
+    def __init__(self, specs: Optional[Dict[str, TenantSpec]] = None):
+        self._specs: Dict[str, TenantSpec] = dict(specs or {})
+
+    def spec(self, tenant: str) -> TenantSpec:
+        return self._specs.get(tenant, _DEFAULT)
+
+    def configured(self) -> Dict[str, TenantSpec]:
+        return dict(self._specs)
+
+    @classmethod
+    def from_config(cls, config) -> "TenantRegistry":
+        """Build from a parsed mapping: either ``{tenants: {...}}`` or
+        the inner ``{name: {weight, guaranteed, borrow_limit}}``
+        mapping directly. Raises ValueError on any invalid spec (zero
+        weight, out-of-range fractions) with the tenant named."""
+        if config is None:
+            return cls()
+        if isinstance(config, TenantRegistry):
+            return config
+        if not isinstance(config, dict):
+            raise ValueError(
+                f"tenant config must be a mapping, got {type(config).__name__}"
+            )
+        tenants = config.get("tenants", config)
+        if not isinstance(tenants, dict):
+            raise ValueError("tenant config: 'tenants' must be a mapping")
+        specs: Dict[str, TenantSpec] = {}
+        for name, raw in tenants.items():
+            raw = raw or {}
+            if not isinstance(raw, dict):
+                raise ValueError(
+                    f"tenant {name!r}: spec must be a mapping, "
+                    f"got {type(raw).__name__}"
+                )
+            unknown = set(raw) - {"weight", "guaranteed", "borrow_limit"}
+            if unknown:
+                raise ValueError(
+                    f"tenant {name!r}: unknown field(s) {sorted(unknown)}"
+                )
+            specs[str(name)] = TenantSpec(
+                name=str(name),
+                weight=float(raw.get("weight", 1.0)),
+                guaranteed=(
+                    None if raw.get("guaranteed") is None
+                    else float(raw["guaranteed"])
+                ),
+                borrow_limit=(
+                    None if raw.get("borrow_limit") is None
+                    else float(raw["borrow_limit"])
+                ),
+            ).validate()
+        return cls(specs)
+
+    @classmethod
+    def load(cls, path: str) -> "TenantRegistry":
+        """Load from a YAML file: a plain mapping or a ConfigMap
+        manifest (possibly multi-document) carrying the mapping."""
+        import yaml
+
+        from ..cluster.k8syaml import tenant_config_from_manifest
+
+        with open(path) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d is not None]
+        for doc in docs:
+            config = tenant_config_from_manifest(doc)
+            if config is not None:
+                return cls.from_config(config)
+        raise ValueError(
+            f"{path}: no tenant config found (expected a 'tenants:' "
+            f"mapping or a ConfigMap with data.tenants)"
+        )

@@ -19,6 +19,8 @@ LABEL_TPU_LIMIT = DOMAIN + "tpu_request_limit"    # burst ceiling (chip fraction
 LABEL_TPU_REQUEST = DOMAIN + "tpu_request"        # guaranteed chip fraction
 LABEL_TPU_MEMORY = DOMAIN + "tpu_mem"             # HBM bytes cap
 LABEL_TPU_MODEL = DOMAIN + "tpu_model"            # chip generation pin (e.g. tpu-v5e)
+LABEL_TENANT = DOMAIN + "tenant"                  # quota tenant override
+                                                  # (default: namespace)
 
 # compat aliases: accept the short names used in docs/examples too
 LABEL_TPU_LIMIT_ALIASES = (LABEL_TPU_LIMIT, DOMAIN + "tpu_limit")

@@ -30,7 +30,7 @@ wires them to the cluster's evict verb.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..cells.cell import Cell, CellTree
 from .labels import PodKind, PodRequirements
@@ -43,6 +43,13 @@ class DefragPlan:
     node: str
     victims: List[str]          # pod keys, eviction order
     displaced: float            # total displaced request (plan score)
+    rank: int = 0               # sum of victim_rank over victims: the
+                                # quota plane's reclaim preference
+                                # (0 = borrowed capacity) dominates the
+                                # displaced-request comparison, so a
+                                # starved tenant claws back borrowed
+                                # chips before touching anyone within
+                                # their entitlement
     leaves: List[str] = None    # uuids of the leaves the plan frees —
                                 # the scope of the post-eviction hold
                                 # (plugin._defrag_holds); holding the
@@ -93,7 +100,7 @@ def _victims_by_leaf(
 
 def _select_victims(
     occupants: List[_Occupant], cap_gap: float, mem_gap: int,
-    max_victims: int,
+    max_victims: int, rank: Callable[[PodStatus], int],
 ) -> Optional[List[_Occupant]]:
     """Cheapest victim set closing both gaps within the cap.
 
@@ -101,9 +108,14 @@ def _select_victims(
     sum solve): greedy smallest-first, and the single smallest victim
     that closes both gaps alone (catches the case where greedy
     accumulates several small pods past max_victims while one bigger
-    pod would have sufficed). Returns the valid set displacing least.
+    pod would have sufficed). Returns the valid set with the lowest
+    (summed victim rank, displaced request) — rank first, so borrowed
+    capacity is reclaimed before within-entitlement pods even when a
+    within-entitlement victim would displace less.
     """
-    ordered = sorted(occupants, key=lambda o: (o.cap, o.status.key))
+    ordered = sorted(
+        occupants, key=lambda o: (rank(o.status), o.cap, o.status.key)
+    )
     candidates: List[List[_Occupant]] = []
 
     greedy: List[_Occupant] = []
@@ -129,7 +141,12 @@ def _select_victims(
 
     if not candidates:
         return None
-    return min(candidates, key=lambda c: sum(o.cap for o in c))
+    return min(
+        candidates,
+        key=lambda c: (
+            sum(rank(o.status) for o in c), sum(o.cap for o in c)
+        ),
+    )
 
 
 def _plan_shared(
@@ -138,6 +155,7 @@ def _plan_shared(
     req: PodRequirements,
     by_leaf: Dict[str, List[_Occupant]],
     max_victims: int,
+    rank: Callable[[PodStatus], int],
 ) -> Optional[DefragPlan]:
     best: Optional[DefragPlan] = None
     for leaf in tree.scan_bound_leaves(node):
@@ -151,7 +169,7 @@ def _plan_shared(
         if cap_gap <= 0 and mem_gap <= 0:
             return None  # already fits — defrag is not the problem
         chosen = _select_victims(
-            by_leaf.get(leaf.uuid, []), cap_gap, mem_gap, max_victims
+            by_leaf.get(leaf.uuid, []), cap_gap, mem_gap, max_victims, rank
         )
         if chosen is None:
             continue  # leaf can't be cleared enough; no blind eviction
@@ -159,9 +177,12 @@ def _plan_shared(
             node=node,
             victims=[o.status.key for o in chosen],
             displaced=sum(o.cap for o in chosen),
+            rank=sum(rank(o.status) for o in chosen),
             leaves=[leaf.uuid],
         )
-        if best is None or plan.displaced < best.displaced:
+        if best is None or (plan.rank, plan.displaced) < (
+            best.rank, best.displaced
+        ):
             best = plan
     return best
 
@@ -172,6 +193,7 @@ def _plan_multi_chip(
     req: PodRequirements,
     by_leaf: Dict[str, List[_Occupant]],
     max_victims: int,
+    rank: Callable[[PodStatus], int],
 ) -> Optional[DefragPlan]:
     need = req.chip_count
     leaves = [l for l in tree.scan_bound_leaves(node) if l.healthy]
@@ -195,17 +217,21 @@ def _plan_multi_chip(
         # all capacity in use on this leaf must belong to evictable
         # pods, or clearing them won't make it whole-free
         if occupants and abs((1.0 - leaf.available) - occ_cap) < 1e-9:
-            clearable.append((occ_cap, leaf.uuid, occupants))
-    clearable.sort(key=lambda t: (t[0], t[1]))
+            # leaf preference: borrowed-capacity occupants clear first
+            # (rank 0), then cheapest occupancy, then uuid
+            leaf_rank = sum(rank(o.status) for o in occupants)
+            clearable.append((leaf_rank, occ_cap, leaf.uuid, occupants))
+    clearable.sort(key=lambda t: (t[0], t[1], t[2]))
     missing = need - whole_free
     if len(clearable) < missing:
         return None
     victims: List[str] = []
     displaced = 0.0
+    plan_rank = 0
     freed_mem = 0
     seen = set()
     freed_leaves: List[str] = []
-    for occ_cap, leaf_uuid, occupants in clearable[:missing]:
+    for _, occ_cap, leaf_uuid, occupants in clearable[:missing]:
         displaced += occ_cap
         freed_leaves.append(leaf_uuid)
         for occ in occupants:
@@ -216,6 +242,7 @@ def _plan_multi_chip(
             if occ.status.key not in seen:
                 seen.add(occ.status.key)
                 victims.append(occ.status.key)
+                plan_rank += rank(occ.status)
     if not victims or len(victims) > max_victims:
         return None
     # the plan must also open enough HBM on the node cell
@@ -231,7 +258,7 @@ def _plan_multi_chip(
         l.uuid for l in leaves if l.is_whole_free
     ]
     return DefragPlan(node=node, victims=victims, displaced=displaced,
-                      leaves=hold_leaves)
+                      rank=plan_rank, leaves=hold_leaves)
 
 
 def find_plan(
@@ -241,21 +268,29 @@ def find_plan(
     req: PodRequirements,
     max_victims: int = 2,
     excluded: Optional[set] = None,
+    victim_rank: Optional[Callable[[PodStatus], int]] = None,
 ) -> Optional[DefragPlan]:
     """Cheapest provable evict-to-fit plan across nodes, or None.
     ``excluded`` pod keys are never victims (in-flight evictions,
-    PDB-blocked pods)."""
+    PDB-blocked pods). ``victim_rank`` (lower = evict first; the quota
+    plane passes 0 for borrowed-capacity tenants, 1 otherwise) orders
+    victim preference ABOVE displaced request, both within a node and
+    across nodes; None ranks everyone equal — exactly the pre-quota
+    behavior."""
     if req.kind == PodKind.REGULAR:
         return None
     by_leaf = _victims_by_leaf(tree, status_store, excluded)
     if not by_leaf:
         return None
+    rank = victim_rank or (lambda status: 0)
     planner = (
         _plan_multi_chip if req.kind == PodKind.MULTI_CHIP else _plan_shared
     )
     best: Optional[DefragPlan] = None
     for node in sorted(nodes):
-        plan = planner(tree, node, req, by_leaf, max_victims)
-        if plan and (best is None or plan.displaced < best.displaced):
+        plan = planner(tree, node, req, by_leaf, max_victims, rank)
+        if plan and (best is None or (plan.rank, plan.displaced) < (
+            best.rank, best.displaced
+        )):
             best = plan
     return best

@@ -34,6 +34,11 @@ _EPS = 1e-9
 # re.ASCII: the Go reference's \d is ASCII-only; without it Python
 # matches Unicode digits that float() happily parses.
 _NUM_RE = re.compile(r"\d+(\.\d+)?", re.ASCII)
+# tenant names feed metric labels and the quota config keys: k8s
+# label-value syntax (alphanumeric ends, [-_.] interior, <= 63 chars)
+_TENANT_RE = re.compile(
+    r"[A-Za-z0-9]([A-Za-z0-9._-]{0,61}[A-Za-z0-9])?", re.ASCII
+)
 
 
 class PodKind(enum.Enum):
@@ -66,6 +71,7 @@ class PodRequirements:
     model: str = ""
     priority: int = 0
     gang: Optional[GangSpec] = None
+    tenant: str = ""  # resolved quota tenant (label override or namespace)
 
     @property
     def is_guarantee(self) -> bool:
@@ -97,6 +103,22 @@ def parse_priority(pod: Pod) -> int:
     if not 0 <= p <= 100:
         raise LabelError(f"pod {pod.key}: priority={p} must be in 0..100")
     return p
+
+
+def parse_tenant(pod: Pod) -> str:
+    """Quota tenant: the ``sharedtpu/tenant`` label wins over the
+    namespace. The label's format is validated (it becomes a metric
+    label and a config key); an empty/absent label is NOT an error —
+    namespace-as-tenant is the default."""
+    raw = pod.labels.get(C.LABEL_TENANT, "")
+    if not raw:
+        return pod.namespace
+    if _TENANT_RE.fullmatch(raw) is None:
+        raise LabelError(
+            f"pod {pod.key}: tenant={raw!r} is not a valid tenant name "
+            f"(k8s label-value syntax)"
+        )
+    return raw
 
 
 def parse_gang(pod: Pod) -> Optional[GangSpec]:
@@ -132,6 +154,7 @@ def parse_pod(pod: Pod) -> PodRequirements:
     with no TPU labels."""
     priority = parse_priority(pod)
     gang = parse_gang(pod)
+    tenant = parse_tenant(pod)
 
     raw_limit = None
     for label in C.LABEL_TPU_LIMIT_ALIASES:
@@ -142,7 +165,9 @@ def parse_pod(pod: Pod) -> PodRequirements:
     raw_memory = pod.labels.get(C.LABEL_TPU_MEMORY)
 
     if raw_limit is None and raw_request is None and raw_memory is None:
-        return PodRequirements(kind=PodKind.REGULAR, priority=priority, gang=gang)
+        return PodRequirements(
+            kind=PodKind.REGULAR, priority=priority, gang=gang, tenant=tenant
+        )
 
     if raw_limit is None:
         raise LabelError(
@@ -156,7 +181,9 @@ def parse_pod(pod: Pod) -> PodRequirements:
     )
 
     if limit == 0.0 and request == 0.0:
-        return PodRequirements(kind=PodKind.REGULAR, priority=priority, gang=gang)
+        return PodRequirements(
+            kind=PodKind.REGULAR, priority=priority, gang=gang, tenant=tenant
+        )
 
     if limit > 1.0 + _EPS:
         # multi-chip: integers, request == limit
@@ -196,4 +223,5 @@ def parse_pod(pod: Pod) -> PodRequirements:
         model=pod.labels.get(C.LABEL_TPU_MODEL, ""),
         priority=priority,
         gang=gang,
+        tenant=tenant,
     )

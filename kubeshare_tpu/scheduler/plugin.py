@@ -31,7 +31,9 @@ from ..utils.logger import get_logger
 from ..utils.trace import Tracer, maybe_span
 from . import constants as C
 from .filtering import node_fits
-from .labels import LabelError, PodKind, PodRequirements, parse_pod
+from .labels import (
+    LabelError, PodKind, PodRequirements, parse_pod, parse_tenant,
+)
 from .podgroup import PodGroupRegistry
 from .scoring import (
     anchor_fingerprint, normalize_scores, pick_best, score_node,
@@ -86,7 +88,14 @@ class TpuShareScheduler:
         defrag_eviction_rate: float = 0.0,
         percentage_of_nodes_to_score: int = 0,
         min_feasible_nodes: int = 64,
+        tenants: Union[None, str, dict, "TenantRegistry"] = None,
     ):
+        # function-scope import: quota depends on scheduler.labels /
+        # scheduler.constants, so a module-level import here would be
+        # circular whichever package loads first
+        from ..quota.policy import QuotaPlane
+        from ..quota.tenant import TenantRegistry
+
         cfg = (
             topology
             if isinstance(topology, TopologyConfig)
@@ -101,7 +110,20 @@ class TpuShareScheduler:
         self.tracer = tracer
 
         self.status = PodStatusStore()
-        self.groups = PodGroupRegistry(clock=clock)
+        self.groups = PodGroupRegistry(clock=clock, log=self.log)
+        # Tenant quota plane: weighted-DRF queue ordering, admission
+        # gate, reclaim preference, per-tenant /metrics gauges. With no
+        # tenant config every tenant gets the permissive default
+        # (weight 1, no quota): nothing is ever gated, and queue order
+        # is equal-weight DRF by namespace (identical to the seed's
+        # priority-then-timestamp order whenever usage is equal).
+        if isinstance(tenants, TenantRegistry):
+            registry = tenants
+        elif isinstance(tenants, str):
+            registry = TenantRegistry.load(tenants)
+        else:
+            registry = TenantRegistry.from_config(tenants)
+        self.quota = QuotaPlane(registry, self.tree, log=self.log)
         self.ports: Dict[str, RRBitmap] = {}
         self._waiting: Dict[str, Dict[str, _Waiting]] = {}  # group_key -> pods
         self._synced_nodes: Set[str] = set()
@@ -219,9 +241,15 @@ class TpuShareScheduler:
             s.key for s in self.status.values()
             if s.state in (PodState.RESERVED, PodState.WAITING)
         ]
+        from ..quota.policy import QuotaPlane
+
         self.tree = tree
         self.status = PodStatusStore()
-        self.groups = PodGroupRegistry(clock=self.clock)
+        self.groups = PodGroupRegistry(clock=self.clock, log=self.log)
+        # fresh ledger on the new tree: bound pods re-charge through
+        # the same _restore_bound_pod replay that rebuilds their
+        # reservations, so usage can never double-count
+        self.quota = QuotaPlane(self.quota.registry, tree, log=self.log)
         self.ports = {}
         self._waiting = {}
         self._synced_nodes = set()
@@ -347,6 +375,10 @@ class TpuShareScheduler:
             )
             if remaining <= 0:
                 self.groups.mark_deleted(group_key)
+        # gc on the informer delete path too, not just tick(): a quiet
+        # cluster (no scheduling passes) must still reclaim expired
+        # deleted-group entries instead of letting them linger
+        self.groups.gc()
 
     def _restore_bound_pod(self, pod: Pod) -> None:
         """Rebuild reservation state from annotations after a restart."""
@@ -374,6 +406,7 @@ class TpuShareScheduler:
             group_key=group.key,
             node_name=pod.node_name,
             state=PodState.BOUND,
+            tenant=req.tenant,
         )
         try:
             memory = int(pod.annotations.get(C.ANNOTATION_TPU_MEMORY, "0"))
@@ -407,21 +440,41 @@ class TpuShareScheduler:
                 )
         status.leaves = leaves
         status.uuids = [l.uuid for l in leaves]
+        if leaves:  # vanished chips held nothing — charge what is held
+            status.charged_chips = (
+                float(len(leaves)) if req.kind == PodKind.MULTI_CHIP
+                else req.request
+            )
+            status.charged_mem = status.memory
+            self.quota.charge(status)
         self.status.put(status)
 
     # ================= framework hooks ===============================
 
     def queue_sort_key(self, pod: Pod):
-        """Priority desc, then group/pod creation time, then key
-        (reference Less, scheduler.go:247-267). Total order is stable
-        across re-sorts; malformed pods sort last (PreFilter will
-        reject them with a real message)."""
+        """Priority desc, then weighted dominant-share deficit (DRF:
+        the tenant furthest under its weighted fair share schedules
+        first within the band), then group/pod creation time, then key
+        (reference Less, scheduler.go:247-267, with the share term
+        spliced between band and timestamp). Total order is stable
+        across re-sorts — the share term only moves when the ledger
+        does, and equal-share tenants fall through to the timestamp —
+        and with all tenants at equal weight and usage it degrades to
+        the seed's priority-then-timestamp order exactly. Malformed
+        pods sort last (PreFilter will reject them with a real
+        message)."""
         try:
             group = self.groups.get_or_create(pod)
+            tenant = parse_tenant(pod)
         except LabelError:
-            return (101, 0.0, pod.key)
+            return (101, 0.0, 0.0, pod.key)
         ts = group.timestamp if group.key else self.groups.pod_timestamp(pod.key, self.clock)
-        return (-group.priority, ts, group.key or pod.key)
+        return (
+            -group.priority,
+            self.quota.share_key(tenant),
+            ts,
+            group.key or pod.key,
+        )
 
     def pre_filter(self, pod: Pod) -> PodRequirements:
         """Label validation + gang sanity. Raises Unschedulable."""
@@ -506,6 +559,7 @@ class TpuShareScheduler:
             leaves=leaves,
             uuids=[l.uuid for l in leaves],
             state=PodState.RESERVED,
+            tenant=req.tenant,
         )
         annotations: Dict[str, str] = {}
         env: Dict[str, str] = {}
@@ -542,7 +596,17 @@ class TpuShareScheduler:
             env[C.ENV_POD_NAME] = pod.key
             env[C.ENV_HBM_LIMIT] = str(memory)
             env[C.ENV_LIBRARY_PATH] = C.LIBRARY_PATH
+        status.charged_chips = (
+            float(len(leaves)) if req.kind == PodKind.MULTI_CHIP
+            else req.request
+        )
+        status.charged_mem = status.memory
         self.cluster.patch_pod(pod.key, annotations=annotations, env=env)
+        # ledger charge only after the last fallible step: a patch_pod
+        # failure escapes reserve() with no PodStatus stored, so a
+        # charge made before it could never be credited back — the
+        # credit in _release is this charge's exact inverse
+        self.quota.charge(status)
         self.status.put(status)
         return status
 
@@ -570,8 +634,15 @@ class TpuShareScheduler:
         return released
 
     def permit(self, pod: Pod, status: PodStatus):
-        """Gang barrier. Returns ("allow", [co-bound members]) or
-        ("wait", timeout_seconds)."""
+        """Quota admission gate + gang barrier. Returns
+        ("allow", [co-bound members]), ("wait", timeout_seconds), or
+        ("deny", reason) — deny means the tenant went over quota
+        between this pod's admission check and its Permit (concurrent
+        reservations, e.g. gang siblings); the caller unreserves and
+        requeues with a retryable Unschedulable."""
+        why = self.quota.over_quota(status)
+        if why:
+            return "deny", why
         group_key = status.group_key
         if not group_key:
             return "allow", []
@@ -623,6 +694,17 @@ class TpuShareScheduler:
         except Unschedulable as e:
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
+
+        # Quota admission gate — BEFORE any filtering and before
+        # defrag: an over-quota guarantee pod waits (retryable; quota
+        # frees as its tenant's pods finish), it must never trigger
+        # evictions. Opportunistic pods past their tenant's borrow
+        # ceiling wait the same way; idle capacity stays borrowable
+        # for everyone else.
+        admitted, why = self.quota.admit(req)
+        if not admitted:
+            return Decision("unschedulable", pod.key, message=why,
+                            retryable=True)
 
         # gang anchors are needed twice: anchor NODES must be examined
         # first (sampling must never hide the node the rest of the gang
@@ -744,6 +826,13 @@ class TpuShareScheduler:
 
         with maybe_span(self.tracer, "permit", pod=pod.key):
             action, extra = self.permit(pod, status)
+        if action == "deny":
+            # tenant went over quota between admission and Permit
+            # (concurrent reservations); release only THIS pod — gang
+            # siblings keep waiting and the barrier decides their fate
+            self.unreserve(pod.key, reject_group=False)
+            return Decision("unschedulable", pod.key, retryable=True,
+                            message=extra)
         if action == "allow":
             try:
                 self._bind(pod.key, best)
@@ -1026,6 +1115,12 @@ class TpuShareScheduler:
         plan = find_plan(
             self.tree, self.status, [n.name for n in nodes], req,
             max_victims=max_victims, excluded=excluded,
+            # reclaim-before-starve preference: victims holding
+            # BORROWED capacity (tenant over its guaranteed
+            # entitlement) are chosen before victims whose tenant is
+            # within quota; guarantee pods stay off the victim list
+            # entirely (defrag invariant)
+            victim_rank=self.quota.victim_rank(),
         )
         if plan is None:
             return []
@@ -1069,6 +1164,7 @@ class TpuShareScheduler:
                 except Exception:
                     pass  # best-effort observability
         if evicted:
+            self.quota.ledger.note_reclaim(req.tenant, len(evicted))
             # hold the plan's freed LEAVES for the beneficiary until it
             # retries (or the hold expires — a crashed beneficiary must
             # not pin capacity forever)
@@ -1181,6 +1277,11 @@ class TpuShareScheduler:
                 self.tree.agg_rebuilds,
             ),
         ]
+        # per-tenant quota plane gauges: dominant share, weighted
+        # share, borrowed chips, quota deficit, reclaim evictions —
+        # the cluster-level counterpart of the arbiter's per-pod
+        # window-usage stats
+        samples += self.quota.samples()
         for node in self.tree.nodes():
             # non-caching read: this runs on the metrics HTTP thread,
             # which must not write the scheduling thread's leaf cache
@@ -1255,6 +1356,10 @@ class TpuShareScheduler:
 
     def _release(self, status: PodStatus) -> None:
         req = status.requirements
+        # ledger credit first (exact inverse of the reserve-time
+        # charge), so even a reclaim that errors below cannot leave
+        # the tenant's share inflated after the pod is gone
+        self.quota.credit(status)
         for i, leaf in enumerate(status.leaves):
             expected_uuid = status.uuids[i] if i < len(status.uuids) else leaf.uuid
             if leaf.uuid != expected_uuid:

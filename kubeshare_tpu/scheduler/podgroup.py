@@ -35,11 +35,13 @@ class PodGroupRegistry:
         self,
         clock: Callable[[], float] = _time.monotonic,
         expiration_seconds: float = C.POD_GROUP_EXPIRATION_SECONDS,
+        log=None,
     ):
         self._groups: Dict[str, PodGroupInfo] = {}
         self._solo_timestamps: Dict[str, float] = {}
         self._clock = clock
         self._expiration = expiration_seconds
+        self._log = log
 
     def get_or_create(self, pod: Pod, gang: Optional[GangSpec] = None) -> PodGroupInfo:
         """Group info for a pod; solo pods get an unregistered one-off
@@ -97,7 +99,10 @@ class PodGroupRegistry:
         self._solo_timestamps.pop(pod_key, None)
 
     def gc(self) -> int:
-        """Remove groups expired longer than the expiration period."""
+        """Remove groups expired longer than the expiration period.
+        Called from the scheduling tick AND the informer pod-delete
+        path (plugin._on_pod_delete), so deleted-group entries cannot
+        linger across quiet periods with no ticks."""
         now = self._clock()
         expired = [
             key
@@ -107,4 +112,8 @@ class PodGroupRegistry:
         ]
         for key in expired:
             del self._groups[key]
+        if expired and self._log is not None:
+            self._log.info(
+                "pod-group gc: reclaimed %d expired group(s)", len(expired)
+            )
         return len(expired)

@@ -35,6 +35,12 @@ class PodStatus:
     memory: int = 0                # resolved HBM bytes (after defaulting)
     port: int = 0                  # pod-manager port (shared pods only)
     state: PodState = PodState.PENDING
+    # quota ledger bookkeeping: the tenant this placement was charged
+    # to and the exact charged amounts, so the release credit is the
+    # precise inverse even if leaf state churned in between
+    tenant: str = ""
+    charged_chips: float = 0.0
+    charged_mem: int = 0
 
 
 class PodStatusStore:

@@ -72,6 +72,10 @@ class SimReport:
     # captured at the tick the gang's Permit barrier released — the
     # trace-scale evidence for the locality/seeding score terms
     gang_hops: List[float] = field(default_factory=list)
+    # chip-seconds credited per tenant (namespace): the numerator of
+    # each tenant's achieved share in the cluster-fairness evidence
+    # (tools/fairness_sim.py Jain index)
+    tenant_chip_seconds: Dict[str, float] = field(default_factory=dict)
 
     @property
     def mean_wait(self) -> float:
@@ -124,6 +128,10 @@ class SimReport:
             ) if self.gang_hops else None,
             "worst_gang_ici_hops": round(max(self.gang_hops), 3)
             if self.gang_hops else None,
+            "tenant_chip_seconds": {
+                t: round(s, 1)
+                for t, s in sorted(self.tenant_chip_seconds.items())
+            },
         }
 
 
@@ -151,6 +159,7 @@ class Simulator:
         tracer=None,
         defrag: bool = False,
         defrag_eviction_rate: float = 0.0,
+        tenants=None,
     ):
         import random
 
@@ -168,6 +177,7 @@ class Simulator:
             topology, self.cluster, clock=lambda: self.clock_now,
             tracer=tracer, defrag=defrag,
             defrag_eviction_rate=defrag_eviction_rate,
+            tenants=tenants,
         )
         self.total_chips = sum(nodes.values())
         self.priority_ratio = priority_ratio
@@ -198,6 +208,10 @@ class Simulator:
             name = f"sim-{idx}-m{member}"
         return Pod(
             name=name,
+            # tenant rides as the namespace — the engine's default
+            # tenant resolution, so a 6-column trace exercises the
+            # quota plane with no extra labels
+            namespace=event.tenant or "default",
             labels=labels,
             scheduler_name=C.SCHEDULER_NAME,
         )
@@ -231,6 +245,10 @@ class Simulator:
         ran_credit = job.event.chips * (self.clock_now - job.bound_at)
         refund = max(0.0, job.credited - ran_credit)
         report.chip_seconds_used -= refund
+        ns = job.pod.namespace
+        report.tenant_chip_seconds[ns] = (
+            report.tenant_chip_seconds.get(ns, 0.0) - refund
+        )
         job.credited -= refund
 
     def _kill_job(self, job: _Job, jobs: Dict[str, "_Job"],
@@ -244,6 +262,7 @@ class Simulator:
         self._resubmits += 1
         clone = Pod(
             name=f"{job.pod.name}-r{self._resubmits}",
+            namespace=job.pod.namespace,  # tenant survives the requeue
             labels=dict(job.pod.labels),
             scheduler_name=C.SCHEDULER_NAME,
         )
@@ -387,6 +406,10 @@ class Simulator:
                     job.event.runtime, max(0.0, end - self.clock_now)
                 )
                 report.chip_seconds_used += job.credited
+                ns = job.pod.namespace
+                report.tenant_chip_seconds[ns] = (
+                    report.tenant_chip_seconds.get(ns, 0.0) + job.credited
+                )
 
             for job in pending:
                 if job.pod.key in gang_bound:
@@ -406,6 +429,7 @@ class Simulator:
                     self._resubmits += 1
                     clone = Pod(
                         name=f"{victim.pod.name}-d{self._resubmits}",
+                        namespace=victim.pod.namespace,  # tenant survives
                         labels=dict(victim.pod.labels),
                         scheduler_name=C.SCHEDULER_NAME,
                     )

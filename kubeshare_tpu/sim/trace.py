@@ -30,6 +30,10 @@ class TraceEvent:
     gang: int = 1       # optional 5th column: the row expands into
                         # this many co-scheduled pods (one PodGroup,
                         # threshold 1.0), each requesting ``chips``
+    tenant: str = ""    # optional 6th column: quota tenant — the
+                        # pod's NAMESPACE in the sim cluster, which is
+                        # the engine's default tenant resolution; ""
+                        # keeps the single-tenant "default" namespace
 
     @property
     def is_fractional(self) -> bool:
@@ -44,9 +48,9 @@ def load_trace(path: str) -> List[TraceEvent]:
             if not line or line.startswith("#"):
                 continue
             parts = line.split()
-            if len(parts) not in (3, 4, 5):
-                raise ValueError(f"{path}:{line_no}: expected 3-5 columns")
-            gang = int(parts[4]) if len(parts) == 5 else 1
+            if len(parts) not in (3, 4, 5, 6):
+                raise ValueError(f"{path}:{line_no}: expected 3-6 columns")
+            gang = int(parts[4]) if len(parts) >= 5 else 1
             if gang < 1:
                 raise ValueError(f"{path}:{line_no}: gang must be >= 1")
             events.append(
@@ -54,6 +58,7 @@ def load_trace(path: str) -> List[TraceEvent]:
                     float(parts[0]), float(parts[1]), float(parts[2]),
                     int(parts[3]) if len(parts) >= 4 else -1,
                     gang,
+                    parts[5] if len(parts) == 6 else "",
                 )
             )
     events.sort(key=lambda e: e.start)
@@ -62,20 +67,25 @@ def load_trace(path: str) -> List[TraceEvent]:
 
 def save_trace(path: str, events: List[TraceEvent]) -> None:
     with open(path, "w") as f:
-        f.write("# start_offset\tchips\truntime[\tpriority[\tgang]]\n")
+        f.write(
+            "# start_offset\tchips\truntime[\tpriority[\tgang[\ttenant]]]\n"
+        )
         for e in events:
             # .10g: plain text for typical values, yet no precision
             # loss on multi-day runtimes (plain :g clips to 6
             # significant digits, breaking generator round-trips)
             cols = [f"{e.start:.10g}", f"{e.chips:.10g}",
                     f"{e.runtime:.10g}"]
-            if e.priority >= 0 or e.gang > 1:
-                # gang needs the priority column present (positional);
-                # -1 round-trips verbatim so "simulator assigns
-                # randomly" survives a save/load cycle
+            if e.priority >= 0 or e.gang > 1 or e.tenant:
+                # gang needs the priority column present (positional),
+                # tenant needs both; -1 round-trips verbatim so
+                # "simulator assigns randomly" survives a save/load
+                # cycle
                 cols.append(str(e.priority))
-            if e.gang > 1:
+            if e.gang > 1 or e.tenant:
                 cols.append(str(e.gang))
+            if e.tenant:
+                cols.append(e.tenant)
             f.write("\t".join(cols) + "\n")
 
 
@@ -139,6 +149,35 @@ def generate_sec_trace(
                 rng.lognormvariate(math.log(330.0), 2.2), 1
             ))
         events.append(TraceEvent(round(t, 3), chips, runtime))
+    return events
+
+
+def generate_tenant_trace(
+    tenants=("anna", "bob", "cara"),
+    jobs_per_tenant: int = 300,
+    chips: float = 0.5,
+    mean_runtime: float = 120.0,
+    mean_interarrival: float = 2.5,
+    seed: int = 0,
+) -> List[TraceEvent]:
+    """Saturating multi-tenant skew load for the cluster-fairness
+    evidence (tools/fairness_sim.py): every tenant submits the SAME
+    arrival stream — identical request size, rate, and runtime
+    distribution — so any difference in achieved chip share is the
+    scheduler's doing (the weighted-DRF queue order), not the
+    workload's. All rows are opportunistic (priority 0): this measures
+    fair SHARING of contended capacity, not the guarantee tier."""
+    rng = random.Random(seed)
+    events: List[TraceEvent] = []
+    for i, tenant in enumerate(tenants):
+        t = 0.0
+        for _ in range(jobs_per_tenant):
+            t += rng.expovariate(1.0 / mean_interarrival)
+            runtime = max(5.0, rng.expovariate(1.0 / mean_runtime))
+            events.append(TraceEvent(
+                round(t, 3), chips, round(runtime, 1), 0, 1, tenant,
+            ))
+    events.sort(key=lambda e: e.start)
     return events
 
 

@@ -1,0 +1,508 @@
+"""Tenant quota plane: registry/config validation, usage ledger,
+weighted-DRF queue ordering, the admission gate, and reclaim victim
+preference (kubeshare_tpu/quota)."""
+
+import random
+
+import pytest
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.cluster.k8syaml import tenant_config_from_manifest
+from kubeshare_tpu.quota.ledger import UsageLedger
+from kubeshare_tpu.quota.tenant import TenantRegistry, TenantSpec
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.labels import LabelError, parse_tenant
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+TOPO = {
+    "cell_types": {
+        "v5e-tray": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 50,
+        },
+        "v5e-node": {
+            "child_cell_type": "v5e-tray",
+            "child_cell_number": 1,
+            "is_node_level": True,
+            "torus": [2, 2],
+        },
+    },
+    "cells": [
+        {"cell_type": "v5e-node", "cell_id": "node-a"},
+        {"cell_type": "v5e-node", "cell_id": "node-b"},
+    ],
+}
+
+GIB = 1 << 30
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def chips(node, n=4, model="tpu-v5e", mem=16 * GIB):
+    return [ChipInfo(f"{node}-chip-{i}", model, mem, i) for i in range(n)]
+
+
+def tpu_pod(name, request=0.5, limit=None, mem=0, priority=0,
+            namespace="default", tenant=""):
+    labels = {
+        C.LABEL_TPU_REQUEST: str(request),
+        C.LABEL_TPU_LIMIT_ALIASES[1]: str(
+            limit if limit is not None
+            else (max(request, 1.0) if request > 1 else 1.0)
+        ),
+    }
+    if mem:
+        labels[C.LABEL_TPU_MEMORY] = str(mem)
+    if priority:
+        labels[C.LABEL_PRIORITY] = str(priority)
+    if tenant:
+        labels[C.LABEL_TENANT] = tenant
+    return Pod(
+        name=name, namespace=namespace, labels=labels,
+        scheduler_name=C.SCHEDULER_NAME,
+    )
+
+
+def make_sched(tenants=None, **kwargs):
+    cluster = FakeCluster()
+    cluster.add_node("node-a", chips("node-a"))
+    cluster.add_node("node-b", chips("node-b"))
+    clock = FakeClock()
+    sched = TpuShareScheduler(
+        TOPO, cluster, clock=clock, tenants=tenants, **kwargs
+    )
+    return cluster, sched, clock
+
+
+# ===================== registry & config =============================
+
+
+class TestTenantRegistry:
+    def test_zero_weight_rejected_with_clear_error(self):
+        with pytest.raises(ValueError, match="weight must be > 0"):
+            TenantRegistry.from_config(
+                {"tenants": {"zed": {"weight": 0.0}}}
+            )
+        with pytest.raises(ValueError, match="zed"):
+            TenantSpec(name="zed", weight=-1.0).validate()
+
+    def test_fraction_bounds_and_ceiling_below_guarantee(self):
+        with pytest.raises(ValueError, match="guaranteed"):
+            TenantRegistry.from_config(
+                {"tenants": {"t": {"guaranteed": 1.5}}}
+            )
+        with pytest.raises(ValueError, match="borrow_limit"):
+            TenantRegistry.from_config(
+                {"tenants": {"t": {"borrow_limit": -0.1}}}
+            )
+        # a ceiling below the guarantee would cap the tenant under its
+        # own entitlement — config error, not a knob
+        with pytest.raises(ValueError, match="below its own guarantee"):
+            TenantRegistry.from_config(
+                {"tenants": {"t": {"guaranteed": 0.5,
+                                   "borrow_limit": 0.25}}}
+            )
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown field"):
+            TenantRegistry.from_config(
+                {"tenants": {"t": {"wieght": 2.0}}}
+            )
+
+    def test_unconfigured_tenant_gets_permissive_default(self):
+        reg = TenantRegistry.from_config(
+            {"tenants": {"a": {"weight": 2.0}}}
+        )
+        spec = reg.spec("stranger")
+        assert spec.weight == 1.0
+        assert spec.guaranteed is None
+        assert spec.borrow_limit is None
+
+    def test_configmap_manifest_and_plain_mapping(self):
+        cm = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": "tenants"},
+            "data": {
+                "tenants": "tenants:\n  ml: {weight: 2.0, guaranteed: 0.5}\n"
+            },
+        }
+        cfg = tenant_config_from_manifest(cm)
+        reg = TenantRegistry.from_config(cfg)
+        assert reg.spec("ml").guaranteed == 0.5
+        # plain mapping document (offline/sim configs)
+        cfg2 = tenant_config_from_manifest({"tenants": {"ml": None}})
+        assert TenantRegistry.from_config(cfg2).spec("ml").weight == 1.0
+        # unrelated manifests carry no tenant config
+        assert tenant_config_from_manifest({"kind": "Pod"}) is None
+
+    def test_load_from_file(self, tmp_path):
+        path = tmp_path / "tenants.yaml"
+        path.write_text("tenants:\n  ml:\n    weight: 3.0\n")
+        assert TenantRegistry.load(str(path)).spec("ml").weight == 3.0
+        empty = tmp_path / "empty.yaml"
+        empty.write_text("kind: Pod\n")
+        with pytest.raises(ValueError, match="no tenant config"):
+            TenantRegistry.load(str(empty))
+
+    def test_tenant_label_overrides_namespace(self):
+        pod = tpu_pod("p", namespace="team-ns", tenant="shared-team")
+        assert parse_tenant(pod) == "shared-team"
+        plain = tpu_pod("q", namespace="team-ns")
+        assert parse_tenant(plain) == "team-ns"
+
+    def test_invalid_tenant_label_raises(self):
+        pod = tpu_pod("p", tenant="-bad-")
+        with pytest.raises(LabelError, match="tenant"):
+            parse_tenant(pod)
+
+
+# ===================== ledger ========================================
+
+
+class TestUsageLedger:
+    def test_credit_is_exact_inverse_and_clamps(self):
+        led = UsageLedger()
+        led.charge("a", 1.5, 4 * GIB, guarantee=True)
+        led.charge("a", 0.5, GIB, guarantee=False)
+        assert led.chips_used("a") == pytest.approx(2.0)
+        assert led.guarantee_chips_used("a") == pytest.approx(1.5)
+        led.credit("a", 0.5, GIB, guarantee=False)
+        led.credit("a", 1.5, 4 * GIB, guarantee=True)
+        # fully drained tenants drop off the books entirely
+        assert "a" not in list(led.tenants())
+        # over-credit clamps at zero, never phantom-negative
+        led.charge("b", 0.25, 0, guarantee=False)
+        led.credit("b", 99.0, GIB, guarantee=False)
+        assert led.chips_used("b") == 0.0
+
+    def test_dominant_share_is_max_of_resources(self):
+        led = UsageLedger()
+        led.charge("a", 1.0, 8 * GIB, guarantee=False)
+        # 1/8 chips but 8/16 GiB -> HBM dominates
+        assert led.dominant_share("a", 8.0, 16 * GIB) == pytest.approx(0.5)
+        # 1/8 chips and 8/64 GiB -> chips dominate
+        assert led.dominant_share("a", 8.0, 64 * GIB) == pytest.approx(0.125)
+        assert led.dominant_share("a", 0.0, 0) == 0.0
+
+
+# ===================== queue ordering ================================
+
+
+TENANTS_WEIGHTED = {
+    "tenants": {
+        "heavy": {"weight": 2.0},
+        "light": {"weight": 1.0},
+    }
+}
+
+
+class TestQueueSortOrder:
+    def _pods(self, cluster, clock, n=24, seed=3):
+        """Pods across tenants/priorities with distinct timestamps."""
+        rng = random.Random(seed)
+        pods = []
+        for i in range(n):
+            clock.now += 1.0
+            p = cluster.create_pod(tpu_pod(
+                f"p{i:02d}", 0.5,
+                priority=rng.choice((0, 0, 50, 80)),
+                namespace=rng.choice(("heavy", "light", "other")),
+            ))
+            # first-seen timestamps are assigned here, in creation order
+            pods.append(p)
+        return pods
+
+    def test_stable_total_order_property(self):
+        cluster, sched, clock = make_sched(tenants=TENANTS_WEIGHTED)
+        pods = self._pods(cluster, clock)
+        bad = cluster.create_pod(Pod(
+            name="bad", labels={C.LABEL_PRIORITY: "abc"},
+            scheduler_name=C.SCHEDULER_NAME))
+        pods.append(bad)
+        # skew the ledger so the share term is live, not all-zero
+        sched.quota.ledger.charge("heavy", 3.0, 0, guarantee=False)
+        sched.quota.ledger.charge("light", 1.0, 0, guarantee=False)
+
+        keys = {p.key: sched.queue_sort_key(p) for p in pods}
+        # stable: re-deriving every key yields the identical value
+        assert keys == {p.key: sched.queue_sort_key(p) for p in pods}
+        # total order, no cycles: every shuffle sorts to one sequence
+        baseline = sorted(pods, key=lambda p: keys[p.key])
+        for shuffle_seed in range(5):
+            shuffled = list(pods)
+            random.Random(shuffle_seed).shuffle(shuffled)
+            assert [p.key for p in
+                    sorted(shuffled, key=lambda p: keys[p.key])] == \
+                [p.key for p in baseline]
+        # antisymmetry on every pair (tuples give this for free, but
+        # the malformed sentinel must stay comparable against real keys)
+        for a in pods:
+            for b in pods:
+                ka, kb = keys[a.key], keys[b.key]
+                assert (ka < kb) + (kb < ka) + (ka == kb) == 1
+        # malformed sorts last
+        assert baseline[-1].key == bad.key
+
+    def test_equal_weight_and_usage_degrades_to_seed_order(self):
+        """Differential: with every tenant at equal weight and usage
+        the quota-aware key must order exactly like the seed's
+        priority-then-timestamp key."""
+        cluster, sched, clock = make_sched()  # no tenant config
+        pods = self._pods(cluster, clock)
+        # equal usage for every tenant (including zero-usage case
+        # below): identical arithmetic -> identical share terms
+        for tenant in ("heavy", "light", "other"):
+            sched.quota.ledger.charge(tenant, 1.0, GIB, guarantee=False)
+
+        def seed_key(p):
+            group = sched.groups.get_or_create(p)
+            ts = sched.groups.pod_timestamp(p.key, sched.clock)
+            return (-group.priority, ts, group.key or p.key)
+
+        quota_order = [p.key for p in
+                       sorted(pods, key=sched.queue_sort_key)]
+        seed_order = [p.key for p in sorted(pods, key=seed_key)]
+        assert quota_order == seed_order
+        # and again with an empty ledger (the unconfigured-cluster case)
+        for tenant in ("heavy", "light", "other"):
+            sched.quota.ledger.credit(tenant, 1.0, GIB, guarantee=False)
+        assert [p.key for p in sorted(pods, key=sched.queue_sort_key)] \
+            == seed_order
+
+    def test_underserved_tenant_sorts_first_within_band(self):
+        cluster, sched, clock = make_sched(tenants=TENANTS_WEIGHTED)
+        clock.now = 1.0
+        hog = cluster.create_pod(tpu_pod("hog", 0.5, namespace="light"))
+        clock.now = 2.0
+        starved = cluster.create_pod(tpu_pod("starved", 0.5,
+                                             namespace="other"))
+        # equal usage: FIFO puts hog (earlier) first
+        assert sched.queue_sort_key(hog) < sched.queue_sort_key(starved)
+        # light accrues usage -> starved's deficit wins despite arriving
+        # later; the tie-break only decides EQUAL shares
+        sched.quota.ledger.charge("light", 4.0, 0, guarantee=False)
+        assert sched.queue_sort_key(starved) < sched.queue_sort_key(hog)
+
+    def test_weight_scales_the_share_term(self):
+        cluster, sched, clock = make_sched(tenants=TENANTS_WEIGHTED)
+        clock.now = 1.0
+        light_pod = cluster.create_pod(tpu_pod("lp", 0.5,
+                                               namespace="light"))
+        clock.now = 2.0
+        heavy_pod = cluster.create_pod(tpu_pod("hp", 0.5,
+                                               namespace="heavy"))
+        # equal USAGE, weights 2:1 -> heavy's weighted share is half
+        # light's, so heavy schedules first despite the later arrival
+        sched.quota.ledger.charge("heavy", 2.0, 0, guarantee=False)
+        sched.quota.ledger.charge("light", 2.0, 0, guarantee=False)
+        assert sched.queue_sort_key(heavy_pod) < \
+            sched.queue_sort_key(light_pod)
+
+    def test_priority_bands_dominate_shares(self):
+        cluster, sched, clock = make_sched(tenants=TENANTS_WEIGHTED)
+        hi = cluster.create_pod(tpu_pod("hi", 0.5, priority=80,
+                                        namespace="light"))
+        lo = cluster.create_pod(tpu_pod("lo", 0.5, namespace="heavy"))
+        # light is massively over-served, but priority bands are outer
+        sched.quota.ledger.charge("light", 100.0, 0, guarantee=False)
+        assert sched.queue_sort_key(hi) < sched.queue_sort_key(lo)
+
+
+# ===================== admission gate ================================
+
+
+QUOTA_TENANTS = {
+    "tenants": {
+        "alpha": {"weight": 1.0, "guaranteed": 0.25},       # 2 of 8 chips
+        "capped": {"weight": 1.0, "borrow_limit": 0.25},    # 2 of 8 chips
+    }
+}
+
+
+class TestAdmissionGate:
+    def test_guarantee_quota_gates_guarantee_pods(self):
+        cluster, sched, _ = make_sched(tenants=QUOTA_TENANTS)
+        for i in range(2):
+            d = sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"g{i}", 1.0, priority=80, namespace="alpha")))
+            assert d.status == "bound"
+        d = sched.schedule_one(cluster.create_pod(tpu_pod(
+            "g2", 1.0, priority=80, namespace="alpha")))
+        assert d.status == "unschedulable"
+        assert d.retryable  # quota frees as pods finish — not terminal
+        assert "over guaranteed quota" in d.message
+
+    def test_opportunistic_pods_borrow_past_guarantee(self):
+        # idle capacity stays borrowable: the guaranteed fraction gates
+        # only the guarantee tier, not opportunistic borrowing
+        cluster, sched, _ = make_sched(tenants=QUOTA_TENANTS)
+        for i in range(4):
+            d = sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"o{i}", 1.0, namespace="alpha")))
+            assert d.status == "bound", d.message
+
+    def test_borrow_ceiling_gates_total_usage(self):
+        cluster, sched, _ = make_sched(tenants=QUOTA_TENANTS)
+        for i in range(2):
+            d = sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"c{i}", 1.0, namespace="capped")))
+            assert d.status == "bound"
+        d = sched.schedule_one(cluster.create_pod(tpu_pod(
+            "c2", 1.0, namespace="capped")))
+        assert d.status == "unschedulable"
+        assert d.retryable
+        assert "borrow ceiling" in d.message
+        # other tenants are untouched by capped's ceiling
+        d = sched.schedule_one(cluster.create_pod(tpu_pod(
+            "free", 1.0, namespace="other")))
+        assert d.status == "bound"
+
+    def test_release_credits_quota_back(self):
+        cluster, sched, _ = make_sched(tenants=QUOTA_TENANTS)
+        for i in range(2):
+            sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"g{i}", 1.0, priority=80, namespace="alpha")))
+        blocked = cluster.create_pod(tpu_pod(
+            "g2", 1.0, priority=80, namespace="alpha"))
+        assert sched.schedule_one(blocked).status == "unschedulable"
+        cluster.delete_pod("alpha/g0")
+        assert sched.schedule_one(blocked).status == "bound"
+        assert sched.quota.ledger.guarantee_chips_used("alpha") == \
+            pytest.approx(2.0)
+
+    def test_unconfigured_tenants_never_gated(self):
+        cluster, sched, _ = make_sched()  # no config at all
+        for i in range(8):
+            d = sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"p{i}", 1.0, priority=80, namespace="anybody")))
+            assert d.status == "bound"
+
+    def test_permit_denies_after_concurrent_overcommit(self):
+        cluster, sched, _ = make_sched(tenants=QUOTA_TENANTS)
+        pod = cluster.create_pod(tpu_pod(
+            "g0", 1.0, priority=80, namespace="alpha"))
+        assert sched.schedule_one(pod).status == "bound"
+        status = sched.status.get("alpha/g0")
+        # a sibling's reservation landed between this pod's admission
+        # check and its Permit: the re-check must deny, retryably
+        sched.quota.ledger.charge("alpha", 5.0, 0, guarantee=True)
+        action, why = sched.permit(pod, status)
+        assert action == "deny"
+        assert "over guaranteed quota" in why
+
+    def test_metrics_expose_tenant_gauges(self):
+        cluster, sched, _ = make_sched(tenants=QUOTA_TENANTS)
+        sched.schedule_one(cluster.create_pod(tpu_pod(
+            "g0", 1.0, priority=80, namespace="alpha")))
+        names = {s.name: s for s in sched.utilization_samples()
+                 if s.labels.get("tenant") == "alpha"}
+        assert names["tpu_scheduler_tenant_chips_used"].value == \
+            pytest.approx(1.0)
+        assert names["tpu_scheduler_tenant_dominant_share"].value == \
+            pytest.approx(0.125)
+        # deficit: 2-chip quota, 1 chip of guarantee usage
+        assert names["tpu_scheduler_tenant_quota_deficit_chips"].value \
+            == pytest.approx(1.0)
+
+
+# ===================== reclaim preference ============================
+
+
+RECLAIM_TENANTS = {
+    "tenants": {
+        # saver's guarantee covers half the cluster; it stays under.
+        # borrower has no entitlement, so ALL its usage is borrowed.
+        "saver": {"weight": 1.0, "guaranteed": 0.5},
+        "alpha": {"weight": 1.0, "guaranteed": 0.25},
+    }
+}
+
+
+class TestReclaimPreference:
+    def _fill(self, cluster, sched):
+        """Saturate 8 chips: 5 borrower pods + 3 saver pods (saver
+        stays under its 4-chip guarantee), all opportunistic."""
+        for i in range(5):
+            d = sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"b{i}", 1.0, namespace="borrower")))
+            assert d.status == "bound"
+        for i in range(3):
+            d = sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"s{i}", 1.0, namespace="saver")))
+            assert d.status == "bound"
+
+    def test_borrowed_pods_are_victims_first(self):
+        cluster, sched, _ = make_sched(
+            tenants=RECLAIM_TENANTS, defrag=True)
+        self._fill(cluster, sched)
+        d = sched.schedule_one(cluster.create_pod(tpu_pod(
+            "a0", 1.0, priority=80, namespace="alpha")))
+        assert d.status == "unschedulable" and d.retryable
+        assert cluster.evictions, "starved guarantee tenant must reclaim"
+        # every victim is a borrower pod: saver is within its
+        # entitlement, so its pods are untouchable while borrowed
+        # capacity exists
+        assert all(k.startswith("borrower/") for k in cluster.evictions)
+
+    def test_guarantee_pods_are_never_victims(self):
+        cluster, sched, _ = make_sched(
+            tenants=RECLAIM_TENANTS, defrag=True)
+        # the whole cluster is borrower GUARANTEE pods (priority 80):
+        # nothing is evictable, so a starved tenant waits instead
+        for i in range(8):
+            assert sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"g{i}", 1.0, priority=80, namespace="borrower"))
+            ).status == "bound"
+        d = sched.schedule_one(cluster.create_pod(tpu_pod(
+            "a0", 1.0, priority=80, namespace="alpha")))
+        assert d.status == "unschedulable"
+        assert cluster.evictions == []
+
+    def test_reclaim_is_ledgered_for_metrics(self):
+        cluster, sched, _ = make_sched(
+            tenants=RECLAIM_TENANTS, defrag=True)
+        self._fill(cluster, sched)
+        sched.schedule_one(cluster.create_pod(tpu_pod(
+            "a0", 1.0, priority=80, namespace="alpha")))
+        assert sched.quota.ledger.reclaim_evictions.get("alpha", 0) == \
+            len(cluster.evictions) > 0
+
+
+# ===================== podgroup gc on delete path ====================
+
+
+class TestGroupGcOnDelete:
+    def test_delete_path_collects_expired_groups(self):
+        cluster, sched, clock = make_sched()
+        labels = {
+            C.LABEL_TPU_REQUEST: "0.5",
+            C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+            C.LABEL_GROUP_NAME: "g",
+            C.LABEL_GROUP_HEADCOUNT: "2",
+            C.LABEL_GROUP_THRESHOLD: "1.0",
+        }
+        pods = [cluster.create_pod(Pod(
+            name=f"m{i}", labels=dict(labels),
+            scheduler_name=C.SCHEDULER_NAME)) for i in range(2)]
+        for p in pods:
+            sched.schedule_one(p)
+        assert "default/g" in sched.groups._groups
+        cluster.delete_pod("default/m0")
+        # last member's delete marks the group; after the expiration
+        # window a further delete-path gc reclaims it with NO tick
+        cluster.delete_pod("default/m1")
+        clock.now += C.POD_GROUP_EXPIRATION_SECONDS + 1
+        solo = cluster.create_pod(tpu_pod("solo", 0.5))
+        sched.schedule_one(solo)
+        cluster.delete_pod("default/solo")
+        assert "default/g" not in sched.groups._groups
