@@ -26,6 +26,12 @@ sim-replay:
 fairness-sim:
 	$(PYTHON) tools/fairness_sim.py
 
+# closed-loop capacity-planner replay on a starvation trace ->
+# AUTOSCALE.json + deploy/nodepool-patch.yaml (recommendations become
+# node-add/node-remove events; fixed-capacity baseline for the A/B)
+autoscale-sim:
+	$(PYTHON) tools/autoscale_sim.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -70,4 +76,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay fairness-sim dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim dryrun images push save kind-e2e perf-evidence clean

@@ -1,0 +1,56 @@
+"""Deficit-driven elastic capacity planner.
+
+The quota plane (kubeshare_tpu/quota) can *measure* starvation — a
+guaranteed tenant's ``tenant_quota_deficit_chips`` — but the cluster
+had no way to *act* on it: once reclaim has clawed back every borrowed
+chip, the only remedy for a persistent deficit was a human adding
+nodes. This package closes that loop, dry-run first:
+
+- ``demand``    — the demand ledger: every pending/waiting pod the
+  scheduler could not place, classified into per-(tenant, model,
+  chip-shape) buckets with a reason code (over-quota,
+  no-feasible-cell, fragmentation-blocked, gang-waiting), fed from
+  the same PreFilter/Permit walks that charge the usage ledger.
+- ``recommend`` — the node-pool recommender: folds demand buckets,
+  per-tenant quota deficits, and per-model bound/free capacity into
+  per-model node-pool target deltas, with hysteresis, per-direction
+  cooldowns, and a max-surge clamp.
+- ``planner``   — snapshots a live engine into the recommender's
+  input (capacity, demand, deficits, drain candidates).
+- ``actuator``  — dry-run only: /metrics gauges, a structured JSON
+  artifact, and a rendered node-pool patch manifest under deploy/.
+  No cloud API calls; the artifact is the interface.
+
+Sim integration lives in kubeshare_tpu/sim (node-add/node-remove
+events + a controller hook) and tools/autoscale_sim.py banks
+AUTOSCALE.json — the closed-loop evidence that recommendations clear
+a starved guaranteed tenant's deficit vs a fixed-capacity baseline.
+"""
+
+from .actuator import DryRunActuator
+from .demand import (
+    REASON_FRAGMENTATION, REASON_GANG_WAITING, REASON_NO_FEASIBLE_CELL,
+    REASON_OVER_QUOTA, DemandEntry, DemandLedger,
+)
+from .planner import CapacityPlanner
+from .recommend import (
+    DrainCandidate, ModelCapacity, ModelPlan, PlannerSnapshot,
+    Recommendation, Recommender,
+)
+
+__all__ = [
+    "CapacityPlanner",
+    "DemandEntry",
+    "DemandLedger",
+    "DrainCandidate",
+    "DryRunActuator",
+    "ModelCapacity",
+    "ModelPlan",
+    "PlannerSnapshot",
+    "Recommendation",
+    "Recommender",
+    "REASON_FRAGMENTATION",
+    "REASON_GANG_WAITING",
+    "REASON_NO_FEASIBLE_CELL",
+    "REASON_OVER_QUOTA",
+]

@@ -1,0 +1,209 @@
+"""Dry-run actuation: the recommendation leaves the process as
+evidence, never as a cloud API call.
+
+Three surfaces, all fed from the last actuated round:
+
+- ``samples()`` — /metrics gauges
+  (``tpu_scheduler_autoscale_*``), merged into the scheduler's
+  exposition the same way the quota plane's gauges are;
+- a structured JSON artifact (``--autoscale-artifact``) — the
+  machine-readable interface an external actuator (or a human) can
+  poll; rewritten atomically each round;
+- a rendered node-pool patch manifest (``--autoscale-manifest``,
+  conventionally under ``deploy/``) — one ``NodePoolPatch`` document
+  per model with a nonzero delta or a drain list, in the shape a
+  ``kubectl apply``-style pipeline or cloud CLI wrapper consumes.
+
+The manifest is a *rendering* of the recommendation, not a CRD this
+repo serves: the point is that the operator's existing node-pool
+tooling — gcloud, terraform, karpenter — is the actuator, and this
+file is its input.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import List, Optional
+
+from ..utils import expfmt
+from .demand import DemandLedger
+from .recommend import PlannerSnapshot, Recommendation
+
+
+class DryRunActuator:
+    def __init__(self, artifact_path: str = "", manifest_path: str = "",
+                 log=None):
+        self.artifact_path = artifact_path
+        self.manifest_path = manifest_path
+        self.log = log
+        self.rounds = 0
+        self._last: Optional[Recommendation] = None
+
+    def actuate(self, rec: Recommendation, snap: PlannerSnapshot,
+                demand: Optional[DemandLedger] = None) -> dict:
+        self.rounds += 1
+        self._last = rec
+        doc = self.render_doc(rec, snap, demand)
+        if self.artifact_path:
+            self._write_atomic(
+                self.artifact_path, json.dumps(doc, indent=1) + "\n"
+            )
+        if self.manifest_path:
+            self._write_atomic(
+                self.manifest_path, self.render_manifest(rec)
+            )
+        if self.log is not None:
+            for plan in rec.plans:
+                if plan.delta_nodes or plan.drain_nodes:
+                    self.log.info(
+                        "autoscale %s: nodes %d -> %d (%+d)%s",
+                        plan.model, plan.current_nodes, plan.target_nodes,
+                        plan.delta_nodes,
+                        f", drain {','.join(plan.drain_nodes)}"
+                        if plan.drain_nodes else "",
+                    )
+        return doc
+
+    # -- renderings ---------------------------------------------------
+
+    @staticmethod
+    def render_doc(rec: Recommendation, snap: PlannerSnapshot,
+                   demand: Optional[DemandLedger] = None) -> dict:
+        doc = {
+            "generated_by": "kubeshare_tpu/autoscale",
+            "at": rec.at,
+            "total_chips": snap.total_chips,
+            "plans": [
+                {
+                    "model": p.model,
+                    "current_nodes": p.current_nodes,
+                    "target_nodes": p.target_nodes,
+                    "delta_nodes": p.delta_nodes,
+                    "chips_needed": p.chips_needed,
+                    "quota_term_chips": p.quota_term_chips,
+                    "placement_term_chips": p.placement_term_chips,
+                    "drain_nodes": list(p.drain_nodes),
+                    "reasons": list(p.reasons),
+                }
+                for p in rec.plans
+            ],
+            "starved_deficit_chips": dict(
+                sorted(rec.starved_deficit_chips.items())
+            ),
+        }
+        if demand is not None:
+            doc["demand"] = [
+                {
+                    "tenant": t, "model": m, "shape": s, "reason": r,
+                    "chips": round(b["chips"], 3), "pods": b["pods"],
+                }
+                for (t, m, s, r), b in sorted(demand.buckets().items())
+            ]
+        return doc
+
+    @staticmethod
+    def render_manifest(rec: Recommendation) -> str:
+        """Multi-document YAML, one NodePoolPatch per model with a
+        change. Hand-rendered (flat, two levels) so the actuator has
+        no YAML dependency on the write path."""
+        docs: List[str] = [
+            "# Rendered by the kubeshare-tpu capacity planner (dry run).",
+            "# One NodePoolPatch per chip model with a recommended",
+            "# change; feed targetNodes/drainNodes to your node-pool",
+            "# tooling. Regenerate: make autoscale-sim (or the live",
+            "# scheduler's --autoscale-manifest).",
+        ]
+        emitted = 0
+        for plan in rec.plans:
+            if not plan.delta_nodes and not plan.drain_nodes:
+                continue
+            emitted += 1
+            lines = [
+                "---",
+                "apiVersion: kubeshare.tpu/v1alpha1",
+                "kind: NodePoolPatch",
+                "metadata:",
+                f"  name: autoscale-{plan.model}",
+                "spec:",
+                f"  model: {plan.model}",
+                f"  currentNodes: {plan.current_nodes}",
+                f"  targetNodes: {plan.target_nodes}",
+                f"  deltaNodes: {plan.delta_nodes}",
+            ]
+            if plan.drain_nodes:
+                lines.append("  drainNodes:")
+                lines += [f"  - {node}" for node in plan.drain_nodes]
+            else:
+                lines.append("  drainNodes: []")
+            if plan.reasons:
+                lines.append("  reasons:")
+                lines += [
+                    f"  - {json.dumps(reason)}" for reason in plan.reasons
+                ]
+            docs.append("\n".join(lines))
+        if not emitted:
+            docs.append("---\n# no changes recommended this round")
+        return "\n".join(docs) + "\n"
+
+    @staticmethod
+    def _write_atomic(path: str, content: str) -> None:
+        """Rename-into-place: a reader polling the artifact must never
+        see a half-written round."""
+        directory = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(
+            dir=directory, prefix=os.path.basename(path) + ".tmp"
+        )
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(content)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # -- observability ------------------------------------------------
+
+    def samples(self) -> List["expfmt.Sample"]:
+        samples = [
+            expfmt.Sample(
+                "tpu_scheduler_autoscale_rounds_total", {}, self.rounds
+            ),
+        ]
+        rec = self._last
+        if rec is None:
+            return samples
+        for plan in rec.plans:
+            labels = {"model": plan.model}
+            samples += [
+                expfmt.Sample(
+                    "tpu_scheduler_autoscale_current_nodes", labels,
+                    plan.current_nodes,
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_autoscale_target_nodes", labels,
+                    plan.target_nodes,
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_autoscale_delta_nodes", labels,
+                    plan.delta_nodes,
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_autoscale_chips_needed", labels,
+                    plan.chips_needed,
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_autoscale_drain_nodes", labels,
+                    len(plan.drain_nodes),
+                ),
+            ]
+        for tenant, chips in sorted(rec.starved_deficit_chips.items()):
+            samples.append(expfmt.Sample(
+                "tpu_scheduler_autoscale_starved_deficit_chips",
+                {"tenant": tenant}, chips,
+            ))
+        return samples

@@ -1,0 +1,192 @@
+"""The demand ledger: what the cluster is failing to place, and why.
+
+The usage ledger (quota/ledger.py) records what each tenant *holds*;
+this ledger records what each tenant is *waiting for*. Every
+scheduling attempt that ends short of a bind files (or refreshes) one
+entry for the pod — keyed so a requeue updates in place — and every
+bind or delete resolves it. Entries carry the pod's RESOLVED demand
+(the same chips/HBM the quota gate charges, so planner math and
+admission math can never disagree) plus a reason code:
+
+- ``over-quota``             — the tenant quota gate refused admission
+  (guaranteed ceiling or borrow ceiling). Not a placement failure:
+  more *capacity* fixes it only because quota fractions are of bound
+  capacity, which is exactly the signal the recommender's quota
+  sizing term consumes.
+- ``no-feasible-cell``       — no node can place the pod and the
+  cluster does not even hold the demand in aggregate: a true
+  capacity shortfall.
+- ``fragmentation-blocked``  — the cluster holds the demand in
+  aggregate (enough free fractional capacity / whole-free chips
+  cluster-wide) but no single node/cell fits it; defrag's territory,
+  and scale-up's when defrag cannot clear it.
+- ``gang-waiting``           — reserved and parked at the Permit
+  barrier waiting for gang members; capacity is held, the rest of
+  the gang's demand is what is pending.
+
+The ledger is scheduling-thread-owned scratch state (like the defrag
+holds): it is rebuilt by the next pass after a restart, never
+persisted. ``samples()`` aggregates entries into per-(tenant, model,
+shape, reason) gauges for /metrics; ``snapshot()`` hands the planner
+an immutable copy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, Iterable, List, Set, Tuple
+
+from ..utils import expfmt
+
+REASON_OVER_QUOTA = "over-quota"
+REASON_NO_FEASIBLE_CELL = "no-feasible-cell"
+REASON_FRAGMENTATION = "fragmentation-blocked"
+REASON_GANG_WAITING = "gang-waiting"
+
+REASONS = (
+    REASON_OVER_QUOTA,
+    REASON_NO_FEASIBLE_CELL,
+    REASON_FRAGMENTATION,
+    REASON_GANG_WAITING,
+)
+
+# reasons that mean "admitted but unplaceable" — capacity the cluster
+# owes right now, vs over-quota which is owed only once quota grows
+UNPLACED_REASONS = (
+    REASON_NO_FEASIBLE_CELL,
+    REASON_FRAGMENTATION,
+    REASON_GANG_WAITING,
+)
+
+
+@dataclass(frozen=True)
+class DemandEntry:
+    pod_key: str
+    tenant: str
+    model: str          # requested chip model, "*" = any
+    shape: str          # "shared" (fractional) or "xN" (whole chips)
+    guarantee: bool     # priority >= 1 — the class guarantees cover
+    chips: float        # resolved chip demand (quota-gate units)
+    mem: int            # resolved HBM demand (quota-gate units)
+    reason: str
+    since: float        # first time this pod was seen pending
+    updated: float      # last attempt that refreshed the entry
+
+
+def shape_of(req) -> str:
+    """Chip-shape bucket key for a requirement: whole-chip pods bucket
+    by count (an x4 pod needs a very different node than an x1), all
+    fractional pods share one bucket (any leaf with headroom serves
+    them)."""
+    from ..scheduler.labels import PodKind
+
+    if req.kind == PodKind.MULTI_CHIP:
+        return f"x{req.chip_count}"
+    return "shared"
+
+
+class DemandLedger:
+    def __init__(self):
+        self._entries: Dict[str, DemandEntry] = {}
+
+    def note(self, pod_key: str, req, reason: str, now: float,
+             chips: float, mem: int) -> None:
+        """File or refresh the pod's pending-demand entry. ``since``
+        survives reason changes — a pod that moved from over-quota to
+        fragmentation-blocked has been starving the whole time."""
+        prior = self._entries.get(pod_key)
+        entry = DemandEntry(
+            pod_key=pod_key,
+            tenant=req.tenant,
+            model=req.model or "*",
+            shape=shape_of(req),
+            guarantee=req.is_guarantee,
+            chips=chips,
+            mem=mem,
+            reason=reason,
+            since=prior.since if prior is not None else now,
+            updated=now,
+        )
+        self._entries[pod_key] = entry
+
+    def resolve(self, pod_key: str) -> None:
+        """The pod bound or left the cluster — either way it no longer
+        wants anything."""
+        self._entries.pop(pod_key, None)
+
+    # -- reads --------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entries(self) -> List[DemandEntry]:
+        return list(self._entries.values())
+
+    def snapshot(self) -> Tuple[DemandEntry, ...]:
+        """Immutable copy for the planner (entries are frozen; the
+        tuple pins membership)."""
+        return tuple(self._entries.values())
+
+    def guarantee_demand_tenants(self) -> Set[str]:
+        """Tenants with pending GUARANTEE-class demand — crossed with
+        the quota deficits this is the 'someone is starving' signal
+        the reclaim budget lane keys on."""
+        return {e.tenant for e in self._entries.values() if e.guarantee}
+
+    def buckets(self) -> Dict[Tuple[str, str, str, str], dict]:
+        """(tenant, model, shape, reason) -> {chips, mem, pods,
+        oldest_since}: the aggregation the gauges and the artifact
+        share."""
+        out: Dict[Tuple[str, str, str, str], dict] = {}
+        for e in list(self._entries.values()):
+            key = (e.tenant, e.model, e.shape, e.reason)
+            bucket = out.get(key)
+            if bucket is None:
+                bucket = out[key] = {
+                    "chips": 0.0, "mem": 0, "pods": 0,
+                    "oldest_since": e.since,
+                }
+            bucket["chips"] += e.chips
+            bucket["mem"] += e.mem
+            bucket["pods"] += 1
+            bucket["oldest_since"] = min(bucket["oldest_since"], e.since)
+        return out
+
+    def samples(self) -> List["expfmt.Sample"]:
+        samples: List[expfmt.Sample] = []
+        for (tenant, model, shape, reason), bucket in sorted(
+            self.buckets().items()
+        ):
+            labels = {
+                "tenant": tenant, "model": model,
+                "shape": shape, "reason": reason,
+            }
+            samples += [
+                expfmt.Sample(
+                    "tpu_scheduler_demand_chips", labels, bucket["chips"]
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_demand_pods", labels, bucket["pods"]
+                ),
+            ]
+        return samples
+
+    # -- planner helpers ---------------------------------------------
+
+    @staticmethod
+    def resolve_models(entries: Iterable[DemandEntry],
+                       models: List[str]) -> List[DemandEntry]:
+        """Rewrite model-agnostic ("*") entries to a concrete model so
+        the per-model sizing math has somewhere to put them: the only
+        model when there is one, else the first sorted model
+        (deterministic; a multi-model cluster that relies on "*"
+        demand should label its pods)."""
+        if not models:
+            return [e for e in entries if e.model != "*"]
+        target = models[0]
+        out = []
+        for e in entries:
+            if e.model == "*":
+                e = replace(e, model=target)
+            out.append(e)
+        return out

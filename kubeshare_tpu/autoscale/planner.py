@@ -1,0 +1,217 @@
+"""Snapshot a live engine into the recommender's input, and drive one
+plan→actuate round.
+
+The planner reads four engine surfaces, all scheduling-thread-owned
+(run it from the scheduler loop, like tick()):
+
+- the cell tree — per-model node template (chips per node), pool size
+  (declared node cells, bound or not), live capacity and free chips;
+- the demand ledger — pending demand entries with reason codes;
+- the quota plane — per-tenant guarantee usage, guaranteed fractions,
+  and deficits;
+- the status store — which pods occupy which node, for drain-candidate
+  classification (idle / movable / guarantee-hosting).
+
+A node is a *drain candidate* when its bound leaves are entirely free
+(``idle``) or every occupant is an opportunistic non-gang pod whose
+chips AND HBM fit into the rest of the cluster's free capacity for
+that model (``movable`` — the feasible move-out plan the scale-down
+safety invariant demands). Guarantee-class pods and pods of tenants
+with a configured guarantee make a node undrainable, full stop: the
+planner counts them and the recommender refuses the node.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import expfmt
+from .actuator import DryRunActuator
+from .recommend import (
+    DrainCandidate, ModelCapacity, PlannerSnapshot, Recommendation,
+    Recommender,
+)
+
+
+class CapacityPlanner:
+    def __init__(self, engine, recommender: Optional[Recommender] = None,
+                 actuator: Optional[DryRunActuator] = None):
+        self.engine = engine
+        self.recommender = recommender or Recommender()
+        self.actuator = actuator or DryRunActuator()
+
+    # -- snapshot -----------------------------------------------------
+
+    def snapshot(self) -> PlannerSnapshot:
+        engine = self.engine
+        tree = engine.tree
+        quota = engine.quota
+
+        # per-model capacity: template from DECLARED leaves (a spare
+        # node cell with no chips yet still defines the pool), live
+        # counts from bound healthy leaves
+        chips_per_node: Dict[str, int] = {}
+        pool_nodes: Dict[str, int] = {}
+        bound_nodes: Dict[str, int] = {}
+        bound_chips: Dict[str, int] = {}
+        free_chips: Dict[str, float] = {}
+        node_model: Dict[str, str] = {}      # node -> dominant model
+        node_free: Dict[str, float] = {}     # node -> free chips (healthy)
+        node_live_chips: Dict[str, int] = {} # node -> healthy bound leaves
+        whole_free: Dict[str, int] = {}      # model -> whole-free leaves
+        node_whole_free: Dict[str, int] = {} # node -> whole-free leaves
+        for node in tree.nodes():
+            declared: Dict[str, int] = {}
+            for leaf in tree.declared_leaves(node):
+                declared[leaf.leaf_cell_type] = (
+                    declared.get(leaf.leaf_cell_type, 0) + 1
+                )
+            for model, count in declared.items():
+                chips_per_node[model] = max(
+                    chips_per_node.get(model, 0), count
+                )
+                pool_nodes[model] = pool_nodes.get(model, 0) + 1
+            dominant = max(declared, key=lambda m: (declared[m], m),
+                           default="")
+            node_model[node] = dominant
+            live = [l for l in tree.leaves_view(node) if l.healthy]
+            if not live:
+                continue
+            node_live_chips[node] = len(live)
+            node_free[node] = sum(l.available for l in live)
+            node_whole_free[node] = sum(1 for l in live if l.is_whole_free)
+            per_model: Dict[str, List] = {}
+            for leaf in live:
+                per_model.setdefault(leaf.leaf_cell_type, []).append(leaf)
+            for model, leaves in per_model.items():
+                bound_nodes[model] = bound_nodes.get(model, 0) + 1
+                bound_chips[model] = bound_chips.get(model, 0) + len(leaves)
+                free_chips[model] = free_chips.get(model, 0.0) + sum(
+                    l.available for l in leaves
+                )
+                whole_free[model] = whole_free.get(model, 0) + sum(
+                    1 for l in leaves if l.is_whole_free
+                )
+
+        capacity = {
+            model: ModelCapacity(
+                model=model,
+                chips_per_node=chips_per_node[model],
+                pool_nodes=pool_nodes.get(model, 0),
+                bound_nodes=bound_nodes.get(model, 0),
+                bound_chips=bound_chips.get(model, 0),
+                free_chips=round(free_chips.get(model, 0.0), 6),
+            )
+            for model in chips_per_node
+        }
+
+        # tenant-side inputs
+        guaranteed_fraction: Dict[str, float] = {}
+        guarantee_used: Dict[str, float] = {}
+        deficits: Dict[str, float] = {}
+        for tenant, spec in quota.registry.configured().items():
+            if spec.guaranteed is None:
+                continue
+            guaranteed_fraction[tenant] = spec.guaranteed
+            guarantee_used[tenant] = quota.ledger.guarantee_chips_used(tenant)
+            deficits[tenant] = quota.deficit_chips(tenant)
+
+        drains = self._drain_candidates(
+            node_model, node_free, node_live_chips, free_chips,
+            whole_free, node_whole_free,
+        )
+
+        total_chips, _ = quota.capacity()
+        return PlannerSnapshot(
+            now=engine.clock(),
+            total_chips=total_chips,
+            capacity=capacity,
+            demand=engine.demand.snapshot(),
+            guarantee_used=guarantee_used,
+            guaranteed_fraction=guaranteed_fraction,
+            deficits=deficits,
+            drains=drains,
+        )
+
+    def _drain_candidates(
+        self,
+        node_model: Dict[str, str],
+        node_free: Dict[str, float],
+        node_live_chips: Dict[str, int],
+        free_chips: Dict[str, float],
+        whole_free: Dict[str, int],
+        node_whole_free: Dict[str, int],
+    ) -> Tuple[DrainCandidate, ...]:
+        from ..scheduler.state import PodState
+
+        engine = self.engine
+        registry = engine.quota.registry
+        by_node: Dict[str, List] = {}
+        for status in engine.status.values():
+            if status.state == PodState.BOUND and status.node_name:
+                by_node.setdefault(status.node_name, []).append(status)
+
+        out: List[DrainCandidate] = []
+        for node, live in sorted(node_live_chips.items()):
+            model = node_model.get(node, "")
+            occupants = by_node.get(node, [])
+            guarantee_pods = sum(
+                1 for s in occupants
+                if s.requirements.is_guarantee
+                or registry.spec(s.tenant).guaranteed is not None
+            )
+            idle = not occupants and node_free.get(node, 0.0) >= live - 1e-9
+            movable = False
+            if occupants and guarantee_pods == 0:
+                from ..scheduler.labels import PodKind
+
+                relocatable = all(
+                    not s.group_key for s in occupants
+                )
+                # move-out feasibility, PER SHAPE: fractional occupants
+                # need fractional headroom, whole-chip occupants need
+                # WHOLE-FREE leaves elsewhere — aggregate fractional
+                # free spread across partial leaves cannot absorb an
+                # x4 pod. (HBM rides along: charged_mem vs free HBM is
+                # dominated by the chip check on uniform nodes, and
+                # the move re-runs real admission anyway.)
+                displaced = sum(s.charged_chips for s in occupants)
+                displaced_whole = sum(
+                    s.requirements.chip_count for s in occupants
+                    if s.requirements.kind == PodKind.MULTI_CHIP
+                )
+                elsewhere = (
+                    free_chips.get(model, 0.0) - node_free.get(node, 0.0)
+                )
+                elsewhere_whole = (
+                    whole_free.get(model, 0)
+                    - node_whole_free.get(node, 0)
+                )
+                movable = (
+                    relocatable
+                    and displaced <= elsewhere + 1e-9
+                    and displaced_whole <= elsewhere_whole
+                )
+            out.append(DrainCandidate(
+                node=node,
+                model=model,
+                chips=live,
+                idle=idle,
+                movable=movable,
+                guarantee_pods=guarantee_pods,
+            ))
+        return tuple(out)
+
+    # -- rounds -------------------------------------------------------
+
+    def plan(self) -> Tuple[Recommendation, PlannerSnapshot]:
+        snap = self.snapshot()
+        return self.recommender.recommend(snap), snap
+
+    def run_once(self) -> dict:
+        """One plan→actuate round; returns the actuated JSON doc."""
+        rec, snap = self.plan()
+        return self.actuator.actuate(rec, snap, self.engine.demand)
+
+    def samples(self) -> List["expfmt.Sample"]:
+        return self.actuator.samples()

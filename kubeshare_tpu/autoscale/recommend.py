@@ -1,0 +1,313 @@
+"""The node-pool recommender: demand + deficits + capacity -> deltas.
+
+Sizing rule (DESIGN.md "PR-3 additions"):
+
+- **Scale-up** per model is the max of two terms, ceiled to whole
+  nodes of that model's topology:
+
+  - *quota term* — the extra bound capacity needed so every guaranteed
+    tenant's pending guarantee demand fits inside its own guarantee:
+    ``max over tenants t of (U_t + D_t)/g_t − C`` (clamped at 0),
+    where ``U_t`` is the tenant's guarantee-class usage, ``D_t`` its
+    pending guarantee demand for this model (ALL reasons — over-quota
+    demand is precisely what this term exists to clear: quota is a
+    fraction of bound capacity, so adding nodes grows the quota), and
+    ``g_t`` its guaranteed fraction. Capacity is shared, so the max
+    over tenants — not the sum — is the binding constraint.
+  - *placement term* — guarantee demand already admitted but
+    unplaceable (no-feasible-cell / fragmentation-blocked /
+    gang-waiting) minus the model's free chips: what the cluster
+    physically owes right now. Deliberately does NOT subtract
+    borrowed-reclaimable capacity: reclaim is the quota plane's lever
+    and it runs regardless; when it suffices the demand clears before
+    the next planning round and the term collapses on its own.
+
+- **Scale-down** drains only nodes whose leaves are entirely free, or
+  whose occupants are all opportunistic non-gang pods the rest of the
+  cluster can absorb (a feasible move-out plan). A node hosting even
+  one guarantee-tenant pod is NEVER drained — re-checked here even if
+  the snapshot flagged the node movable, so the safety invariant does
+  not depend on the snapshot builder.
+
+Stability: per-direction cooldowns, a max-surge clamp per round in
+both directions, and scale-down hysteresis (a node must be
+continuously drainable for ``down_stable_s`` before it is
+recommended) keep recommendations monotone under oscillating load;
+a model is never scaled up and down in the same round. The
+recommender is deterministic given its snapshot sequence: no wall
+clock, no randomness — two fresh instances fed the same snapshots
+emit identical recommendations (property-tested).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from .demand import UNPLACED_REASONS, DemandEntry, DemandLedger
+
+_EPS = 1e-9
+
+
+@dataclass(frozen=True)
+class ModelCapacity:
+    model: str
+    chips_per_node: int     # node template size (topology)
+    pool_nodes: int         # declared node cells (max pool size)
+    bound_nodes: int        # nodes currently live (healthy, bound)
+    bound_chips: int        # healthy bound leaves
+    free_chips: float       # sum of availability over those leaves
+
+
+@dataclass(frozen=True)
+class DrainCandidate:
+    node: str
+    model: str
+    chips: int
+    idle: bool              # every bound leaf whole-free
+    movable: bool           # occupants all opportunistic + relocatable
+    guarantee_pods: int     # guarantee-class or guarantee-tenant pods
+
+
+@dataclass(frozen=True)
+class PlannerSnapshot:
+    now: float
+    total_chips: float                     # cluster bound chips (quota denominator)
+    capacity: Dict[str, ModelCapacity]     # keyed by model
+    demand: Tuple[DemandEntry, ...]
+    guarantee_used: Dict[str, float]       # tenant -> guarantee chips used
+    guaranteed_fraction: Dict[str, float]  # tenant -> g (configured only)
+    deficits: Dict[str, float]             # tenant -> guaranteed deficit chips
+    drains: Tuple[DrainCandidate, ...] = ()
+
+
+@dataclass(frozen=True)
+class ModelPlan:
+    model: str
+    current_nodes: int
+    target_nodes: int
+    delta_nodes: int                  # target - current (>0 up, <0 down)
+    chips_needed: float               # pre-clamp scale-up sizing
+    quota_term_chips: float
+    placement_term_chips: float
+    drain_nodes: Tuple[str, ...]      # names recommended for drain
+    reasons: Tuple[str, ...]          # human-readable sizing/clamp notes
+
+
+@dataclass(frozen=True)
+class Recommendation:
+    at: float
+    plans: Tuple[ModelPlan, ...]
+    # starvation the planner is reacting to: min(quota deficit,
+    # pending guarantee demand) per tenant — 0 for a tenant that is
+    # merely idle under its guarantee
+    starved_deficit_chips: Dict[str, float] = field(default_factory=dict)
+
+
+class Recommender:
+    def __init__(
+        self,
+        up_cooldown_s: float = 60.0,
+        down_cooldown_s: float = 300.0,
+        down_stable_s: float = 120.0,
+        max_surge_nodes: int = 2,
+        min_nodes: int = 1,
+    ):
+        if max_surge_nodes < 1:
+            raise ValueError(
+                f"max_surge_nodes must be >= 1, got {max_surge_nodes}"
+            )
+        self.up_cooldown_s = up_cooldown_s
+        self.down_cooldown_s = down_cooldown_s
+        self.down_stable_s = down_stable_s
+        self.max_surge_nodes = max_surge_nodes
+        self.min_nodes = min_nodes
+        self._last_up: Dict[str, float] = {}     # model -> last up round
+        self._last_down: Dict[str, float] = {}   # model -> last down round
+        self._drainable_since: Dict[str, float] = {}  # node -> first seen
+        self._drain_model: Dict[str, str] = {}   # node -> model tracked under
+
+    # -- sizing terms -------------------------------------------------
+
+    @staticmethod
+    def _quota_term(snap: PlannerSnapshot,
+                    entries: List[DemandEntry], model: str) -> float:
+        """Extra bound capacity so every guaranteed tenant's pending
+        guarantee demand for ``model`` fits inside its guarantee."""
+        needed_capacity = 0.0
+        for tenant, g in snap.guaranteed_fraction.items():
+            if g <= 0:
+                continue
+            demand = sum(
+                e.chips for e in entries
+                if e.tenant == tenant and e.guarantee and e.model == model
+            )
+            if demand <= 0:
+                continue
+            used = snap.guarantee_used.get(tenant, 0.0)
+            needed_capacity = max(needed_capacity, (used + demand) / g)
+        return max(0.0, needed_capacity - snap.total_chips)
+
+    @staticmethod
+    def _placement_term(cap: ModelCapacity,
+                        entries: List[DemandEntry], model: str) -> float:
+        unmet = sum(
+            e.chips for e in entries
+            if e.guarantee and e.model == model
+            and e.reason in UNPLACED_REASONS
+        )
+        if unmet <= 0:
+            return 0.0
+        return max(0.0, unmet - cap.free_chips)
+
+    # -- the round ----------------------------------------------------
+
+    def recommend(self, snap: PlannerSnapshot) -> Recommendation:
+        models = sorted(snap.capacity)
+        entries = DemandLedger.resolve_models(list(snap.demand), models)
+        now = snap.now
+
+        plans: List[ModelPlan] = []
+        for model in models:
+            cap = snap.capacity[model]
+            reasons: List[str] = []
+
+            quota_term = self._quota_term(snap, entries, model)
+            placement_term = self._placement_term(cap, entries, model)
+            chips_needed = max(quota_term, placement_term)
+
+            up_nodes = 0
+            if chips_needed > _EPS and cap.chips_per_node > 0:
+                up_nodes = math.ceil(chips_needed / cap.chips_per_node)
+                if up_nodes > self.max_surge_nodes:
+                    reasons.append(
+                        f"max-surge clamp {up_nodes}->{self.max_surge_nodes}"
+                    )
+                    up_nodes = self.max_surge_nodes
+                headroom = cap.pool_nodes - cap.bound_nodes
+                if up_nodes > headroom:
+                    reasons.append(
+                        f"pool exhausted: {headroom} spare of "
+                        f"{cap.pool_nodes} declared"
+                    )
+                    up_nodes = max(0, headroom)
+                last = self._last_up.get(model)
+                if up_nodes > 0 and last is not None \
+                        and now - last < self.up_cooldown_s:
+                    reasons.append(
+                        f"scale-up cooldown ({self.up_cooldown_s:.0f}s)"
+                    )
+                    up_nodes = 0
+
+            # streaks update EVERY round — a node that was busy during
+            # a scale-up window must not keep a stale "drainable since"
+            # stamp and get drained the instant demand clears
+            eligible = self._update_drain_streaks(snap, model)
+            drain_nodes: Tuple[str, ...] = ()
+            if up_nodes == 0 and chips_needed <= _EPS:
+                drain_nodes = self._pick_drains(
+                    snap, cap, model, eligible, reasons
+                )
+            elif chips_needed > _EPS:
+                reasons.append("scale-up pending; no drains considered")
+
+            if up_nodes > 0:
+                self._last_up[model] = now
+            if drain_nodes:
+                self._last_down[model] = now
+
+            delta = up_nodes - len(drain_nodes)
+            plans.append(ModelPlan(
+                model=model,
+                current_nodes=cap.bound_nodes,
+                target_nodes=cap.bound_nodes + delta,
+                delta_nodes=delta,
+                chips_needed=round(chips_needed, 3),
+                quota_term_chips=round(quota_term, 3),
+                placement_term_chips=round(placement_term, 3),
+                drain_nodes=drain_nodes,
+                reasons=tuple(reasons),
+            ))
+
+        return Recommendation(
+            at=now,
+            plans=tuple(plans),
+            starved_deficit_chips=self._starved(snap, entries),
+        )
+
+    def _update_drain_streaks(self, snap: PlannerSnapshot,
+                              model: str) -> List[DrainCandidate]:
+        """Refresh the drainable-since tracker for one model and
+        return the candidates whose streak cleared ``down_stable_s``.
+        Runs EVERY round — including rounds that scale up — so a busy
+        blip always resets a node's streak."""
+        now = snap.now
+        eligible: List[DrainCandidate] = []
+        seen_this_round = set()
+        for cand in snap.drains:
+            if cand.model != model:
+                continue
+            # The safety invariant lives HERE, not in the snapshot
+            # builder: a node hosting any guarantee-tenant pod is
+            # never drained, whatever the movable/idle flags claim.
+            if cand.guarantee_pods > 0 or not (cand.idle or cand.movable):
+                continue
+            seen_this_round.add(cand.node)
+            self._drain_model[cand.node] = model
+            since = self._drainable_since.setdefault(cand.node, now)
+            if now - since >= self.down_stable_s:
+                eligible.append(cand)
+        # THIS model's nodes that stopped being drainable lose their
+        # hysteresis streak (other models' streaks are untouched —
+        # each recommend() round visits every model once)
+        for node in [
+            n for n, m in self._drain_model.items()
+            if m == model and n not in seen_this_round
+        ]:
+            self._drainable_since.pop(node, None)
+            self._drain_model.pop(node, None)
+        return eligible
+
+    def _pick_drains(self, snap: PlannerSnapshot, cap: ModelCapacity,
+                     model: str, eligible: List[DrainCandidate],
+                     reasons: List[str]) -> Tuple[str, ...]:
+        """Cooldown/floor/surge-gated selection over streak-cleared
+        candidates."""
+        now = snap.now
+        if not eligible:
+            return ()
+        last = self._last_down.get(model)
+        if last is not None and now - last < self.down_cooldown_s:
+            reasons.append(
+                f"scale-down cooldown ({self.down_cooldown_s:.0f}s)"
+            )
+            return ()
+        budget = min(
+            self.max_surge_nodes,
+            max(0, cap.bound_nodes - self.min_nodes),
+        )
+        if budget <= 0:
+            reasons.append(f"min-nodes floor ({self.min_nodes})")
+            return ()
+        # idle nodes first (zero disruption), then movable; name-sorted
+        # within each class for determinism
+        eligible.sort(key=lambda c: (not c.idle, c.node))
+        picked = tuple(c.node for c in eligible[:budget])
+        if len(eligible) > budget:
+            reasons.append(
+                f"max-surge clamp {len(eligible)}->{budget} drains"
+            )
+        return picked
+
+    @staticmethod
+    def _starved(snap: PlannerSnapshot,
+                 entries: List[DemandEntry]) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for tenant, deficit in snap.deficits.items():
+            pending = sum(
+                e.chips for e in entries
+                if e.tenant == tenant and e.guarantee
+            )
+            out[tenant] = round(min(max(0.0, deficit), pending), 3)
+        return out

@@ -634,6 +634,14 @@ class CellTree:
             return by_model.get(model, ())
         return bound
 
+    def declared_leaves(self, node: str) -> List[Cell]:
+        """Every leaf cell the topology declares under ``node``, bound
+        or not — the node's TEMPLATE. The capacity planner sizes
+        whole-node scale-ups from this (chips a node of this shape
+        brings when it joins), which live bound counts cannot answer
+        for a node that has not joined yet."""
+        return list(self._leaves_by_node.get(node, []))
+
     def scan_bound_leaves(self, node: str) -> List[Cell]:
         """Non-caching bound-leaf read for observer threads (the
         scheduler's /metrics handler): never writes ``_bound_cache``,

@@ -100,6 +100,33 @@ def build_parser() -> argparse.ArgumentParser:
              "waits, goodput NOT recovered",
     )
     parser.add_argument(
+        "--defrag-reclaim-share", type=float, default=0.5,
+        help="fraction of the eviction budget reserved for "
+             "quota-reclaim defrag while a guaranteed tenant is "
+             "starving (deficit + pending guarantee demand); "
+             "opportunistic defrag is confined to the remainder. 0 "
+             "disables the lane; only meaningful with "
+             "--defrag-eviction-rate",
+    )
+    parser.add_argument(
+        "--autoscale-interval", type=float, default=0.0,
+        help="run the capacity planner every N seconds (0 = off): "
+             "demand ledger + quota deficits -> per-model node-pool "
+             "recommendations, exported as gauges and dry-run "
+             "artifacts (no cloud API calls)",
+    )
+    parser.add_argument(
+        "--autoscale-artifact", default="", metavar="PATH",
+        help="write the planner's JSON recommendation artifact here "
+             "each round (atomic replace; the interface an external "
+             "node-pool actuator polls)",
+    )
+    parser.add_argument(
+        "--autoscale-manifest", default="", metavar="PATH",
+        help="render the NodePoolPatch manifest here each round "
+             "(conventionally deploy/nodepool-patch.yaml)",
+    )
+    parser.add_argument(
         "--tenants", default="", metavar="PATH",
         help="tenant quota config (YAML mapping or ConfigMap manifest "
              "with data.tenants): per-tenant fair-share weight, "
@@ -189,11 +216,12 @@ class SchedulerMetrics:
     (scheduler.go [Filter]/[Score]/[Reserve] Infof)."""
 
     def __init__(self, clock=time.time, tracer=None, engine=None,
-                 elector=None):
+                 elector=None, planner=None):
         self.clock = clock
         self.tracer = tracer
         self.engine = engine
         self.elector = elector
+        self.planner = planner
         self.decisions = {"bound": 0, "waiting": 0, "unschedulable": 0}
         self.passes = 0
         self.last_pass_seconds = 0.0
@@ -240,6 +268,8 @@ class SchedulerMetrics:
         ]
         if self.engine is not None:
             samples += self.engine.utilization_samples()
+        if self.planner is not None:
+            samples += self.planner.samples()
         if self.tracer is not None:
             samples += self.tracer.metric_samples("tpu_scheduler_phase")
         return expfmt.render(samples)
@@ -421,6 +451,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         defrag_max_victims=args.defrag_max_victims,
         defrag_hold_ttl=args.defrag_hold_ttl,
         defrag_eviction_rate=args.defrag_eviction_rate,
+        defrag_reclaim_share=args.defrag_reclaim_share,
         percentage_of_nodes_to_score=args.percentage_of_nodes_to_score,
         min_feasible_nodes=args.min_feasible_nodes,
         tenants=args.tenants or None,
@@ -452,7 +483,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     # snapshot adapters expose refresh(); the kube adapter poll()
     sync = getattr(cluster, "refresh", None) or cluster.poll
 
-    metrics = SchedulerMetrics(tracer=tracer, engine=engine, elector=elector)
+    # dry-run capacity planner: rides the scheduling loop (it reads
+    # scheduling-thread state — demand ledger, status store), emits
+    # gauges + artifacts only
+    planner = None
+    if args.autoscale_interval > 0 or args.autoscale_artifact \
+            or args.autoscale_manifest:
+        from ..autoscale import CapacityPlanner, DryRunActuator
+
+        planner = CapacityPlanner(
+            engine,
+            actuator=DryRunActuator(
+                artifact_path=args.autoscale_artifact,
+                manifest_path=args.autoscale_manifest,
+                log=log,
+            ),
+        )
+
+    metrics = SchedulerMetrics(tracer=tracer, engine=engine,
+                               elector=elector, planner=planner)
     metrics_server = None
     if args.metrics_port:
         from ..utils.httpserv import MetricServer
@@ -478,6 +527,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         try:
             sync()
             run_pass(engine, cluster, journal, metrics, guard)
+            if planner is not None:
+                planner.run_once()
         finally:
             # a raised pass must still vacate the lease, or the next
             # --once run is locked out for the full lease duration
@@ -495,6 +546,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     stop = setup_signal_handler()
     log.info("scheduler loop started (interval %.1fs)", args.interval)
     trace_written_at = 0
+    planner_ran_at = -1e18  # first planner round on the first pass
     # reservations dropped by a hot-reload, carried until a pass
     # actually runs with them: poll() consumes the file's mtime, so a
     # sync()/run_pass() failure in the same iteration must not lose
@@ -514,6 +566,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             run_pass(engine, cluster, journal, metrics, guard,
                      requeue=requeue)
             requeue = []
+            if planner is not None and (
+                time.monotonic() - planner_ran_at
+                >= max(args.autoscale_interval, args.interval)
+            ):
+                planner.run_once()
+                planner_ran_at = time.monotonic()
         except Exception as e:  # apiserver blips must not kill the loop
             log.error("scheduling pass failed: %s", e)
         if args.trace_out and metrics.passes - trace_written_at >= 100:

@@ -36,8 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
                         help="share of pods given a guarantee priority")
     parser.add_argument(
         "--faults", default="",
-        help="fault-injection file: lines 'time kind [target]' with kind "
-             "in node_down|node_up|pod_kill (# comments allowed)",
+        help="fault-injection file: lines 'time kind [target [chips]]' "
+             "with kind in node_down|node_up|pod_kill|node_add|"
+             "node_remove; chips only for node_add (# comments allowed)",
     )
     parser.add_argument(
         "--defrag", action="store_true",
@@ -62,13 +63,15 @@ def load_faults(path: str):
             if not line or line.startswith("#"):
                 continue
             parts = line.split()
-            if len(parts) not in (2, 3):
+            if len(parts) not in (2, 3, 4):
                 raise SystemExit(
-                    f"{path}:{line_no}: expected 'time kind [target]'"
+                    f"{path}:{line_no}: expected 'time kind "
+                    f"[target [chips]]'"
                 )
             faults.append(FaultEvent(
                 time=float(parts[0]), kind=parts[1],
-                target=parts[2] if len(parts) == 3 else "",
+                target=parts[2] if len(parts) >= 3 else "",
+                chips=int(parts[3]) if len(parts) == 4 else 0,
             ))
     return faults
 

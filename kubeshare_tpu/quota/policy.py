@@ -49,6 +49,12 @@ class QuotaPlane:
         self.ledger = UsageLedger()
         self.tree = tree
         self.log = log
+        # per-model max leaf HBM memo for demand resolution; the
+        # fingerprint (bound leaf count, total HBM) moves on exactly
+        # the events that can change the answer — chips binding/
+        # unbinding and collector HBM corrections
+        self._max_mem_cache: dict = {}
+        self._max_mem_fp: Tuple[int, int] = (-1, -1)
 
     # -- capacity & demand -------------------------------------------
 
@@ -58,30 +64,69 @@ class QuotaPlane:
         declared topology."""
         return float(len(self.tree.leaf_cells)), self.tree.total_full_memory
 
-    @staticmethod
-    def demand(req: PodRequirements) -> Tuple[float, int]:
-        """Pre-reserve demand in (chips, HBM). Memory uses the
-        DECLARED cap only — the proportional default is resolved
-        against a concrete leaf at reserve time, and the ledger charges
-        that resolved value; admission gates on what the user asked
-        for."""
+    def _max_leaf_memory(self, model: str) -> int:
+        """Largest bound-leaf HBM for ``model`` ("" = any model) — the
+        upper bound a proportional-default reservation can resolve to.
+        O(leaves) rebuilt only when the bound set or total HBM moved;
+        O(1) dict probe otherwise (this sits on the admission path)."""
+        fp = (len(self.tree.leaf_cells), self.tree.total_full_memory)
+        if fp != self._max_mem_fp:
+            self._max_mem_cache = {}
+            self._max_mem_fp = fp
+        cached = self._max_mem_cache.get(model)
+        if cached is None:
+            cached = self._max_mem_cache[model] = max(
+                (
+                    l.full_memory
+                    for l in self.tree.leaf_cells.values()
+                    if not model or l.leaf_cell_type == model
+                ),
+                default=0,
+            )
+        return cached
+
+    def demand(self, req: PodRequirements, count: int = 1
+               ) -> Tuple[float, int]:
+        """Pre-reserve demand in (chips, HBM) for ``count`` identical
+        pods (a gang's not-yet-reserved members admit as one unit).
+
+        Memory is RESOLVED the way reserve will charge it, not merely
+        declared: an unset cap defaults to a proportional slice of the
+        chosen leaf (scoring._resolved_memory), and a multi-chip pod
+        charges its leaves' full HBM whatever it declared — so the
+        gate uses the same proportional default against the largest
+        candidate leaf (admitted can never be under-resolved-quota).
+        No leaf bound yet means zero resolution, which is fine: the
+        capacity denominators are zero too."""
         if req.kind == PodKind.MULTI_CHIP:
-            return float(req.chip_count), req.memory
+            mem = req.chip_count * self._max_leaf_memory(req.model)
+            return float(req.chip_count) * count, mem * count
         if req.kind == PodKind.SHARED:
-            return req.request, req.memory
+            mem = req.memory
+            if mem <= 0:
+                mem = int(req.request * self._max_leaf_memory(req.model))
+            return req.request * count, mem * count
         return 0.0, 0
 
     # -- admission ----------------------------------------------------
 
-    def admit(self, req: PodRequirements) -> Tuple[bool, str]:
+    def admit(self, req: PodRequirements, count: int = 1
+              ) -> Tuple[bool, str]:
         """Gate BEFORE any filtering or reserve work — and before
-        defrag: an over-quota guarantee pod must wait, never evict."""
-        chips, mem = self.demand(req)
+        defrag: an over-quota guarantee pod must wait, never evict.
+
+        ``count`` > 1 is the gang-granular gate: the first member
+        admits the whole gang's outstanding demand (its own plus every
+        member not yet holding a reservation), so a gang can no longer
+        straddle the quota boundary — early members binding while late
+        ones are doomed to die at the Permit barrier."""
+        chips, mem = self.demand(req, count)
         if chips <= 0 and mem <= 0:
             return True, ""
         spec = self.registry.spec(req.tenant)
         if spec.guaranteed is None and spec.borrow_limit is None:
             return True, ""  # unconfigured tenant: seed behavior
+        gang = f" (gang of {count})" if count > 1 else ""
         cap_chips, cap_mem = self.capacity()
         if req.is_guarantee and spec.guaranteed is not None:
             quota_chips = spec.guaranteed * cap_chips
@@ -91,7 +136,7 @@ class QuotaPlane:
             if (used + chips > quota_chips + _EPS
                     or used_mem + mem > quota_mem + _EPS):
                 return False, (
-                    f"tenant {req.tenant} over guaranteed quota: "
+                    f"tenant {req.tenant} over guaranteed quota{gang}: "
                     f"{used:.3f}+{chips:.3f} chips vs "
                     f"{quota_chips:.3f} guaranteed "
                     f"({spec.guaranteed:.0%} of {cap_chips:.0f}); waiting"
@@ -104,7 +149,7 @@ class QuotaPlane:
             if (used + chips > ceil_chips + _EPS
                     or used_mem + mem > ceil_mem + _EPS):
                 return False, (
-                    f"tenant {req.tenant} at borrow ceiling: "
+                    f"tenant {req.tenant} at borrow ceiling{gang}: "
                     f"{used:.3f}+{chips:.3f} chips vs "
                     f"{ceil_chips:.3f} ceiling "
                     f"({spec.borrow_limit:.0%} of {cap_chips:.0f}); waiting"
@@ -168,6 +213,22 @@ class QuotaPlane:
         status.charged_chips = 0.0
         status.charged_mem = 0
 
+    def deficit_chips(self, tenant: str) -> float:
+        """Unmet guaranteed entitlement in chips: how far the tenant's
+        guarantee-class usage sits below its guaranteed fraction of
+        bound capacity. The scale-up signal (autoscale/) and the
+        reclaim budget lane's starvation test both read this; 0 for
+        tenants with no configured guarantee."""
+        spec = self.registry.spec(tenant)
+        if spec.guaranteed is None:
+            return 0.0
+        cap_chips, _ = self.capacity()
+        return max(
+            0.0,
+            spec.guaranteed * cap_chips
+            - self.ledger.guarantee_chips_used(tenant),
+        )
+
     # -- reclaim ------------------------------------------------------
 
     def borrowing(self, tenant: str) -> bool:
@@ -201,7 +262,14 @@ class QuotaPlane:
     def samples(self) -> List["expfmt.Sample"]:
         cap_chips, cap_mem = self.capacity()
         samples: List[expfmt.Sample] = []
-        for tenant in self.ledger.tenants():
+        # ledger tenants UNION configured tenants: a fully-starved
+        # guaranteed tenant (zero usage — everything gated or
+        # unplaceable) must still expose its quota/deficit gauges,
+        # they are the autoscale plane's scale-up signal
+        tenants = sorted(
+            set(self.ledger.tenants()) | set(self.registry.configured())
+        )
+        for tenant in tenants:
             labels = {"tenant": tenant}
             spec = self.registry.spec(tenant)
             chips = self.ledger.chips_used(tenant)
@@ -235,8 +303,7 @@ class QuotaPlane:
                     ),
                     expfmt.Sample(
                         "tpu_scheduler_tenant_quota_deficit_chips", labels,
-                        max(0.0, guaranteed_chips
-                            - self.ledger.guarantee_chips_used(tenant)),
+                        self.deficit_chips(tenant),
                     ),
                 ]
         return samples

@@ -22,6 +22,8 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
+from ..autoscale import demand as D
+from ..autoscale.demand import DemandLedger
 from ..cells.cell import _EPS, Cell, CellTree, ChipInfo
 from ..cells.spec import TopologyConfig, load_topology
 from ..cluster.api import ClusterAPI, Conflict, Node, Pod
@@ -86,6 +88,7 @@ class TpuShareScheduler:
         defrag_cooldown: float = 30.0,
         defrag_hold_ttl: float = 45.0,
         defrag_eviction_rate: float = 0.0,
+        defrag_reclaim_share: float = 0.5,
         percentage_of_nodes_to_score: int = 0,
         min_feasible_nodes: int = 64,
         tenants: Union[None, str, dict, "TenantRegistry"] = None,
@@ -124,6 +127,11 @@ class TpuShareScheduler:
         else:
             registry = TenantRegistry.from_config(tenants)
         self.quota = QuotaPlane(registry, self.tree, log=self.log)
+        # Demand ledger (autoscale plane): every schedule_one that
+        # falls short of a bind files/refreshes one entry with a
+        # reason code; binds and deletes resolve it. Scheduling-thread
+        # scratch state, rebuilt by the next pass after a restart.
+        self.demand = DemandLedger()
         self.ports: Dict[str, RRBitmap] = {}
         self._waiting: Dict[str, Dict[str, _Waiting]] = {}  # group_key -> pods
         self._synced_nodes: Set[str] = set()
@@ -188,7 +196,22 @@ class TpuShareScheduler:
                 f"eviction/minute, got {defrag_eviction_rate}"
             )
         self.defrag_eviction_rate = defrag_eviction_rate
-        self._defrag_evict_times: List[float] = []
+        # Quota-reclaim budget lane: while some guaranteed tenant is
+        # starving (positive quota deficit AND pending guarantee
+        # demand on the ledger), non-reclaim defrag may spend at most
+        # (1 - share) of the eviction budget — opportunistic churn can
+        # no longer rate-starve a guaranteed tenant's clawback. With
+        # no one starving, the full budget is open to everyone (the
+        # lane reserves, it does not waste).
+        if not 0.0 <= defrag_reclaim_share < 1.0:
+            raise ValueError(
+                "defrag_reclaim_share must be in [0, 1), got "
+                f"{defrag_reclaim_share}"
+            )
+        self.defrag_reclaim_share = defrag_reclaim_share
+        self.defrag_quota_evictions = 0  # evictions spent on reclaim
+        # sliding one-minute window: (time, quota_driven) per eviction
+        self._defrag_evict_times: List[Tuple[float, bool]] = []
 
         # Feasible-node sampling (kube-scheduler percentageOfNodesToScore
         # analog): on big clusters, stop filtering once enough feasible
@@ -250,6 +273,8 @@ class TpuShareScheduler:
         # the same _restore_bound_pod replay that rebuilds their
         # reservations, so usage can never double-count
         self.quota = QuotaPlane(self.quota.registry, tree, log=self.log)
+        # pending demand re-files itself on each pod's next attempt
+        self.demand = DemandLedger()
         self.ports = {}
         self._waiting = {}
         self._synced_nodes = set()
@@ -359,6 +384,7 @@ class TpuShareScheduler:
         self._defrag_last.pop(pod.key, None)
         self._defrag_inflight.discard(pod.key)  # eviction completed
         self._drop_defrag_holds(pod.key)  # beneficiary gone -> free the space
+        self.demand.resolve(pod.key)  # a deleted pod wants nothing
         self.groups.forget_pod(pod.key)
         status = self.status.pop(pod.key)
         if status is not None:
@@ -694,6 +720,7 @@ class TpuShareScheduler:
         except Unschedulable as e:
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
+        group = self.groups.get_or_create(pod, req.gang)
 
         # Quota admission gate — BEFORE any filtering and before
         # defrag: an over-quota guarantee pod waits (retryable; quota
@@ -701,17 +728,32 @@ class TpuShareScheduler:
         # evictions. Opportunistic pods past their tenant's borrow
         # ceiling wait the same way; idle capacity stays borrowable
         # for everyone else.
-        admitted, why = self.quota.admit(req)
+        #
+        # Gang-granular: a gang member admits the demand of every
+        # member NOT yet holding a reservation (min_available minus
+        # held — the barrier's own release threshold), so the first
+        # member gates the whole gang and a group can no longer
+        # straddle the quota boundary, binding early members only to
+        # die at the barrier (ROADMAP "gang-granular admission").
+        gang_pending = 1
+        if group.key:
+            held = sum(
+                1 for s in self.status.in_group(group.key)
+                if s.state in (
+                    PodState.RESERVED, PodState.WAITING, PodState.BOUND
+                )
+            )
+            gang_pending = max(1, group.min_available - held)
+        admitted, why = self.quota.admit(req, count=gang_pending)
         if not admitted:
+            self._note_demand(pod.key, req, D.REASON_OVER_QUOTA)
             return Decision("unschedulable", pod.key, message=why,
                             retryable=True)
 
         # gang anchors are needed twice: anchor NODES must be examined
         # first (sampling must never hide the node the rest of the gang
         # sits on), and the leaves weight locality scoring below
-        anchors = self.status.group_placed_leaves(
-            self.groups.get_or_create(pod, req.gang).key
-        )
+        anchors = self.status.group_placed_leaves(group.key)
         with maybe_span(self.tracer, "filter", pod=pod.key):
             # the incrementally-maintained sorted index replaces the
             # per-cycle list_nodes()+sorted() scan — per-pod cost is
@@ -736,6 +778,16 @@ class TpuShareScheduler:
             evicted = self._maybe_defrag(
                 pod, req,
                 [n for n in self.cluster.list_nodes() if n.healthy],
+            )
+            # demand-ledger classification: an eviction in flight, or
+            # aggregate capacity that exists but fits under no single
+            # node, is fragmentation (defrag's and/or scale-up's
+            # territory); anything else is a true capacity shortfall
+            self._note_demand(
+                pod.key, req,
+                D.REASON_FRAGMENTATION
+                if evicted or self._aggregate_fits(req)
+                else D.REASON_NO_FEASIBLE_CELL,
             )
             if evicted:
                 return Decision(
@@ -831,6 +883,7 @@ class TpuShareScheduler:
             # (concurrent reservations); release only THIS pod — gang
             # siblings keep waiting and the barrier decides their fate
             self.unreserve(pod.key, reject_group=False)
+            self._note_demand(pod.key, req, D.REASON_OVER_QUOTA)
             return Decision("unschedulable", pod.key, retryable=True,
                             message=extra)
         if action == "allow":
@@ -843,6 +896,9 @@ class TpuShareScheduler:
                     message="bind conflict (another replica acted); requeued",
                 )
             return Decision("bound", pod.key, node=best, bound_with=extra)
+        # parked at the Permit barrier: capacity is held, the rest of
+        # the gang's demand is what the cluster still owes
+        self._note_demand(pod.key, req, D.REASON_GANG_WAITING)
         return Decision(
             "waiting", pod.key, node=best,
             message=f"gang barrier, timeout {extra}s",
@@ -1023,6 +1079,39 @@ class TpuShareScheduler:
                     )
         return feasible, reasons, scans, consumed
 
+    def _note_demand(self, pod_key: str, req, reason: str) -> None:
+        """File/refresh the pod's pending-demand entry with the same
+        RESOLVED chips/HBM the quota gate uses, so planner sizing and
+        admission can never disagree about what a pod costs."""
+        if req.kind == PodKind.REGULAR:
+            return  # consumes no TPU capacity; not capacity demand
+        chips, mem = self.quota.demand(req)
+        self.demand.note(pod_key, req, reason, self.clock(), chips, mem)
+
+    def _aggregate_fits(self, req) -> bool:
+        """Does the cluster hold this demand in AGGREGATE (ignoring
+        node boundaries)? True for an unplaceable pod means the block
+        is fragmentation, not raw capacity. Cold path only — it runs
+        when nothing fit, never per candidate."""
+        model = req.model or None
+        if req.kind == PodKind.MULTI_CHIP:
+            whole = 0
+            for node in self._node_index:
+                for leaf in self.tree.leaves_view(node, model):
+                    if leaf.healthy and leaf.is_whole_free:
+                        whole += 1
+                        if whole >= req.chip_count:
+                            return True
+            return False
+        total = 0.0
+        for node in self._node_index:
+            for leaf in self.tree.leaves_view(node, model):
+                if leaf.healthy:
+                    total += leaf.available
+                    if total >= req.request - _EPS:
+                        return True
+        return False
+
     def _held_leaves(self, pod: Pod, req, node_name: str):
         """Leaves on ``node_name`` this pod must treat as nonexistent:
         a live defrag hold scopes its freed leaves to the beneficiary.
@@ -1090,15 +1179,40 @@ class TpuShareScheduler:
         if last is not None and now - last < self.defrag_cooldown:
             return []  # this pod already cost evictions recently
         max_victims = self.defrag_max_victims
+        # Quota-reclaim lane: this defrag is RECLAIM when its
+        # beneficiary is a guarantee pod whose tenant holds an unmet
+        # guarantee; while any tenant is starving (deficit + pending
+        # guarantee demand on the ledger), non-reclaim defrag is
+        # confined to the general share of the eviction budget so
+        # opportunistic churn cannot rate-starve the clawback.
+        # (is_guarantee is already guaranteed by the guard above;
+        # stated here so the classification never silently widens if
+        # that guard moves.)
+        quota_driven = (
+            req.is_guarantee
+            and self.quota.deficit_chips(req.tenant) > _EPS
+        )
         if self.defrag_eviction_rate > 0:
             self._defrag_evict_times = [
-                t for t in self._defrag_evict_times if t > now - 60.0
+                e for e in self._defrag_evict_times if e[0] > now - 60.0
             ]
             remaining = int(
                 self.defrag_eviction_rate - len(self._defrag_evict_times)
             )
+            if not quota_driven and self.defrag_reclaim_share > 0 and any(
+                self.quota.deficit_chips(t) > _EPS
+                for t in self.demand.guarantee_demand_tenants()
+            ):
+                general_cap = int(
+                    self.defrag_eviction_rate
+                    * (1.0 - self.defrag_reclaim_share)
+                )
+                general_used = sum(
+                    1 for e in self._defrag_evict_times if not e[1]
+                )
+                remaining = min(remaining, general_cap - general_used)
             if remaining <= 0:
-                return []  # cluster-wide budget spent this minute
+                return []  # this lane's budget spent this minute
             # a multi-victim plan must fit the REMAINING budget or the
             # realized rate overshoots the documented bound
             max_victims = min(max_victims, remaining)
@@ -1146,10 +1260,12 @@ class TpuShareScheduler:
             # the guarantee pod before that would double-book HBM.
             # (kube-scheduler preemption waits the same way.)
             self.defrag_evictions += 1
+            if quota_driven:
+                self.defrag_quota_evictions += 1
             if self.defrag_eviction_rate > 0:
                 # only track when budgeted: at rate=0 nothing prunes
                 # this list and it would grow for the process lifetime
-                self._defrag_evict_times.append(now)
+                self._defrag_evict_times.append((now, quota_driven))
             self._defrag_inflight.add(victim)
             evicted.append(victim)
             post = getattr(self.cluster, "post_event", None)
@@ -1220,6 +1336,13 @@ class TpuShareScheduler:
                 "tpu_scheduler_defrag_evictions_total", {},
                 self.defrag_evictions,
             ),
+            # reclaim lane usage: evictions whose beneficiary was a
+            # starved guaranteed tenant (quota clawback, not
+            # opportunistic defrag)
+            expfmt.Sample(
+                "tpu_scheduler_defrag_reclaim_evictions_total", {},
+                self.defrag_quota_evictions,
+            ),
             # live holds: LEAVES currently reserved for defrag
             # beneficiaries. This runs on the metrics HTTP thread while
             # the scheduling thread mutates the dict: snapshot with
@@ -1282,6 +1405,10 @@ class TpuShareScheduler:
         # the cluster-level counterpart of the arbiter's per-pod
         # window-usage stats
         samples += self.quota.samples()
+        # demand-ledger gauges: what the cluster is failing to place,
+        # per (tenant, model, shape, reason) — the autoscale plane's
+        # raw signal, useful on its own for starvation triage
+        samples += self.demand.samples()
         for node in self.tree.nodes():
             # non-caching read: this runs on the metrics HTTP thread,
             # which must not write the scheduling thread's leaf cache
@@ -1329,6 +1456,7 @@ class TpuShareScheduler:
     def _bind(self, pod_key: str, node_name: str) -> None:
         self.cluster.bind(pod_key, node_name)
         self._drop_defrag_holds(pod_key)  # beneficiary placed; debt paid
+        self.demand.resolve(pod_key)      # placed: demand satisfied
         status = self.status.get(pod_key)
         if status is not None:
             status.state = PodState.BOUND
@@ -1339,6 +1467,7 @@ class TpuShareScheduler:
     def _bind_regular(self, pod: Pod, node_name: str) -> None:
         self.cluster.bind(pod.key, node_name)
         self._drop_defrag_holds(pod.key)
+        self.demand.resolve(pod.key)
 
     def _ensure_synced(self, node_name: str) -> None:
         if node_name not in self._unsynced:

@@ -36,12 +36,20 @@ class FaultEvent:
 
     kinds: ``node_down`` / ``node_up`` (target = node name; down kills
     and resubmits that node's running sim pods), ``pod_kill`` (target =
-    pod key, or "" for the longest-running bound pod).
+    pod key, or "" for the longest-running bound pod), ``node_add`` /
+    ``node_remove`` (elastic capacity: a node-pool actuator bringing a
+    node up with ``chips`` chips, or draining one — remove kills and
+    resubmits any running occupants, like a real drain's controller
+    restarts). The autoscale closed loop (tools/autoscale_sim.py)
+    drives the same verbs through ``Simulator.add_node`` /
+    ``remove_node`` from its controller hook instead of a pre-scripted
+    fault list.
     """
 
     time: float
-    kind: str         # node_down | node_up | pod_kill
+    kind: str         # node_down | node_up | pod_kill | node_add | node_remove
     target: str = ""
+    chips: int = 0    # node_add only: chips the new node brings (0 = default)
 
 
 @dataclass
@@ -76,6 +84,13 @@ class SimReport:
     # each tenant's achieved share in the cluster-fairness evidence
     # (tools/fairness_sim.py Jain index)
     tenant_chip_seconds: Dict[str, float] = field(default_factory=dict)
+    # per-tenant bind waits: the per-class split above answers the
+    # defrag A/B, this answers the autoscale one (did the STARVED
+    # tenant's wait improve, not the guarantee tier's average)
+    tenant_waits: Dict[str, List[float]] = field(default_factory=dict)
+    # elastic capacity: node-add/node-remove events applied
+    nodes_added: int = 0
+    nodes_removed: int = 0
 
     @property
     def mean_wait(self) -> float:
@@ -132,6 +147,8 @@ class SimReport:
                 t: round(s, 1)
                 for t, s in sorted(self.tenant_chip_seconds.items())
             },
+            "nodes_added": self.nodes_added,
+            "nodes_removed": self.nodes_removed,
         }
 
 
@@ -180,6 +197,20 @@ class Simulator:
             tenants=tenants,
         )
         self.total_chips = sum(nodes.values())
+        self.chip_model = chip_model
+        self.chip_memory = chip_memory
+        self.default_chips_per_node = max(nodes.values(), default=4)
+        # Elastic capacity: chips currently live (node-add/node-remove
+        # move it), integrated over virtual time so utilization's
+        # denominator is chip-seconds the cluster ACTUALLY had, not
+        # final-size x span. Constant-capacity runs integrate to
+        # exactly the old total_chips x span.
+        self.current_chips = self.total_chips
+        self._cap_integral = 0.0
+        self._cap_last_t = 0.0
+        self._jobs: Optional[Dict[str, _Job]] = None
+        self._pending: Optional[List[_Job]] = None
+        self._report: Optional[SimReport] = None
         self.priority_ratio = priority_ratio
         self._rng = random.Random(seed)
 
@@ -303,15 +334,94 @@ class Simulator:
             if job is not None and job.bound_at is not None:
                 self._kill_job(job, jobs, pending, report)
             return
+        if fault.kind == "node_add":
+            self.add_node(fault.target, fault.chips)
+            return
+        if fault.kind == "node_remove":
+            self.remove_node(fault.target)
+            return
         raise ValueError(f"unknown fault kind {fault.kind!r}")
 
+    # ---- elastic capacity (node-pool actuator verbs) ---------------
+
+    def add_node(self, name: str, n_chips: int = 0) -> None:
+        """Bring a node up mid-replay: a fresh node joins with
+        ``n_chips`` chips (default: the initial nodes' size), or a
+        previously drained node re-joins with its original chips. The
+        engine binds the inventory through the same informer path a
+        real node registration takes; quota denominators grow with the
+        bound capacity automatically."""
+        existing = self.cluster.get_node(name)
+        if existing is not None:
+            if not existing.ready:
+                self.cluster.set_node_ready(name, True)
+                self.current_chips += len(self.cluster.chips_on_node(name))
+                if self._report is not None:
+                    self._report.nodes_added += 1
+            return
+        n = n_chips or self.default_chips_per_node
+        self.cluster.add_node(
+            name,
+            [
+                ChipInfo(f"{name}-chip-{i}", self.chip_model,
+                         self.chip_memory, i)
+                for i in range(n)
+            ],
+        )
+        self.current_chips += n
+        if self._report is not None:
+            self._report.nodes_added += 1
+
+    def remove_node(self, name: str) -> None:
+        """Drain a node mid-replay: running occupants are killed and
+        resubmitted (a real drain's controllers restart them
+        elsewhere), then the node leaves the schedulable set. The
+        capacity integral stops counting its chips from this tick."""
+        node = self.cluster.get_node(name)
+        if node is None or not node.ready:
+            return
+        if self._jobs is None:
+            raise RuntimeError("remove_node is only usable during run()")
+        doomed = [
+            j for j in list(self._jobs.values())
+            if j.bound_at is not None
+            and self.cluster.get_pod(j.pod.key) is not None
+            and self.cluster.get_pod(j.pod.key).node_name == name
+        ]
+        for job in doomed:
+            self._kill_job(job, self._jobs, self._pending, self._report)
+        self.cluster.set_node_ready(name, False)
+        self.current_chips -= len(self.cluster.chips_on_node(name))
+        self._report.nodes_removed += 1
+
+    def _advance_capacity_to(self, t: float) -> None:
+        if t > self._cap_last_t:
+            self._cap_integral += self.current_chips * (t - self._cap_last_t)
+            self._cap_last_t = t
+
     def run(self, events: List[TraceEvent], horizon: float = 0.0,
-            faults: Optional[List[FaultEvent]] = None) -> SimReport:
+            faults: Optional[List[FaultEvent]] = None,
+            controller=None,
+            controller_interval: float = 30.0) -> SimReport:
+        """``controller(sim, report)`` — called every
+        ``controller_interval`` virtual seconds — is the closed-loop
+        hook: a capacity planner reads the engine and calls
+        ``add_node``/``remove_node`` on the live replay. It requires a
+        horizon: a controller that keeps adding capacity could
+        otherwise keep a drained-but-pending replay alive forever."""
+        if controller is not None and not horizon:
+            raise ValueError("a controller requires an explicit horizon")
         report = SimReport()
         pending: List[_Job] = []
         finishes: List = []  # heap of (finish_time, key)
         jobs: Dict[str, _Job] = {}
         self._resubmits = 0
+        # live references for the controller verbs (remove_node kills
+        # occupants through the same path as a node_down fault)
+        self._jobs, self._pending, self._report = jobs, pending, report
+        self._cap_integral = 0.0
+        self._cap_last_t = 0.0
+        next_ctrl = controller_interval
         fault_queue = sorted(faults or [], key=lambda f: f.time)
         fi = 0
 
@@ -327,7 +437,8 @@ class Simulator:
         # beneficiary (plugin defrag hold) — waiting minutes for an
         # unrelated completion would mismodel that
         retry_at: Optional[float] = None
-        while i < len(arrivals) or pending or finishes or fi < len(fault_queue):
+        while (i < len(arrivals) or pending or finishes
+               or fi < len(fault_queue) or controller is not None):
             # next event time: arrival, finish, fault, or prompt retry
             candidates = []
             if i < len(arrivals):
@@ -339,11 +450,17 @@ class Simulator:
             if retry_at is not None:
                 candidates.append(retry_at)
                 retry_at = None
+            if controller is not None:
+                # planner ticks run to the horizon even when the trace
+                # has drained: scale-DOWN evidence (idle nodes draining
+                # after load subsides) only exists on those idle ticks
+                candidates.append(next_ctrl)
             if not candidates:
                 break
             next_t = max(self.clock_now, min(candidates))
             if next_t > end:
                 break  # horizon reached: stop before processing past it
+            self._advance_capacity_to(next_t)
             self.clock_now = next_t
 
             # completions first: frees capacity before this tick's retries
@@ -375,6 +492,14 @@ class Simulator:
                     report.submitted += 1
                 i += 1
 
+            # planner ticks due at this tick (closed loop: the
+            # controller reads the engine's demand/quota/cell state
+            # and applies node events before this tick's pass, so a
+            # scale-up is schedulable the moment it is recommended)
+            while controller is not None and next_ctrl <= self.clock_now:
+                controller(self, report)
+                next_ctrl += controller_interval
+
             # one scheduling pass over the queue (queue-sorted)
             pending.sort(key=lambda j: self.engine.queue_sort_key(j.pod))
             still_pending: List[_Job] = []
@@ -396,6 +521,9 @@ class Simulator:
                 (report.guarantee_waits
                  if parse_priority(job.pod) > 0
                  else report.opportunistic_waits).append(wait)
+                report.tenant_waits.setdefault(
+                    job.pod.namespace, []
+                ).append(wait)
                 heapq.heappush(
                     finishes,
                     (self.clock_now + job.event.runtime, job.pod.key),
@@ -465,7 +593,8 @@ class Simulator:
                     still_pending.append(job)  # capacity: retry next tick
             # drop members that a LATER sibling's Permit release bound
             # after they were already parked in still_pending this pass
-            pending = [
+            # (slice-assign: remove_node holds a reference to THIS list)
+            pending[:] = [
                 j for j in still_pending if j.pod.key not in gang_bound
             ]
             if evictions_seen > evictions_at_pass_start and pending:
@@ -474,13 +603,19 @@ class Simulator:
             self.engine.tick()
 
             if (i >= len(arrivals) and not finishes and pending
-                    and fi >= len(fault_queue)):
-                # nothing will ever free capacity for these
+                    and fi >= len(fault_queue) and controller is None):
+                # nothing will ever free capacity for these (with a
+                # controller, capacity can still ARRIVE — the horizon
+                # bounds the wait instead)
                 for job in pending:
                     report.unschedulable += 1
                     self.cluster.delete_pod(job.pod.key)
-                pending = []
+                pending.clear()
 
         span = end if end != float("inf") else self.clock_now
-        report.chip_seconds_capacity = self.total_chips * max(span, 1e-9)
+        self._advance_capacity_to(span)
+        report.chip_seconds_capacity = (
+            self._cap_integral if self._cap_integral > 0
+            else self.total_chips * 1e-9
+        )
         return report

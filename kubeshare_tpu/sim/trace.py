@@ -181,6 +181,80 @@ def generate_tenant_trace(
     return events
 
 
+def generate_starvation_trace(
+    pinned_chips: int = 18,
+    pinned_runtime: float = 4000.0,
+    prod_pods: int = 3,
+    prod_chips: int = 4,
+    prod_start: float = 300.0,
+    prod_runtime: float = 4000.0,
+    ci_pods: int = 3,
+    ci_chips: int = 4,
+    ci_start: float = 500.0,
+    ci_runtime: float = 250.0,
+    background_stop: float = 700.0,
+    mean_interarrival: float = 4.0,
+    mean_runtime: float = 120.0,
+    max_runtime: float = 240.0,
+    seed: int = 0,
+) -> List[TraceEvent]:
+    """The autoscale evidence trace (tools/autoscale_sim.py): a
+    guaranteed tenant whose deficit CANNOT be cleared by reclaim, so
+    fixed capacity starves it and only node-pool growth fixes it.
+
+    Four tenants:
+
+    - ``infra`` — ``pinned_chips`` single-chip guarantee pods at t=0
+      whose runtime outlives any horizon: guarantee-class occupancy
+      reclaim must never touch.
+    - ``batch`` — opportunistic 0.5-chip churn (Poisson until
+      ``background_stop``) that borrows every idle chip: the
+      fragmentation + reclaim-victim pool.
+    - ``prod``  — the starved tenant: ``prod_pods`` whole-node
+      ``prod_chips``-chip guarantee pods at ``prod_start``, runtime
+      past the horizon. Whole-node shape means single-leaf reclaim
+      cannot open a fit on infra-diluted nodes — the deficit persists
+      at fixed capacity no matter what defrag does.
+    - ``ci``    — a finite guarantee burst at ``ci_start`` that ENDS
+      (runtime ``ci_runtime``): the nodes scale-up adds for it go
+      idle afterwards, which is what the scale-down path drains —
+      giving one trace both directions of the planner.
+
+    Batch runtimes are CAPPED at ``max_runtime``: scale-down evidence
+    needs load that genuinely subsides after ``background_stop``; an
+    exponential tail would keep every node busy past any horizon.
+    """
+    rng = random.Random(seed)
+    events: List[TraceEvent] = []
+    for k in range(pinned_chips):
+        events.append(TraceEvent(
+            round(0.5 + 0.1 * k, 3), 1.0, pinned_runtime, 90, 1, "infra",
+        ))
+    t = 0.0
+    while t < background_stop:
+        t += rng.expovariate(1.0 / mean_interarrival)
+        if t >= background_stop:
+            break
+        runtime = min(max_runtime,
+                      max(5.0, rng.expovariate(1.0 / mean_runtime)))
+        events.append(TraceEvent(
+            round(t, 3), round(rng.uniform(0.3, 0.7), 2),
+            round(runtime, 1), 0, 1, "batch",
+        ))
+    for k in range(prod_pods):
+        events.append(TraceEvent(
+            round(prod_start + 0.1 * k, 3), float(prod_chips),
+            prod_runtime, 80, 1, "prod",
+        ))
+    for k in range(ci_pods):
+        events.append(TraceEvent(
+            round(ci_start + 0.1 * k, 3), float(ci_chips), ci_runtime,
+            70, 1, "ci",
+        ))
+    events.sort(key=lambda e: e.start)
+    return events
+
+
 def generate_gang_trace(
     gangs: int = 60,
     gang_sizes=(2, 4, 8),

@@ -1,0 +1,707 @@
+"""Capacity-planner subsystem (kubeshare_tpu/autoscale): demand-ledger
+classification fed from the live engine, recommender properties
+(determinism, sizing terms, cooldown/hysteresis/surge/pool clamps, the
+scale-down safety invariant), planner snapshots of a real engine, the
+dry-run actuator's artifacts, and the three quota satellites that ride
+along (gang-granular admission, declared-vs-resolved HBM, the
+quota-reclaim eviction budget lane)."""
+
+import json
+import os
+
+import pytest
+
+from kubeshare_tpu.autoscale import (
+    REASON_FRAGMENTATION, REASON_GANG_WAITING, REASON_NO_FEASIBLE_CELL,
+    REASON_OVER_QUOTA, CapacityPlanner, DemandLedger, DrainCandidate,
+    DryRunActuator, ModelCapacity, PlannerSnapshot, Recommender,
+)
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+GIB = 1 << 30
+
+
+def topology(pool_nodes=4, chips=4):
+    return {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": chips,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": f"n{i:02d}"}
+            for i in range(pool_nodes)
+        ],
+    }
+
+
+def chip_list(node, n=4, model="tpu-v5e", mem=16 * GIB):
+    return [ChipInfo(f"{node}-chip-{i}", model, mem, i) for i in range(n)]
+
+
+def tpu_pod(name, request=0.5, limit=None, mem=0, priority=0,
+            namespace="default", gang=None):
+    labels = {
+        C.LABEL_TPU_REQUEST: str(request),
+        C.LABEL_TPU_LIMIT_ALIASES[1]: str(
+            limit if limit is not None
+            else (max(request, 1.0) if request > 1 else 1.0)
+        ),
+    }
+    if mem:
+        labels[C.LABEL_TPU_MEMORY] = str(mem)
+    if priority:
+        labels[C.LABEL_PRIORITY] = str(priority)
+    if gang:
+        name_, headcount = gang
+        labels[C.LABEL_GROUP_NAME] = name_
+        labels[C.LABEL_GROUP_HEADCOUNT] = str(headcount)
+        labels[C.LABEL_GROUP_THRESHOLD] = "1.0"
+    return Pod(name=name, namespace=namespace, labels=labels,
+               scheduler_name=C.SCHEDULER_NAME)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def make_env(pool_nodes=4, live_nodes=2, tenants=None, **kwargs):
+    cluster = FakeCluster()
+    for i in range(live_nodes):
+        cluster.add_node(f"n{i:02d}", chip_list(f"n{i:02d}"))
+    clock = FakeClock()
+    engine = TpuShareScheduler(
+        topology(pool_nodes), cluster, clock=clock, tenants=tenants,
+        **kwargs,
+    )
+    return cluster, engine, clock
+
+
+# ===================== demand ledger =================================
+
+
+class TestDemandLedger:
+    def test_engine_files_over_quota_and_resolves_on_bind(self):
+        cluster, engine, clock = make_env(tenants={
+            "tenants": {"alpha": {"guaranteed": 0.25}},
+        })
+        # quota: 0.25 * 8 = 2 chips; two 1.0 guarantee pods fill it
+        for i in range(2):
+            pod = cluster.create_pod(tpu_pod(
+                f"a{i}", 1.0, priority=50, namespace="alpha",
+            ))
+            assert engine.schedule_one(pod).status == "bound"
+        blocked = cluster.create_pod(tpu_pod(
+            "a2", 1.0, priority=50, namespace="alpha",
+        ))
+        d = engine.schedule_one(blocked)
+        assert d.status == "unschedulable"
+        [entry] = engine.demand.entries()
+        assert entry.reason == REASON_OVER_QUOTA
+        assert entry.tenant == "alpha" and entry.guarantee
+        assert entry.chips == pytest.approx(1.0)
+        assert entry.mem == 16 * GIB  # resolved, not declared-0
+        assert engine.demand.guarantee_demand_tenants() == {"alpha"}
+        # quota frees -> the pod binds -> the entry resolves
+        cluster.delete_pod("alpha/a0")
+        assert engine.schedule_one(blocked).status == "bound"
+        assert len(engine.demand) == 0
+
+    def test_fragmentation_vs_capacity_classification(self):
+        cluster, engine, clock = make_env()
+        # 0.6 on every leaf (two 0.6s cannot share a chip): aggregate
+        # free is 8 x 0.4 = 3.2 chips, yet a 0.5 request fits nowhere
+        for i in range(8):
+            pod = cluster.create_pod(tpu_pod(f"frag{i}", 0.6))
+            assert engine.schedule_one(pod).status == "bound"
+        pod = cluster.create_pod(tpu_pod("big", 0.5, priority=50))
+        d = engine.schedule_one(pod)
+        assert d.status == "unschedulable"
+        entry = engine.demand.entries()[0]
+        assert entry.reason == REASON_FRAGMENTATION
+        # a demand NO aggregate capacity covers: true shortfall
+        whale = cluster.create_pod(tpu_pod("whale", 16.0, 16.0,
+                                           priority=50))
+        d = engine.schedule_one(whale)
+        assert d.status == "unschedulable"
+        by_key = {e.pod_key: e for e in engine.demand.entries()}
+        assert by_key["default/whale"].reason == REASON_NO_FEASIBLE_CELL
+        assert by_key["default/whale"].shape == "x16"
+
+    def test_gang_waiting_reason_and_delete_resolves(self):
+        cluster, engine, clock = make_env()
+        p0 = cluster.create_pod(tpu_pod("g0", 1.0, priority=50,
+                                        gang=("gg", 3)))
+        cluster.create_pod(tpu_pod("g1", 1.0, priority=50,
+                                   gang=("gg", 3)))
+        cluster.create_pod(tpu_pod("g2", 1.0, priority=50,
+                                   gang=("gg", 3)))
+        d = engine.schedule_one(p0)
+        assert d.status == "waiting"
+        entry = {e.pod_key: e for e in engine.demand.entries()}[
+            "default/g0"
+        ]
+        assert entry.reason == REASON_GANG_WAITING
+        cluster.delete_pod("default/g0")
+        assert "default/g0" not in {
+            e.pod_key for e in engine.demand.entries()
+        }
+
+    def test_since_survives_reason_changes_and_buckets_aggregate(self):
+        ledger = DemandLedger()
+        from kubeshare_tpu.scheduler.labels import parse_pod
+
+        req = parse_pod(tpu_pod("x", 1.0, priority=50))
+        ledger.note("ns/x", req, REASON_OVER_QUOTA, 10.0, 1.0, GIB)
+        ledger.note("ns/x", req, REASON_FRAGMENTATION, 50.0, 1.0, GIB)
+        [entry] = ledger.entries()
+        assert entry.since == 10.0 and entry.updated == 50.0
+        ledger.note("ns/y", req, REASON_FRAGMENTATION, 60.0, 1.0, GIB)
+        buckets = ledger.buckets()
+        key = ("default", "*", "shared", REASON_FRAGMENTATION)
+        assert buckets[key]["pods"] == 2
+        assert buckets[key]["chips"] == pytest.approx(2.0)
+        assert buckets[key]["oldest_since"] == 10.0
+        names = {s.name for s in ledger.samples()}
+        assert names == {
+            "tpu_scheduler_demand_chips", "tpu_scheduler_demand_pods",
+        }
+
+
+# ===================== recommender ===================================
+
+
+def mk_snapshot(now=0.0, total=8.0, free=0.0, pool=4, bound=2,
+                demand=(), drains=(), guaranteed=None, used=None,
+                deficits=None):
+    return PlannerSnapshot(
+        now=now,
+        total_chips=total,
+        capacity={
+            "tpu-v5e": ModelCapacity(
+                model="tpu-v5e", chips_per_node=4, pool_nodes=pool,
+                bound_nodes=bound, bound_chips=int(total),
+                free_chips=free,
+            ),
+        },
+        demand=tuple(demand),
+        guarantee_used=dict(used or {}),
+        guaranteed_fraction=dict(guaranteed or {}),
+        deficits=dict(deficits or {}),
+        drains=tuple(drains),
+    )
+
+
+def mk_entry(tenant="prod", chips=4.0, reason=REASON_NO_FEASIBLE_CELL,
+             guarantee=True, model="tpu-v5e", pod="p"):
+    from kubeshare_tpu.autoscale.demand import DemandEntry
+
+    return DemandEntry(
+        pod_key=f"{tenant}/{pod}", tenant=tenant, model=model,
+        shape="x4", guarantee=guarantee, chips=chips, mem=0,
+        reason=reason, since=0.0, updated=0.0,
+    )
+
+
+class TestRecommender:
+    def test_deterministic_given_snapshot(self):
+        snap = mk_snapshot(
+            demand=[mk_entry(chips=8.0)],
+            guaranteed={"prod": 0.5}, used={"prod": 0.0},
+            deficits={"prod": 4.0},
+        )
+        a = Recommender().recommend(snap)
+        b = Recommender().recommend(snap)
+        assert a == b
+
+    def test_placement_term_sizes_scale_up_in_whole_nodes(self):
+        snap = mk_snapshot(
+            free=1.0,
+            demand=[mk_entry(chips=6.0)],
+            guaranteed={"prod": 1.0}, used={"prod": 0.0},
+        )
+        [plan] = Recommender(max_surge_nodes=8).recommend(snap).plans
+        # 6 unmet - 1 free = 5 chips -> ceil(5/4) = 2 nodes
+        assert plan.placement_term_chips == pytest.approx(5.0)
+        assert plan.delta_nodes == 2
+
+    def test_quota_term_clears_over_quota_demand(self):
+        # g=0.5, U=4, D=4 (over-quota): capacity must reach 16
+        snap = mk_snapshot(
+            total=8.0,
+            demand=[mk_entry(chips=4.0, reason=REASON_OVER_QUOTA)],
+            guaranteed={"prod": 0.5}, used={"prod": 4.0},
+        )
+        [plan] = Recommender(max_surge_nodes=8).recommend(snap).plans
+        assert plan.quota_term_chips == pytest.approx(8.0)
+        assert plan.delta_nodes == 2
+
+    def test_opportunistic_demand_never_scales_up(self):
+        snap = mk_snapshot(
+            demand=[mk_entry(chips=100.0, guarantee=False,
+                             reason=REASON_NO_FEASIBLE_CELL)],
+        )
+        [plan] = Recommender().recommend(snap).plans
+        assert plan.delta_nodes == 0 and plan.chips_needed == 0
+
+    def test_max_surge_and_pool_clamps(self):
+        snap = mk_snapshot(
+            pool=3, bound=2,
+            demand=[mk_entry(chips=64.0)],
+            guaranteed={"prod": 1.0}, used={"prod": 0.0},
+        )
+        [plan] = Recommender(max_surge_nodes=2).recommend(snap).plans
+        # surge would allow 2 but the pool only has 1 spare cell
+        assert plan.delta_nodes == 1
+        assert any("pool exhausted" in r for r in plan.reasons)
+        snap2 = mk_snapshot(
+            pool=64, bound=2,
+            demand=[mk_entry(chips=64.0)],
+            guaranteed={"prod": 1.0}, used={"prod": 0.0},
+        )
+        [plan2] = Recommender(max_surge_nodes=2).recommend(snap2).plans
+        assert plan2.delta_nodes == 2
+        assert any("max-surge" in r for r in plan2.reasons)
+
+    def test_up_cooldown_defers_second_round(self):
+        rec = Recommender(up_cooldown_s=60.0, max_surge_nodes=1)
+        demand = [mk_entry(chips=64.0)]
+        kw = dict(pool=64, demand=demand,
+                  guaranteed={"prod": 1.0}, used={"prod": 0.0})
+        [p1] = rec.recommend(mk_snapshot(now=0.0, **kw)).plans
+        assert p1.delta_nodes == 1
+        [p2] = rec.recommend(mk_snapshot(now=30.0, **kw)).plans
+        assert p2.delta_nodes == 0
+        assert any("cooldown" in r for r in p2.reasons)
+        [p3] = rec.recommend(mk_snapshot(now=61.0, **kw)).plans
+        assert p3.delta_nodes == 1
+
+    def test_never_drains_guarantee_hosting_node_even_if_flagged(self):
+        """The safety invariant holds against an adversarial snapshot:
+        a node wrongly flagged idle+movable but hosting guarantee pods
+        is still refused."""
+        drain = DrainCandidate(node="n01", model="tpu-v5e", chips=4,
+                               idle=True, movable=True, guarantee_pods=1)
+        rec = Recommender(down_stable_s=0.0, down_cooldown_s=0.0)
+        snap = mk_snapshot(drains=[drain])
+        for now in (0.0, 100.0, 1000.0):
+            r = rec.recommend(mk_snapshot(now=now, drains=[drain]))
+            assert r.plans[0].drain_nodes == ()
+        assert rec.recommend(snap).plans[0].drain_nodes == ()
+
+    def test_drain_hysteresis_and_streak_reset(self):
+        drain = DrainCandidate(node="n01", model="tpu-v5e", chips=4,
+                               idle=True, movable=False,
+                               guarantee_pods=0)
+        busy = DrainCandidate(node="n01", model="tpu-v5e", chips=4,
+                              idle=False, movable=False,
+                              guarantee_pods=0)
+        rec = Recommender(down_stable_s=120.0, down_cooldown_s=0.0,
+                          min_nodes=1)
+        assert rec.recommend(
+            mk_snapshot(now=0.0, drains=[drain])
+        ).plans[0].drain_nodes == ()
+        # continuously drainable past stable_s -> recommended
+        assert rec.recommend(
+            mk_snapshot(now=130.0, drains=[drain])
+        ).plans[0].drain_nodes == ("n01",)
+        # a busy blip resets the streak
+        rec2 = Recommender(down_stable_s=120.0, down_cooldown_s=0.0,
+                           min_nodes=1)
+        rec2.recommend(mk_snapshot(now=0.0, drains=[drain]))
+        rec2.recommend(mk_snapshot(now=60.0, drains=[busy]))
+        assert rec2.recommend(
+            mk_snapshot(now=130.0, drains=[drain])
+        ).plans[0].drain_nodes == ()
+
+    def test_busy_blip_during_scale_up_window_resets_streak(self):
+        """Streak tracking runs on EVERY round, including ones that
+        scale up: a node busy mid-window must not keep a stale
+        drainable-since stamp and get drained the instant demand
+        clears."""
+        drain = DrainCandidate(node="n01", model="tpu-v5e", chips=4,
+                               idle=True, movable=False,
+                               guarantee_pods=0)
+        busy = DrainCandidate(node="n01", model="tpu-v5e", chips=4,
+                              idle=False, movable=False,
+                              guarantee_pods=0)
+        up = dict(demand=[mk_entry(chips=8.0)],
+                  guaranteed={"prod": 1.0}, used={"prod": 0.0})
+        rec = Recommender(down_stable_s=120.0, down_cooldown_s=0.0,
+                          up_cooldown_s=0.0, min_nodes=1)
+        rec.recommend(mk_snapshot(now=0.0, drains=[drain]))
+        # demand spike: scale-up rounds, node busy the whole time
+        rec.recommend(mk_snapshot(now=30.0, drains=[busy], **up))
+        rec.recommend(mk_snapshot(now=90.0, drains=[busy], **up))
+        # demand clears at 150: streak restarted at 150, not 0
+        [p] = rec.recommend(
+            mk_snapshot(now=150.0, drains=[drain])
+        ).plans
+        assert p.drain_nodes == ()
+        [p2] = rec.recommend(
+            mk_snapshot(now=280.0, drains=[drain])
+        ).plans
+        assert p2.drain_nodes == ("n01",)
+
+    def test_down_cooldown_and_min_nodes_floor(self):
+        drains = [
+            DrainCandidate(node=f"n{i:02d}", model="tpu-v5e", chips=4,
+                           idle=True, movable=False, guarantee_pods=0)
+            for i in range(3)
+        ]
+        rec = Recommender(down_stable_s=0.0, down_cooldown_s=300.0,
+                          max_surge_nodes=1, min_nodes=1)
+        [p1] = rec.recommend(
+            mk_snapshot(now=10.0, bound=3, drains=drains)
+        ).plans
+        assert len(p1.drain_nodes) == 1  # surge caps drains too
+        [p2] = rec.recommend(
+            mk_snapshot(now=20.0, bound=3, drains=drains)
+        ).plans
+        assert p2.drain_nodes == ()  # down cooldown
+        rec2 = Recommender(down_stable_s=0.0, down_cooldown_s=0.0,
+                           min_nodes=3)
+        [p3] = rec2.recommend(
+            mk_snapshot(now=10.0, bound=3, drains=drains)
+        ).plans
+        assert p3.drain_nodes == ()  # min-nodes floor
+
+    def test_no_up_and_down_in_same_round(self):
+        drain = DrainCandidate(node="n01", model="tpu-v5e", chips=4,
+                               idle=True, movable=False,
+                               guarantee_pods=0)
+        snap = mk_snapshot(
+            demand=[mk_entry(chips=8.0)],
+            guaranteed={"prod": 1.0}, used={"prod": 0.0},
+            drains=[drain],
+        )
+        [plan] = Recommender(
+            down_stable_s=0.0, down_cooldown_s=0.0
+        ).recommend(snap).plans
+        assert plan.delta_nodes > 0 and plan.drain_nodes == ()
+
+    def test_starved_deficit_is_demand_weighted(self):
+        snap = mk_snapshot(
+            demand=[mk_entry(chips=2.0)],
+            guaranteed={"prod": 0.5, "idle": 0.5},
+            used={"prod": 0.0, "idle": 0.0},
+            deficits={"prod": 4.0, "idle": 4.0},
+        )
+        rec = Recommender().recommend(snap)
+        # prod: min(deficit 4, pending 2) = 2; idle tenant: no demand
+        assert rec.starved_deficit_chips == {"prod": 2.0, "idle": 0.0}
+
+
+# ===================== planner snapshots =============================
+
+
+class TestPlannerSnapshot:
+    def test_capacity_counts_pool_vs_live(self):
+        cluster, engine, clock = make_env(pool_nodes=4, live_nodes=2)
+        snap = CapacityPlanner(engine).snapshot()
+        cap = snap.capacity["tpu-v5e"]
+        assert cap.pool_nodes == 4          # declared cells
+        assert cap.bound_nodes == 2         # actually live
+        assert cap.chips_per_node == 4
+        assert cap.bound_chips == 8
+        assert cap.free_chips == pytest.approx(8.0)
+        assert snap.total_chips == pytest.approx(8.0)
+
+    def test_drain_classification_idle_movable_guarded(self):
+        cluster, engine, clock = make_env(
+            pool_nodes=4, live_nodes=3,
+            tenants={"tenants": {"secure": {"guaranteed": 0.25}}},
+        )
+        # n00: an opportunistic pod (movable while space exists
+        # elsewhere); n01: a guarantee-TENANT pod (opportunistic
+        # priority but its tenant holds a guarantee -> undrainable);
+        # n02: untouched (idle)
+        p_opp = cluster.create_pod(tpu_pod("opp", 0.5))
+        assert engine.schedule_one(p_opp).status == "bound"
+        opp_node = engine.status.get("default/opp").node_name
+        p_sec = cluster.create_pod(tpu_pod(
+            "sec", 0.5, namespace="secure",
+        ))
+        # force placement away from the opportunistic pod's node by
+        # trying until nodes differ (packing may co-locate them)
+        d = engine.schedule_one(p_sec)
+        assert d.status == "bound"
+        by_node = {c.node: c for c in
+                   CapacityPlanner(engine).snapshot().drains}
+        sec_node = engine.status.get("secure/sec").node_name
+        for name, cand in by_node.items():
+            if name == sec_node:
+                assert cand.guarantee_pods >= 1
+            elif name == opp_node:
+                assert cand.guarantee_pods == 0
+                assert cand.movable and not cand.idle
+            else:
+                assert cand.idle
+
+    def test_movable_whole_chip_occupant_needs_whole_free_leaves(self):
+        """A node hosting an x2 opportunistic pod is NOT movable when
+        the rest of the cluster's free capacity is only fractional
+        slivers — aggregate headroom cannot absorb whole-chip pods."""
+        cluster, engine, clock = make_env(pool_nodes=2, live_nodes=2)
+        # three 0.6 pods dirty three leaves of one node (two 0.6s
+        # cannot share a chip), then an x2 pod takes the other node
+        for i in range(3):
+            pod = cluster.create_pod(tpu_pod(f"s{i}", 0.6))
+            assert engine.schedule_one(pod).status == "bound", i
+        multi = cluster.create_pod(tpu_pod("multi", 2.0, 2.0))
+        assert engine.schedule_one(multi).status == "bound"
+        host = engine.status.get("default/multi").node_name
+        other = [n for n in ("n00", "n01") if n != host][0]
+        # precondition: the OLD fractional check would call this
+        # movable (elsewhere free 3x0.4 + 1.0 = 2.2 >= displaced 2.0)
+        # while only ONE whole-free leaf exists elsewhere
+        elsewhere_free = sum(
+            l.available for l in engine.tree.leaves_view(other)
+        )
+        elsewhere_whole = sum(
+            1 for l in engine.tree.leaves_view(other) if l.is_whole_free
+        )
+        assert elsewhere_free >= 2.0 and elsewhere_whole < 2
+        by_node = {c.node: c for c in
+                   CapacityPlanner(engine).snapshot().drains}
+        assert not by_node[host].movable
+
+    def test_movable_requires_room_elsewhere(self):
+        cluster, engine, clock = make_env(pool_nodes=2, live_nodes=1)
+        pod = cluster.create_pod(tpu_pod("solo", 0.5))
+        assert engine.schedule_one(pod).status == "bound"
+        [cand] = CapacityPlanner(engine).snapshot().drains
+        # one live node: nowhere to move the occupant
+        assert not cand.movable and not cand.idle
+
+
+# ===================== actuator ======================================
+
+
+class TestActuator:
+    def _rec(self):
+        snap = mk_snapshot(
+            demand=[mk_entry(chips=6.0)],
+            guaranteed={"prod": 1.0}, used={"prod": 0.0},
+            deficits={"prod": 6.0},
+        )
+        rec = Recommender(max_surge_nodes=8).recommend(snap)
+        return rec, snap
+
+    def test_artifact_and_manifest_written_atomically(self, tmp_path):
+        rec, snap = self._rec()
+        artifact = tmp_path / "autoscale.json"
+        manifest = tmp_path / "nodepool-patch.yaml"
+        act = DryRunActuator(str(artifact), str(manifest))
+        doc = act.actuate(rec, snap)
+        on_disk = json.loads(artifact.read_text())
+        assert on_disk == json.loads(json.dumps(doc))
+        [plan] = on_disk["plans"]
+        assert plan["delta_nodes"] == 2
+        text = manifest.read_text()
+        assert "kind: NodePoolPatch" in text
+        assert "targetNodes: 4" in text
+        assert not [
+            p for p in os.listdir(tmp_path) if ".tmp" in p
+        ], "no temp droppings"
+
+    def test_no_change_round_renders_placeholder(self):
+        rec = Recommender().recommend(mk_snapshot())
+        text = DryRunActuator.render_manifest(rec)
+        assert "no changes recommended" in text
+
+    def test_samples_expose_last_round(self):
+        rec, snap = self._rec()
+        act = DryRunActuator()
+        act.actuate(rec, snap)
+        by_name = {}
+        for s in act.samples():
+            by_name.setdefault(s.name, []).append(s)
+        assert by_name["tpu_scheduler_autoscale_rounds_total"][0].value == 1
+        [delta] = by_name["tpu_scheduler_autoscale_delta_nodes"]
+        assert delta.value == 2 and delta.labels == {"model": "tpu-v5e"}
+        [starved] = by_name[
+            "tpu_scheduler_autoscale_starved_deficit_chips"
+        ]
+        assert starved.labels == {"tenant": "prod"}
+        assert starved.value == pytest.approx(6.0)
+
+
+# ===================== quota satellites ==============================
+
+
+class TestGangGranularAdmission:
+    def test_first_member_gates_whole_gang(self):
+        """A gang whose TOTAL demand exceeds quota is gated at the
+        FIRST member's PreFilter — no member reserves, so none can
+        bind early and die at the barrier later."""
+        cluster, engine, clock = make_env(tenants={
+            "tenants": {"alpha": {"guaranteed": 0.5}},
+        })
+        # quota 4 chips; gang of 6 x 1.0 guarantee pods
+        pods = [
+            cluster.create_pod(tpu_pod(
+                f"g{i}", 1.0, priority=50, namespace="alpha",
+                gang=("gang6", 6),
+            ))
+            for i in range(6)
+        ]
+        d = engine.schedule_one(pods[0])
+        assert d.status == "unschedulable" and d.retryable
+        assert "gang of 6" in d.message
+        # nothing was reserved: the quota ledger is untouched
+        assert engine.quota.ledger.chips_used("alpha") == 0
+        [entry] = engine.demand.entries()
+        assert entry.reason == REASON_OVER_QUOTA
+
+    def test_gang_within_quota_still_binds(self):
+        cluster, engine, clock = make_env(tenants={
+            "tenants": {"alpha": {"guaranteed": 0.5}},
+        })
+        pods = [
+            cluster.create_pod(tpu_pod(
+                f"g{i}", 1.0, priority=50, namespace="alpha",
+                gang=("gang4", 4),
+            ))
+            for i in range(4)
+        ]
+        statuses = [engine.schedule_one(p).status for p in pods]
+        assert statuses.count("bound") >= 1  # barrier released
+        assert engine.quota.ledger.chips_used("alpha") == \
+            pytest.approx(4.0)
+
+    def test_later_members_admit_only_outstanding_demand(self):
+        """Once siblings hold reservations, a member's gate covers
+        only the REMAINING demand — the gang is not double-counted."""
+        cluster, engine, clock = make_env(tenants={
+            "tenants": {"alpha": {"guaranteed": 0.5}},
+        })
+        pods = [
+            cluster.create_pod(tpu_pod(
+                f"g{i}", 1.0, priority=50, namespace="alpha",
+                gang=("gang4", 4),
+            ))
+            for i in range(4)
+        ]
+        d0 = engine.schedule_one(pods[0])
+        assert d0.status == "waiting"  # 1 reserved, demand was 4 <= 4
+        d1 = engine.schedule_one(pods[1])
+        # outstanding = 3, ledger holds 1: 1 + 3 = 4 <= quota -> admitted
+        assert d1.status in ("waiting", "bound")
+
+
+class TestResolvedHbmAdmission:
+    def test_demand_resolves_proportional_default(self):
+        cluster, engine, clock = make_env()
+        from kubeshare_tpu.scheduler.labels import parse_pod
+
+        req = parse_pod(tpu_pod("x", 0.5))
+        chips, mem = engine.quota.demand(req)
+        assert chips == pytest.approx(0.5)
+        assert mem == int(0.5 * 16 * GIB)  # resolved vs declared 0
+        multi = parse_pod(tpu_pod("y", 2.0, 2.0))
+        chips, mem = engine.quota.demand(multi)
+        assert chips == pytest.approx(2.0)
+        assert mem == 2 * 16 * GIB  # multi-chip charges full leaves
+
+    def test_heterogeneous_memory_gates_on_worst_case_leaf(self):
+        """On mixed-HBM nodes the proportional default must resolve
+        against the LARGEST candidate leaf before the gate: the old
+        declared-only gate admitted default-memory pods past where
+        their resolved usage lands."""
+        cluster = FakeCluster()
+        cluster.add_node("n00", chip_list("n00", mem=16 * GIB))
+        cluster.add_node("n01", chip_list("n01", mem=32 * GIB))
+        engine = TpuShareScheduler(
+            topology(2), cluster, clock=FakeClock(),
+            tenants={"tenants": {"alpha": {"guaranteed": 0.5}}},
+        )
+        # quota: 4 chips, 96 GiB. Three 1.0 default-memory pods can
+        # resolve to 32 GiB each = 96 GiB; a fourth (chips 4 <= 4
+        # would pass the chip gate) must be stopped by resolved HBM
+        for i in range(3):
+            pod = cluster.create_pod(tpu_pod(
+                f"a{i}", 1.0, priority=50, namespace="alpha",
+            ))
+            assert engine.schedule_one(pod).status == "bound", i
+        blocked = cluster.create_pod(tpu_pod(
+            "a3", 1.0, priority=50, namespace="alpha",
+        ))
+        d = engine.schedule_one(blocked)
+        assert d.status == "unschedulable"
+        assert "over guaranteed quota" in d.message
+
+
+class TestReclaimBudgetLane:
+    def _fragment(self, cluster, engine):
+        """One 0.9 opportunistic pod per leaf: every defrag needs an
+        eviction, and a whole-cluster multi-chip ask is unplannable."""
+        for i in range(8):
+            pod = cluster.create_pod(tpu_pod(f"bg{i}", 0.9))
+            assert engine.schedule_one(pod).status == "bound", i
+
+    def test_opportunistic_defrag_confined_while_tenant_starves(self):
+        cluster, engine, clock = make_env(
+            defrag=True, defrag_eviction_rate=2.0,
+            defrag_reclaim_share=0.5,
+            tenants={"tenants": {"alpha": {"guaranteed": 0.5}}},
+        )
+        self._fragment(cluster, engine)
+        # alpha starves: deficit 4, pending guarantee demand on the
+        # ledger (an 8-chip ask nothing can open -> no evictions)
+        whale = cluster.create_pod(tpu_pod(
+            "whale", 8.0, 8.0, priority=50, namespace="alpha",
+        ))
+        assert engine.schedule_one(whale).status == "unschedulable"
+        assert len(cluster.evictions) == 0
+        assert engine.quota.deficit_chips("alpha") > 0
+        # non-reclaim guarantee pod: general lane = floor(2*0.5) = 1
+        h1 = cluster.create_pod(tpu_pod("h1", 0.8, priority=50))
+        d1 = engine.schedule_one(h1)
+        assert "defrag" in d1.message and len(cluster.evictions) == 1
+        assert engine.schedule_one(h1).status == "bound"  # takes its hole
+        h2 = cluster.create_pod(tpu_pod("h2", 0.8, priority=50))
+        d2 = engine.schedule_one(h2)
+        assert d2.status == "unschedulable"
+        assert len(cluster.evictions) == 1, \
+            "general lane spent; opportunistic defrag must wait"
+        # reclaim (alpha, quota-driven) still has the reserved lane
+        g1 = cluster.create_pod(tpu_pod(
+            "g1", 0.8, priority=50, namespace="alpha",
+        ))
+        d3 = engine.schedule_one(g1)
+        assert "defrag" in d3.message and len(cluster.evictions) == 2
+        assert engine.defrag_quota_evictions == 1
+        assert engine.schedule_one(g1).status == "bound"
+        # window slides: the general lane refills
+        clock.now = 61.0
+        d4 = engine.schedule_one(h2)
+        assert "defrag" in d4.message and len(cluster.evictions) == 3
+
+    def test_full_budget_open_when_nobody_starves(self):
+        cluster, engine, clock = make_env(
+            defrag=True, defrag_eviction_rate=2.0,
+            defrag_reclaim_share=0.5,
+        )
+        self._fragment(cluster, engine)
+        h1 = cluster.create_pod(tpu_pod("h1", 0.8, priority=50))
+        assert "defrag" in engine.schedule_one(h1).message
+        assert engine.schedule_one(h1).status == "bound"
+        h2 = cluster.create_pod(tpu_pod("h2", 0.8, priority=50))
+        assert "defrag" in engine.schedule_one(h2).message
+        assert len(cluster.evictions) == 2  # no lane: full budget
+
+    def test_invalid_share_rejected(self):
+        with pytest.raises(ValueError, match="reclaim_share"):
+            make_env(defrag=True, defrag_reclaim_share=1.0)

@@ -182,6 +182,31 @@ class TestSchedulerCli:
         assert all(d["status"] == "bound" for d in decisions)
         assert all(d["node"] == "node-a" for d in decisions)
 
+    def test_once_writes_autoscale_artifacts(self, tmp_path):
+        """--once with the autoscale flags runs one planner round and
+        leaves the dry-run interface on disk (JSON + manifest)."""
+        topo = tmp_path / "topo.yaml"
+        topo.write_text(TOPO_YAML)
+        state = tmp_path / "state.json"
+        state.write_text(json.dumps(snapshot_dict([shared_pod("p1")])))
+        artifact = tmp_path / "autoscale.json"
+        manifest = tmp_path / "nodepool-patch.yaml"
+        rc = scheduler_cmd.main([
+            "--topology", str(topo),
+            "--cluster-state", str(state),
+            "--decisions-out", "",
+            "--autoscale-artifact", str(artifact),
+            "--autoscale-manifest", str(manifest),
+            "--once",
+        ])
+        assert rc == 0
+        doc = json.loads(artifact.read_text())
+        assert doc["generated_by"] == "kubeshare_tpu/autoscale"
+        [plan] = doc["plans"]
+        assert plan["model"] == "tpu-v5e"
+        assert plan["delta_nodes"] == 0  # nothing pending, no churn
+        assert "no changes recommended" in manifest.read_text()
+
     def test_self_metrics_counters(self, tmp_path):
         from kubeshare_tpu.cmd.scheduler import SchedulerMetrics
         from kubeshare_tpu.cluster.snapshot import SnapshotCluster
