@@ -32,6 +32,12 @@ fairness-sim:
 autoscale-sim:
 	$(PYTHON) tools/autoscale_sim.py
 
+# decision-provenance evidence on the starvation trace ->
+# EXPLAIN.json (per-tenant wait percentiles + reason-transition
+# matrix + the journal export the explain CLI renders offline)
+explain-report:
+	$(PYTHON) tools/explain_report.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -76,4 +82,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim explain-report dryrun images push save kind-e2e perf-evidence clean

@@ -86,15 +86,31 @@ def shape_of(req) -> str:
 
 
 class DemandLedger:
-    def __init__(self):
+    def __init__(self, on_transition=None):
+        """``on_transition(pod_key, old_reason, new_reason, now)`` is
+        called whenever an entry is first filed (old_reason None) or
+        its reason CODE changes — the decision journal's reason
+        timeline rides this hook, so time-in-each-blocked-reason is
+        derived from the exact classifications the autoscale plane
+        acts on, not a parallel reimplementation."""
         self._entries: Dict[str, DemandEntry] = {}
+        self.on_transition = on_transition
 
     def note(self, pod_key: str, req, reason: str, now: float,
-             chips: float, mem: int) -> None:
-        """File or refresh the pod's pending-demand entry. ``since``
-        survives reason changes — a pod that moved from over-quota to
+             chips: float, mem: int) -> DemandEntry:
+        """File or refresh the pod's pending-demand entry; returns it
+        (the decision journal reconciles against the entry's ``since``
+        to survive its own LRU evictions). ``since`` survives reason
+        changes — a pod that moved from over-quota to
         fragmentation-blocked has been starving the whole time."""
         prior = self._entries.get(pod_key)
+        if self.on_transition is not None and (
+            prior is None or prior.reason != reason
+        ):
+            self.on_transition(
+                pod_key, prior.reason if prior is not None else None,
+                reason, now,
+            )
         entry = DemandEntry(
             pod_key=pod_key,
             tenant=req.tenant,
@@ -108,6 +124,7 @@ class DemandLedger:
             updated=now,
         )
         self._entries[pod_key] = entry
+        return entry
 
     def resolve(self, pod_key: str) -> None:
         """The pod bound or left the cluster — either way it no longer

@@ -67,6 +67,12 @@ class Node:
     labels: Dict[str, str] = field(default_factory=dict)
     ready: bool = True
     unschedulable: bool = False
+    # True only on the event delivered when the Node OBJECT left the
+    # cluster (apiserver DELETE / vanished from a relist) — distinct
+    # from a mere health flip: the engine unbinds the node's chips
+    # immediately so quota denominators shrink with the pool, instead
+    # of waiting for an inventory sync.
+    deleted: bool = False
 
     @property
     def healthy(self) -> bool:
@@ -120,9 +126,14 @@ class ClusterAPI(Protocol):
         ...
 
     def post_event(self, pod_key: str, reason: str, message: str,
-                   event_type: str = "Normal") -> None:
+                   event_type: str = "Normal",
+                   fingerprint: str = "") -> None:
         """Record a v1 Event against the pod (``kubectl describe pod``
         visibility — Scheduled / FailedScheduling / DefragEvicted).
+        ``fingerprint`` distinguishes semantically different events
+        under one reason for dedup purposes (e.g. a FailedScheduling
+        whose blocked-reason moved from over-quota to
+        fragmentation-blocked must not be suppressed as a repeat).
         Best-effort: adapters must not raise from here."""
         ...
 

@@ -88,6 +88,19 @@ class FakeCluster:
         for handler in self._node_handlers:
             handler(node)
 
+    def delete_node(self, name: str) -> None:
+        """The Node OBJECT leaves the cluster (kube DELETE semantics,
+        not a health flip): handlers see ``deleted=True`` and the
+        engine unbinds the node's chips immediately."""
+        node = self._nodes.pop(name, None)
+        if node is None:
+            return
+        self._chips.pop(name, None)
+        node.ready = False
+        node.deleted = True
+        for handler in self._node_handlers:
+            handler(node)
+
     def chips_on_node(self, node_name: str) -> List[ChipInfo]:
         """The inventory source (stands in for the collector scrape)."""
         return list(self._chips.get(node_name, []))
@@ -107,7 +120,10 @@ class FakeCluster:
         self.delete_pod(pod_key)
 
     def post_event(self, pod_key: str, reason: str, message: str,
-                   event_type: str = "Normal") -> None:
+                   event_type: str = "Normal",
+                   fingerprint: str = "") -> None:
+        # fingerprint is dedup state, not event content — the fake has
+        # no dedup, so the 4-tuple record shape is unchanged
         self.events.append((pod_key, reason, message, event_type))
 
     def delete_pod(self, key: str) -> Optional[Pod]:

@@ -374,20 +374,27 @@ class KubeCluster:
             cached.annotations.update(annotations)
 
     def post_event(self, pod_key: str, reason: str, message: str,
-                   event_type: str = "Normal") -> None:
+                   event_type: str = "Normal",
+                   fingerprint: str = "") -> None:
         """Best-effort v1 Event. Client-side dedup: the same
-        (pod, reason) within 60s is suppressed — a transiently
-        unschedulable pod is re-examined every pass and must not write
-        an Event per tick the way the apiserver-side count aggregation
-        would eventually throttle anyway. The message is deliberately
-        NOT part of the key: FailedScheduling messages concatenate
-        per-node reasons, so any per-pass fluctuation in wording would
-        defeat the window and re-add a blocking POST per stuck pod per
-        pass (the breaker only trips on errors, not volume)."""
+        (pod, reason, fingerprint) within 60s is suppressed — a
+        transiently unschedulable pod is re-examined every pass and
+        must not write an Event per tick the way the apiserver-side
+        count aggregation would eventually throttle anyway. The
+        message is deliberately NOT part of the key: FailedScheduling
+        messages concatenate per-node reasons, so any per-pass
+        fluctuation in wording would defeat the window and re-add a
+        blocking POST per stuck pod per pass (the breaker only trips
+        on errors, not volume). ``fingerprint`` is the caller's
+        semantic discriminator under one reason — the scheduler
+        passes the pod's blocked-reason code, so a pod moving from
+        over-quota to fragmentation-blocked posts a fresh
+        FailedScheduling inside the window instead of being
+        suppressed as a repeat."""
         now = time.time()
         if now < self._event_breaker_until:
             return  # persistent failures (e.g. missing RBAC): stand down
-        dedup_key = (pod_key, reason)
+        dedup_key = (pod_key, reason, fingerprint)
         last = self._event_sent.get(dedup_key, 0.0)
         if now - last < 60.0:
             return
@@ -661,7 +668,11 @@ class KubeCluster:
         # handlers fire BEFORE the cache commit: a handler exception
         # must leave the cache as-is so the retried event still diffs
         if etype == "DELETED":
+            # a real node DELETE, not a health flip: flag it so the
+            # engine unbinds the node's chips immediately and quota
+            # denominators shrink with the pool
             node.ready = False
+            node.deleted = True
             for handler in self._node_update:
                 handler(node)
             self._nodes.pop(node.name, None)
@@ -729,8 +740,11 @@ class KubeCluster:
                 for handler in self._node_update:
                     handler(node)
         for name in [n for n in self._nodes if n not in nodes]:
+            # vanished from a full relist = the Node object is gone
+            # (deleted), not merely NotReady
             gone = self._nodes.pop(name)
             gone.ready = False
+            gone.deleted = True
             for handler in self._node_update:
                 handler(gone)
         self._nodes = nodes

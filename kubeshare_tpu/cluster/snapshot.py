@@ -135,7 +135,7 @@ class SnapshotCluster:
                 handler(pod)
 
     def post_event(self, pod_key, reason, message,
-                   event_type="Normal") -> None:
+                   event_type="Normal", fingerprint="") -> None:
         pass  # snapshot mode has no event store
 
     def on_pod_event(self, add, delete) -> None:

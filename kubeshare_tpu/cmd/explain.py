@@ -1,0 +1,154 @@
+"""Explain a scheduling decision: render a pod's decision journal.
+
+``python -m kubeshare_tpu explain <namespace/pod>`` asks the live
+scheduler's metrics server (``--url``, the same port as ``/metrics``)
+for the pod's journal and renders it human-readably: quota admission
+verdicts with the ledger numbers behind them, per-reason Filter
+rejection counts with exemplar nodes, score winner/runner-up, gang
+state, defrag interaction, and the cumulative reason timeline.
+
+Without a pod key it lists journaled pods (``--tenant`` filters).
+``--journal`` renders from an exported artifact instead of a live
+server — EXPLAIN.json (``make explain-report``) and raw
+``DecisionJournal.export()`` documents both work.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional, Sequence
+
+from ..explain.render import render_listing, render_pod
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-explain", description=__doc__
+    )
+    parser.add_argument(
+        "pod", nargs="?", default="",
+        help="pod key (namespace/name; a bare name assumes 'default/')",
+    )
+    parser.add_argument(
+        "--url", default="http://127.0.0.1:9006",
+        help="scheduler metrics server base URL (the --metrics-port "
+             "endpoint serving /explain)",
+    )
+    parser.add_argument(
+        "--journal", default="", metavar="PATH",
+        help="render from an exported journal artifact (EXPLAIN.json "
+             "or a DecisionJournal.export() document) instead of a "
+             "live server",
+    )
+    parser.add_argument(
+        "--tenant", default="",
+        help="listing mode: only this tenant's pods",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the raw JSON document instead of rendering",
+    )
+    return parser
+
+
+def _artifact_pods(doc: dict) -> dict:
+    """Locate the per-pod journal map in any of the artifact shapes:
+    a raw export ({"pods": ...}), EXPLAIN.json ({"result":
+    {"journal": {"pods": ...}}}), or a bare {"journal": ...}."""
+    for candidate in (
+        doc,
+        doc.get("journal") or {},
+        (doc.get("result") or {}).get("journal") or {},
+    ):
+        pods = candidate.get("pods")
+        if isinstance(pods, dict):
+            return pods
+    raise ValueError("no 'pods' journal map found in the artifact")
+
+
+def _listing_rows(pods: dict) -> list:
+    rows = []
+    for key, doc in pods.items():
+        timeline = doc.get("timeline") or []
+        rows.append({
+            "pod": key,
+            "tenant": doc.get("tenant", ""),
+            "shape": doc.get("shape", ""),
+            "outcome": doc.get("outcome", ""),
+            "reason": timeline[-1]["state"] if timeline else "",
+            "attempts": doc.get("attempts", 0),
+            "waited_s": doc.get("waited_s", 0.0),
+        })
+    rows.sort(key=lambda r: -r["waited_s"])
+    return rows
+
+
+def _fetch(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except (ValueError, OSError):
+            return e.code, {"error": f"HTTP {e.code}"}
+    except (urllib.error.URLError, OSError) as e:
+        raise SystemExit(
+            f"cannot reach scheduler metrics server at {url}: {e}\n"
+            f"(is the scheduler running with --metrics-port, or did "
+            f"you mean --journal <artifact>?)"
+        )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    pod = args.pod
+    if pod and "/" not in pod:
+        pod = f"default/{pod}"
+
+    if args.journal:
+        with open(args.journal) as f:
+            doc = json.load(f)
+        pods = _artifact_pods(doc)
+        if pod:
+            entry = pods.get(pod)
+            if entry is None:
+                print(f"no journal entry for {pod} in {args.journal}",
+                      file=sys.stderr)
+                return 1
+            print(json.dumps(entry, indent=1) if args.json
+                  else render_pod(entry))
+            return 0
+        if args.tenant:
+            pods = {k: d for k, d in pods.items()
+                    if d.get("tenant") == args.tenant}
+        print(json.dumps(pods, indent=1) if args.json
+              else render_listing(_listing_rows(pods)))
+        return 0
+
+    base = args.url.rstrip("/")
+    if pod:
+        status, doc = _fetch(f"{base}/explain/{pod}")
+        if status != 200:
+            print(doc.get("error", f"HTTP {status}"), file=sys.stderr)
+            return 1
+        print(json.dumps(doc, indent=1) if args.json else render_pod(doc))
+        return 0
+    query = f"?tenant={urllib.parse.quote(args.tenant)}" if args.tenant \
+        else ""
+    status, doc = _fetch(f"{base}/explain{query}")
+    if status != 200:
+        print(doc.get("error", f"HTTP {status}"), file=sys.stderr)
+        return 1
+    print(json.dumps(doc, indent=1) if args.json
+          else render_listing(doc.get("pods", [])))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -164,7 +164,17 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument(
         "--metrics-port", type=int, default=0,
         help="serve scheduler self-metrics (tpu_scheduler_*) on this "
-             "port (0 = off)",
+             "port (0 = off); the same server answers /explain "
+             "decision-provenance queries (see "
+             "`python -m kubeshare_tpu explain`)",
+    )
+    parser.add_argument(
+        "--explain-capacity", type=int, default=512,
+        help="decision-journal bound, >= 1: at most this many pods' "
+             "provenance kept (LRU; evictions counted on "
+             "tpu_scheduler_explain_journal_evictions_total). The "
+             "journal also feeds the wait-SLO histograms, so it "
+             "cannot be disabled — shrink it instead",
     )
     parser.add_argument(
         "--trace-out", default="", metavar="PATH",
@@ -367,7 +377,7 @@ def _run_pass_inner(engine, cluster, journal, metrics, started,
         decision = engine.schedule_one(pod)
         acted += 1
         if post is not None:
-            _post_decision_event(post, decision)
+            _post_decision_event(post, decision, engine)
         if metrics is not None:
             metrics.record(decision)
         if journal is not None:
@@ -390,9 +400,15 @@ def _run_pass_inner(engine, cluster, journal, metrics, started,
     return acted
 
 
-def _post_decision_event(post, decision) -> None:
+def _post_decision_event(post, decision, engine=None) -> None:
     """kubectl-describe visibility, mirroring the stock kube-scheduler
     (Scheduled / FailedScheduling); the kube adapter dedups repeats.
+    FailedScheduling messages are sourced from the decision journal:
+    the per-reason node counts are already aggregated into the
+    message, the journal appends cumulative wait accounting, and the
+    pod's current blocked-reason code rides as the dedup fingerprint —
+    a reason CHANGE (over-quota -> fragmentation-blocked) posts a
+    fresh Event inside the 60s window instead of being suppressed.
     Best-effort: event plumbing must never fail a pass."""
     try:
         if decision.status == "bound":
@@ -410,9 +426,19 @@ def _post_decision_event(post, decision) -> None:
         elif decision.status == "waiting":
             post(decision.pod_key, "WaitingForGang", decision.message)
         elif decision.status == "unschedulable":
+            message, fingerprint = decision.message, ""
+            if engine is not None:
+                journal = engine.explain
+                message = journal.event_message(
+                    decision.pod_key, engine.clock(), message
+                )
+                fingerprint = (
+                    "permanent" if not decision.retryable
+                    else journal.current_reason(decision.pod_key)
+                )
             post(
-                decision.pod_key, "FailedScheduling", decision.message,
-                "Warning",
+                decision.pod_key, "FailedScheduling", message,
+                "Warning", fingerprint=fingerprint,
             )
     except Exception:
         pass
@@ -420,6 +446,12 @@ def _post_decision_event(post, decision) -> None:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.explain_capacity < 1:
+        raise SystemExit(
+            "--explain-capacity must be >= 1 (the decision journal "
+            "also feeds the wait-SLO histograms, so it cannot be "
+            "turned off; use a small value to bound memory instead)"
+        )
     log = component_logger("scheduler", args)
     if args.kube:
         from ..cluster.kube import KubeCluster
@@ -455,6 +487,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         percentage_of_nodes_to_score=args.percentage_of_nodes_to_score,
         min_feasible_nodes=args.min_feasible_nodes,
         tenants=args.tenants or None,
+        explain_capacity=args.explain_capacity,
     )
     elector = None
     if args.leader_elect:
@@ -508,8 +541,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         metrics_server = MetricServer(port=args.metrics_port)
         metrics_server.route("/metrics", metrics.render)
+        # decision provenance on the same server: /explain/<ns>/<pod>
+        # and /explain?tenant=... (journal reads are lock-protected,
+        # so the metrics thread never races the scheduling thread)
+        from ..explain.http import register_explain
+
+        register_explain(metrics_server, engine)
         metrics_server.start()
-        log.info("self-metrics on :%d/metrics", metrics_server.port)
+        log.info("self-metrics on :%d/metrics (+ /explain)",
+                 metrics_server.port)
 
     # guard: re-proves (and when due, renews) leadership before every
     # bind; None when election is off

@@ -1,0 +1,16 @@
+"""Decision provenance: per-pod scheduling journals and wait SLOs.
+
+The metrics plane (histograms, occupancy gauges, the demand ledger)
+answers *aggregate* questions; this package answers the operator's
+per-pod one — "why is THIS pod pending, what rejected it on each
+node, and how long do pods like it usually wait?" — by journaling
+every ``schedule_one`` attempt's phase outcomes and the pod's
+cumulative wait/reason history, bounded in memory and queryable over
+the metrics HTTP server (``/explain``), the CLI
+(``python -m kubeshare_tpu explain``), and Kubernetes Events.
+"""
+
+from .journal import (  # noqa: F401
+    DecisionJournal, RejectionAgg, WAIT_BUCKETS, transition_matrix,
+)
+from .render import render_listing, render_pod  # noqa: F401

@@ -1,0 +1,47 @@
+"""``/explain`` endpoints on the scheduler's metrics HTTP server.
+
+- ``GET /explain/<namespace>/<name>`` — the pod's full decision
+  journal as JSON (404 with an error body when the pod was never
+  attempted or its entry was evicted);
+- ``GET /explain`` / ``GET /explain?tenant=<t>`` — summary listing,
+  most-recently-touched first.
+
+Handlers run on the metrics thread; the journal's lock makes that
+safe against the scheduling thread's writes.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Tuple
+
+
+def explain_handler(
+    engine, clock=None
+) -> Callable[[str, Dict[str, List[str]]], Tuple[int, str, str]]:
+    """Prefix-route handler for ``MetricServer.route_prefix``. The
+    clock defaults to the engine's own (so documents age on the same
+    axis the journal was written on)."""
+    clock = clock or engine.clock
+
+    def handle(rest: str, params: Dict[str, List[str]]):
+        now = clock()
+        if rest:
+            doc = engine.explain.get(rest, now)
+            if doc is None:
+                return 404, "application/json", json.dumps(
+                    {"error": f"no journal entry for pod {rest!r} "
+                              f"(never attempted, or evicted)"}
+                ) + "\n"
+            return 200, "application/json", json.dumps(doc, indent=1) + "\n"
+        tenant = (params.get("tenant") or [""])[0] or None
+        rows = engine.explain.listing(now, tenant=tenant)
+        return 200, "application/json", json.dumps(
+            {"tenant": tenant, "pods": rows}, indent=1
+        ) + "\n"
+
+    return handle
+
+
+def register_explain(server, engine, clock=None) -> None:
+    server.route_prefix("/explain", explain_handler(engine, clock))

@@ -1,0 +1,477 @@
+"""The decision journal: bounded per-pod scheduling provenance.
+
+One ``PodJournal`` per pod the engine has attempted, holding:
+
+- a ring of the most recent attempt records — per attempt, the phase
+  outcomes ``schedule_one`` actually produced: the quota admission
+  verdict with the ledger numbers behind it, per-node Filter
+  rejections aggregated into ``{reason -> node count, exemplars}``,
+  the score winner and runner-up, Permit/gang state, and any defrag
+  interaction;
+- cumulative wait accounting: first-enqueue timestamp, attempt count,
+  and a reason timeline (``enqueued -> over-quota ->
+  fragmentation-blocked -> bound``) fed by the demand ledger's
+  transition hook, so time-in-each-blocked-reason is derivable;
+- the terminal outcome (``bound`` / ``unschedulable`` — permanent
+  reject / ``deleted``), which also feeds the per-(tenant, shape,
+  outcome) time-to-bind SLO histograms
+  ``tpu_scheduler_pod_wait_seconds``.
+
+Memory is bounded: at most ``capacity`` pods (strict LRU on last
+touch, evictions counted and exported — never silent) and at most
+``attempts_per_pod`` attempt records per pod (older attempts drop off
+the ring; the cumulative counters survive). All mutation happens on
+the scheduling thread; reads (``/explain`` HTTP handlers, metrics
+scrapes) happen on the metrics thread, so every public method takes
+the internal lock — mutations are tiny dict operations, so the hot
+path pays nanoseconds, not contention.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..utils import expfmt
+from ..utils.trace import Histogram
+
+# Queue-wait buckets in seconds: sub-minute binds are the healthy
+# case, hours-long waits are the starvation tail the SLO exists to
+# catch (the phase histograms' 10us..10s buckets are far too fine).
+WAIT_BUCKETS: Tuple[float, ...] = (
+    1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1800.0,
+    3600.0, 7200.0, 14400.0,
+)
+
+# Terminal timeline states (everything else is a blocked reason).
+OUTCOME_BOUND = "bound"
+OUTCOME_UNSCHEDULABLE = "unschedulable"   # permanent reject
+OUTCOME_DELETED = "deleted"               # left the cluster while pending
+OUTCOME_PENDING = "pending"               # censored (no terminal yet)
+
+
+class RejectionAgg:
+    """Aggregate per-node Filter rejections: ``{reason -> (node
+    count, capped exemplar nodes)}`` instead of one string per
+    rejecting node — on a 2048-node cluster the flat list is 2048
+    near-identical strings joined into one unreadable message."""
+
+    MAX_EXEMPLARS = 3
+
+    __slots__ = ("by_reason", "total")
+
+    def __init__(self):
+        self.by_reason: Dict[str, list] = {}  # reason -> [count, [nodes]]
+        self.total = 0
+
+    def add(self, reason: str, node: str = "") -> None:
+        self.total += 1
+        entry = self.by_reason.get(reason)
+        if entry is None:
+            entry = self.by_reason[reason] = [0, []]
+        entry[0] += 1
+        if node and len(entry[1]) < self.MAX_EXEMPLARS:
+            entry[1].append(node)
+
+    def __bool__(self) -> bool:
+        return bool(self.by_reason)
+
+    def summary(self) -> str:
+        """The unschedulable-Decision message: reasons by descending
+        node count, exemplars capped."""
+        parts = []
+        for reason, (count, exemplars) in sorted(
+            self.by_reason.items(), key=lambda kv: (-kv[1][0], kv[0])
+        ):
+            if count == 1 and exemplars:
+                parts.append(f"{reason} [{exemplars[0]}]")
+            elif exemplars:
+                more = ", …" if count > len(exemplars) else ""
+                parts.append(
+                    f"{reason} (x{count}: {', '.join(exemplars)}{more})"
+                )
+            else:
+                parts.append(f"{reason} (x{count})")
+        return "; ".join(parts)
+
+    def to_dict(self) -> Dict[str, dict]:
+        return {
+            reason: {"nodes": count, "exemplars": list(exemplars)}
+            for reason, (count, exemplars) in sorted(self.by_reason.items())
+        }
+
+
+class PodJournal:
+    """Everything the journal knows about one pod. Internal — readers
+    get dict snapshots via ``DecisionJournal.get()``."""
+
+    __slots__ = (
+        "pod_key", "tenant", "model", "shape", "guarantee",
+        "first_seen", "attempt_count", "attempts", "timeline",
+        "outcome", "outcome_at", "node",
+    )
+
+    def __init__(self, pod_key: str, now: float, attempts_per_pod: int):
+        self.pod_key = pod_key
+        self.tenant = ""
+        self.model = ""
+        self.shape = ""
+        self.guarantee = False
+        self.first_seen = now
+        self.attempt_count = 0
+        self.attempts: deque = deque(maxlen=attempts_per_pod)
+        # (state, since): "enqueued" then blocked-reason transitions,
+        # closed by a terminal outcome. A repeated reason never
+        # re-appends — duration accrues in place.
+        self.timeline: List[Tuple[str, float]] = [("enqueued", now)]
+        self.outcome = ""
+        self.outcome_at = 0.0
+        self.node = ""
+
+    def to_dict(self, now: float) -> dict:
+        end = self.outcome_at if self.outcome else now
+        timeline = []
+        for i, (state, since) in enumerate(self.timeline):
+            until = (
+                self.timeline[i + 1][1] if i + 1 < len(self.timeline) else end
+            )
+            timeline.append({
+                "state": state,
+                "since_s": round(since, 3),
+                "seconds": round(max(0.0, until - since), 3),
+            })
+        return {
+            "pod": self.pod_key,
+            "tenant": self.tenant,
+            "model": self.model,
+            "shape": self.shape,
+            "guarantee": self.guarantee,
+            "first_enqueue_s": round(self.first_seen, 3),
+            "attempts": self.attempt_count,
+            "outcome": self.outcome or OUTCOME_PENDING,
+            "node": self.node,
+            "waited_s": round(max(0.0, end - self.first_seen), 3),
+            "timeline": timeline,
+            "attempt_log": list(self.attempts),
+        }
+
+
+class DecisionJournal:
+    def __init__(self, capacity: int = 512, attempts_per_pod: int = 8,
+                 log=None):
+        if capacity < 1:
+            raise ValueError(f"journal capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.attempts_per_pod = attempts_per_pod
+        self.log = log
+        self.evictions = 0
+        self._entries: "OrderedDict[str, PodJournal]" = OrderedDict()
+        self._lock = threading.Lock()
+        # time-to-terminal histograms per (tenant, shape, outcome)
+        self._wait_hist: Dict[Tuple[str, str, str], Histogram] = {}
+
+    # -- writes (scheduling thread) ----------------------------------
+
+    def _ensure(self, pod_key: str, now: float) -> PodJournal:
+        entry = self._entries.get(pod_key)
+        if entry is None:
+            entry = self._entries[pod_key] = PodJournal(
+                pod_key, now, self.attempts_per_pod
+            )
+            while len(self._entries) > self.capacity:
+                evicted_key, _ = self._entries.popitem(last=False)
+                self.evictions += 1
+                if self.log is not None:
+                    self.log.info(
+                        "explain journal evicted %s (capacity %d)",
+                        evicted_key, self.capacity,
+                    )
+        else:
+            self._entries.move_to_end(pod_key)
+        return entry
+
+    def _live_entry(self, pod_key: str, now: float,
+                    attempt_start: Optional[float] = None) -> PodJournal:
+        """``_ensure``, except a ``bound``/``deleted`` terminal entry
+        from a PREVIOUS incarnation is replaced: a reused pod name
+        (StatefulSet-style recreate) must not inherit the old pod's
+        terminal outcome — its binds would never be observed and
+        ``/explain`` would show the dead incarnation forever. The
+        same-attempt case (bind recorded moments before the attempt
+        record lands) is distinguished by the attempt's start time.
+        Permanent ``unschedulable`` entries are NOT reset: the same
+        malformed pod is re-examined every pass and must keep
+        deduping, not re-observe a terminal per pass."""
+        entry = self._ensure(pod_key, now)
+        threshold = now if attempt_start is None else attempt_start
+        if entry.outcome in (OUTCOME_BOUND, OUTCOME_DELETED) \
+                and entry.outcome_at < threshold:
+            self._entries.pop(pod_key, None)
+            entry = self._ensure(pod_key, now)
+        return entry
+
+    def record_attempt(
+        self, pod_key: str, now: float, record: dict,
+        tenant: str = "", model: str = "", shape: str = "",
+        guarantee: bool = False,
+    ) -> None:
+        """One finished ``schedule_one`` attempt. ``record`` is the
+        phase-outcome dict the engine built during the walk."""
+        with self._lock:
+            entry = self._live_entry(pod_key, now,
+                                     attempt_start=record.get("at"))
+            if tenant:
+                entry.tenant = tenant
+            if model:
+                entry.model = model
+            if shape:
+                entry.shape = shape
+            entry.guarantee = entry.guarantee or guarantee
+            entry.attempt_count += 1
+            entry.attempts.append(record)
+
+    def note_reason(self, pod_key: str, old: Optional[str], new: str,
+                    now: float) -> None:
+        """Demand-ledger transition hook (DemandLedger.on_transition):
+        the pod's blocked reason changed — extend the timeline."""
+        with self._lock:
+            entry = self._live_entry(pod_key, now)
+            if entry.timeline[-1][0] != new:
+                entry.timeline.append((new, now))
+
+    def sync_reason(self, pod_key: str, reason: str, now: float,
+                    since: Optional[float] = None) -> None:
+        """Unconditional reconciliation against the demand ledger,
+        called every time an entry is (re)filed — the transition hook
+        only fires on CHANGES, so a journal entry rebuilt after an
+        LRU eviction (more pending pods than capacity) would
+        otherwise sit at ``enqueued`` with a fresh first_seen
+        forever. A virgin entry inherits the ledger's ``since`` as
+        its first-enqueue (the ledger keeps it across reason changes
+        AND journal evictions), and the current blocked reason is
+        appended if the timeline does not already end on it."""
+        with self._lock:
+            entry = self._live_entry(pod_key, now)
+            # attempt_count == 0 marks an entry minted THIS attempt
+            # (record_attempt lands after the demand note), so the
+            # backdate is safe even when the transition hook already
+            # appended a reason moments ago — the pre-eviction
+            # timeline is gone either way, but the wait must not be
+            if (
+                since is not None
+                and since < entry.first_seen
+                and entry.attempt_count == 0
+                and entry.timeline[0][0] == "enqueued"
+            ):
+                entry.first_seen = since
+                entry.timeline[0] = ("enqueued", since)
+            if not entry.outcome and entry.timeline[-1][0] != reason:
+                entry.timeline.append((reason, now))
+
+    def note_outcome(self, pod_key: str, outcome: str, now: float,
+                     node: str = "", tenant: str = "",
+                     shape: str = "", create: bool = True) -> None:
+        """Terminal state: ``bound``, permanent ``unschedulable``, or
+        ``deleted``. Feeds the wait-SLO histograms (bound /
+        unschedulable only — deletion is not a scheduling outcome).
+        Idempotent: an already-terminal entry is left alone (a bound
+        pod's eventual delete must not rewrite its provenance)."""
+        with self._lock:
+            if not create and pod_key not in self._entries:
+                return
+            # only a BIND may displace a stale terminal entry (a
+            # reused pod name binding again); a delete arriving for an
+            # already-bound entry is the same incarnation completing
+            # and must leave its provenance alone
+            if outcome == OUTCOME_BOUND:
+                entry = self._live_entry(pod_key, now)
+            else:
+                entry = self._ensure(pod_key, now)
+            if entry.outcome:
+                return
+            if tenant:
+                entry.tenant = tenant
+            if shape:
+                entry.shape = shape
+            entry.outcome = outcome
+            entry.outcome_at = now
+            entry.node = node
+            if entry.timeline[-1][0] != outcome:
+                entry.timeline.append((outcome, now))
+            if outcome in (OUTCOME_BOUND, OUTCOME_UNSCHEDULABLE):
+                key = (entry.tenant, entry.shape, outcome)
+                hist = self._wait_hist.get(key)
+                if hist is None:
+                    hist = self._wait_hist[key] = Histogram(WAIT_BUCKETS)
+                hist.observe(max(0.0, now - entry.first_seen))
+
+    def carry_over(self, old_key: str, new_key: str) -> None:
+        """A pod was resubmitted under a new name (fault kill / defrag
+        eviction: the controller recreates it). The replacement
+        inherits the original's first-enqueue time, attempt count, and
+        timeline so the disruption stays visible in wait accounting —
+        the simulator calls this on every resubmit."""
+        with self._lock:
+            old = self._entries.get(old_key)
+            if old is None:
+                return
+            entry = self._ensure(new_key, old.first_seen)
+            entry.tenant = old.tenant
+            entry.model = old.model
+            entry.shape = old.shape
+            entry.guarantee = old.guarantee
+            entry.first_seen = old.first_seen
+            entry.attempt_count = old.attempt_count
+            entry.attempts = deque(old.attempts, maxlen=self.attempts_per_pod)
+            entry.timeline = list(old.timeline)
+            if entry.timeline[-1][0] in (
+                OUTCOME_BOUND, OUTCOME_UNSCHEDULABLE, OUTCOME_DELETED
+            ):
+                entry.timeline.pop()  # the kill reopened the terminal state
+            entry.outcome = ""
+            entry.outcome_at = 0.0
+            entry.node = ""
+            self._entries.pop(old_key, None)
+
+    # -- reads (any thread) ------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, pod_key: str, now: float) -> Optional[dict]:
+        with self._lock:
+            entry = self._entries.get(pod_key)
+            return None if entry is None else entry.to_dict(now)
+
+    def current_reason(self, pod_key: str) -> str:
+        """The pod's latest timeline state ("" if unjournaled) — the
+        kube adapter's event-dedup fingerprint, so a reason CHANGE
+        (over-quota -> fragmentation-blocked) posts a fresh Event
+        inside the dedup window."""
+        with self._lock:
+            entry = self._entries.get(pod_key)
+            return entry.timeline[-1][0] if entry is not None else ""
+
+    def event_message(self, pod_key: str, now: float, fallback: str) -> str:
+        """Enrich a FailedScheduling message with the journal's
+        cumulative wait accounting (the per-reason node counts are
+        already in the message via the rejection aggregation)."""
+        with self._lock:
+            entry = self._entries.get(pod_key)
+            if entry is None or entry.attempt_count <= 1:
+                return fallback
+            waited = max(0.0, now - entry.first_seen)
+            return (
+                f"{fallback} [attempt {entry.attempt_count}, "
+                f"waiting {waited:.0f}s since first enqueue]"
+            )
+
+    def listing(self, now: float, tenant: Optional[str] = None) -> List[dict]:
+        """Summary rows (no attempt logs), most-recently-touched
+        first, optionally filtered by tenant."""
+        with self._lock:
+            rows = []
+            for entry in reversed(self._entries.values()):
+                if tenant is not None and entry.tenant != tenant:
+                    continue
+                end = entry.outcome_at if entry.outcome else now
+                rows.append({
+                    "pod": entry.pod_key,
+                    "tenant": entry.tenant,
+                    "shape": entry.shape,
+                    "outcome": entry.outcome or OUTCOME_PENDING,
+                    "reason": entry.timeline[-1][0],
+                    "attempts": entry.attempt_count,
+                    "waited_s": round(max(0.0, end - entry.first_seen), 3),
+                })
+            return rows
+
+    def export(self, now: float, max_attempts: Optional[int] = None) -> dict:
+        """Full journal as one JSON-ready document (the artifact the
+        CLI can render offline). ``max_attempts`` trims each pod's
+        attempt ring to its most recent N records."""
+        with self._lock:
+            pods = {}
+            for key, entry in self._entries.items():
+                doc = entry.to_dict(now)
+                if max_attempts is not None:
+                    doc["attempt_log"] = doc["attempt_log"][-max_attempts:]
+                pods[key] = doc
+            return {
+                "capacity": self.capacity,
+                "evictions": self.evictions,
+                "pods": pods,
+            }
+
+    def samples(self, now: float) -> List["expfmt.Sample"]:
+        """Journal health + the wait-time SLO families, computed on
+        the metrics thread like the occupancy gauges:
+
+        - ``tpu_scheduler_pod_wait_seconds{tenant,shape,outcome}`` —
+          time-to-terminal histograms (bound / unschedulable);
+        - ``tpu_scheduler_pod_wait_pending_seconds{tenant,shape}`` —
+          the censored gauge: the LONGEST wait among still-pending
+          pods (each has been waiting since its first enqueue);
+        - ``tpu_scheduler_queue_depth{tenant}`` — pending pods.
+        """
+        with self._lock:
+            samples: List[expfmt.Sample] = [
+                expfmt.Sample(
+                    "tpu_scheduler_explain_journal_pods", {},
+                    len(self._entries),
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_explain_journal_evictions_total", {},
+                    self.evictions,
+                ),
+            ]
+            for (tenant, shape, outcome), hist in sorted(
+                self._wait_hist.items()
+            ):
+                samples += hist.samples(
+                    "tpu_scheduler_pod_wait_seconds",
+                    {"tenant": tenant, "shape": shape, "outcome": outcome},
+                )
+            depth: Dict[str, int] = {}
+            pending_max: Dict[Tuple[str, str], float] = {}
+            for entry in self._entries.values():
+                if entry.outcome:
+                    continue
+                depth[entry.tenant] = depth.get(entry.tenant, 0) + 1
+                key = (entry.tenant, entry.shape)
+                wait = max(0.0, now - entry.first_seen)
+                pending_max[key] = max(pending_max.get(key, 0.0), wait)
+            for tenant in sorted(depth):
+                samples.append(expfmt.Sample(
+                    "tpu_scheduler_queue_depth", {"tenant": tenant},
+                    depth[tenant],
+                ))
+            for (tenant, shape) in sorted(pending_max):
+                samples.append(expfmt.Sample(
+                    "tpu_scheduler_pod_wait_pending_seconds",
+                    {"tenant": tenant, "shape": shape},
+                    round(pending_max[(tenant, shape)], 3),
+                ))
+            return samples
+
+
+def transition_matrix(pod_docs: Iterable[dict]) -> Dict[str, Dict[str, int]]:
+    """Reason-transition counts over exported pod journals: for every
+    consecutive timeline pair (a, b), ``matrix[a][b] += 1``. Pods with
+    no terminal outcome contribute a final edge into ``pending`` so
+    every pod's path ends in exactly one terminal column (bound /
+    unschedulable / deleted / pending) — the conservation property
+    tests/test_explain_report.py pins."""
+    matrix: Dict[str, Dict[str, int]] = {}
+    terminal = (OUTCOME_BOUND, OUTCOME_UNSCHEDULABLE, OUTCOME_DELETED,
+                OUTCOME_PENDING)
+    for doc in pod_docs:
+        states = [t["state"] for t in doc["timeline"]]
+        if not states or states[-1] not in terminal:
+            states.append(OUTCOME_PENDING)
+        for a, b in zip(states, states[1:]):
+            row = matrix.setdefault(a, {})
+            row[b] = row.get(b, 0) + 1
+    return matrix

@@ -1,0 +1,158 @@
+"""Human-readable rendering of journal documents (the CLI surface).
+
+Works from either a live ``/explain`` HTTP response or an exported
+journal artifact (EXPLAIN.json) — both carry the same dict shapes
+produced by ``DecisionJournal.get()`` / ``listing()``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+
+def _fmt_seconds(s: float) -> str:
+    if s >= 3600:
+        return f"{s / 3600:.1f}h"
+    if s >= 60:
+        return f"{s / 60:.1f}m"
+    return f"{s:.1f}s"
+
+
+def render_pod(doc: dict) -> str:
+    """The full per-pod explanation: identity, wait accounting, the
+    reason timeline, and the most recent attempts' phase outcomes."""
+    lines: List[str] = []
+    head = f"pod {doc['pod']}"
+    if doc.get("tenant"):
+        head += f"  tenant={doc['tenant']}"
+    if doc.get("shape"):
+        head += f"  shape={doc['shape']}"
+    if doc.get("model"):
+        head += f"  model={doc['model']}"
+    head += "  class=" + ("guarantee" if doc.get("guarantee") else
+                          "opportunistic")
+    lines.append(head)
+    outcome = doc.get("outcome", "pending")
+    waited = _fmt_seconds(doc.get("waited_s", 0.0))
+    tail = f" on {doc['node']}" if doc.get("node") else ""
+    lines.append(
+        f"  outcome: {outcome}{tail} after {waited} "
+        f"({doc.get('attempts', 0)} attempts)"
+    )
+    timeline = doc.get("timeline") or []
+    if timeline:
+        lines.append("  timeline:")
+        for step in timeline:
+            lines.append(
+                f"    {step['state']:<24} {_fmt_seconds(step['seconds'])}"
+            )
+    for record in (doc.get("attempt_log") or [])[-3:]:
+        lines.append(f"  attempt at t={record.get('at', 0.0):.1f}:")
+        lines.extend("    " + l for l in _render_attempt(record))
+    return "\n".join(lines)
+
+
+def _render_attempt(record: dict) -> List[str]:
+    lines: List[str] = []
+    if record.get("prefilter"):
+        lines.append(f"prefilter: REJECTED — {record['prefilter']}")
+    quota = record.get("quota")
+    if quota:
+        verdict = "admitted" if quota.get("admitted") else (
+            "REFUSED — " + quota.get("why", "")
+        )
+        lines.append(f"quota: {verdict}")
+        used = quota.get("chips_used")
+        if used is not None:
+            lines.append(
+                f"  ledger: {used:.2f} chips used"
+                + (
+                    f" / {quota['quota_chips']:.2f} guaranteed"
+                    if quota.get("quota_chips") is not None else ""
+                )
+                + (
+                    f" / {quota['ceiling_chips']:.2f} ceiling"
+                    if quota.get("ceiling_chips") is not None else ""
+                )
+                + f" (demand +{quota.get('chips_demand', 0.0):.2f}, "
+                  f"capacity {quota.get('capacity_chips', 0.0):.0f})"
+            )
+    flt = record.get("filter")
+    if flt:
+        lines.append(
+            f"filter: {flt.get('feasible', 0)} feasible of "
+            f"{flt.get('examined', 0)} examined"
+        )
+        for reason, agg in (flt.get("rejections") or {}).items():
+            exemplars = ", ".join(agg.get("exemplars", []))
+            more = "" if agg["nodes"] <= len(agg.get("exemplars", [])) \
+                else ", …"
+            lines.append(
+                f"  ✗ {reason}  ({agg['nodes']} nodes: {exemplars}{more})"
+            )
+    score = record.get("score")
+    if score:
+        winner = score.get("winner") or {}
+        line = (
+            f"score: winner {winner.get('node')} "
+            f"({winner.get('score', 0.0):.1f})"
+        )
+        runner = score.get("runner_up")
+        if runner:
+            line += f", runner-up {runner['node']} ({runner['score']:.1f})"
+        lines.append(line)
+    defrag = record.get("defrag")
+    if defrag:
+        evicted = defrag.get("evicted") or []
+        if evicted:
+            lines.append(f"defrag: evicted {', '.join(evicted)}")
+        else:
+            lines.append(
+                "defrag: no plan"
+                + (
+                    " (aggregate capacity exists — fragmentation)"
+                    if defrag.get("aggregate_fits") else ""
+                )
+            )
+    permit = record.get("permit")
+    if permit:
+        lines.append(
+            f"permit: {permit.get('action')}"
+            + (f" — {permit['detail']}" if permit.get("detail") else "")
+        )
+    lines.append(
+        f"=> {record.get('outcome', '?')}"
+        + (f" on {record['node']}" if record.get("node") else "")
+        + (f": {record['message']}" if record.get("message") else "")
+    )
+    return lines
+
+
+def render_listing(rows: Iterable[dict]) -> str:
+    rows = list(rows)
+    if not rows:
+        return "journal empty (no scheduling attempts recorded)"
+    widths = {
+        "pod": max(3, *(len(r["pod"]) for r in rows)),
+        "tenant": max(6, *(len(r.get("tenant", "")) for r in rows)),
+        "shape": max(5, *(len(r.get("shape", "")) for r in rows)),
+        "outcome": max(7, *(len(r.get("outcome", "")) for r in rows)),
+        "reason": max(6, *(len(r.get("reason", "")) for r in rows)),
+    }
+    header = (
+        f"{'POD':<{widths['pod']}}  {'TENANT':<{widths['tenant']}}  "
+        f"{'SHAPE':<{widths['shape']}}  {'OUTCOME':<{widths['outcome']}}  "
+        f"{'REASON':<{widths['reason']}}  ATTEMPTS  WAITED"
+    )
+    lines = [header]
+    for r in rows:
+        lines.append(
+            f"{r['pod']:<{widths['pod']}}  "
+            f"{r.get('tenant', ''):<{widths['tenant']}}  "
+            f"{r.get('shape', ''):<{widths['shape']}}  "
+            f"{r.get('outcome', ''):<{widths['outcome']}}  "
+            f"{r.get('reason', ''):<{widths['reason']}}  "
+            f"{r.get('attempts', 0):>8}  "
+            f"{_fmt_seconds(r.get('waited_s', 0.0))}"
+        )
+    return "\n".join(lines)

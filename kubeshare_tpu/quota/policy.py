@@ -120,19 +120,44 @@ class QuotaPlane:
         member not yet holding a reservation), so a gang can no longer
         straddle the quota boundary — early members binding while late
         ones are doomed to die at the Permit barrier."""
+        admitted, why, _ = self.admit_detail(req, count)
+        return admitted, why
+
+    def admit_detail(self, req: PodRequirements, count: int = 1
+                     ) -> Tuple[bool, str, dict]:
+        """``admit`` plus the ledger numbers behind the verdict — the
+        decision journal records these so ``/explain`` can show WHY
+        the gate refused (used vs quota vs demanded, against what
+        capacity), not just that it did. The detail dict carries:
+        chips/mem demand, capacity denominators, and — when the
+        matching limit is configured — guarantee usage vs quota and
+        total usage vs borrow ceiling."""
         chips, mem = self.demand(req, count)
+        detail: dict = {
+            "tenant": req.tenant,
+            "chips_demand": round(chips, 3),
+            "mem_demand": mem,
+            "gang_count": count,
+        }
         if chips <= 0 and mem <= 0:
-            return True, ""
+            return True, "", detail
         spec = self.registry.spec(req.tenant)
         if spec.guaranteed is None and spec.borrow_limit is None:
-            return True, ""  # unconfigured tenant: seed behavior
+            detail["unconfigured"] = True
+            return True, "", detail  # unconfigured tenant: seed behavior
         gang = f" (gang of {count})" if count > 1 else ""
         cap_chips, cap_mem = self.capacity()
+        detail["capacity_chips"] = round(cap_chips, 3)
+        detail["capacity_mem"] = cap_mem
+        detail["chips_used"] = round(self.ledger.chips_used(req.tenant), 3)
         if req.is_guarantee and spec.guaranteed is not None:
             quota_chips = spec.guaranteed * cap_chips
             quota_mem = spec.guaranteed * cap_mem
             used = self.ledger.guarantee_chips_used(req.tenant)
             used_mem = self.ledger.guarantee_mem_used(req.tenant)
+            detail["guaranteed_fraction"] = spec.guaranteed
+            detail["quota_chips"] = round(quota_chips, 3)
+            detail["guarantee_chips_used"] = round(used, 3)
             if (used + chips > quota_chips + _EPS
                     or used_mem + mem > quota_mem + _EPS):
                 return False, (
@@ -140,12 +165,14 @@ class QuotaPlane:
                     f"{used:.3f}+{chips:.3f} chips vs "
                     f"{quota_chips:.3f} guaranteed "
                     f"({spec.guaranteed:.0%} of {cap_chips:.0f}); waiting"
-                )
+                ), detail
         if spec.borrow_limit is not None:
             ceil_chips = spec.borrow_limit * cap_chips
             ceil_mem = spec.borrow_limit * cap_mem
             used = self.ledger.chips_used(req.tenant)
             used_mem = self.ledger.mem_used(req.tenant)
+            detail["borrow_limit"] = spec.borrow_limit
+            detail["ceiling_chips"] = round(ceil_chips, 3)
             if (used + chips > ceil_chips + _EPS
                     or used_mem + mem > ceil_mem + _EPS):
                 return False, (
@@ -153,8 +180,8 @@ class QuotaPlane:
                     f"{used:.3f}+{chips:.3f} chips vs "
                     f"{ceil_chips:.3f} ceiling "
                     f"({spec.borrow_limit:.0%} of {cap_chips:.0f}); waiting"
-                )
-        return True, ""
+                ), detail
+        return True, "", detail
 
     def over_quota(self, status) -> str:
         """Permit-time re-check with the pod's own charge already on

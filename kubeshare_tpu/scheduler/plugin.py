@@ -27,6 +27,7 @@ from ..autoscale.demand import DemandLedger
 from ..cells.cell import _EPS, Cell, CellTree, ChipInfo
 from ..cells.spec import TopologyConfig, load_topology
 from ..cluster.api import ClusterAPI, Conflict, Node, Pod
+from ..explain.journal import DecisionJournal, RejectionAgg
 from ..utils import expfmt
 from ..utils.bitmap import RRBitmap
 from ..utils.logger import get_logger
@@ -92,6 +93,7 @@ class TpuShareScheduler:
         percentage_of_nodes_to_score: int = 0,
         min_feasible_nodes: int = 64,
         tenants: Union[None, str, dict, "TenantRegistry"] = None,
+        explain_capacity: int = 512,
     ):
         # function-scope import: quota depends on scheduler.labels /
         # scheduler.constants, so a module-level import here would be
@@ -127,11 +129,18 @@ class TpuShareScheduler:
         else:
             registry = TenantRegistry.from_config(tenants)
         self.quota = QuotaPlane(registry, self.tree, log=self.log)
+        # Decision journal (explain plane): every schedule_one attempt
+        # records its phase outcomes per pod — bounded LRU, evictions
+        # counted, queryable over /explain and the CLI. Also owns the
+        # per-(tenant, shape, outcome) wait-SLO histograms.
+        self.explain = DecisionJournal(capacity=explain_capacity,
+                                       log=self.log)
         # Demand ledger (autoscale plane): every schedule_one that
         # falls short of a bind files/refreshes one entry with a
         # reason code; binds and deletes resolve it. Scheduling-thread
-        # scratch state, rebuilt by the next pass after a restart.
-        self.demand = DemandLedger()
+        # scratch state, rebuilt by the next pass after a restart. Its
+        # transition hook feeds the journal's reason timeline.
+        self.demand = DemandLedger(on_transition=self.explain.note_reason)
         self.ports: Dict[str, RRBitmap] = {}
         self._waiting: Dict[str, Dict[str, _Waiting]] = {}  # group_key -> pods
         self._synced_nodes: Set[str] = set()
@@ -273,8 +282,10 @@ class TpuShareScheduler:
         # the same _restore_bound_pod replay that rebuilds their
         # reservations, so usage can never double-count
         self.quota = QuotaPlane(self.quota.registry, tree, log=self.log)
-        # pending demand re-files itself on each pod's next attempt
-        self.demand = DemandLedger()
+        # pending demand re-files itself on each pod's next attempt;
+        # the decision journal deliberately SURVIVES the reload — it
+        # is observability history, not accounting state
+        self.demand = DemandLedger(on_transition=self.explain.note_reason)
         self.ports = {}
         self._waiting = {}
         self._synced_nodes = set()
@@ -329,7 +340,20 @@ class TpuShareScheduler:
     def _on_node_update(self, node: Node) -> None:
         if not node.healthy:
             self._index_remove(node.name)
-            self.tree.set_node_health(node.name, False)
+            if getattr(node, "deleted", False):
+                # The Node OBJECT left the cluster (apiserver DELETE /
+                # vanished from a relist), not a health flip: unbind
+                # its chips NOW — bind_node with an empty inventory
+                # withdraws every bound leaf — so QuotaPlane.capacity()
+                # (the quota denominator) shrinks with the pool instead
+                # of waiting for an inventory sync that will never
+                # come. A NotReady node keeps its bound leaves exactly
+                # as before (it may come back with its pods running).
+                self.tree.bind_node(node.name, [])
+                self._synced_nodes.discard(node.name)
+                self._bound_queue.pop(node.name, None)
+            else:
+                self.tree.set_node_health(node.name, False)
             return
         self._index_add(node.name)
         try:
@@ -385,6 +409,12 @@ class TpuShareScheduler:
         self._defrag_inflight.discard(pod.key)  # eviction completed
         self._drop_defrag_holds(pod.key)  # beneficiary gone -> free the space
         self.demand.resolve(pod.key)  # a deleted pod wants nothing
+        # journal: a pod deleted while pending closes its timeline as
+        # "deleted" (a bound pod's entry is already terminal and is
+        # left alone); create=False — a delete for a pod never
+        # attempted must not mint a journal entry
+        self.explain.note_outcome(pod.key, "deleted", self.clock(),
+                                  create=False)
         self.groups.forget_pod(pod.key)
         status = self.status.pop(pod.key)
         if status is not None:
@@ -706,7 +736,10 @@ class TpuShareScheduler:
     # ================= cycle driver ==================================
 
     def schedule_one(self, pod: Pod) -> Decision:
-        """One full scheduling cycle for one pod."""
+        """One full scheduling cycle for one pod, journaled: the
+        attempt's phase outcomes land in the decision journal (the
+        ``/explain`` surface). The no-op requeue-race short circuit is
+        NOT an attempt and is not journaled."""
         existing = self.status.get(pod.key)
         if existing is not None and existing.state != PodState.PENDING:
             # already reserved/waiting/bound — a requeue race must not
@@ -714,12 +747,51 @@ class TpuShareScheduler:
             state = "waiting" if existing.state == PodState.WAITING else "bound"
             return Decision(state, pod.key, node=existing.node_name,
                             message="already scheduled")
+        # exact clock, no rounding: _live_entry compares this attempt
+        # start against the bind's outcome_at to tell "bound moments
+        # ago in THIS attempt" from "bound by a previous incarnation",
+        # and a round-up would misfile the former as the latter
+        rec: dict = {"at": self.clock()}
+        decision = self._schedule_attempt(pod, rec)
+        req = rec.pop("_req", None)
+        rec["outcome"] = decision.status
+        if decision.node:
+            rec["node"] = decision.node
+        if decision.message:
+            rec["message"] = decision.message
+        now = self.clock()
+        if req is not None:
+            shape = ("regular" if req.kind == PodKind.REGULAR
+                     else D.shape_of(req))
+            self.explain.record_attempt(
+                pod.key, now, rec, tenant=req.tenant,
+                model=req.model or "*", shape=shape,
+                guarantee=req.is_guarantee,
+            )
+        else:  # prefilter rejected before requirements existed
+            shape = ""
+            self.explain.record_attempt(pod.key, now, rec,
+                                        tenant=pod.namespace)
+        if decision.status == "unschedulable" and not decision.retryable:
+            # permanent reject: a terminal outcome for wait accounting
+            self.explain.note_outcome(
+                pod.key, "unschedulable", now,
+                tenant=req.tenant if req is not None else pod.namespace,
+                shape=shape,
+            )
+        return decision
+
+    def _schedule_attempt(self, pod: Pod, rec: dict) -> Decision:
+        """The scheduling walk. ``rec`` accumulates phase outcomes for
+        the journal: the caller (schedule_one) owns recording it."""
         try:
             with maybe_span(self.tracer, "prefilter", pod=pod.key):
                 req = self.pre_filter(pod)
         except Unschedulable as e:
+            rec["prefilter"] = str(e)
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
+        rec["_req"] = req
         group = self.groups.get_or_create(pod, req.gang)
 
         # Quota admission gate — BEFORE any filtering and before
@@ -744,7 +816,13 @@ class TpuShareScheduler:
                 )
             )
             gang_pending = max(1, group.min_available - held)
-        admitted, why = self.quota.admit(req, count=gang_pending)
+        admitted, why, quota_detail = self.quota.admit_detail(
+            req, count=gang_pending
+        )
+        quota_detail["admitted"] = admitted
+        if why:
+            quota_detail["why"] = why
+        rec["quota"] = quota_detail
         if not admitted:
             self._note_demand(pod.key, req, D.REASON_OVER_QUOTA)
             return Decision("unschedulable", pod.key, message=why,
@@ -769,11 +847,18 @@ class TpuShareScheduler:
             anchor_nodes = {l.node for l in anchors if l.node}
             start = self._filter_cursor % n_names if n_names else 0
             self.filter_attempts += 1
-            feasible, reasons, scans, consumed = self._filter_candidates(
+            feasible, rejections, scans, consumed = self._filter_candidates(
                 pod, req, names, n_names, start, target, anchor_nodes
             )
             self._filter_cursor = (start + consumed) % max(1, n_names)
             self.filter_scans += scans
+        rec["filter"] = filter_rec = {
+            "examined": scans,
+            "feasible": len(feasible),
+            "target": target,
+        }
+        if rejections:
+            filter_rec["rejections"] = rejections.to_dict()
         if not feasible:
             evicted = self._maybe_defrag(
                 pod, req,
@@ -783,10 +868,13 @@ class TpuShareScheduler:
             # aggregate capacity that exists but fits under no single
             # node, is fragmentation (defrag's and/or scale-up's
             # territory); anything else is a true capacity shortfall
+            agg_fits = bool(evicted) or self._aggregate_fits(req)
+            rec["defrag"] = {
+                "evicted": list(evicted), "aggregate_fits": agg_fits,
+            }
             self._note_demand(
                 pod.key, req,
-                D.REASON_FRAGMENTATION
-                if evicted or self._aggregate_fits(req)
+                D.REASON_FRAGMENTATION if agg_fits
                 else D.REASON_NO_FEASIBLE_CELL,
             )
             if evicted:
@@ -799,7 +887,8 @@ class TpuShareScheduler:
                     ),
                 )
             return Decision(
-                "unschedulable", pod.key, message="; ".join(reasons) or "no nodes"
+                "unschedulable", pod.key,
+                message=rejections.summary() or "no nodes",
             )
 
         with maybe_span(self.tracer, "score", pod=pod.key):
@@ -858,10 +947,25 @@ class TpuShareScheduler:
                     for name in feasible
                 }
             best = pick_best(scores)
+            # journal: winner + runner-up with raw scores (the same
+            # values pick_best normalizes) — the "why THIS node"
+            # record. Runner-up is pick_best over the rest, so it is
+            # literally who would have won had the winner not existed.
+            rec["score"] = score_rec = {
+                "candidates": len(scores),
+                "winner": {"node": best, "score": round(scores[best], 2)},
+            }
+            if len(scores) > 1:
+                rest = dict(scores)
+                rest.pop(best)
+                runner = pick_best(rest)
+                score_rec["runner_up"] = {
+                    "node": runner, "score": round(rest[runner], 2),
+                }
 
         if req.kind == PodKind.REGULAR:
             try:
-                self._bind_regular(pod, best)
+                self._bind_regular(pod, best, req)
             except Conflict:
                 return Decision(
                     "unschedulable", pod.key, retryable=True,
@@ -878,6 +982,18 @@ class TpuShareScheduler:
 
         with maybe_span(self.tracer, "permit", pod=pod.key):
             action, extra = self.permit(pod, status)
+        rec["permit"] = permit_rec = {"action": action}
+        if group.key:
+            permit_rec["group"] = group.key
+            permit_rec["min_available"] = group.min_available
+        if action == "deny":
+            permit_rec["detail"] = extra
+        elif action == "wait":
+            permit_rec["detail"] = f"gang barrier, timeout {extra}s"
+        elif extra:
+            permit_rec["detail"] = (
+                f"barrier released, co-binding {len(extra)} members"
+            )
         if action == "deny":
             # tenant went over quota between admission and Permit
             # (concurrent reservations); release only THIS pod — gang
@@ -913,14 +1029,18 @@ class TpuShareScheduler:
         start: int,
         target: int,
         anchor_nodes: Set[str],
-    ) -> Tuple[List[str], List[str], int, int]:
+    ) -> Tuple[List[str], RejectionAgg, int, int]:
         """The candidate scan: anchor nodes first (sampling must never
         hide the node the rest of a gang sits on), then the rotation
         window until ``target`` feasible nodes are found. Returns
-        (feasible, reasons, scans, consumed) where ``consumed`` is
-        rotation-window progress only — counting anchor scans would
-        skip never-examined nodes and systematically under-sample a
-        wedge of the cluster under steady gang traffic.
+        (feasible, rejections, scans, consumed) where ``rejections``
+        aggregates per-node refusals into {reason -> node count,
+        exemplars} — on a 2048-node cluster the old one-string-per-
+        rejecting-node list grew into a 2048-part unschedulable
+        message — and ``consumed`` is rotation-window progress only:
+        counting anchor scans would skip never-examined nodes and
+        systematically under-sample a wedge of the cluster under
+        steady gang traffic.
 
         Steady state — no defrag hold that could apply to this pod —
         the rotation loop reads the feasibility index directly: per
@@ -937,7 +1057,7 @@ class TpuShareScheduler:
         Anchor nodes (few, and only present for gangs) always take
         the hook chain."""
         feasible: List[str] = []
-        reasons: List[str] = []
+        rejections = RejectionAgg()
         scans = consumed = 0
         tree = self.tree
         for name in sorted(anchor_nodes):
@@ -948,9 +1068,9 @@ class TpuShareScheduler:
             if fit:
                 feasible.append(name)
             elif reason:
-                reasons.append(reason)
+                rejections.add(self._generic_reason(reason, name), name)
         if len(feasible) >= target or not n_names:
-            return feasible, reasons, scans, consumed
+            return feasible, rejections, scans, consumed
 
         fast = not (
             req.kind == PodKind.REGULAR
@@ -969,8 +1089,8 @@ class TpuShareScheduler:
                     if len(feasible) >= target:
                         break
                 elif reason:
-                    reasons.append(reason)
-            return feasible, reasons, scans, consumed
+                    rejections.add(self._generic_reason(reason, name), name)
+            return feasible, rejections, scans, consumed
 
         needs_port = req.kind == PodKind.SHARED
         is_multi = req.kind == PodKind.MULTI_CHIP
@@ -1004,7 +1124,7 @@ class TpuShareScheduler:
                     if len(feasible) >= target:
                         break
                 elif reason:
-                    reasons.append(reason)
+                    rejections.add(self._generic_reason(reason, name), name)
                 continue
             if needs_port:
                 pool = ports_get(name)
@@ -1062,22 +1182,32 @@ class TpuShareScheduler:
                 rejected.append(name)
         tree.filter_fast_hits += probes
         if not feasible and rejected:
-            # cold path: reconstruct the rejection strings the hot
+            # cold path: reconstruct the rejection reasons the hot
             # loop skipped (they only surface in the unschedulable
-            # Decision, i.e. when nothing fit)
+            # Decision and the journal, i.e. when nothing fit) — same
+            # generic keys the hook-chain paths normalize to, so both
+            # paths aggregate into one bucket per cause
             for name in rejected:
                 if needs_port and self._node_ports(name).full():
-                    reasons.append(
-                        f"node {name}: pod-manager port pool full"
-                    )
+                    rejections.add("pod-manager port pool full", name)
                 elif rmodel and rmodel not in models_on_node(name):
-                    reasons.append(f"node {name} has no {rmodel} chips")
+                    rejections.add(f"node has no {rmodel} chips", name)
                 else:
-                    reasons.append(
-                        f"node {name} cannot fit request={request} "
-                        f"mem={memory}"
+                    rejections.add(
+                        f"node cannot fit request={request} mem={memory}",
+                        name,
                     )
-        return feasible, reasons, scans, consumed
+        return feasible, rejections, scans, consumed
+
+    @staticmethod
+    def _generic_reason(reason: str, node: str) -> str:
+        """Normalize a per-node reason string for aggregation: strip
+        the node name so identical causes on different nodes share one
+        bucket (the node itself becomes the exemplar)."""
+        prefix = f"node {node}: "
+        if reason.startswith(prefix):
+            return reason[len(prefix):]
+        return reason.replace(f"node {node}", "node", 1)
 
     def _note_demand(self, pod_key: str, req, reason: str) -> None:
         """File/refresh the pod's pending-demand entry with the same
@@ -1086,7 +1216,15 @@ class TpuShareScheduler:
         if req.kind == PodKind.REGULAR:
             return  # consumes no TPU capacity; not capacity demand
         chips, mem = self.quota.demand(req)
-        self.demand.note(pod_key, req, reason, self.clock(), chips, mem)
+        now = self.clock()
+        entry = self.demand.note(pod_key, req, reason, now, chips, mem)
+        # reconcile the journal against the ledger: the transition
+        # hook only fires on reason CHANGES, so a journal entry
+        # rebuilt after an LRU eviction (more pending pods than
+        # --explain-capacity) would otherwise report a fresh
+        # first-enqueue and an empty timeline; the ledger's `since`
+        # survives both reason changes and journal evictions
+        self.explain.sync_reason(pod_key, reason, now, since=entry.since)
 
     def _aggregate_fits(self, req) -> bool:
         """Does the cluster hold this demand in AGGREGATE (ignoring
@@ -1409,6 +1547,12 @@ class TpuShareScheduler:
         # per (tenant, model, shape, reason) — the autoscale plane's
         # raw signal, useful on its own for starvation triage
         samples += self.demand.samples()
+        # explain plane: journal health (size + evictions — bounded,
+        # never silent), the per-(tenant, shape, outcome) wait-SLO
+        # histograms, per-tenant queue depth, and the censored
+        # still-pending wait gauge. The journal's lock makes this
+        # metrics-thread read safe against scheduling-thread writes.
+        samples += self.explain.samples(now)
         for node in self.tree.nodes():
             # non-caching read: this runs on the metrics HTTP thread,
             # which must not write the scheduling thread's leaf cache
@@ -1460,14 +1604,29 @@ class TpuShareScheduler:
         status = self.status.get(pod_key)
         if status is not None:
             status.state = PodState.BOUND
+            # journal terminal: time-to-bind observed into the wait-SLO
+            # histogram under the pod's (tenant, shape). This is the
+            # single bind choke point, so gang members released by a
+            # sibling's Permit are covered too.
+            self.explain.note_outcome(
+                pod_key, "bound", self.clock(), node=node_name,
+                tenant=status.tenant,
+                shape=D.shape_of(status.requirements),
+            )
         group_key = status.group_key if status else ""
         if group_key and group_key in self._waiting:
             self._waiting[group_key].pop(pod_key, None)
 
-    def _bind_regular(self, pod: Pod, node_name: str) -> None:
+    def _bind_regular(self, pod: Pod, node_name: str,
+                      req: Optional[PodRequirements] = None) -> None:
         self.cluster.bind(pod.key, node_name)
         self._drop_defrag_holds(pod.key)
         self.demand.resolve(pod.key)
+        self.explain.note_outcome(
+            pod.key, "bound", self.clock(), node=node_name,
+            tenant=req.tenant if req is not None else pod.namespace,
+            shape="regular",
+        )
 
     def _ensure_synced(self, node_name: str) -> None:
         if node_name not in self._unsynced:

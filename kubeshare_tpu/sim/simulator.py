@@ -305,6 +305,10 @@ class Simulator:
                         submitted_at=job.submitted_at)
         jobs[clone.key] = requeued
         pending.append(requeued)
+        # the decision journal follows the resubmit the same way: the
+        # clone inherits the original's first-enqueue time, attempts,
+        # and reason timeline (provenance survives the disruption)
+        self.engine.explain.carry_over(job.pod.key, clone.key)
         report.resubmitted += 1
         report.submitted += 1
 
@@ -569,6 +573,9 @@ class Simulator:
                                     submitted_at=victim.submitted_at)
                     jobs[clone.key] = requeued
                     still_pending.append(requeued)
+                    self.engine.explain.carry_over(
+                        victim.pod.key, clone.key
+                    )
                     report.resubmitted += 1
                     report.submitted += 1
                 if decision.status == "bound":

@@ -3,34 +3,59 @@
 Each exporter (collector :9004, aggregator :9005 in the reference —
 cmd/kubeshare-collector/main.go:23-24, cmd/kubeshare-aggregator/
 main.go:23-24) serves one path returning text exposition produced by a
-callback at scrape time.
+callback at scrape time. Prefix routes (``route_prefix``) additionally
+serve parameterized JSON endpoints — the scheduler's ``/explain``
+decision-provenance surface rides the same server as ``/metrics``.
 """
 
 from __future__ import annotations
 
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Dict
+from typing import Callable, Dict, List, Tuple
+
+# prefix-route handler: (path remainder, query params) ->
+# (status code, content type, body)
+PrefixHandler = Callable[[str, Dict[str, List[str]]], Tuple[int, str, str]]
 
 
 class MetricServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self._routes: Dict[str, Callable[[], str]] = {}
+        self._prefix_routes: Dict[str, PrefixHandler] = {}
         routes = self._routes
+        prefix_routes = self._prefix_routes
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802
-                fn = routes.get(self.path)
-                if fn is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                body = fn().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
+            def _reply(self, code: int, content_type: str, body: str):
+                payload = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
-                self.wfile.write(body)
+                self.wfile.write(payload)
+
+            def do_GET(self):  # noqa: N802
+                path, _, query = self.path.partition("?")
+                fn = routes.get(path)
+                if fn is not None:
+                    self._reply(200, "text/plain; version=0.0.4", fn())
+                    return
+                # longest matching prefix wins; the remainder (pod
+                # keys contain '/') and parsed query go to the handler
+                for prefix in sorted(prefix_routes, key=len, reverse=True):
+                    if path == prefix or path.startswith(prefix + "/"):
+                        handler = prefix_routes[prefix]
+                        rest = urllib.parse.unquote(
+                            path[len(prefix):].lstrip("/")
+                        )
+                        params = urllib.parse.parse_qs(query)
+                        code, ctype, body = handler(rest, params)
+                        self._reply(code, ctype, body)
+                        return
+                self.send_response(404)
+                self.end_headers()
 
             def log_message(self, *args):  # silence per-request stderr noise
                 pass
@@ -44,6 +69,13 @@ class MetricServer:
 
     def route(self, path: str, fn: Callable[[], str]) -> None:
         self._routes[path] = fn
+
+    def route_prefix(self, prefix: str, fn: PrefixHandler) -> None:
+        """Serve every path at or under ``prefix``. The handler
+        receives the path remainder (no leading slash, URL-unquoted)
+        and the parsed query string, and returns (status, content
+        type, body). Exact ``route`` matches take precedence."""
+        self._prefix_routes[prefix.rstrip("/")] = fn
 
     def start(self) -> "MetricServer":
         self._thread.start()

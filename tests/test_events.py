@@ -54,6 +54,91 @@ class TestPostEvent:
         )
         assert len(stub.events_posted) == 2
 
+    def test_dedup_reason_change_posts_fresh_event(self, stub):
+        """Regression: dedup keys on (pod, reason, FINGERPRINT) — a
+        pod whose blocked reason moves (over-quota ->
+        fragmentation-blocked) must emit a fresh FailedScheduling
+        within the 60s window instead of being suppressed as a
+        repeat of the same reason string."""
+        cluster = make_cluster(stub)
+        cluster.post_event("default/p1", "FailedScheduling",
+                           "over quota", "Warning",
+                           fingerprint="over-quota")
+        assert len(stub.events_posted) == 1
+        # same blocked reason, reworded message: still suppressed
+        cluster.post_event("default/p1", "FailedScheduling",
+                           "over quota, still", "Warning",
+                           fingerprint="over-quota")
+        assert len(stub.events_posted) == 1
+        # the blocked reason MOVED: fresh event inside the window
+        cluster.post_event("default/p1", "FailedScheduling",
+                           "no single node fits", "Warning",
+                           fingerprint="fragmentation-blocked")
+        assert len(stub.events_posted) == 2
+        # and flapping back is again a dedup hit on the first key
+        cluster.post_event("default/p1", "FailedScheduling",
+                           "over quota again", "Warning",
+                           fingerprint="over-quota")
+        assert len(stub.events_posted) == 2
+
+    def test_decision_event_carries_journal_fingerprint(self):
+        """The cmd layer sources FailedScheduling fingerprints (and
+        wait enrichment) from the decision journal."""
+        from kubeshare_tpu.cells.cell import ChipInfo as Chip
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.scheduler import constants as C
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        topo = {
+            "cell_types": {
+                "v5e-node": {
+                    "child_cell_type": "tpu-v5e",
+                    "child_cell_number": 4,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+            },
+            "cells": [{"cell_type": "v5e-node", "cell_id": "n00"}],
+        }
+        cluster = FakeCluster()
+        cluster.add_node(
+            "n00", [Chip(f"c{i}", "tpu-v5e", 16 << 30, i)
+                    for i in range(4)]
+        )
+        clock = [0.0]
+        engine = TpuShareScheduler(
+            topo, cluster, clock=lambda: clock[0],
+            tenants={"tenants": {"alpha": {"guaranteed": 0.25}}},
+        )
+        pod = cluster.create_pod(Pod(
+            name="hungry", namespace="alpha",
+            labels={C.LABEL_TPU_REQUEST: "2",
+                    C.LABEL_TPU_LIMIT_ALIASES[1]: "2",
+                    C.LABEL_PRIORITY: "50"},
+            scheduler_name=C.SCHEDULER_NAME,
+        ))
+        posted = []
+
+        def post(pod_key, reason, message, event_type="Normal",
+                 fingerprint=""):
+            posted.append((pod_key, reason, message, fingerprint))
+
+        decision = engine.schedule_one(pod)  # 2 > 25% of 4 chips
+        assert decision.status == "unschedulable"
+        scheduler_cmd._post_decision_event(post, decision, engine)
+        [(key, reason, message, fingerprint)] = posted
+        assert reason == "FailedScheduling"
+        assert fingerprint == "over-quota"
+        # second attempt later: the message is enriched with the
+        # journal's cumulative wait accounting
+        clock[0] = 120.0
+        decision = engine.schedule_one(cluster.get_pod("alpha/hungry"))
+        scheduler_cmd._post_decision_event(post, decision, engine)
+        _, _, message, fingerprint = posted[1]
+        assert fingerprint == "over-quota"
+        assert "attempt 2" in message and "120s" in message
+
     def test_apiserver_failure_is_swallowed(self, stub):
         cluster = make_cluster(stub)
         stub.stop()

@@ -1,0 +1,567 @@
+"""Decision-provenance plane (kubeshare_tpu/explain): the journal's
+phase records, reason timelines, bounded memory, wait-SLO histograms,
+the /explain HTTP surface, and the explain CLI."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.cmd import explain as explain_cmd
+from kubeshare_tpu.explain.journal import (
+    DecisionJournal, RejectionAgg, transition_matrix,
+)
+from kubeshare_tpu.explain.render import render_listing, render_pod
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+GIB = 1 << 30
+
+
+def topo(n_nodes=2, chips_per_node=4):
+    return {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": chips_per_node,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": f"n{i:02d}"}
+            for i in range(n_nodes)
+        ],
+    }
+
+
+def chips(node, n=4, model="tpu-v5e", mem=16 * GIB):
+    return [ChipInfo(f"{node}-chip-{i}", model, mem, i) for i in range(n)]
+
+
+def tpu_pod(name, request=0.5, limit=None, priority=0,
+            namespace="default"):
+    labels = {
+        C.LABEL_TPU_REQUEST: str(request),
+        C.LABEL_TPU_LIMIT_ALIASES[1]: str(
+            limit if limit is not None else max(float(request), 1.0)
+        ),
+    }
+    if priority:
+        labels[C.LABEL_PRIORITY] = str(priority)
+    return Pod(name=name, namespace=namespace, labels=labels,
+               scheduler_name=C.SCHEDULER_NAME)
+
+
+def make_engine(n_nodes=2, tenants=None, **kwargs):
+    cluster = FakeCluster()
+    for i in range(n_nodes):
+        cluster.add_node(f"n{i:02d}", chips(f"n{i:02d}"))
+    clock = [0.0]
+    engine = TpuShareScheduler(
+        topo(n_nodes), cluster, clock=lambda: clock[0],
+        tenants=tenants, **kwargs,
+    )
+    return cluster, engine, clock
+
+
+# ===================== rejection aggregation =========================
+
+
+class TestRejectionAgg:
+    def test_counts_and_capped_exemplars(self):
+        agg = RejectionAgg()
+        for i in range(10):
+            agg.add("node cannot fit request=2.0 mem=0", f"n{i:02d}")
+        agg.add("no tpu-v4 chips", "n99")
+        d = agg.to_dict()
+        assert d["node cannot fit request=2.0 mem=0"]["nodes"] == 10
+        assert len(
+            d["node cannot fit request=2.0 mem=0"]["exemplars"]
+        ) == RejectionAgg.MAX_EXEMPLARS
+        summary = agg.summary()
+        # dominant reason first, count visible, exemplars capped
+        assert summary.startswith("node cannot fit request=2.0 mem=0 (x10:")
+        assert "…" in summary
+        assert "no tpu-v4 chips [n99]" in summary
+
+    def test_unschedulable_message_is_aggregated_not_per_node(self):
+        """Satellite: on a big cluster the Decision message must stay
+        O(reasons), not O(nodes) — one bucket per cause with a count,
+        instead of one string per rejecting node."""
+        n = 48
+        cluster, engine, clock = make_engine(n_nodes=n)
+        d = engine.schedule_one(cluster.create_pod(
+            tpu_pod("whale", request=8, limit=8)  # > any node
+        ))
+        assert d.status == "unschedulable"
+        assert f"(x{n}:" in d.message
+        # every node rejected, yet the message names at most
+        # MAX_EXEMPLARS of them
+        named = sum(
+            1 for i in range(n) if f"n{i:02d}" in d.message
+        )
+        assert named <= RejectionAgg.MAX_EXEMPLARS
+        assert len(d.message) < 200
+
+
+# ===================== journal content ===============================
+
+
+class TestJournalRecords:
+    def test_quota_verdict_with_ledger_numbers(self):
+        tenants = {"tenants": {"alpha": {"weight": 1.0,
+                                         "guaranteed": 0.25}}}
+        cluster, engine, clock = make_engine(tenants=tenants)
+        d = engine.schedule_one(cluster.create_pod(tpu_pod(
+            "big", request=4, limit=4, priority=50, namespace="alpha",
+        )))
+        assert d.status == "unschedulable"
+        doc = engine.explain.get("alpha/big", clock[0])
+        [attempt] = doc["attempt_log"]
+        quota = attempt["quota"]
+        assert quota["admitted"] is False
+        assert quota["quota_chips"] == pytest.approx(2.0)  # 25% of 8
+        assert quota["chips_demand"] == pytest.approx(4.0)
+        assert quota["capacity_chips"] == pytest.approx(8.0)
+        assert "over guaranteed quota" in quota["why"]
+        assert doc["outcome"] == "pending"
+        assert doc["timeline"][-1]["state"] == "over-quota"
+
+    def test_filter_rejections_and_score_winner(self):
+        cluster, engine, clock = make_engine(n_nodes=2)
+        # fill n00 entirely so it rejects and n01 wins
+        for i in range(4):
+            d = engine.schedule_one(cluster.create_pod(tpu_pod(
+                f"f{i}", request=1, limit=1,
+            )))
+            assert d.status == "bound"
+        d = engine.schedule_one(cluster.create_pod(tpu_pod(
+            "late", request=4, limit=4, priority=10,
+        )))
+        assert d.status == "bound"
+        doc = engine.explain.get("default/late", clock[0])
+        [attempt] = doc["attempt_log"]
+        assert attempt["outcome"] == "bound"
+        assert attempt["score"]["winner"]["node"] == d.node
+        assert attempt["filter"]["feasible"] == 1
+        assert doc["outcome"] == "bound"
+        assert doc["node"] == d.node
+
+    def test_runner_up_recorded_when_nodes_compete(self):
+        cluster, engine, clock = make_engine(n_nodes=2)
+        d = engine.schedule_one(cluster.create_pod(tpu_pod("p")))
+        doc = engine.explain.get("default/p", clock[0])
+        [attempt] = doc["attempt_log"]
+        score = attempt["score"]
+        assert score["candidates"] == 2
+        assert {score["winner"]["node"], score["runner_up"]["node"]} \
+            == {"n00", "n01"}
+        assert score["winner"]["node"] == d.node
+
+    def test_prefilter_reject_is_terminal_unschedulable(self):
+        cluster, engine, clock = make_engine()
+        d = engine.schedule_one(cluster.create_pod(tpu_pod(
+            "bad", request=1.0, limit=0.5,  # request > limit
+        )))
+        assert d.status == "unschedulable" and not d.retryable
+        doc = engine.explain.get("default/bad", clock[0])
+        assert doc["outcome"] == "unschedulable"
+        assert "exceeds limit" in doc["attempt_log"][0]["prefilter"]
+
+    def test_reason_timeline_transitions_to_bound(self):
+        """The ISSUE's canonical path: over-quota ->
+        fragmentation-blocked -> bound, with time accounted to each
+        state."""
+        tenants = {"tenants": {"alpha": {"weight": 1.0,
+                                         "guaranteed": 0.5}}}
+        cluster, engine, clock = make_engine(tenants=tenants)
+        # alpha holds its full guarantee (4 of 8 chips)...
+        for i in range(4):
+            assert engine.schedule_one(cluster.create_pod(tpu_pod(
+                f"h{i}", request=1, limit=1, priority=50,
+                namespace="alpha",
+            ))).status == "bound"
+        # beta (unconfigured, guarantee class so its halves SPREAD
+        # across free chips) occupies the other node half-by-half
+        for i in range(4):
+            assert engine.schedule_one(cluster.create_pod(tpu_pod(
+                f"s{i}", request=0.5, priority=50, namespace="beta",
+            ))).status == "bound"
+        # ...so the next alpha guarantee pod gates over-quota
+        late = cluster.create_pod(tpu_pod(
+            "late", request=2, limit=2, priority=50, namespace="alpha",
+        ))
+        assert engine.schedule_one(late).status == "unschedulable"
+        # quota frees (two alpha pods finish), but beta halves take
+        # the freed chips before late retries: admitted now, yet no
+        # two whole-free chips remain — the blocked reason MOVES
+        clock[0] = 100.0
+        cluster.delete_pod("alpha/h0")
+        cluster.delete_pod("alpha/h1")
+        for i in range(4, 6):
+            assert engine.schedule_one(cluster.create_pod(tpu_pod(
+                f"s{i}", request=0.5, priority=50, namespace="beta",
+            ))).status == "bound"
+        d = engine.schedule_one(cluster.get_pod("alpha/late"))
+        assert d.status == "unschedulable"
+        doc = engine.explain.get("alpha/late", clock[0])
+        states = [t["state"] for t in doc["timeline"]]
+        assert states[0] == "enqueued"
+        assert "over-quota" in states
+        assert states[-1] in ("fragmentation-blocked", "no-feasible-cell")
+        # the filler load finishes: whole chips reopen and late binds
+        clock[0] = 250.0
+        for i in range(6):
+            cluster.delete_pod(f"beta/s{i}")
+        cluster.delete_pod("alpha/h2")
+        cluster.delete_pod("alpha/h3")
+        d = engine.schedule_one(cluster.get_pod("alpha/late"))
+        assert d.status == "bound", d.message
+        doc = engine.explain.get("alpha/late", clock[0])
+        states = [t["state"] for t in doc["timeline"]]
+        assert states[-1] == "bound" and "over-quota" in states
+        # the over-quota stretch accrued its real duration
+        over = next(t for t in doc["timeline"]
+                    if t["state"] == "over-quota")
+        assert over["seconds"] == pytest.approx(100.0)
+        assert doc["waited_s"] == pytest.approx(250.0)
+        # and the transition matrix sees the multi-step path
+        matrix = transition_matrix([doc])
+        assert matrix["enqueued"] == {"over-quota": 1}
+        assert matrix[states[-2]]["bound"] == 1
+
+    def test_deleted_while_pending_closes_timeline(self):
+        cluster, engine, clock = make_engine()
+        d = engine.schedule_one(cluster.create_pod(tpu_pod(
+            "whale", request=8, limit=8,
+        )))
+        assert d.status == "unschedulable"
+        clock[0] = 5.0
+        cluster.delete_pod("default/whale")
+        doc = engine.explain.get("default/whale", clock[0])
+        assert doc["outcome"] == "deleted"
+        assert doc["timeline"][-1]["state"] == "deleted"
+
+
+# ===================== bounded memory ================================
+
+
+class TestJournalBounds:
+    def test_lru_eviction_counted_and_exported(self):
+        cluster, engine, clock = make_engine(
+            explain_capacity=8, n_nodes=1
+        )
+        for i in range(20):
+            engine.schedule_one(cluster.create_pod(tpu_pod(
+                f"p{i}", request=0.1,
+            )))
+        assert len(engine.explain) <= 8
+        assert engine.explain.evictions == 20 - 8
+        by_name = {
+            (s.name, tuple(sorted(s.labels.items()))): s.value
+            for s in engine.explain.samples(clock[0])
+        }
+        assert by_name[("tpu_scheduler_explain_journal_pods", ())] <= 8
+        assert by_name[
+            ("tpu_scheduler_explain_journal_evictions_total", ())
+        ] == 12
+        # evicted pods answer None, surviving pods answer
+        assert engine.explain.get("default/p0", clock[0]) is None
+        assert engine.explain.get("default/p19", clock[0]) is not None
+
+    def test_attempt_ring_bounded_but_counters_cumulative(self):
+        cluster, engine, clock = make_engine(n_nodes=1)
+        engine.explain.attempts_per_pod = 4  # before the entry exists
+        pod = cluster.create_pod(tpu_pod("whale", request=8, limit=8))
+        for i in range(10):
+            engine.schedule_one(pod)
+            clock[0] += 1.0
+        doc = engine.explain.get("default/whale", clock[0])
+        assert doc["attempts"] == 10         # cumulative count survives
+        assert len(doc["attempt_log"]) == 4  # ring keeps the latest N
+        assert doc["attempt_log"][-1]["at"] == 9.0
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError, match="capacity"):
+            DecisionJournal(capacity=0)
+
+
+# ===================== wait SLO metrics ==============================
+
+
+class TestWaitMetrics:
+    def test_bound_histogram_and_queue_depth(self):
+        tenants = {"tenants": {"alpha": {"weight": 2.0}}}
+        cluster, engine, clock = make_engine(tenants=tenants)
+        assert engine.schedule_one(cluster.create_pod(tpu_pod(
+            "quick", namespace="alpha",
+        ))).status == "bound"
+        engine.schedule_one(cluster.create_pod(tpu_pod(
+            "stuck", request=8, limit=8, namespace="alpha",
+        )))
+        clock[0] = 42.0
+        samples = engine.explain.samples(clock[0])
+        hist = [
+            s for s in samples
+            if s.name == "tpu_scheduler_pod_wait_seconds_count"
+            and s.labels == {"tenant": "alpha", "shape": "shared",
+                             "outcome": "bound"}
+        ]
+        assert len(hist) == 1 and hist[0].value == 1
+        buckets = [
+            s for s in samples
+            if s.name == "tpu_scheduler_pod_wait_seconds_bucket"
+            and s.labels.get("outcome") == "bound"
+        ]
+        assert any(s.labels["le"] == "+Inf" for s in buckets)
+        [depth] = [
+            s for s in samples if s.name == "tpu_scheduler_queue_depth"
+        ]
+        assert depth.labels == {"tenant": "alpha"} and depth.value == 1
+        [pending] = [
+            s for s in samples
+            if s.name == "tpu_scheduler_pod_wait_pending_seconds"
+        ]
+        assert pending.value == pytest.approx(42.0)
+        assert pending.labels == {"tenant": "alpha", "shape": "x8"}
+
+    def test_permanent_reject_observed_as_unschedulable(self):
+        cluster, engine, clock = make_engine()
+        clock[0] = 3.0
+        engine.schedule_one(cluster.create_pod(tpu_pod(
+            "bad", request=1.0, limit=0.5,
+        )))
+        count = [
+            s for s in engine.explain.samples(clock[0])
+            if s.name == "tpu_scheduler_pod_wait_seconds_count"
+            and s.labels.get("outcome") == "unschedulable"
+        ]
+        assert len(count) == 1 and count[0].value == 1
+
+    def test_reused_pod_name_starts_a_fresh_incarnation(self):
+        """A recreated pod under the same key (StatefulSet-style name
+        reuse) must not inherit the previous incarnation's terminal
+        outcome — its bind is a fresh observation, not a suppressed
+        repeat."""
+        cluster, engine, clock = make_engine()
+        assert engine.schedule_one(cluster.create_pod(
+            tpu_pod("tpu-0")
+        )).status == "bound"
+        clock[0] = 10.0
+        cluster.delete_pod("default/tpu-0")
+        clock[0] = 60.0
+        assert engine.schedule_one(cluster.create_pod(
+            tpu_pod("tpu-0")
+        )).status == "bound"
+        doc = engine.explain.get("default/tpu-0", clock[0])
+        assert doc["outcome"] == "bound"
+        assert doc["first_enqueue_s"] == 60.0   # new incarnation
+        assert doc["attempts"] == 1
+        count = [
+            s for s in engine.explain.samples(clock[0])
+            if s.name == "tpu_scheduler_pod_wait_seconds_count"
+            and s.labels.get("outcome") == "bound"
+        ]
+        assert sum(s.value for s in count) == 2  # both binds observed
+
+    def test_eviction_churn_recovers_wait_and_reason_from_ledger(self):
+        """With more pending pods than journal capacity, per-pass LRU
+        churn rebuilds entries — the rebuilt entry must recover the
+        pod's true first-enqueue and blocked reason from the demand
+        ledger, or censored waits collapse to one pass interval and
+        /explain shows 'enqueued' for a starving pod."""
+        cluster, engine, clock = make_engine(
+            n_nodes=1, explain_capacity=4
+        )
+        pods = [
+            cluster.create_pod(tpu_pod(f"w{i}", request=8, limit=8))
+            for i in range(8)
+        ]
+        for p in pods:
+            engine.schedule_one(p)
+        for tick in range(1, 4):
+            clock[0] = tick * 30.0
+            for p in pods:
+                engine.schedule_one(p)
+        assert engine.explain.evictions > 0
+        # strict LRU: the last-touched half survives; each survivor
+        # was evicted and re-journaled at least once along the way,
+        # yet recovered its true first-enqueue + reason from the
+        # ledger
+        assert engine.explain.get("default/w0", clock[0]) is None
+        doc = engine.explain.get("default/w7", clock[0])
+        assert doc is not None
+        assert doc["first_enqueue_s"] == 0.0  # ledger since recovered
+        assert doc["waited_s"] == pytest.approx(90.0)
+        assert doc["timeline"][-1]["state"] == "no-feasible-cell"
+        assert engine.explain.current_reason("default/w7") \
+            == "no-feasible-cell"
+        # the censored pending gauge reports the true starvation age
+        [pending] = [
+            s for s in engine.explain.samples(clock[0])
+            if s.name == "tpu_scheduler_pod_wait_pending_seconds"
+        ]
+        assert pending.value == pytest.approx(90.0)
+
+    def test_eviction_coinciding_with_reason_change_keeps_wait(self):
+        """Regression: when the re-attempt after a journal eviction
+        also CHANGES the blocked reason, the transition hook appends
+        the new reason before the ledger sync runs — the backdate
+        must still land (the wait survives even though the
+        pre-eviction timeline cannot)."""
+        tenants = {"tenants": {"alpha": {"weight": 1.0,
+                                         "guaranteed": 0.25}}}
+        cluster = FakeCluster()
+        cluster.add_node("n00", chips("n00"))  # pool declares 3 cells
+        clock = [0.0]
+        engine = TpuShareScheduler(
+            topo(3), cluster, clock=lambda: clock[0],
+            tenants=tenants, explain_capacity=2,
+        )
+        stuck = cluster.create_pod(tpu_pod(
+            "stuck", request=2, limit=2, priority=50,
+            namespace="alpha",
+        ))
+        assert engine.schedule_one(stuck).status == "unschedulable"
+        assert engine.explain.current_reason("alpha/stuck") \
+            == "over-quota"
+        # churn the tiny journal until stuck's entry is evicted
+        for i in range(4):
+            engine.schedule_one(cluster.create_pod(tpu_pod(
+                f"w{i}", request=8, limit=8,
+            )))
+        assert engine.explain.get("alpha/stuck", clock[0]) is None
+        # quota opens (capacity grows), so the next attempt files a
+        # DIFFERENT reason than the ledger held at eviction time
+        for n in ("n01", "n02"):
+            cluster.add_node(n, chips(n))
+        # spreading guarantee halves fragment every chip: stuck is
+        # now admitted (quota 3 of 12) but no whole chip remains
+        for i in range(12):
+            assert engine.schedule_one(cluster.create_pod(tpu_pod(
+                f"fill-{i}", request=0.5, priority=50,
+                namespace="beta",
+            ))).status == "bound"
+        clock[0] = 100.0
+        d = engine.schedule_one(cluster.get_pod("alpha/stuck"))
+        assert d.status == "unschedulable"
+        doc = engine.explain.get("alpha/stuck", clock[0])
+        assert doc["timeline"][-1]["state"] != "over-quota"  # changed
+        assert doc["first_enqueue_s"] == 0.0  # backdate still landed
+        assert doc["waited_s"] == pytest.approx(100.0)
+
+    def test_scheduler_flag_rejects_zero_capacity_cleanly(self):
+        from kubeshare_tpu.cmd import scheduler as scheduler_cmd
+
+        with pytest.raises(SystemExit, match="explain-capacity"):
+            scheduler_cmd.main([
+                "--topology", "x.yaml", "--cluster-state", "y.json",
+                "--explain-capacity", "0",
+            ])
+
+    def test_carry_over_preserves_first_enqueue(self):
+        cluster, engine, clock = make_engine()
+        assert engine.schedule_one(cluster.create_pod(
+            tpu_pod("victim")
+        )).status == "bound"
+        clock[0] = 50.0
+        cluster.delete_pod("default/victim")  # evicted/killed
+        engine.explain.carry_over("default/victim", "default/victim-r1")
+        assert engine.schedule_one(cluster.create_pod(
+            tpu_pod("victim-r1")
+        )).status == "bound"
+        doc = engine.explain.get("default/victim-r1", clock[0])
+        assert doc["first_enqueue_s"] == 0.0
+        assert doc["waited_s"] == pytest.approx(50.0)
+        assert engine.explain.get("default/victim", clock[0]) is None
+
+
+# ===================== HTTP + CLI surfaces ===========================
+
+
+@pytest.fixture
+def live_server():
+    from kubeshare_tpu.explain.http import register_explain
+    from kubeshare_tpu.utils.httpserv import MetricServer
+
+    tenants = {"tenants": {"alpha": {"weight": 1.0,
+                                     "guaranteed": 0.25}}}
+    cluster, engine, clock = make_engine(tenants=tenants)
+    engine.schedule_one(cluster.create_pod(tpu_pod(
+        "stuck", request=4, limit=4, priority=50, namespace="alpha",
+    )))
+    engine.schedule_one(cluster.create_pod(tpu_pod("ok")))
+    server = MetricServer(host="127.0.0.1", port=0)
+    register_explain(server, engine)
+    server.start()
+    try:
+        yield f"http://127.0.0.1:{server.port}", engine
+    finally:
+        server.stop()
+
+
+class TestExplainHttp:
+    def test_pod_document(self, live_server):
+        base, engine = live_server
+        with urllib.request.urlopen(f"{base}/explain/alpha/stuck") as r:
+            assert r.headers["Content-Type"] == "application/json"
+            doc = json.loads(r.read().decode())
+        assert doc["pod"] == "alpha/stuck"
+        assert doc["attempt_log"][0]["quota"]["admitted"] is False
+        assert doc["timeline"][-1]["state"] == "over-quota"
+
+    def test_listing_filtered_by_tenant(self, live_server):
+        base, engine = live_server
+        with urllib.request.urlopen(f"{base}/explain?tenant=alpha") as r:
+            doc = json.loads(r.read().decode())
+        assert [p["pod"] for p in doc["pods"]] == ["alpha/stuck"]
+        with urllib.request.urlopen(f"{base}/explain") as r:
+            assert len(json.loads(r.read().decode())["pods"]) == 2
+
+    def test_unknown_pod_is_404_with_error_body(self, live_server):
+        base, engine = live_server
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/explain/ns/ghost")
+        assert exc.value.code == 404
+        assert "no journal entry" in json.loads(
+            exc.value.read().decode()
+        )["error"]
+
+    def test_cli_renders_live_pod_and_listing(self, live_server, capsys):
+        base, engine = live_server
+        assert explain_cmd.main(["--url", base, "alpha/stuck"]) == 0
+        out = capsys.readouterr().out
+        assert "over guaranteed quota" in out
+        assert "timeline:" in out
+        assert explain_cmd.main(["--url", base, "--tenant", "alpha"]) == 0
+        out = capsys.readouterr().out
+        assert "alpha/stuck" in out and "default/ok" not in out
+        assert explain_cmd.main(["--url", base, "ns/ghost"]) == 1
+
+    def test_cli_renders_from_artifact(self, live_server, tmp_path,
+                                       capsys):
+        base, engine = live_server
+        artifact = tmp_path / "journal.json"
+        artifact.write_text(json.dumps(engine.explain.export(10.0)))
+        assert explain_cmd.main(
+            ["--journal", str(artifact), "alpha/stuck"]
+        ) == 0
+        assert "over guaranteed quota" in capsys.readouterr().out
+        assert explain_cmd.main(["--journal", str(artifact)]) == 0
+        assert "alpha/stuck" in capsys.readouterr().out
+        assert explain_cmd.main(
+            ["--journal", str(artifact), "ns/ghost"]
+        ) == 1
+
+
+# ===================== rendering =====================================
+
+
+class TestRender:
+    def test_render_handles_minimal_doc(self):
+        assert "pod x/y" in render_pod({"pod": "x/y"})
+        assert "journal empty" in render_listing([])

@@ -506,3 +506,73 @@ class TestGroupGcOnDelete:
         sched.schedule_one(solo)
         cluster.delete_pod("default/solo")
         assert "default/g" not in sched.groups._groups
+
+
+# ===================== node delete vs quota denominators =============
+
+
+class TestNodeDeleteShrinksQuota:
+    """A real node DELETE (the Node object leaves the cluster) unbinds
+    its chips immediately, so quota fractions are recomputed against
+    the shrunken pool — a drained-but-NotReady node keeps its bound
+    leaves exactly as before (it may come back with its pods still
+    running)."""
+
+    def test_delete_shrinks_capacity_and_guaranteed_share(self):
+        tenants = {"tenants": {"alpha": {"weight": 1.0,
+                                         "guaranteed": 0.5}}}
+        cluster, sched, clock = make_sched(tenants=tenants)
+        cap_chips, cap_mem = sched.quota.capacity()
+        assert cap_chips == 8.0
+        # alpha may hold 4 chips (50% of 8): 3 whole-chip guarantee
+        # pods admit...
+        for i in range(3):
+            d = sched.schedule_one(cluster.create_pod(tpu_pod(
+                f"a{i}", request=1, limit=1, priority=50,
+                namespace="alpha",
+            )))
+            assert d.status == "bound", d.message
+        cluster.delete_node("node-b")
+        # ...but the pool halved: capacity AND the HBM denominator
+        # shrink right away, no inventory sync needed
+        cap_chips, cap_mem = sched.quota.capacity()
+        assert cap_chips == 4.0
+        assert cap_mem == 4 * 16 * GIB
+        # alpha's guarantee is now 2 chips and its guarantee-class
+        # usage still counts whatever survived on node-a, so a fresh
+        # guarantee pod is gated instead of admitted against the dead
+        # node's chips
+        survivors = sum(
+            s.charged_chips for s in sched.status.values()
+            if s.tenant == "alpha"
+        )
+        admitted, why = sched.quota.admit(
+            sched.pre_filter(cluster.create_pod(tpu_pod(
+                "a-late", request=1, limit=1, priority=50,
+                namespace="alpha",
+            )))
+        )
+        if survivors + 1 > 0.5 * 4 + 1e-9:
+            assert not admitted and "over guaranteed quota" in why
+        else:
+            assert admitted
+
+    def test_not_ready_keeps_denominators(self):
+        # the pre-existing semantics a DELETE must not change: NotReady
+        # marks leaves unhealthy but leaves them bound
+        cluster, sched, clock = make_sched()
+        assert sched.quota.capacity()[0] == 8.0
+        cluster.set_node_ready("node-b", False)
+        assert sched.quota.capacity()[0] == 8.0
+
+    def test_deleted_node_can_rejoin_with_fresh_inventory(self):
+        cluster, sched, clock = make_sched()
+        cluster.delete_node("node-b")
+        assert sched.quota.capacity()[0] == 4.0
+        assert "node-b" not in sched._synced_nodes
+        cluster.add_node("node-b", chips("node-b"))
+        assert sched.quota.capacity()[0] == 8.0
+        d = sched.schedule_one(cluster.create_pod(tpu_pod(
+            "p", request=4, limit=4, priority=50,
+        )))
+        assert d.status == "bound" and d.node == "node-b"
