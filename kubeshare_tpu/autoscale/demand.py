@@ -126,6 +126,19 @@ class DemandLedger:
         self._entries[pod_key] = entry
         return entry
 
+    def note_batch(self, items, resolver) -> List[DemandEntry]:
+        """File a wave's buffered notes in one pass: ``items`` is a
+        sequence of ``(pod_key, req, reason, now)`` and ``resolver``
+        maps a requirement to its resolved ``(chips, mem)`` (the quota
+        plane's ``demand`` — resolution happens at flush time so the
+        gate and the ledger still share one answer). Returns the
+        filed entries in order, for the journal reconciliation that
+        rides each one's ``since``."""
+        return [
+            self.note(pod_key, req, reason, now, *resolver(req))
+            for pod_key, req, reason, now in items
+        ]
+
     def resolve(self, pod_key: str) -> None:
         """The pod bound or left the cluster — either way it no longer
         wants anything."""

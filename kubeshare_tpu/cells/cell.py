@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from .spec import CellSpec, CellTypeSpec, TopologyConfig
 from .topology import unravel
@@ -209,9 +209,14 @@ class Cell:
 
 
 class NodeModelAgg:
-    """Per-(node, model) feasibility aggregates, rebuilt lazily when the
-    node's generation counter moves (any reserve/reclaim/bind/unbind/
-    health flip on the node). Queries are O(1): the Pareto frontier of
+    """Per-(node, model) feasibility aggregates, DELTA-MAINTAINED: a
+    reserve/reclaim on one of the node's leaves refreshes the touched
+    (node, model) aggregate in place (``refresh``, O(leaves-on-node) —
+    constant for a fixed node shape) instead of invalidating it for a
+    rebuild at the next read. Structural changes — bind/unbind from a
+    relist, health flips — still evict via the node's generation bump
+    (``CellTree._bump_generation``); a popped aggregate rebuilds
+    lazily at the next query. Queries are O(1): the Pareto frontier of
     (available, free HBM) over healthy bound leaves answers shared-fit
     exactly (a leaf fits (r, m) iff some frontier point dominates it),
     and the cached per-node-cell whole-free counts answer multi-chip
@@ -223,6 +228,17 @@ class NodeModelAgg:
 
     def __init__(self, gen: int, leaves: Sequence[Cell]):
         self.gen = gen
+        self._recompute(leaves)
+
+    def refresh(self, gen: int, leaves: Sequence[Cell]) -> None:
+        """Re-derive from the (already mutated) live leaves — the
+        delta-application path. Called by the tree immediately after a
+        reserve/reclaim touched one of these leaves, so readers never
+        see a stale aggregate and never pay a rebuild."""
+        self.gen = gen
+        self._recompute(leaves)
+
+    def _recompute(self, leaves: Sequence[Cell]) -> None:
         # Pareto-max (available, free_memory) points over healthy bound
         # leaves, available descending / free_memory strictly ascending.
         pts = sorted(
@@ -281,6 +297,13 @@ class CellTree:
         self.models_by_priority: List[str] = sorted(
             self.chip_priority, key=lambda m: -self.chip_priority[m]
         )
+        # homogeneous-cluster fast path: the one declared chip model
+        # ("" when the topology declares several) — lets the inline
+        # Filter loop skip the per-candidate models_on_node lookup
+        self.single_model: str = (
+            self.models_by_priority[0]
+            if len(self.models_by_priority) == 1 else ""
+        )
         # free_list[leaf_type][level] -> roots of trees with that leaf type
         self.free_list: Dict[str, Dict[int, List[Cell]]] = {}
         self.leaf_cells: Dict[str, Cell] = {}  # chip uuid -> leaf
@@ -293,16 +316,26 @@ class CellTree:
         self._bound_cache: Dict[
             str, Tuple[List[Cell], Dict[str, List[Cell]], List[str]]
         ] = {}
-        # Incremental feasibility index: a per-node generation counter
-        # bumped by every state change touching the node's leaves
-        # (reserve/reclaim/bind/unbind/HBM correction/health flip), and
-        # a per-(node, model) aggregate cache keyed by it. Steady-state
-        # Filter cost per examined node is O(1); only nodes actually
-        # touched since their last examination pay an O(leaves-on-node)
-        # rebuild. Counters are exported through the scheduler's
-        # /metrics so the fast/slow split is observable.
+        # Incremental feasibility index, delta-maintained: accounting
+        # walks (reserve/reclaim) refresh the touched (node, model)
+        # aggregate IN PLACE at mutation time — O(leaves-on-node),
+        # constant per node shape — so the per-node generation counter
+        # moves only on structural events (bind/unbind from a relist,
+        # HBM correction, health flip), which evict the node's cached
+        # aggregates for a lazy rebuild at the next query. External
+        # memos (the scheduler's score cache) ride the ``on_delta``
+        # hook, which fires on BOTH paths. Counters are exported
+        # through the scheduler's /metrics so the delta/rebuild split
+        # is observable.
         self._node_gen: Dict[str, int] = {}
-        self._agg_cache: Dict[Tuple[str, str], NodeModelAgg] = {}
+        # model -> {node -> aggregate}: the fast Filter loop hoists the
+        # per-model inner dict, so the steady-state probe is one
+        # string-keyed get (no per-probe key-tuple allocation)
+        self._agg_cache: Dict[str, Dict[str, NodeModelAgg]] = {}
+        # fired with the node name on every leaf-state change (delta
+        # application AND generation bump): the scheduler's score memo
+        # evicts its per-(node, shape) entries from this hook
+        self.on_delta: Optional[Callable[[str], None]] = None
         # Total HBM across bound leaves, maintained by the same
         # bind/unbind/HBM-correction walks that bump generations: the
         # quota plane's capacity denominator must be O(1) per read
@@ -312,7 +345,9 @@ class CellTree:
         self.total_full_memory = 0
         self.filter_fast_hits = 0   # O(1) aggregate answers
         self.filter_slow_walks = 0  # exhaustive walks (defrag holds)
-        self.agg_rebuilds = 0       # aggregate recomputes (gen moved)
+        self.agg_rebuilds = 0       # gen-bump evictions (rebuild debt)
+        self.agg_builds = 0         # cold builds (first query)
+        self.agg_delta_updates = 0  # in-place refreshes (reserve/reclaim)
         self.agg_invalidations = 0  # generation bumps
         # Differential oracle: when True, every fast-path answer is
         # asserted against the exhaustive walk (tests only — the point
@@ -453,29 +488,68 @@ class CellTree:
         return bound
 
     def _bump_generation(self, node: str) -> None:
-        """Invalidate ``node``'s feasibility aggregates (and any
-        external caches keyed by :meth:`node_generation`)."""
+        """Structural invalidation (bind/unbind/HBM correction/health
+        flip): bump the node's generation and EVICT its cached
+        aggregates — every model's, because the event may have changed
+        which models the node even has. The next query rebuilds from
+        the live leaves. Accounting walks (reserve/reclaim) do NOT
+        come through here — they delta-refresh in place
+        (:meth:`_apply_leaf_delta`)."""
         if node:
             self._node_gen[node] = self._node_gen.get(node, 0) + 1
             self.agg_invalidations += 1
+            for by_node in self._agg_cache.values():
+                if by_node.pop(node, None) is not None:
+                    self.agg_rebuilds += 1  # rebuild debt: next read pays
+            if self.on_delta is not None:
+                self.on_delta(node)
+
+    def _apply_leaf_delta(self, leaf: Cell) -> None:
+        """Delta maintenance for an accounting change on ``leaf``
+        (reserve/reclaim): refresh the one affected (node, model)
+        aggregate in place from the already-mutated leaves —
+        O(leaves-on-node for that model) — and fire ``on_delta`` so
+        external memos (score cache) evict their entries for this
+        node. No generation bump: readers holding the aggregate see
+        the post-mutation state immediately, and untouched nodes'
+        caches are left alone."""
+        node = leaf.node
+        if not node:
+            return
+        by_node = self._agg_cache.get(leaf.leaf_cell_type)
+        if by_node is not None:
+            agg = by_node.get(node)
+            if agg is not None:
+                agg.refresh(
+                    self._node_gen.get(node, 0),
+                    self.leaves_view(node, leaf.leaf_cell_type),
+                )
+                self.agg_delta_updates += 1
+        if self.on_delta is not None:
+            self.on_delta(node)
 
     def node_generation(self, node: str) -> int:
-        """Monotonic per-node state counter: moves whenever anything
-        that can change a Filter/Score outcome on ``node`` changes.
-        External memos (the scheduler's score cache) key on it."""
+        """Monotonic per-node STRUCTURAL state counter: moves on
+        bind/unbind/HBM-correction/health events (accounting deltas
+        refresh aggregates in place instead). External caches that
+        need accounting-level invalidation subscribe to ``on_delta``,
+        which fires on both paths."""
         return self._node_gen.get(node, 0)
 
     def node_model_agg(self, node: str, model: str) -> NodeModelAgg:
-        """The (node, model) feasibility aggregate, rebuilt only when
-        the node's generation moved since the cached copy."""
-        gen = self._node_gen.get(node, 0)
-        key = (node, model)
-        agg = self._agg_cache.get(key)
-        if agg is None or agg.gen != gen:
-            agg = self._agg_cache[key] = NodeModelAgg(
-                gen, self.leaves_view(node, model)
+        """The (node, model) feasibility aggregate. A cached entry is
+        always valid: accounting walks refresh it in place and
+        structural events evict it, so this is one dict probe on the
+        steady-state Filter path and a cold build otherwise."""
+        by_node = self._agg_cache.get(model)
+        if by_node is None:
+            by_node = self._agg_cache[model] = {}
+        agg = by_node.get(node)
+        if agg is None:
+            agg = by_node[node] = NodeModelAgg(
+                self._node_gen.get(node, 0), self.leaves_view(node, model)
             )
-            self.agg_rebuilds += 1
+            self.agg_builds += 1
         return agg
 
     def _bind_leaf(self, leaf: Cell, chip: ChipInfo) -> None:
@@ -572,7 +646,7 @@ class CellTree:
         whole_delta = int(leaf.is_whole_free) - int(was_whole)
         leaf.available_whole_cell += whole_delta
         self._propagate(leaf, -request, whole_delta, -memory, 0)
-        self._bump_generation(leaf.node)
+        self._apply_leaf_delta(leaf)
 
     def reclaim(self, leaf: Cell, request: float, memory: int) -> None:
         if leaf.level != 1:
@@ -596,7 +670,7 @@ class CellTree:
         whole_delta = int(leaf.is_whole_free) - int(was_whole)
         leaf.available_whole_cell += whole_delta
         self._propagate(leaf, request, whole_delta, memory, 0)
-        self._bump_generation(leaf.node)
+        self._apply_leaf_delta(leaf)
 
     # -- queries -------------------------------------------------------
 

@@ -139,7 +139,7 @@ def build_parser() -> argparse.ArgumentParser:
              "candidates (kube-scheduler analog); 0 = adaptive",
     )
     parser.add_argument(
-        "--min-feasible-nodes", type=int, default=64,
+        "--min-feasible-nodes", type=int, default=48,
         help="clusters at or under this size are always fully scanned; "
              "also the sampling floor above it",
     )
@@ -170,11 +170,30 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--explain-capacity", type=int, default=512,
-        help="decision-journal bound, >= 1: at most this many pods' "
+        help="decision-journal bound: at most this many pods' "
              "provenance kept (LRU; evictions counted on "
-             "tpu_scheduler_explain_journal_evictions_total). The "
-             "journal also feeds the wait-SLO histograms, so it "
-             "cannot be disabled — shrink it instead",
+             "tpu_scheduler_explain_journal_evictions_total). 0 "
+             "disables the journal entirely — attempt records are "
+             "never built (zero hot-path cost), at the price of "
+             "/explain and the wait-SLO histograms",
+    )
+    parser.add_argument(
+        "--wave-size", type=int, default=0, metavar="K",
+        help="drain the queue as ONE batched wave of up to K "
+             "attempts per pass (engine.schedule_wave: one inventory "
+             "reconcile, per-tenant ledger memos, one journal flush; "
+             "the undrained tail stays queued). 0 = per-pod "
+             "sequential loop (the default; waves check the "
+             "leader-election guard once per pass, not per pod — a "
+             "trade only worth making on big backlogs)",
+    )
+    parser.add_argument(
+        "--backfill", action="store_true",
+        help="with --wave-size: head-of-line backfill — when a gang "
+             "or multi-chip pod cannot place, schedule strictly-"
+             "smaller pods behind it only onto capacity that provably "
+             "cannot delay it (EASY-style); violations counted on "
+             "tpu_scheduler_backfill_head_delays_total (must stay 0)",
     )
     parser.add_argument(
         "--trace-out", default="", metavar="PATH",
@@ -333,28 +352,35 @@ class TopologyWatcher:
 
 
 def run_pass(engine: TpuShareScheduler, cluster, journal, metrics=None,
-             guard=None, requeue=()) -> int:
+             guard=None, requeue=(), wave_size=0, backfill=False) -> int:
     """One queue drain. Returns number of pods scheduled/acted on.
 
     ``guard`` (from leader election) is re-proven before EVERY pod: a
     long pass must not keep binding after the lease lapsed mid-pass —
     that is how two replicas end up placing different pods onto the
     same fractional chip. The guard renews the lease when it is due,
-    so a slow pass also keeps leadership alive.
+    so a slow pass also keeps leadership alive. With ``wave_size`` set
+    the pass is ONE ``schedule_wave`` over the whole queue capped at
+    that many attempts (the tail stays queued for the next pass) and
+    the guard is proven once per pass — the amortization trade is
+    bounded by the wave size, which is why waves are opt-in here.
 
     ``requeue``: pod keys whose reservations were just dropped (by a
     topology hot-reload) — promoted to the head of this pass so the
-    drop→reschedule gap is one pass even at slow tick rates."""
+    drop→reschedule gap is one pass even at slow tick rates (in wave
+    mode the promotion lands them in the first wave; order within it
+    is the wave's queue sort)."""
     from ..utils.trace import maybe_span
 
     started = time.monotonic()
     with maybe_span(engine.tracer, "pass"):
         return _run_pass_inner(engine, cluster, journal, metrics, started,
-                               guard, requeue)
+                               guard, requeue, wave_size, backfill)
 
 
 def _run_pass_inner(engine, cluster, journal, metrics, started,
-                    guard=None, requeue=()) -> int:
+                    guard=None, requeue=(), wave_size=0,
+                    backfill=False) -> int:
     pending = [
         p
         for p in cluster.list_pods()
@@ -371,11 +397,8 @@ def _run_pass_inner(engine, cluster, journal, metrics, started,
         pending.sort(key=lambda p: p.key not in rq)
     acted = 0
     post = getattr(cluster, "post_event", None)
-    for pod in pending:
-        if guard is not None and not guard():
-            break  # leadership lapsed mid-pass; stop binding NOW
-        decision = engine.schedule_one(pod)
-        acted += 1
+
+    def report(decision) -> None:
         if post is not None:
             _post_decision_event(post, decision, engine)
         if metrics is not None:
@@ -394,6 +417,27 @@ def _run_pass_inner(engine, cluster, journal, metrics, started,
                 + "\n"
             )
             journal.flush()
+
+    if wave_size > 0:
+        # ONE wave over the whole queue with an attempt limit — not
+        # independent chunks: chunking would scope a blocked head's
+        # hold (and the queue sort itself) to its own chunk, letting
+        # later chunks consume exactly the capacity the head waits
+        # for. The undrained tail past the limit stays queued for the
+        # next pass.
+        if guard is None or guard():
+            for decision in engine.schedule_wave(
+                pending, limit=wave_size, backfill=backfill,
+            ):
+                acted += 1
+                report(decision)
+    else:
+        for pod in pending:
+            if guard is not None and not guard():
+                break  # leadership lapsed mid-pass; stop binding NOW
+            decision = engine.schedule_one(pod)
+            acted += 1
+            report(decision)
     engine.tick()
     if metrics is not None:
         metrics.record_pass(time.monotonic() - started, acted)
@@ -446,11 +490,10 @@ def _post_decision_event(post, decision, engine=None) -> None:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    if args.explain_capacity < 1:
+    if args.explain_capacity < 0:
         raise SystemExit(
-            "--explain-capacity must be >= 1 (the decision journal "
-            "also feeds the wait-SLO histograms, so it cannot be "
-            "turned off; use a small value to bound memory instead)"
+            "--explain-capacity must be >= 0 (0 disables the journal "
+            "and the wait-SLO histograms it feeds)"
         )
     log = component_logger("scheduler", args)
     if args.kube:
@@ -566,7 +609,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             return 1
         try:
             sync()
-            run_pass(engine, cluster, journal, metrics, guard)
+            run_pass(engine, cluster, journal, metrics, guard,
+                     wave_size=args.wave_size, backfill=args.backfill)
             if planner is not None:
                 planner.run_once()
         finally:
@@ -604,7 +648,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             requeue.extend(watcher.poll() or ())
             sync()
             run_pass(engine, cluster, journal, metrics, guard,
-                     requeue=requeue)
+                     requeue=requeue, wave_size=args.wave_size,
+                     backfill=args.backfill)
             requeue = []
             if planner is not None and (
                 time.monotonic() - planner_ran_at

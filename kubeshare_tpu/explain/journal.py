@@ -158,10 +158,18 @@ class PodJournal:
 
 
 class DecisionJournal:
+    """``capacity=0`` disables the journal entirely: every write is an
+    early return before any locking or dict work, and the engine gates
+    attempt-record construction on :attr:`enabled` so the feed costs
+    nothing — not merely dropped at the door. Wait-SLO histograms and
+    ``/explain`` are empty in that mode (documented trade)."""
+
     def __init__(self, capacity: int = 512, attempts_per_pod: int = 8,
                  log=None):
-        if capacity < 1:
-            raise ValueError(f"journal capacity must be >= 1, got {capacity}")
+        if capacity < 0:
+            raise ValueError(
+                f"journal capacity must be >= 0 (0 disables), got {capacity}"
+            )
         self.capacity = capacity
         self.attempts_per_pod = attempts_per_pod
         self.log = log
@@ -170,6 +178,10 @@ class DecisionJournal:
         self._lock = threading.Lock()
         # time-to-terminal histograms per (tenant, shape, outcome)
         self._wait_hist: Dict[Tuple[str, str, str], Histogram] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
 
     # -- writes (scheduling thread) ----------------------------------
 
@@ -218,23 +230,48 @@ class DecisionJournal:
     ) -> None:
         """One finished ``schedule_one`` attempt. ``record`` is the
         phase-outcome dict the engine built during the walk."""
+        if not self.capacity:
+            return
         with self._lock:
-            entry = self._live_entry(pod_key, now,
-                                     attempt_start=record.get("at"))
-            if tenant:
-                entry.tenant = tenant
-            if model:
-                entry.model = model
-            if shape:
-                entry.shape = shape
-            entry.guarantee = entry.guarantee or guarantee
-            entry.attempt_count += 1
-            entry.attempts.append(record)
+            self._record_attempt_locked(
+                pod_key, now, record, tenant, model, shape, guarantee
+            )
+
+    def record_attempts(self, batch) -> None:
+        """Per-wave flush: a sequence of ``record_attempt`` argument
+        tuples ``(pod_key, now, record, tenant, model, shape,
+        guarantee)`` applied under ONE lock acquisition — a K-pod wave
+        pays one lock round-trip for its whole attempt feed instead
+        of K."""
+        if not self.capacity or not batch:
+            return
+        with self._lock:
+            for args in batch:
+                self._record_attempt_locked(*args)
+
+    def _record_attempt_locked(
+        self, pod_key: str, now: float, record: dict,
+        tenant: str = "", model: str = "", shape: str = "",
+        guarantee: bool = False,
+    ) -> None:
+        entry = self._live_entry(pod_key, now,
+                                 attempt_start=record.get("at"))
+        if tenant:
+            entry.tenant = tenant
+        if model:
+            entry.model = model
+        if shape:
+            entry.shape = shape
+        entry.guarantee = entry.guarantee or guarantee
+        entry.attempt_count += 1
+        entry.attempts.append(record)
 
     def note_reason(self, pod_key: str, old: Optional[str], new: str,
                     now: float) -> None:
         """Demand-ledger transition hook (DemandLedger.on_transition):
         the pod's blocked reason changed — extend the timeline."""
+        if not self.capacity:
+            return
         with self._lock:
             entry = self._live_entry(pod_key, now)
             if entry.timeline[-1][0] != new:
@@ -251,6 +288,8 @@ class DecisionJournal:
         its first-enqueue (the ledger keeps it across reason changes
         AND journal evictions), and the current blocked reason is
         appended if the timeline does not already end on it."""
+        if not self.capacity:
+            return
         with self._lock:
             entry = self._live_entry(pod_key, now)
             # attempt_count == 0 marks an entry minted THIS attempt
@@ -277,6 +316,8 @@ class DecisionJournal:
         unschedulable only — deletion is not a scheduling outcome).
         Idempotent: an already-terminal entry is left alone (a bound
         pod's eventual delete must not rewrite its provenance)."""
+        if not self.capacity:
+            return
         with self._lock:
             if not create and pod_key not in self._entries:
                 return
@@ -312,6 +353,8 @@ class DecisionJournal:
         inherits the original's first-enqueue time, attempt count, and
         timeline so the disruption stays visible in wait accounting —
         the simulator calls this on every resubmit."""
+        if not self.capacity:
+            return
         with self._lock:
             old = self._entries.get(old_key)
             if old is None:

@@ -55,6 +55,51 @@ class QuotaPlane:
         # unbinding and collector HBM corrections
         self._max_mem_cache: dict = {}
         self._max_mem_fp: Tuple[int, int] = (-1, -1)
+        # wave-scoped memos (schedule_wave): per-tenant ledger usage
+        # tuple and DRF share term, valid until that tenant's ledger
+        # moves (charge/credit invalidate) or wave_end. None = no wave
+        # live (every read goes straight to the ledger, seed behavior).
+        self._wave_usage: Optional[dict] = None
+        self._wave_share: Optional[dict] = None
+
+    # -- wave memoization --------------------------------------------
+
+    def wave_begin(self) -> None:
+        """Start a batched scheduling wave: one ledger read per tenant
+        serves every wave member of that tenant (admission gate AND
+        queue-sort share term) until a charge/credit moves the
+        tenant's ledger, which invalidates just that tenant's memo —
+        so mid-wave binds are visible to later members exactly as in
+        the sequential loop."""
+        self._wave_usage = {}
+        self._wave_share = {}
+
+    def wave_end(self) -> None:
+        self._wave_share = None
+        self._wave_usage = None
+
+    def _usage(self, tenant: str) -> Tuple[float, int, float, int]:
+        """(chips, mem, guarantee chips, guarantee mem) used —
+        wave-memoized when a wave is live."""
+        cache = self._wave_usage
+        if cache is not None:
+            v = cache.get(tenant)
+            if v is not None:
+                return v
+        v = (
+            self.ledger.chips_used(tenant),
+            self.ledger.mem_used(tenant),
+            self.ledger.guarantee_chips_used(tenant),
+            self.ledger.guarantee_mem_used(tenant),
+        )
+        if cache is not None:
+            cache[tenant] = v
+        return v
+
+    def _wave_invalidate(self, tenant: str) -> None:
+        if self._wave_usage is not None:
+            self._wave_usage.pop(tenant, None)
+            self._wave_share.pop(tenant, None)
 
     # -- capacity & demand -------------------------------------------
 
@@ -120,10 +165,12 @@ class QuotaPlane:
         member not yet holding a reservation), so a gang can no longer
         straddle the quota boundary — early members binding while late
         ones are doomed to die at the Permit barrier."""
-        admitted, why, _ = self.admit_detail(req, count)
+        admitted, why, _ = self.admit_detail(req, count,
+                                             with_detail=False)
         return admitted, why
 
-    def admit_detail(self, req: PodRequirements, count: int = 1
+    def admit_detail(self, req: PodRequirements, count: int = 1,
+                     with_detail: bool = True
                      ) -> Tuple[bool, str, dict]:
         """``admit`` plus the ledger numbers behind the verdict — the
         decision journal records these so ``/explain`` can show WHY
@@ -131,9 +178,13 @@ class QuotaPlane:
         capacity), not just that it did. The detail dict carries:
         chips/mem demand, capacity denominators, and — when the
         matching limit is configured — guarantee usage vs quota and
-        total usage vs borrow ceiling."""
+        total usage vs borrow ceiling. ``with_detail=False`` (the
+        journal-disabled engine via ``admit``) skips building the
+        dict entirely — verdict and refusal message unchanged, the
+        zero-cost journal gate stays zero-cost at the quota gate
+        too."""
         chips, mem = self.demand(req, count)
-        detail: dict = {
+        detail: dict = {} if not with_detail else {
             "tenant": req.tenant,
             "chips_demand": round(chips, 3),
             "mem_demand": mem,
@@ -143,21 +194,28 @@ class QuotaPlane:
             return True, "", detail
         spec = self.registry.spec(req.tenant)
         if spec.guaranteed is None and spec.borrow_limit is None:
-            detail["unconfigured"] = True
+            if with_detail:
+                detail["unconfigured"] = True
             return True, "", detail  # unconfigured tenant: seed behavior
         gang = f" (gang of {count})" if count > 1 else ""
         cap_chips, cap_mem = self.capacity()
-        detail["capacity_chips"] = round(cap_chips, 3)
-        detail["capacity_mem"] = cap_mem
-        detail["chips_used"] = round(self.ledger.chips_used(req.tenant), 3)
+        # one per-tenant ledger read serves the whole wave (memoized
+        # until this tenant's ledger moves); outside a wave it is the
+        # same four dict gets as before
+        t_chips, t_mem, t_gchips, t_gmem = self._usage(req.tenant)
+        if with_detail:
+            detail["capacity_chips"] = round(cap_chips, 3)
+            detail["capacity_mem"] = cap_mem
+            detail["chips_used"] = round(t_chips, 3)
         if req.is_guarantee and spec.guaranteed is not None:
             quota_chips = spec.guaranteed * cap_chips
             quota_mem = spec.guaranteed * cap_mem
-            used = self.ledger.guarantee_chips_used(req.tenant)
-            used_mem = self.ledger.guarantee_mem_used(req.tenant)
-            detail["guaranteed_fraction"] = spec.guaranteed
-            detail["quota_chips"] = round(quota_chips, 3)
-            detail["guarantee_chips_used"] = round(used, 3)
+            used = t_gchips
+            used_mem = t_gmem
+            if with_detail:
+                detail["guaranteed_fraction"] = spec.guaranteed
+                detail["quota_chips"] = round(quota_chips, 3)
+                detail["guarantee_chips_used"] = round(used, 3)
             if (used + chips > quota_chips + _EPS
                     or used_mem + mem > quota_mem + _EPS):
                 return False, (
@@ -169,10 +227,11 @@ class QuotaPlane:
         if spec.borrow_limit is not None:
             ceil_chips = spec.borrow_limit * cap_chips
             ceil_mem = spec.borrow_limit * cap_mem
-            used = self.ledger.chips_used(req.tenant)
-            used_mem = self.ledger.mem_used(req.tenant)
-            detail["borrow_limit"] = spec.borrow_limit
-            detail["ceiling_chips"] = round(ceil_chips, 3)
+            used = t_chips
+            used_mem = t_mem
+            if with_detail:
+                detail["borrow_limit"] = spec.borrow_limit
+                detail["ceiling_chips"] = round(ceil_chips, 3)
             if (used + chips > ceil_chips + _EPS
                     or used_mem + mem > ceil_mem + _EPS):
                 return False, (
@@ -219,10 +278,20 @@ class QuotaPlane:
         ascending (largest deficit first). Equal-usage equal-weight
         tenants get IDENTICAL terms (same arithmetic on the same
         values), so the sort falls through to the timestamp tiebreak
-        and the total order degrades exactly to the seed's."""
+        and the total order degrades exactly to the seed's. Memoized
+        per tenant while a wave is live (a K-pod wave sort reads each
+        tenant's ledger once, not K times)."""
+        cache = self._wave_share
+        if cache is not None:
+            v = cache.get(tenant)
+            if v is not None:
+                return v
         cap_chips, cap_mem = self.capacity()
         share = self.ledger.dominant_share(tenant, cap_chips, cap_mem)
-        return share / self.registry.spec(tenant).weight
+        v = share / self.registry.spec(tenant).weight
+        if cache is not None:
+            cache[tenant] = v
+        return v
 
     # -- accounting (plugin call sites) ------------------------------
 
@@ -231,6 +300,7 @@ class QuotaPlane:
             status.tenant, status.charged_chips, status.charged_mem,
             status.requirements.is_guarantee,
         )
+        self._wave_invalidate(status.tenant)
 
     def credit(self, status) -> None:
         self.ledger.credit(
@@ -239,6 +309,7 @@ class QuotaPlane:
         )
         status.charged_chips = 0.0
         status.charged_mem = 0
+        self._wave_invalidate(status.tenant)
 
     def deficit_chips(self, tenant: str) -> float:
         """Unmet guaranteed entitlement in chips: how far the tenant's

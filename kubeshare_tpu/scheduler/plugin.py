@@ -31,7 +31,7 @@ from ..explain.journal import DecisionJournal, RejectionAgg
 from ..utils import expfmt
 from ..utils.bitmap import RRBitmap
 from ..utils.logger import get_logger
-from ..utils.trace import Tracer, maybe_span
+from ..utils.trace import Histogram, Tracer, maybe_span
 from . import constants as C
 from .filtering import node_fits
 from .labels import (
@@ -39,7 +39,7 @@ from .labels import (
 )
 from .podgroup import PodGroupRegistry
 from .scoring import (
-    anchor_fingerprint, normalize_scores, pick_best, score_node,
+    anchor_fingerprint, pick_top2_seq, score_node,
     seed_eligible, select_leaves, _resolved_memory,
 )
 from .state import PodState, PodStatus, PodStatusStore
@@ -91,7 +91,7 @@ class TpuShareScheduler:
         defrag_eviction_rate: float = 0.0,
         defrag_reclaim_share: float = 0.5,
         percentage_of_nodes_to_score: int = 0,
-        min_feasible_nodes: int = 64,
+        min_feasible_nodes: int = 48,
         tenants: Union[None, str, dict, "TenantRegistry"] = None,
         explain_capacity: int = 512,
     ):
@@ -142,6 +142,11 @@ class TpuShareScheduler:
         # transition hook feeds the journal's reason timeline.
         self.demand = DemandLedger(on_transition=self.explain.note_reason)
         self.ports: Dict[str, RRBitmap] = {}
+        # nodes whose pod-manager port pool is exhausted — maintained
+        # at every bitmap mutation site so the inline Filter loop's
+        # port check is one (usually falsy) set probe instead of a
+        # dict get + method call per SHARED candidate
+        self._full_port_nodes: Set[str] = set()
         self._waiting: Dict[str, Dict[str, _Waiting]] = {}  # group_key -> pods
         self._synced_nodes: Set[str] = set()
         self._bound_queue: Dict[str, List[Pod]] = {}  # node -> pods to resync
@@ -157,13 +162,30 @@ class TpuShareScheduler:
         # test steady-state instead of a per-call set lookup
         self._unsynced: Set[str] = set()
         # Node-score memo, two-level: req-shape/anchor fingerprint ->
-        # {node -> (node generation, score)}. A node whose generation
-        # didn't move since it was last scored for the same requirement
-        # shape skips score_node entirely. Invalidation rides the cell
-        # tree's reserve/reclaim/bind/health generation counters.
-        self._score_cache: Dict[Tuple, Dict[str, Tuple[int, float]]] = {}
+        # {node -> score}. Entries are evicted per (node, shape) from
+        # the cell tree's ``on_delta`` hook — fired by every leaf-state
+        # change on the node (accounting delta or structural event) —
+        # so a cached entry is always valid and the probe is one dict
+        # get, no generation compare. Evictions are counted and
+        # exported; the old fingerprint-wholesale clears survive only
+        # as the outer-dict size bound (gang anchor sets mint shapes).
+        self._score_cache: Dict[Tuple, Dict[str, float]] = {}
+        # reverse index: node -> shapes currently caching a score for
+        # it, maintained on the miss path — eviction on a delta walks
+        # exactly the entries that exist for that node instead of
+        # every cached shape (gang churn can mint ~1024 shapes, and
+        # deltas are the hottest mutation in the engine)
+        self._score_node_shapes: Dict[str, set] = {}
         self.score_cache_hits = 0
         self.score_cache_misses = 0
+        self.score_cache_evictions = 0
+        self.tree.on_delta = self._on_tree_delta
+
+        # every _release (delete, unreserve on Permit-deny or bind
+        # conflict, gang-barrier expiry) returns capacity to the
+        # tree; the wave's backfill-failure memo keys its validity on
+        # this counter (capacity gains void the monotone-loss premise)
+        self.capacity_releases = 0
 
         self.defrag = defrag
         self.defrag_max_victims = defrag_max_victims
@@ -229,12 +251,47 @@ class TpuShareScheduler:
         # rotating cursor spreads which nodes get examined first so the
         # sample isn't always the same prefix. Clusters at or under
         # min_feasible_nodes are always scanned in full (exact behavior,
-        # which is also what every small-topology test sees).
+        # which is also what every small-topology test sees). The floor
+        # default dropped 64 -> 48 in PR-5: 48 candidates is ample
+        # scoring choice for TPU-shaped pods (kube's 100-node floor
+        # serves far more heterogeneous filtering), and the floor is
+        # the binding term at 512-1024 nodes, where it was 2x the
+        # 32-node row's whole filter+score budget.
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.min_feasible_nodes = min_feasible_nodes
         self._filter_cursor = 0
         self.filter_scans = 0     # nodes examined across all attempts
         self.filter_attempts = 0  # scheduling attempts that filtered
+
+        # Wave scheduling (schedule_wave): batched backlog cycles with
+        # head-of-line backfill. _backfill_hold is live only while a
+        # wave is placing strictly-smaller pods behind a blocked head:
+        # node -> frozenset(leaf uuids) the backfill pod must treat as
+        # nonexistent (the head's provable claim).
+        self._backfill_hold: Dict[str, frozenset] = {}
+        self.wave_count = 0
+        self.wave_pods_total = 0
+        # wave-size distribution (powers of two up to the biggest
+        # clusters the bench drives)
+        self._wave_size_hist = Histogram(
+            (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0,
+             1024.0, 2048.0, 4096.0)
+        )
+        self.backfill_binds = 0        # binds placed behind a blocked head
+        self.backfill_head_delays = 0  # safety violations (must stay 0)
+        # per-phase wave wall time (seconds, cumulative): where a
+        # wave's budget goes — inventory sync, queue sort, the
+        # attempt loop, journal flush. Plain perf_counter sums, not
+        # tracer spans: the breakdown must not tax the path it times.
+        self.wave_phase_seconds = {
+            "sync": 0.0, "sort": 0.0, "attempts": 0.0, "flush": 0.0,
+        }
+        # set by _schedule_attempt for the wave driver: the parsed
+        # requirements and demand-reason of the LAST attempt (cheaper
+        # than threading them through every return path)
+        self._last_attempt_req: Optional[PodRequirements] = None
+        self._last_demand_reason = ""
+        self._wave_demand: Optional[List[tuple]] = None  # buffered notes
 
         cluster.on_pod_event(self._on_pod_add, self._on_pod_delete)
         cluster.on_node_event(self._on_node_update)
@@ -287,6 +344,7 @@ class TpuShareScheduler:
         # is observability history, not accounting state
         self.demand = DemandLedger(on_transition=self.explain.note_reason)
         self.ports = {}
+        self._full_port_nodes = set()
         self._waiting = {}
         self._synced_nodes = set()
         self._bound_queue = {}
@@ -294,6 +352,9 @@ class TpuShareScheduler:
         self._node_index_set = set()
         self._unsynced = set()
         self._score_cache = {}
+        self._score_node_shapes = {}
+        self.tree.on_delta = self._on_tree_delta
+        self._backfill_hold = {}
         self._defrag_last = {}
         self._defrag_inflight = set()
         self._defrag_blocked = {}
@@ -321,6 +382,27 @@ class TpuShareScheduler:
         return dropped
 
     # ================= informer handlers =============================
+
+    def _on_tree_delta(self, node: str) -> None:
+        """Cell-tree ``on_delta`` subscriber: a leaf-state change on
+        ``node`` (reserve/reclaim delta or structural event) makes
+        every memoized score for that node stale — evict exactly those
+        per (node, shape) entries, found through the node→shapes
+        reverse index so the cost is O(entries for this node), not
+        O(all cached shapes). Replaces the old generation-compare
+        (reserve no longer bumps generations) and the fingerprint-
+        wholesale clears; counted so churn is observable."""
+        shapes = self._score_node_shapes.pop(node, None)
+        if not shapes:
+            return
+        evicted = 0
+        cache_get = self._score_cache.get
+        for shape in shapes:
+            by_shape = cache_get(shape)
+            if by_shape is not None and by_shape.pop(node, None) is not None:
+                evicted += 1
+        if evicted:
+            self.score_cache_evictions += evicted
 
     def _index_add(self, name: str) -> None:
         if name not in self._node_index_set:
@@ -485,9 +567,9 @@ class TpuShareScheduler:
                 <= port
                 < C.POD_MANAGER_PORT_START + C.POD_MANAGER_PORT_COUNT
             ):
-                self._node_ports(pod.node_name).mask(
-                    port - C.POD_MANAGER_PORT_START
-                )
+                pool = self._node_ports(pod.node_name)
+                pool.mask(port - C.POD_MANAGER_PORT_START)
+                self._note_port_state(pod.node_name, pool)
                 status.port = port
             elif port:
                 self.log.error(
@@ -633,11 +715,13 @@ class TpuShareScheduler:
         else:
             leaf = leaves[0]
             memory = _resolved_memory(leaf, req)
-            port_slot = self._node_ports(node_name).find_next_and_set()
+            pool = self._node_ports(node_name)
+            port_slot = pool.find_next_and_set()
             if port_slot == -1:
                 raise Unschedulable(
                     f"pod {pod.key}: node {node_name} pod-manager port pool full"
                 )
+            self._note_port_state(node_name, pool)
             port = port_slot + C.POD_MANAGER_PORT_START
             self.tree.reserve(leaf, req.request, memory)
             status.memory = memory
@@ -739,7 +823,10 @@ class TpuShareScheduler:
         """One full scheduling cycle for one pod, journaled: the
         attempt's phase outcomes land in the decision journal (the
         ``/explain`` surface). The no-op requeue-race short circuit is
-        NOT an attempt and is not journaled."""
+        NOT an attempt and is not journaled. With the journal disabled
+        (``--explain-capacity 0``) the attempt record and shape
+        strings are never even built — the feed is zero-cost, not
+        merely dropped at the journal's door."""
         existing = self.status.get(pod.key)
         if existing is not None and existing.state != PodState.PENDING:
             # already reserved/waiting/bound — a requeue race must not
@@ -747,13 +834,27 @@ class TpuShareScheduler:
             state = "waiting" if existing.state == PodState.WAITING else "bound"
             return Decision(state, pod.key, node=existing.node_name,
                             message="already scheduled")
+        return self._attempt(pod, self.explain.enabled)
+
+    def _attempt(self, pod: Pod, journal_on: bool,
+                 batch: Optional[list] = None) -> Decision:
+        """One journaled attempt (no requeue-race short circuit — the
+        caller handles that). ``batch`` non-None buffers the attempt
+        record for a per-wave flush instead of taking the journal lock
+        per pod."""
+        self._last_attempt_req = None
+        self._last_demand_reason = ""
+        if not journal_on:
+            with maybe_span(self.tracer, "attempt", pod=pod.key):
+                return self._schedule_attempt(pod, None)
         # exact clock, no rounding: _live_entry compares this attempt
         # start against the bind's outcome_at to tell "bound moments
         # ago in THIS attempt" from "bound by a previous incarnation",
         # and a round-up would misfile the former as the latter
         rec: dict = {"at": self.clock()}
-        decision = self._schedule_attempt(pod, rec)
-        req = rec.pop("_req", None)
+        with maybe_span(self.tracer, "attempt", pod=pod.key):
+            decision = self._schedule_attempt(pod, rec)
+        req = self._last_attempt_req
         rec["outcome"] = decision.status
         if decision.node:
             rec["node"] = decision.node
@@ -763,15 +864,18 @@ class TpuShareScheduler:
         if req is not None:
             shape = ("regular" if req.kind == PodKind.REGULAR
                      else D.shape_of(req))
-            self.explain.record_attempt(
-                pod.key, now, rec, tenant=req.tenant,
-                model=req.model or "*", shape=shape,
-                guarantee=req.is_guarantee,
-            )
+            record = (pod.key, now, rec, req.tenant, req.model or "*",
+                      shape, req.is_guarantee)
         else:  # prefilter rejected before requirements existed
             shape = ""
-            self.explain.record_attempt(pod.key, now, rec,
-                                        tenant=pod.namespace)
+            record = (pod.key, now, rec, pod.namespace, "", "", False)
+        if batch is not None:
+            batch.append(record)
+        else:
+            self.explain.record_attempt(
+                record[0], record[1], record[2], tenant=record[3],
+                model=record[4], shape=record[5], guarantee=record[6],
+            )
         if decision.status == "unschedulable" and not decision.retryable:
             # permanent reject: a terminal outcome for wait accounting
             self.explain.note_outcome(
@@ -781,17 +885,346 @@ class TpuShareScheduler:
             )
         return decision
 
-    def _schedule_attempt(self, pod: Pod, rec: dict) -> Decision:
+    def schedule_wave(self, pods: Sequence[Pod], limit: int = 0,
+                      backfill: bool = True) -> List[Decision]:
+        """Batched scheduling cycle: drain up to ``limit`` attempts
+        (0 = all) from ``pods`` against ONE reconciled snapshot.
+
+        Per wave, not per pod: unsynced inventory is reconciled once
+        up front (every member filters zero-copy against the same
+        index), the queue order is computed with per-tenant ledger
+        reads memoized until that tenant's ledger moves
+        (``QuotaPlane.wave_begin``), and journal/demand records are
+        buffered and flushed once. In-wave binds still apply their
+        leaf deltas immediately — later pods in the wave see earlier
+        binds exactly as the sequential loop would, so with
+        ``backfill=False`` the wave is decision-for-decision identical
+        to a ``schedule_one`` loop over the same sorted queue
+        (property-pinned by tests/test_scheduler_wave.py).
+
+        ``backfill=True`` adds head-of-line semantics (EASY-style):
+        when a gang or multi-chip pod fails for capacity, it becomes
+        the wave's blocked head — pods behind it are only attempted if
+        strictly smaller, and only onto capacity that provably cannot
+        delay the head (nodes outside the head's feasible hold set, or
+        non-blocking fractional fits on already-fractional leaves).
+        Everything else gets a cheap retryable head-of-line decision
+        without a filter scan, which is what bounds a saturated
+        backlog's per-cycle cost. ``backfill_head_delays`` counts
+        safety violations and must stay 0.
+
+        Pods already reserved/waiting/bound (gang siblings co-bound
+        mid-wave, requeue races) get the same unjournaled
+        "already scheduled" short circuit ``schedule_one`` gives them.
+        """
+        decisions: List[Decision] = []
+        if not pods:
+            return decisions
+        perf = _time.perf_counter
+        phase = self.wave_phase_seconds
+        t0 = perf()
+        if self._unsynced:
+            for name in sorted(self._unsynced):
+                self._ensure_synced(name)
+        t1 = t2 = perf()
+        phase["sync"] += t1 - t0
+        journal_on = self.explain.enabled
+        batch: Optional[list] = [] if journal_on else None
+        self.quota.wave_begin()
+        self._wave_demand = []
+        self.wave_count += 1
+        self.wave_pods_total += len(pods)
+        self._wave_size_hist.observe(float(len(pods)))
+        head_key: Optional[str] = None
+        head_req = None
+        head_size = 0.0
+        head_reason = D.REASON_NO_FEASIBLE_CELL
+        hold: Dict[str, frozenset] = {}
+        whole_counts: Optional[Dict[str, int]] = None
+        backfill_open = False
+        attempts = 0
+        # Per-wave backfill failure memo with dominance: mid-wave the
+        # cluster normally only LOSES capacity (binds/reserves;
+        # completions never land mid-wave), so once a pod of some
+        # (tenant, kind, model, guarantee) class fails FOR CAPACITY
+        # at size s and memory m, every pod of that class demanding
+        # >= s AND >= m behind it this wave fails too — skipped
+        # without a scan (exact-shape memoing alone dedups poorly:
+        # fractional requests take ~80 distinct values). Only
+        # capacity-classified failures are memoed (an over-quota or
+        # Permit-deny refusal says nothing about the NEXT pod of the
+        # class), and any mid-wave capacity RELEASE — defrag
+        # eviction, Permit-deny unreserve, bind-conflict unreserve,
+        # informer delete — voids the monotone-loss premise and
+        # clears the memo (capacity_releases counts every _release).
+        failed_shapes: Dict[tuple, List[Tuple[float, int]]] = {}
+        releases_at_start = self.capacity_releases
+        try:
+            order = sorted(pods, key=self.queue_sort_key)
+            t2 = perf()
+            phase["sort"] += t2 - t1
+            for pod in order:
+                existing = self.status.get(pod.key)
+                if existing is not None and \
+                        existing.state != PodState.PENDING:
+                    state = (
+                        "waiting" if existing.state == PodState.WAITING
+                        else "bound"
+                    )
+                    decisions.append(Decision(
+                        state, pod.key, node=existing.node_name,
+                        message="already scheduled",
+                    ))
+                    continue
+                if head_key is not None:
+                    # head-of-line: only strictly-smaller pods may
+                    # attempt, and only behind the head's hold set;
+                    # everyone else waits without paying a filter scan
+                    try:
+                        req0 = parse_pod(pod)
+                    except LabelError:
+                        # malformed: attempt anyway so the permanent
+                        # reject still happens
+                        req0 = None
+                    # REGULAR pods reserve no leaves: they can never
+                    # delay the head and always pass — even when the
+                    # hold blankets the cluster (backfill_open False)
+                    skip = (
+                        req0 is not None
+                        and req0.kind != PodKind.REGULAR
+                    )
+                    shape_key = None
+                    size = 0.0
+                    mem0 = 0
+                    if skip and backfill_open:
+                        shape_key = (
+                            req0.tenant, req0.kind, req0.model,
+                            req0.is_guarantee,
+                        )
+                        size = self._req_size(req0)
+                        mem0 = req0.memory
+                        pts = failed_shapes.get(shape_key)
+                        skip = size >= head_size or (
+                            pts is not None and any(
+                                fr <= size and fm <= mem0
+                                for fr, fm in pts
+                            )
+                        )
+                    if skip:
+                        # still DEMAND: the autoscale planner sizes
+                        # node pools from the ledger, and a skipped
+                        # pod is blocked for the same capacity reason
+                        # its head is — a scan-free decision must not
+                        # make queued demand invisible (the sequential
+                        # loop filed a note per blocked pod per pass)
+                        self._note_demand(pod.key, req0, head_reason)
+                        decisions.append(Decision(
+                            "unschedulable", pod.key, retryable=True,
+                            message=(
+                                "head-of-line: queued behind blocked "
+                                f"head {head_key}"
+                            ),
+                        ))
+                        continue
+                if limit and attempts >= limit:
+                    break  # undrained tail stays queued for next wave
+                attempts += 1
+                if head_key is None:
+                    decision = self._attempt(pod, journal_on, batch)
+                    decisions.append(decision)
+                    req = self._last_attempt_req
+                    if (
+                        backfill
+                        and decision.status == "unschedulable"
+                        and decision.retryable
+                        and req is not None
+                        and self._last_demand_reason in (
+                            D.REASON_NO_FEASIBLE_CELL,
+                            D.REASON_FRAGMENTATION,
+                        )
+                        and (req.kind == PodKind.MULTI_CHIP
+                             or (req.gang is not None
+                                 and req.gang.headcount > 1))
+                    ):
+                        head_key = pod.key
+                        head_req = req
+                        head_size = self._req_size(req)
+                        head_reason = self._last_demand_reason
+                        hold, whole_counts = self._backfill_hold_map(req)
+                        # a fractional-head hold covers whole nodes;
+                        # if it blankets the cluster nothing can
+                        # backfill and every follower takes the cheap
+                        # skip
+                        backfill_open = (
+                            whole_counts is not None
+                            or len(hold) < len(self._node_index)
+                        )
+                    continue
+                # backfill attempt behind the blocked head
+                self._backfill_hold = hold
+                try:
+                    decision = self._attempt(pod, journal_on, batch)
+                finally:
+                    self._backfill_hold = {}
+                decisions.append(decision)
+                if self.capacity_releases != releases_at_start:
+                    # capacity was freed mid-wave (eviction, deny/
+                    # conflict unreserve, delete): the monotone-loss
+                    # premise is void — forget proven failures
+                    failed_shapes.clear()
+                    releases_at_start = self.capacity_releases
+                elif (
+                    decision.status == "unschedulable"
+                    and decision.retryable
+                    and shape_key is not None
+                    and self._last_demand_reason in (
+                        D.REASON_NO_FEASIBLE_CELL,
+                        D.REASON_FRAGMENTATION,
+                    )
+                ):
+                    # memo only CAPACITY failures: an over-quota or
+                    # Permit-deny refusal is tenant/ledger state and
+                    # says nothing about the next pod of the class
+                    failed_shapes.setdefault(shape_key, []).append(
+                        (size, mem0)
+                    )
+                if decision.status == "bound":
+                    self.backfill_binds += 1
+                req_b = self._last_attempt_req
+                if (
+                    decision.node
+                    and decision.status in ("bound", "waiting")
+                    and req_b is not None
+                    and req_b.kind != PodKind.REGULAR
+                ):
+                    # reserve is the consumption point: verify the
+                    # head's claim survived this placement. REGULAR
+                    # pods reserve no leaves — binding one onto a held
+                    # node is not a violation (they cannot delay
+                    # anything)
+                    self._check_head_delay(
+                        decision.node, head_req, hold, whole_counts
+                    )
+        finally:
+            t3 = perf()
+            phase["attempts"] += t3 - t2
+            self.quota.wave_end()
+            self._flush_wave_demand()
+            self._wave_demand = None
+            if batch:
+                self.explain.record_attempts(batch)
+            phase["flush"] += perf() - t3
+        return decisions
+
+    @staticmethod
+    def _req_size(req: PodRequirements) -> float:
+        """Chip-demand magnitude for the strictly-smaller backfill
+        rule: whole-chip pods compare by chip count, fractional by
+        request; regular pods are 0 (no TPU capacity — they may
+        always backfill)."""
+        if req.kind == PodKind.MULTI_CHIP:
+            return float(req.chip_count)
+        if req.kind == PodKind.SHARED:
+            return req.request
+        return 0.0
+
+    def _backfill_hold_map(
+        self, req: PodRequirements
+    ) -> Tuple[Dict[str, frozenset], Optional[Dict[str, int]]]:
+        """The blocked head's claim: for every node that could
+        EVENTUALLY serve it (enough healthy bound leaves of its model,
+        once occupants finish), the leaf uuids backfill pods must
+        treat as nonexistent.
+
+        Multi-chip head: the held leaves are the model's whole-free
+        chips — a backfill placement on an already-fractional leaf
+        provably cannot shrink the node's whole-free supply (whole-
+        free requires full HBM free, so memory taken on a fractional
+        leaf never affects another leaf's wholeness). Returns the
+        per-node whole-free snapshot for the delay check.
+
+        Fractional (gang) head: any leaf with headroom could be the
+        one the head needs, so every leaf on a feasible node is held —
+        backfill is hold-set-disjoint only — and the snapshot is None
+        (any bind on a held node is a violation by construction)."""
+        model = req.model or None
+        whole_only = req.kind == PodKind.MULTI_CHIP
+        needed = req.chip_count if whole_only else 1
+        hold: Dict[str, frozenset] = {}
+        whole_counts: Dict[str, int] = {}
+        tree = self.tree
+        for node in self._node_index:
+            healthy = 0
+            whole = 0
+            held_uuids: List[str] = []
+            for leaf in tree.leaves_view(node, model):
+                if not leaf.healthy:
+                    continue
+                healthy += 1
+                if whole_only:
+                    if leaf.is_whole_free:
+                        held_uuids.append(leaf.uuid)
+                        whole += 1
+                else:
+                    held_uuids.append(leaf.uuid)
+            if healthy < needed:
+                continue  # can never host the head: fair game
+            hold[node] = frozenset(held_uuids)
+            if whole_only:
+                whole_counts[node] = whole
+        return hold, (whole_counts if whole_only else None)
+
+    def _check_head_delay(
+        self, node: str, head_req, hold: Dict[str, frozenset],
+        whole_counts: Optional[Dict[str, int]],
+    ) -> None:
+        """Safety oracle for the backfill rule: a backfill reservation
+        on a hold-set node must not have reduced the head's prospects
+        there. Violations are counted (``backfill_head_delays``, must
+        stay 0) and logged — the counter existing means the rule is
+        CHECKED, not assumed."""
+        if node not in hold:
+            return
+        if whole_counts is None:
+            # fractional-head hold: every leaf there was held, so any
+            # placement on a feasible node delayed the head
+            self.backfill_head_delays += 1
+            self.log.error(
+                "backfill bound onto fully-held node %s behind a "
+                "fractional head", node,
+            )
+            return
+        model = head_req.model or None
+        whole = sum(
+            1 for l in self.tree.leaves_view(node, model)
+            if l.healthy and l.is_whole_free
+        )
+        before = whole_counts.get(node, 0)
+        if whole < before:
+            self.backfill_head_delays += 1
+            self.log.error(
+                "backfill consumed a whole-free chip on %s held for "
+                "the blocked head (%d -> %d)", node, before, whole,
+            )
+        whole_counts[node] = whole
+
+    def _schedule_attempt(self, pod: Pod, rec: Optional[dict]) -> Decision:
         """The scheduling walk. ``rec`` accumulates phase outcomes for
-        the journal: the caller (schedule_one) owns recording it."""
+        the journal — None when the journal is disabled, in which case
+        no record fields (nor the journal-only runner-up scoring) are
+        built at all. The parsed requirements and last demand-reason
+        are left on ``_last_attempt_req`` / ``_last_demand_reason``
+        for the caller (schedule_one's journal wrapper, the wave
+        driver's head-of-line logic)."""
         try:
             with maybe_span(self.tracer, "prefilter", pod=pod.key):
                 req = self.pre_filter(pod)
         except Unschedulable as e:
-            rec["prefilter"] = str(e)
+            if rec is not None:
+                rec["prefilter"] = str(e)
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
-        rec["_req"] = req
+        self._last_attempt_req = req
         group = self.groups.get_or_create(pod, req.gang)
 
         # Quota admission gate — BEFORE any filtering and before
@@ -809,20 +1242,19 @@ class TpuShareScheduler:
         # die at the barrier (ROADMAP "gang-granular admission").
         gang_pending = 1
         if group.key:
-            held = sum(
-                1 for s in self.status.in_group(group.key)
-                if s.state in (
-                    PodState.RESERVED, PodState.WAITING, PodState.BOUND
-                )
+            gang_pending = max(
+                1,
+                group.min_available
+                - self.status.held_in_group(group.key),
             )
-            gang_pending = max(1, group.min_available - held)
         admitted, why, quota_detail = self.quota.admit_detail(
-            req, count=gang_pending
+            req, count=gang_pending, with_detail=rec is not None
         )
-        quota_detail["admitted"] = admitted
-        if why:
-            quota_detail["why"] = why
-        rec["quota"] = quota_detail
+        if rec is not None:
+            quota_detail["admitted"] = admitted
+            if why:
+                quota_detail["why"] = why
+            rec["quota"] = quota_detail
         if not admitted:
             self._note_demand(pod.key, req, D.REASON_OVER_QUOTA)
             return Decision("unschedulable", pod.key, message=why,
@@ -852,26 +1284,25 @@ class TpuShareScheduler:
             )
             self._filter_cursor = (start + consumed) % max(1, n_names)
             self.filter_scans += scans
-        rec["filter"] = filter_rec = {
-            "examined": scans,
-            "feasible": len(feasible),
-            "target": target,
-        }
-        if rejections:
-            filter_rec["rejections"] = rejections.to_dict()
+        if rec is not None:
+            rec["filter"] = filter_rec = {
+                "examined": scans,
+                "feasible": len(feasible),
+                "target": target,
+            }
+            if rejections:
+                filter_rec["rejections"] = rejections.to_dict()
         if not feasible:
-            evicted = self._maybe_defrag(
-                pod, req,
-                [n for n in self.cluster.list_nodes() if n.healthy],
-            )
+            evicted = self._maybe_defrag(pod, req)
             # demand-ledger classification: an eviction in flight, or
             # aggregate capacity that exists but fits under no single
             # node, is fragmentation (defrag's and/or scale-up's
             # territory); anything else is a true capacity shortfall
             agg_fits = bool(evicted) or self._aggregate_fits(req)
-            rec["defrag"] = {
-                "evicted": list(evicted), "aggregate_fits": agg_fits,
-            }
+            if rec is not None:
+                rec["defrag"] = {
+                    "evicted": list(evicted), "aggregate_fits": agg_fits,
+                }
             self._note_demand(
                 pod.key, req,
                 D.REASON_FRAGMENTATION if agg_fits
@@ -896,20 +1327,24 @@ class TpuShareScheduler:
                 self._gang_seed_frees(req, feasible) if not anchors else None
             )
             # Node-score memo: score_node is a pure function of the
-            # node's leaf state (generation-counted), the requirement
-            # shape, and the anchor set — so an unchanged node scored
-            # for the same shape is a dict hit, not a leaf walk.
-            # Uncacheable cases: gang seeding (seed_frees couples the
-            # score to OTHER nodes' free sets) and opportunistic pods
-            # while defrag holds are live (_held_leaves varies by pod).
-            cacheable = seed_frees is None and (
-                req.is_guarantee or not self._defrag_holds
+            # node's leaf state, the requirement shape, and the anchor
+            # set — and every leaf-state change evicts the node's memo
+            # entries through the tree's on_delta hook, so a cached
+            # entry is always valid: one dict probe, no generation
+            # compare. Uncacheable cases: gang seeding (seed_frees
+            # couples the score to OTHER nodes' free sets), pods
+            # placing under a backfill hold (the hold varies by wave),
+            # and opportunistic pods while defrag holds are live
+            # (_held_leaves varies by pod).
+            cacheable = (
+                seed_frees is None
+                and not self._backfill_hold
+                and (req.is_guarantee or not self._defrag_holds)
             )
             if cacheable:
-                # two-level memo (shape -> node -> (gen, score)): the
-                # shape tuple is hashed once per pod, not once per
-                # feasible node, and the inner loop is one string-keyed
-                # dict probe plus a generation compare
+                # two-level memo (shape -> node -> score): the shape
+                # tuple is hashed once per pod, not once per feasible
+                # node, and the inner loop is one string-keyed dict get
                 shape = (req.kind, req.model, req.is_guarantee,
                          anchor_fingerprint(anchors))
                 by_shape = self._score_cache.get(shape)
@@ -918,50 +1353,62 @@ class TpuShareScheduler:
                         # every gang's anchor set mints a fresh shape
                         # key, so the OUTER dict needs a bound too or
                         # weeks of gang churn leak it; wholesale clear
-                        # over LRU — misses just re-score
+                        # over LRU — misses just re-score (counted as
+                        # evictions so the artifact shows the churn)
+                        self.score_cache_evictions += sum(
+                            len(v) for v in self._score_cache.values()
+                        )
                         self._score_cache.clear()
+                        self._score_node_shapes.clear()
                     by_shape = self._score_cache[shape] = {}
-                scores = {}
-                gens_get = self.tree._node_gen.get
                 cache_get = by_shape.get
+                node_shapes = self._score_node_shapes
                 hits = misses = 0
+                values: List[float] = []
+                vappend = values.append
                 for name in feasible:
-                    gen = gens_get(name, 0)
-                    entry = cache_get(name)
-                    if entry is not None and entry[0] == gen:
-                        hits += 1
-                        scores[name] = entry[1]
-                    else:
+                    value = cache_get(name)
+                    if value is None:
                         misses += 1
                         value = self.score(pod, req, name, anchors,
                                            seed_frees)
-                        if len(by_shape) > (1 << 16):
-                            by_shape.clear()  # bound the memo
-                        by_shape[name] = (gen, value)
-                        scores[name] = value
+                        by_shape[name] = value
+                        shapes = node_shapes.get(name)
+                        if shapes is None:
+                            shapes = node_shapes[name] = set()
+                        shapes.add(shape)
+                    else:
+                        hits += 1
+                    vappend(value)
                 self.score_cache_hits += hits
                 self.score_cache_misses += misses
             else:
-                scores = {
-                    name: self.score(pod, req, name, anchors, seed_frees)
+                values = [
+                    self.score(pod, req, name, anchors, seed_frees)
                     for name in feasible
+                ]
+            # winner + runner-up in ONE pass over the parallel lists
+            # (pick_top2_seq ≡ pick_best then pick_best-over-the-rest,
+            # property-pinned): the old journal path built a per-pod
+            # dict and then copied it just to find the runner-up
+            best, runner, best_raw, runner_raw = pick_top2_seq(
+                feasible, values
+            )
+            if rec is not None:
+                # journal: winner + runner-up with raw scores (the
+                # same values pick_best normalizes) — the "why THIS
+                # node" record. Runner-up is who would have won had
+                # the winner not existed.
+                rec["score"] = score_rec = {
+                    "candidates": len(values),
+                    "winner": {
+                        "node": best, "score": round(best_raw, 2),
+                    },
                 }
-            best = pick_best(scores)
-            # journal: winner + runner-up with raw scores (the same
-            # values pick_best normalizes) — the "why THIS node"
-            # record. Runner-up is pick_best over the rest, so it is
-            # literally who would have won had the winner not existed.
-            rec["score"] = score_rec = {
-                "candidates": len(scores),
-                "winner": {"node": best, "score": round(scores[best], 2)},
-            }
-            if len(scores) > 1:
-                rest = dict(scores)
-                rest.pop(best)
-                runner = pick_best(rest)
-                score_rec["runner_up"] = {
-                    "node": runner, "score": round(rest[runner], 2),
-                }
+                if runner is not None:
+                    score_rec["runner_up"] = {
+                        "node": runner, "score": round(runner_raw, 2),
+                    }
 
         if req.kind == PodKind.REGULAR:
             try:
@@ -982,18 +1429,19 @@ class TpuShareScheduler:
 
         with maybe_span(self.tracer, "permit", pod=pod.key):
             action, extra = self.permit(pod, status)
-        rec["permit"] = permit_rec = {"action": action}
-        if group.key:
-            permit_rec["group"] = group.key
-            permit_rec["min_available"] = group.min_available
-        if action == "deny":
-            permit_rec["detail"] = extra
-        elif action == "wait":
-            permit_rec["detail"] = f"gang barrier, timeout {extra}s"
-        elif extra:
-            permit_rec["detail"] = (
-                f"barrier released, co-binding {len(extra)} members"
-            )
+        if rec is not None:
+            rec["permit"] = permit_rec = {"action": action}
+            if group.key:
+                permit_rec["group"] = group.key
+                permit_rec["min_available"] = group.min_available
+            if action == "deny":
+                permit_rec["detail"] = extra
+            elif action == "wait":
+                permit_rec["detail"] = f"gang barrier, timeout {extra}s"
+            elif extra:
+                permit_rec["detail"] = (
+                    f"barrier released, co-binding {len(extra)} members"
+                )
         if action == "deny":
             # tenant went over quota between admission and Permit
             # (concurrent reservations); release only THIS pod — gang
@@ -1072,11 +1520,18 @@ class TpuShareScheduler:
         if len(feasible) >= target or not n_names:
             return feasible, rejections, scans, consumed
 
-        fast = not (
+        # The aggregate loop is EXACT when no hold can apply to this
+        # pod, and still usable as a SCREEN when only a backfill hold
+        # is live: holds only shrink capacity, so an aggregate miss is
+        # a certain miss, and only aggregate hits pay the hold-aware
+        # hook chain — a saturated backfill scan costs fast-probe per
+        # candidate instead of a leaf walk per candidate.
+        hook_only = (
             req.kind == PodKind.REGULAR
             or not (req.is_guarantee or not self._defrag_holds)
         )
-        if not fast:
+        screen = bool(self._backfill_hold) and not hook_only
+        if hook_only:
             for k in range(n_names):
                 name = names[(start + k) % n_names]
                 consumed += 1
@@ -1092,28 +1547,56 @@ class TpuShareScheduler:
                     rejections.add(self._generic_reason(reason, name), name)
             return feasible, rejections, scans, consumed
 
+        from itertools import chain
+
         needs_port = req.kind == PodKind.SHARED
         is_multi = req.kind == PodKind.MULTI_CHIP
         request, memory = req.request, req.memory
         request_floor = request - _EPS  # fge(), constant-folded
         chips_n, rmodel = req.chip_count, req.model
-        one_model = (rmodel,)
-        ports_get = self.ports.get
+        # port exhaustion is rare (hundreds of slots per node): the
+        # per-probe check is membership in the maintained full-pool
+        # set — one falsy truthiness test cluster-wide when no pool is
+        # full — instead of a dict get + .full() call per candidate
+        full_ports = self._full_port_nodes if needs_port else None
+        has_anchors = bool(anchor_nodes)
         node_model_agg = tree.node_model_agg
         models_on_node = tree.models_on_node
         bound_get = tree._bound_cache.get  # models_on_node, sans frames
-        agg_get = tree._agg_cache.get
-        gens_get = tree._node_gen.get
+        agg_cache = tree._agg_cache
+        check = tree.check_aggregates
         append = feasible.append
         unsynced = self._unsynced  # mutated in place by lazy syncs
         rejected: List[str] = []
         probes = 0
-        for k in range(n_names):
-            name = names[(start + k) % n_names]
-            consumed += 1
-            if name in anchor_nodes:
+        # Pinned model — the pod's, or a homogeneous cluster's only
+        # one: the per-candidate probe collapses to one string-keyed
+        # dict get on the model's aggregate map (a cached aggregate is
+        # always valid — accounting deltas refresh it in place and
+        # structural events evict it, so there is no per-probe
+        # generation compare and no key-tuple allocation).
+        m0 = rmodel or tree.single_model
+        if m0:
+            aggs0 = agg_cache.get(m0)
+            if aggs0 is None:
+                aggs0 = agg_cache[m0] = {}
+            aggs0_get = aggs0.get
+        else:
+            aggs0_get = None
+        # two plain index ranges instead of a per-iteration modulo:
+        # the rotation window is [start:] then [:start]. The window
+        # progress counters (consumed/scans) are derived from the
+        # break position AFTER the loop — two fewer increments per
+        # candidate on the hottest loop in the engine.
+        need = target - len(feasible)
+        anchor_skips = 0
+        broke = False
+        k = start - 1 if start else n_names - 1  # exhaustion fallback
+        for k in chain(range(start, n_names), range(start)):
+            name = names[k]
+            if has_anchors and name in anchor_nodes:
+                anchor_skips += 1
                 continue  # examined above
-            scans += 1
             if unsynced and name in unsynced:
                 # per-candidate detour, not a cluster-wide fallback:
                 # filter() runs the lazy inventory sync for THIS node
@@ -1121,49 +1604,89 @@ class TpuShareScheduler:
                 fit, reason = self.filter(pod, req, name)
                 if fit:
                     append(name)
-                    if len(feasible) >= target:
+                    need -= 1
+                    if not need:
+                        broke = True
                         break
                 elif reason:
                     rejections.add(self._generic_reason(reason, name), name)
                 continue
-            if needs_port:
-                pool = ports_get(name)
-                if pool is not None and pool.full():
-                    rejected.append(name)
-                    continue
-            if rmodel:
-                models = one_model
+            if full_ports and name in full_ports:
+                rejected.append(name)
+                continue
+            if aggs0_get is not None:
+                probes += 1
+                agg = aggs0_get(name)
+                if agg is None:
+                    agg = node_model_agg(name, m0)
+                if is_multi:
+                    fit = agg.multi_chip_fits(chips_n, memory)
+                else:
+                    # inlined agg.shared_fits: the single-point
+                    # frontier is the overwhelmingly common shape (a
+                    # node whose free leaves are interchangeable), and
+                    # this runs per candidate per pod
+                    fit = False
+                    frontier = agg.frontier
+                    if frontier:
+                        avail, mem = frontier[0]
+                        if avail >= request_floor and mem >= memory:
+                            fit = True
+                        elif len(frontier) > 1:
+                            fit = agg.shared_fits(request, memory)
             else:
                 entry = bound_get(name)
                 models = entry[2] if entry is not None else \
                     models_on_node(name)
-            fit = False
-            for m in models:
-                probes += 1
-                agg = agg_get((name, m))
-                if agg is None or agg.gen != gens_get(name, 0):
-                    agg = node_model_agg(name, m)
-                if is_multi:
-                    if agg.multi_chip_fits(chips_n, memory):
-                        fit = True
-                        break
+                fit = False
+                for m in models:
+                    probes += 1
+                    by_node = agg_cache.get(m)
+                    agg = by_node.get(name) if by_node is not None else None
+                    if agg is None:
+                        agg = node_model_agg(name, m)
+                    if is_multi:
+                        if agg.multi_chip_fits(chips_n, memory):
+                            fit = True
+                            break
+                        continue
+                    frontier = agg.frontier
+                    if frontier:
+                        avail, mem = frontier[0]
+                        if avail >= request_floor and mem >= memory:
+                            fit = True
+                            break
+                        if len(frontier) > 1 and agg.shared_fits(
+                            request, memory
+                        ):
+                            fit = True
+                            break
+            if screen:
+                if fit:
+                    # aggregate hit under a backfill hold: confirm
+                    # against the hold-aware hook chain
+                    fit, reason = self.filter(pod, req, name)
+                    if fit:
+                        append(name)
+                        need -= 1
+                        if not need:
+                            broke = True
+                            break
+                    elif reason:
+                        rejections.add(
+                            self._generic_reason(reason, name), name
+                        )
                     continue
-                # inlined agg.shared_fits: the single-point frontier is
-                # the overwhelmingly common shape (a node whose free
-                # leaves are interchangeable), and this loop runs per
-                # candidate per pod
-                frontier = agg.frontier
-                if frontier:
-                    avail, mem = frontier[0]
-                    if avail >= request_floor and mem >= memory:
-                        fit = True
-                        break
-                    if len(frontier) > 1 and agg.shared_fits(
-                        request, memory
-                    ):
-                        fit = True
-                        break
-            if tree.check_aggregates:
+                if check:
+                    # monotonicity oracle: an aggregate miss must be a
+                    # hook-chain miss too (holds only shrink capacity)
+                    assert not self.filter(pod, req, name)[0], (
+                        f"backfill screen dropped a feasible node "
+                        f"{name}: kind={req.kind} model={rmodel!r}"
+                    )
+                rejected.append(name)
+                continue
+            if check:
                 # differential oracle for the INLINE loop itself, not
                 # just the aggregates it reads: every verdict must
                 # match the full filter() hook chain (port pool, hold
@@ -1176,27 +1699,38 @@ class TpuShareScheduler:
                 )
             if fit:
                 append(name)
-                if len(feasible) >= target:
+                need -= 1
+                if not need:
+                    broke = True
                     break
             else:
                 rejected.append(name)
+        if broke:
+            window = k - start + 1 if k >= start else n_names - start + k + 1
+        else:
+            window = n_names
+        consumed += window
+        scans += window - anchor_skips
         tree.filter_fast_hits += probes
         if not feasible and rejected:
             # cold path: reconstruct the rejection reasons the hot
             # loop skipped (they only surface in the unschedulable
             # Decision and the journal, i.e. when nothing fit) — same
             # generic keys the hook-chain paths normalize to, so both
-            # paths aggregate into one bucket per cause
+            # paths aggregate into one bucket per cause. Reason
+            # strings are per CAUSE, not per node: built once, outside
+            # the loop (a 2048-node total miss used to format 2048
+            # identical f-strings here, per failed attempt).
+            port_reason = "pod-manager port pool full"
+            model_reason = f"node has no {rmodel} chips" if rmodel else ""
+            fit_reason = f"node cannot fit request={request} mem={memory}"
             for name in rejected:
-                if needs_port and self._node_ports(name).full():
-                    rejections.add("pod-manager port pool full", name)
+                if full_ports and name in full_ports:
+                    rejections.add(port_reason, name)
                 elif rmodel and rmodel not in models_on_node(name):
-                    rejections.add(f"node has no {rmodel} chips", name)
+                    rejections.add(model_reason, name)
                 else:
-                    rejections.add(
-                        f"node cannot fit request={request} mem={memory}",
-                        name,
-                    )
+                    rejections.add(fit_reason, name)
         return feasible, rejections, scans, consumed
 
     @staticmethod
@@ -1212,9 +1746,17 @@ class TpuShareScheduler:
     def _note_demand(self, pod_key: str, req, reason: str) -> None:
         """File/refresh the pod's pending-demand entry with the same
         RESOLVED chips/HBM the quota gate uses, so planner sizing and
-        admission can never disagree about what a pod costs."""
+        admission can never disagree about what a pod costs. During a
+        wave the note is buffered and flushed once at wave end (or
+        eagerly by any mid-wave reader of the ledger — defrag's
+        reclaim lane), so a K-pod wave pays one batched pass instead
+        of K journal reconciliations."""
         if req.kind == PodKind.REGULAR:
             return  # consumes no TPU capacity; not capacity demand
+        self._last_demand_reason = reason
+        if self._wave_demand is not None:
+            self._wave_demand.append((pod_key, req, reason, self.clock()))
+            return
         chips, mem = self.quota.demand(req)
         now = self.clock()
         entry = self.demand.note(pod_key, req, reason, now, chips, mem)
@@ -1225,6 +1767,21 @@ class TpuShareScheduler:
         # first-enqueue and an empty timeline; the ledger's `since`
         # survives both reason changes and journal evictions
         self.explain.sync_reason(pod_key, reason, now, since=entry.since)
+
+    def _flush_wave_demand(self) -> None:
+        """Apply the wave's buffered demand notes (ledger entries +
+        journal reason reconciliation) in one pass. Buffering stays
+        active afterwards — a mid-wave flush (defrag reading the
+        ledger) drains what exists and later notes re-buffer."""
+        buf = self._wave_demand
+        if not buf:
+            return
+        items, buf[:] = list(buf), []
+        sync = self.explain.sync_reason
+        for (pod_key, req, reason, now), entry in zip(
+            items, self.demand.note_batch(items, self.quota.demand)
+        ):
+            sync(pod_key, reason, now, since=entry.since)
 
     def _aggregate_fits(self, req) -> bool:
         """Does the cluster hold this demand in AGGREGATE (ignoring
@@ -1252,13 +1809,21 @@ class TpuShareScheduler:
 
     def _held_leaves(self, pod: Pod, req, node_name: str):
         """Leaves on ``node_name`` this pod must treat as nonexistent:
-        a live defrag hold scopes its freed leaves to the beneficiary.
-        A non-beneficiary sees the UNION of the node's live holds;
-        guarantee pods (every beneficiary is one) see everything."""
+        a live defrag hold scopes its freed leaves to the beneficiary,
+        and a wave's backfill hold protects the blocked head's claim
+        from EVERY pod scheduled behind it (guarantee class included —
+        head-of-line priority outranks class). A non-beneficiary sees
+        the UNION of the node's live defrag holds; guarantee pods
+        (every beneficiary is one) see everything but the backfill
+        hold."""
+        bf = (
+            self._backfill_hold.get(node_name)
+            if self._backfill_hold else None
+        )
         if req.is_guarantee or not self._defrag_holds:
-            return frozenset()
+            return bf or frozenset()
         now = self.clock()
-        held: set = set()
+        held: set = set(bf) if bf else set()
         for (node, beneficiary), (until, leaves) in list(
             self._defrag_holds.items()
         ):
@@ -1306,12 +1871,18 @@ class TpuShareScheduler:
             pct = max(5, 50 - n_nodes // 8)
         return max(self.min_feasible_nodes, n_nodes * pct // 100)
 
-    def _maybe_defrag(self, pod: Pod, req, nodes) -> List[str]:
+    def _maybe_defrag(self, pod: Pod, req) -> List[str]:
         """Evict-to-fit for a guarantee pod no node can place (see
         scheduler/defrag.py for the policy). Returns the evicted pod
-        keys ([] = no defrag happened)."""
+        keys ([] = no defrag happened). The healthy-node list is
+        materialized only past the guards — with defrag off this must
+        cost nothing, it runs on every placement failure."""
         if not self.defrag or not req.is_guarantee:
             return []
+        # the reclaim budget lane reads the demand ledger below; a
+        # wave buffers demand notes, so drain them first or a mid-wave
+        # defrag would see a stale starvation signal
+        self._flush_wave_demand()
         now = self.clock()
         last = self._defrag_last.get(pod.key)
         if last is not None and now - last < self.defrag_cooldown:
@@ -1364,8 +1935,9 @@ class TpuShareScheduler:
             self._defrag_blocked = {
                 k: u for k, u in self._defrag_blocked.items() if u > now
             }
+        nodes = [n.name for n in self.cluster.list_nodes() if n.healthy]
         plan = find_plan(
-            self.tree, self.status, [n.name for n in nodes], req,
+            self.tree, self.status, nodes, req,
             max_victims=max_victims, excluded=excluded,
             # reclaim-before-starve preference: victims holding
             # BORROWED capacity (tenant over its guaranteed
@@ -1529,6 +2101,13 @@ class TpuShareScheduler:
                 "tpu_scheduler_score_cache_misses_total", {},
                 self.score_cache_misses,
             ),
+            # per-(node, shape) memo evictions from the tree's delta
+            # hook — the churn signal that replaced generation-compare
+            # staleness (satellite: exported, not silent)
+            expfmt.Sample(
+                "tpu_scheduler_score_cache_evictions_total", {},
+                self.score_cache_evictions,
+            ),
             expfmt.Sample(
                 "tpu_scheduler_index_invalidations_total", {},
                 self.tree.agg_invalidations,
@@ -1537,7 +2116,39 @@ class TpuShareScheduler:
                 "tpu_scheduler_index_rebuilds_total", {},
                 self.tree.agg_rebuilds,
             ),
+            # delta-maintenance health: in-place refreshes should
+            # dominate; rebuilds only follow health/relist walks
+            expfmt.Sample(
+                "tpu_scheduler_index_delta_updates_total", {},
+                self.tree.agg_delta_updates,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_index_builds_total", {},
+                self.tree.agg_builds,
+            ),
+            # wave scheduling: waves driven, pods offered per wave
+            # (histogram), backfill activity, and the safety counter
+            # that must stay 0
+            expfmt.Sample(
+                "tpu_scheduler_waves_total", {}, self.wave_count,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_backfill_binds_total", {},
+                self.backfill_binds,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_backfill_head_delays_total", {},
+                self.backfill_head_delays,
+            ),
         ]
+        # where wave wall time goes, cumulative per phase: sync vs
+        # sort vs the attempt loop vs the journal flush
+        for phase in sorted(self.wave_phase_seconds):
+            samples.append(expfmt.Sample(
+                "tpu_scheduler_wave_phase_seconds_total",
+                {"phase": phase}, self.wave_phase_seconds[phase],
+            ))
+        samples += self._wave_size_hist.samples("tpu_scheduler_wave_size")
         # per-tenant quota plane gauges: dominant share, weighted
         # share, borrowed chips, quota deficit, reclaim evictions —
         # the cluster-level counterpart of the arbiter's per-pod
@@ -1597,6 +2208,16 @@ class TpuShareScheduler:
             ports = self.ports[node_name] = RRBitmap(C.POD_MANAGER_PORT_COUNT)
         return ports
 
+    def _note_port_state(self, node_name: str, ports: RRBitmap) -> None:
+        """Keep the full-pool membership set exact after any bitmap
+        mutation (the inline Filter loop's cheap port check; must
+        always agree with ``ports.full()`` — the check_aggregates
+        oracle asserts it does)."""
+        if ports.full():
+            self._full_port_nodes.add(node_name)
+        else:
+            self._full_port_nodes.discard(node_name)
+
     def _bind(self, pod_key: str, node_name: str) -> None:
         self.cluster.bind(pod_key, node_name)
         self._drop_defrag_holds(pod_key)  # beneficiary placed; debt paid
@@ -1644,6 +2265,7 @@ class TpuShareScheduler:
 
     def _release(self, status: PodStatus) -> None:
         req = status.requirements
+        self.capacity_releases += 1
         # ledger credit first (exact inverse of the reserve-time
         # charge), so even a reclaim that errors below cannot leave
         # the tenant's share inflated after the pod is gone
@@ -1669,9 +2291,9 @@ class TpuShareScheduler:
                 # delete path
                 self.log.error("release %s: %s", status.key, e)
         if status.port >= C.POD_MANAGER_PORT_START and status.node_name in self.ports:
-            self.ports[status.node_name].clear(
-                status.port - C.POD_MANAGER_PORT_START
-            )
+            pool = self.ports[status.node_name]
+            pool.clear(status.port - C.POD_MANAGER_PORT_START)
+            self._note_port_state(status.node_name, pool)
         status.leaves = []
         status.uuids = []
         status.state = PodState.PENDING

@@ -233,6 +233,59 @@ def pick_best(scores: dict) -> str:
     )
 
 
+def pick_top2(scores: dict) -> Tuple[str, Optional[str]]:
+    """Winner + runner-up in one pass, without copying the candidate
+    dict. The winner is bit-equal to ``pick_best`` (that IS the
+    placement decision); the runner-up — a journal-only field — is
+    second place under the SAME normalization as the winner (the old
+    journal path re-ran pick_best over a copy minus the winner, which
+    silently RE-normalized the remainder onto a different scale — and
+    cost an O(candidates) dict copy per pod). None with a single
+    candidate."""
+    best, runner, _, _ = pick_top2_seq(list(scores), list(scores.values()))
+    return best, runner
+
+
+def pick_top2_seq(
+    names: Sequence[str], values: Sequence[float]
+) -> Tuple[str, Optional[str], float, float]:
+    """``pick_top2`` over parallel sequences, also returning the
+    winner's and runner-up's RAW scores — the engine's score loop
+    already walks the feasible list, so it collects plain lists
+    instead of building a per-pod dict just to tear it apart here.
+    Returns (best, runner, best_raw, runner_raw); runner fields are
+    (None, 0.0) with a single candidate."""
+    lo, hi = min(values), max(values)
+    shift = -lo if lo < 0 else 0.0
+    hi += shift
+    lo = 0.0 if shift else lo
+    span = None
+    if hi > 100:
+        span = (hi - lo) or 100.0
+    best = runner = None
+    best_b = runner_b = 0
+    best_raw = runner_raw = 0.0
+    for i, raw in enumerate(values):
+        # identical arithmetic to pick_best, term for term — a
+        # refactored expression can truncate into a different int
+        # bucket at the boundary. Bucket-then-name compares inline
+        # (no per-candidate key tuple): this loop runs once per
+        # feasible node per pod.
+        if span is None:
+            b = int(raw + shift)
+        else:
+            b = int(100.0 * (raw + shift - lo) / span)
+        name = names[i]
+        if best is None or b > best_b or (b == best_b and name > best):
+            runner, runner_b, runner_raw = best, best_b, best_raw
+            best, best_b, best_raw = name, b, raw
+        elif runner is None or b > runner_b or (
+            b == runner_b and name > runner
+        ):
+            runner, runner_b, runner_raw = name, b, raw
+    return best, runner, best_raw, runner_raw
+
+
 def select_leaves(
     tree: CellTree,
     node: str,

@@ -84,6 +84,21 @@ class PodStatusStore:
             return []
         return list(self._by_group.get(group_key, {}).values())
 
+    def held_in_group(self, group_key: str) -> int:
+        """Members currently HOLDING capacity (reserved / parked at
+        the Permit barrier / bound) — the gang-granular admission
+        gate's term, computed without materializing the member list
+        (it runs once per scheduling attempt of every gang pod)."""
+        if not group_key:
+            return 0
+        count = 0
+        for status in self._by_group.get(group_key, {}).values():
+            if status.state in (
+                PodState.RESERVED, PodState.WAITING, PodState.BOUND
+            ):
+                count += 1
+        return count
+
     def group_placed_leaves(self, group_key: str) -> List[Cell]:
         """Leaf cells already held by members of a gang — the locality
         anchors for guarantee scoring."""

@@ -177,6 +177,10 @@ class Simulator:
         defrag: bool = False,
         defrag_eviction_rate: float = 0.0,
         tenants=None,
+        use_waves: bool = True,
+        wave_size: int = 0,
+        backfill: bool = False,
+        explain_capacity: int = 512,
     ):
         import random
 
@@ -194,8 +198,21 @@ class Simulator:
             topology, self.cluster, clock=lambda: self.clock_now,
             tracer=tracer, defrag=defrag,
             defrag_eviction_rate=defrag_eviction_rate,
-            tenants=tenants,
+            tenants=tenants, explain_capacity=explain_capacity,
         )
+        # Wave-driven run loop (PR-5): each tick's scheduling pass is
+        # one engine.schedule_wave over the pending queue instead of a
+        # sim-side sort + per-pod schedule_one loop. With backfill off
+        # (the default) the wave is decision-for-decision identical to
+        # the sequential loop — use_waves=False keeps that loop alive
+        # as the same-commit A/B baseline (tools/engine_bench.py) and
+        # the differential oracle (tests/test_scheduler_wave.py).
+        # backfill=True adds head-of-line semantics: strictly-smaller
+        # pods may bind behind a blocked gang/multi-chip head, only
+        # onto capacity that provably cannot delay it.
+        self.use_waves = use_waves
+        self.wave_size = wave_size
+        self.backfill = backfill
         self.total_chips = sum(nodes.values())
         self.chip_model = chip_model
         self.chip_memory = chip_memory
@@ -505,7 +522,6 @@ class Simulator:
                 next_ctrl += controller_interval
 
             # one scheduling pass over the queue (queue-sorted)
-            pending.sort(key=lambda j: self.engine.queue_sort_key(j.pod))
             still_pending: List[_Job] = []
             evictions_seen = evictions_at_pass_start = len(
                 self.cluster.evictions
@@ -543,13 +559,11 @@ class Simulator:
                     report.tenant_chip_seconds.get(ns, 0.0) + job.credited
                 )
 
-            for job in pending:
-                if job.pod.key in gang_bound:
-                    continue  # bound this pass via a sibling's Permit
-                decision = self.engine.schedule_one(job.pod)
+            def drain_evictions() -> None:
                 # defrag victims: the engine evicted them through the
                 # cluster (FakeCluster deletes synchronously); their
                 # controller resubmits them as fresh arrivals
+                nonlocal evictions_seen
                 while evictions_seen < len(self.cluster.evictions):
                     victim_key = self.cluster.evictions[evictions_seen]
                     evictions_seen += 1
@@ -578,6 +592,8 @@ class Simulator:
                     )
                     report.resubmitted += 1
                     report.submitted += 1
+
+            def handle(job: _Job, decision) -> None:
                 if decision.status == "bound":
                     mark_bound(job)
                     # a non-empty bound_with is the Permit barrier
@@ -591,13 +607,49 @@ class Simulator:
                         self._record_gang_hops(
                             [job.pod.key, *decision.bound_with], report
                         )
-                elif decision.status == "unschedulable" and not decision.retryable:
+                elif (decision.status == "unschedulable"
+                        and not decision.retryable):
                     # malformed spec: permanent reject
                     self.cluster.delete_pod(job.pod.key)
                     jobs.pop(job.pod.key, None)
                     report.unschedulable += 1
                 else:
                     still_pending.append(job)  # capacity: retry next tick
+
+            if self.use_waves:
+                # wave-driven pass: the engine sorts the queue (with
+                # per-wave ledger memos), reconciles inventory once,
+                # and drains the backlog as one batched cycle
+                decisions = self.engine.schedule_wave(
+                    [j.pod for j in pending], limit=self.wave_size,
+                    backfill=self.backfill,
+                )
+                drain_evictions()
+                handled = set()
+                for decision in decisions:
+                    handled.add(decision.pod_key)
+                    job = jobs.get(decision.pod_key)
+                    if job is None or decision.pod_key in gang_bound:
+                        continue
+                    handle(job, decision)
+                # a wave limit can leave an undrained tail with no
+                # decision this tick: it stays queued
+                for job in pending:
+                    if (job.pod.key not in handled
+                            and job.pod.key not in gang_bound
+                            and job.bound_at is None
+                            and job.pod.key in jobs):
+                        still_pending.append(job)
+            else:
+                # sequential per-pod loop — kept as the same-commit
+                # A/B baseline and the wave differential oracle
+                pending.sort(key=lambda j: self.engine.queue_sort_key(j.pod))
+                for job in pending:
+                    if job.pod.key in gang_bound:
+                        continue  # bound this pass via a sibling's Permit
+                    decision = self.engine.schedule_one(job.pod)
+                    drain_evictions()
+                    handle(job, decision)
             # drop members that a LATER sibling's Permit release bound
             # after they were already parked in still_pending this pass
             # (slice-assign: remove_node holds a reference to THIS list)

@@ -255,6 +255,46 @@ def generate_starvation_trace(
     return events
 
 
+def generate_backlog_trace(
+    count: int = 3072,
+    seed: int = 0,
+    span_s: float = 10.0,
+    fractional_ratio: float = 0.6,
+) -> List[TraceEvent]:
+    """Saturated backlog drain — the wave scheduler's home turf
+    (tools/engine_bench.py --mode backlog): ``count`` pods all arrive
+    within ``span_s`` (arrival times quantized to 0.5 s so the drain
+    is a handful of fat scheduling ticks, not thousands of one-pod
+    ticks — the A/B measures per-cycle cost, not tick count), sized
+    to oversubscribe the target cluster by ~10-15%.
+
+    ``fractional_ratio`` of the pods are opportunistic fractional
+    requests (priority 0); the rest are x2/x4 whole-chip guarantee
+    pods (priority 50). Strict priority puts the guarantee class
+    first, so once capacity runs out the queue head is an unplaceable
+    multi-chip pod: the sequential loop re-attempts the whole blocked
+    tail every tick, while the wave blocks the head, cheap-skips
+    equal-size pods, and backfills the fractional tail onto capacity
+    the head provably cannot use. Runtimes are quantized to whole
+    minutes in [2, 6] so completions batch into few distinct ticks.
+    """
+    rng = random.Random(seed)
+    events: List[TraceEvent] = []
+    for _ in range(count):
+        t = round(rng.uniform(0.0, span_s) * 2) / 2.0
+        runtime = 60.0 * rng.randint(2, 6)
+        if rng.random() < fractional_ratio:
+            events.append(TraceEvent(
+                t, round(rng.uniform(0.1, 0.9), 2), runtime, 0,
+            ))
+        else:
+            events.append(TraceEvent(
+                t, 2.0 if rng.random() < 0.5 else 4.0, runtime, 50,
+            ))
+    events.sort(key=lambda e: e.start)
+    return events
+
+
 def generate_gang_trace(
     gangs: int = 60,
     gang_sizes=(2, 4, 8),
@@ -262,6 +302,7 @@ def generate_gang_trace(
     seed: int = 0,
     mean_interarrival: float = 4.0,
     mean_runtime: float = 180.0,
+    gang_chips: float = 1.0,
 ) -> List[TraceEvent]:
     """Gang-heavy load (VERDICT r4 #7): ``gangs`` whole-chip guarantee
     gangs with sizes cycling through ``gang_sizes``, interleaved with
@@ -282,8 +323,12 @@ def generate_gang_trace(
         if kind == "gang":
             size = gang_sizes[g % len(gang_sizes)]
             g += 1
+            # gang_chips > 1 makes each member a whole-node-chunk
+            # multi-chip pod — the shape head-of-line backfill exists
+            # for (a fragmented node cannot host it, so gang heads
+            # genuinely block under churn)
             events.append(TraceEvent(
-                round(t, 3), 1.0, round(runtime, 1), 80, size,
+                round(t, 3), gang_chips, round(runtime, 1), 80, size,
             ))
         else:
             chips = (round(rng.uniform(0.1, 0.9), 2)

@@ -4,19 +4,19 @@ simulate --bench numbers previously lived only in commit messages).
 Two guards: the committed ENGINE_BENCH.json artifact must exist, be in
 the tool's shape, and clear absolute + scaling floors; and a fresh
 in-process run must clear a conservative floor so a hot-path
-regression fails CI rather than silently shipping (floor is ~half the
-measured rate — CI boxes are noisy, while a real hot-path regression
-is usually 5-10x).
+regression fails CI rather than silently shipping (floor is well below
+the measured rate — CI boxes are noisy, while a real hot-path
+regression is usually 5-10x).
 
-Floors were re-baselined for PR 1 (incremental feasibility index +
-score cache) on the PR-1 CI box, which is ~2x slower than the box that
-produced the round-1..5 artifacts (seed code idle: 2,222/s @ 32 nodes
-here vs 4,778/s committed). The number that is machine-independent is
-the SCALING RATIO — 1024-node rate / 32-node rate — which the index
-moved from 0.33 (seed, same box) to ~0.6-0.8 (run-to-run box
-variance); the committed-artifact
-assertions therefore lean on ratios, with absolute floors as a
-secondary sanity net.
+Floors were re-baselined for PR 5 (wave scheduler + delta-maintained
+aggregates) on the PR-5 CI box. Boxes differ ~2x in absolute rate
+across this repo's history, so the machine-independent assertions are
+the RATIOS: idle scaling (1024-node / 32-node placements/s — the
+"per-pod cost does not grow with cluster size" claim, >= 0.85 per the
+PR-5 acceptance), the backlog drain speedup (wave vs sequential on
+the same box/commit, >= 1.5x), and the structural counters (delta
+maintenance engaged, zero slow walks on idle, zero backfill head
+delays anywhere).
 """
 
 import json
@@ -36,67 +36,94 @@ COUNTERS = (
     "filter_slow_walks",
     "index_invalidations",
     "index_rebuilds",
+    "index_builds",
+    "index_delta_updates",
     "score_cache_hits",
     "score_cache_misses",
+    "score_cache_evictions",
+    "waves",
+    "backfill_binds",
+    "backfill_head_delays",
 )
+
+
+def _doc():
+    return json.load(open(ARTIFACT))
 
 
 class TestCommittedArtifact:
     def test_exists_and_well_formed(self):
-        doc = json.load(open(ARTIFACT))
+        doc = _doc()
         assert doc["generated_by"] == "tools/engine_bench.py"
         by_nodes = {r["nodes"]: r for r in doc["results"]}
         assert set(by_nodes) == {32, 128, 512, 1024, 2048}
         for r in doc["results"]:
             assert r["placements_per_sec"] > 0
             assert r["bound"] > 0
+            assert r["attempt_p50_us"] > 0
+            assert r["attempt_p99_us"] >= r["attempt_p50_us"]
             for key in COUNTERS:
                 assert key in r["counters"], (r["nodes"], key)
         assert doc["scaling_ratio_1024_over_32"] > 0
+        for section in ("backlog", "gang", "journal_ab"):
+            assert section in doc, section
 
     def test_recorded_counters_prove_fast_path_engaged(self):
         """The index must actually answer Filter: a silently-disabled
         fast path (every query routed to the leaves_view walk) would
         still produce plausible wall times on a small box, so the
-        counters are the artifact's proof of mechanism. Slow walks are
-        defrag-hold-only and the synthetic trace holds rarely."""
-        doc = json.load(open(ARTIFACT))
+        counters are the artifact's proof of mechanism."""
+        doc = _doc()
         for r in doc["results"]:
             c = r["counters"]
             assert c["filter_fast_hits"] > 0, r["nodes"]
             assert c["score_cache_hits"] > 0, r["nodes"]
-            assert c["filter_slow_walks"] <= c["filter_fast_hits"] * 0.05
-            # lazy rebuilds, not per-query: rebuilds << fast hits
-            assert c["index_rebuilds"] < c["filter_fast_hits"] * 0.5
+            # idle trace: no defrag holds, no backfill — the slow
+            # walk counter stays PINNED at zero (PR-5 satellite)
+            assert c["filter_slow_walks"] == 0, r["nodes"]
+
+    def test_delta_maintenance_replaced_rebuilds(self):
+        """PR-5 satellite: reserve/reclaim delta-refresh aggregates in
+        place, so generation-forced rebuilds on the idle trace are
+        (near) gone — <= 0.1 per bind, where the invalidate-then-
+        rebuild design measured ~2 per bind."""
+        doc = _doc()
+        for r in doc["results"]:
+            c = r["counters"]
+            assert c["index_delta_updates"] > 0, r["nodes"]
+            assert c["index_rebuilds"] <= 0.1 * r["bound"], (
+                r["nodes"],
+                "generation rebuilds are tracking binds again — "
+                "delta maintenance is being bypassed",
+            )
+
+    def test_no_backfill_head_delays_any_mode(self):
+        """PR-5 acceptance: the backfill safety counter is zero in
+        every mode the artifact records — it is a checked invariant,
+        and the bench is the widest net that checks it."""
+        doc = _doc()
+        rows = list(doc["results"])
+        for section in ("backlog", "gang"):
+            rows.append(doc[section]["wave"])
+            rows.append(doc[section]["sequential"])
+        for r in rows:
+            assert r["counters"]["backfill_head_delays"] == 0
 
     def test_recorded_floor_32_nodes(self):
-        doc = json.load(open(ARTIFACT))
+        doc = _doc()
         [r32] = [r for r in doc["results"] if r["nodes"] == 32]
-        assert r32["placements_per_sec"] >= 2000, (
-            "committed engine bench fell below the PR-1 baseline "
-            "(2,5-3,5k/s measured range); investigate before regenerating "
-            "ENGINE_BENCH.json"
-        )
-
-    def test_recorded_floor_512_nodes(self):
-        """Pod-slice scale (2048 chips): sampling bought >= 1k/s
-        (VERDICT r2 #7); the feasibility index roughly doubles it
-        (1,009 -> ~2,000-2,600/s seed vs PR 1, same box)."""
-        doc = json.load(open(ARTIFACT))
-        [r512] = [r for r in doc["results"] if r["nodes"] == 512]
-        assert r512["placements_per_sec"] >= 1500, (
-            "committed 512-node engine bench fell below the floor; "
+        assert r32["placements_per_sec"] >= 1500, (
+            "committed engine bench fell below the PR-5 baseline; "
             "investigate before regenerating ENGINE_BENCH.json"
         )
 
+    def test_recorded_floor_512_nodes(self):
+        doc = _doc()
+        [r512] = [r for r in doc["results"] if r["nodes"] == 512]
+        assert r512["placements_per_sec"] >= 1500
+
     def test_recorded_floor_1024_nodes(self):
-        """The index bounds steady-state per-pod cost by O(examined
-        candidates), so the rate must stay near-flat from 512 to 1024
-        nodes (4096 chips): assert the RELATIVE bound (an O(nodes)
-        regression would halve the rate at 2x scale, which an absolute
-        floor could miss) plus the absolute floor (~3x the seed's
-        722/s on this box)."""
-        doc = json.load(open(ARTIFACT))
+        doc = _doc()
         [r1k] = [r for r in doc["results"] if r["nodes"] == 1024]
         [r512] = [r for r in doc["results"] if r["nodes"] == 512]
         assert r1k["placements_per_sec"] >= 1500
@@ -105,55 +132,92 @@ class TestCommittedArtifact:
             "per-pod cost is growing with cluster size again"
         )
 
+    def test_recorded_floor_2048_nodes(self):
+        doc = _doc()
+        [r2k] = [r for r in doc["results"] if r["nodes"] == 2048]
+        assert r2k["placements_per_sec"] >= 1000
+
     def test_recorded_scaling_ratio(self):
-        """The headline: 1024-node placements/s within 2x of the
-        32-node rate (ratio >= 0.5). Seed measured 0.33 on this box /
-        0.38 on the round-5 box; the feasibility index + score cache
-        hold ~0.6-0.8. Asserted from the row data, not the convenience
-        field — which must agree with the rows it summarizes."""
-        doc = json.load(open(ARTIFACT))
+        """The PR-5 idle headline: 1024-node placements/s >= 0.85 of
+        the 32-node rate (acceptance floor; seed measured 0.33, PR-1
+        0.69). Asserted from the row data, not the convenience field
+        — which must agree with the rows it summarizes."""
+        doc = _doc()
         by_nodes = {r["nodes"]: r for r in doc["results"]}
         ratio = (
             by_nodes[1024]["placements_per_sec"]
             / by_nodes[32]["placements_per_sec"]
         )
-        assert ratio >= 0.5, (
+        assert ratio >= 0.85, (
             f"scaling ratio {ratio:.2f}: per-pod cost is growing with "
-            "cluster size again (index bypassed or invalidation storm)"
+            "cluster size again (delta maintenance bypassed, score "
+            "cache churning, or sampling floor regressed)"
         )
         assert abs(doc["scaling_ratio_1024_over_32"] - ratio) < 0.01
 
-    def test_recorded_floor_2048_nodes(self):
-        """8192 chips — the row PR 1 added: even at 2x the previous
-        max scale the engine must beat the seed's 1024-node rate
-        (722/s on this box)."""
-        doc = json.load(open(ARTIFACT))
-        [r2k] = [r for r in doc["results"] if r["nodes"] == 2048]
-        assert r2k["placements_per_sec"] >= 1000
+    def test_backlog_drain_speedup(self):
+        """The PR-5 wave headline: same-commit same-box A/B — the
+        batched wave cycle with head-of-line backfill drains a
+        saturated 1024-node backlog >= 1.5x faster than the PR-4
+        sequential loop, while backfill actually fills (> 0 binds)
+        and provably never delays the head (== 0 delays, asserted
+        above across all modes)."""
+        doc = _doc()
+        b = doc["backlog"]
+        assert b["nodes"] == 1024
+        assert b["speedup_wave_over_sequential"] >= 1.5
+        assert b["wave"]["counters"]["backfill_binds"] > 0
+        assert b["wave"]["bound"] == b["sequential"]["bound"], (
+            "wave and sequential drains bound different pod counts — "
+            "the A/B is not comparing the same work"
+        )
+
+    def test_gang_mode_backfill_engages(self):
+        """Gang-heavy saturation: wave drain at least matches the
+        sequential loop and the backfill machinery demonstrably
+        engages behind blocked gang heads."""
+        doc = _doc()
+        g = doc["gang"]
+        assert g["speedup_wave_over_sequential"] >= 1.0
+        assert g["wave"]["counters"]["backfill_binds"] > 0
+        assert g["sequential"]["counters"]["backfill_binds"] == 0
+
+    def test_journal_ab_recorded(self):
+        """PR-5 satellite: the explain/journal feed's hot-path cost
+        is measured (journal on vs --explain-capacity 0) and stays a
+        modest fraction — the gate exists so operators can buy it
+        back entirely."""
+        doc = _doc()
+        j = doc["journal_ab"]
+        assert j["journal_on_placements_per_sec"] > 0
+        assert j["journal_off_placements_per_sec"] > 0
+        # sanity bound only: a 2x regression would mean the journal
+        # feed grew a hot-path dependency it must not have
+        assert j["journal_overhead_pct"] <= 50.0
 
 
 class TestFreshRunFloor:
     def test_live_floor_32_nodes(self):
         r = run(32, events=600)
-        assert r["placements_per_sec"] >= 1200, (
+        assert r["placements_per_sec"] >= 1000, (
             f"engine hot path regressed: {r['placements_per_sec']:.0f} "
-            "placements/s @ 32 nodes (committed artifact has "
-            ">= 2000; floor leaves CI-noise margin)"
+            "placements/s @ 32 nodes (committed artifact is well "
+            "above; floor leaves CI-noise margin)"
         )
 
     def test_live_floor_512_nodes(self):
         """Catches an O(nodes)-per-pod regression (e.g. sampling or
         the feasibility index accidentally disabled): unsampled this
-        runs ~125/s, and the seed's walk-per-node Filter ran ~1,000/s
-        on this box where the index holds ~2,000/s. 1000 events, not
-        300: at index speed 300 events is ~0.15s of wall — short
-        enough that one GC pause or scheduler hiccup halves the
-        measured rate (observed flaking in-suite at events=300)."""
+        runs ~125/s. 1000 events, not 300: at index speed 300 events
+        is short enough that one GC pause halves the measured rate
+        (observed flaking in-suite at events=300)."""
         r = run(512, events=1000)
-        assert r["placements_per_sec"] >= 1000, (
+        assert r["placements_per_sec"] >= 900, (
             f"engine hot path regressed at scale: "
             f"{r['placements_per_sec']:.0f} placements/s @ 512 nodes"
         )
         c = r["counters"]
         assert c["filter_fast_hits"] > 0
         assert c["score_cache_hits"] > 0
+        assert c["index_delta_updates"] > 0
+        assert c["filter_slow_walks"] == 0

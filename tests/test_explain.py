@@ -287,7 +287,23 @@ class TestJournalBounds:
 
     def test_capacity_validation(self):
         with pytest.raises(ValueError, match="capacity"):
-            DecisionJournal(capacity=0)
+            DecisionJournal(capacity=-1)
+
+    def test_capacity_zero_disables(self):
+        """PR-5: capacity 0 = the journal is OFF — writes are no-ops
+        (no entries, no SLO histograms) and ``enabled`` is False so
+        the engine skips building attempt records entirely."""
+        j = DecisionJournal(capacity=0)
+        assert not j.enabled
+        j.record_attempt("ns/p", 1.0, {"at": 1.0}, tenant="t")
+        j.note_reason("ns/p", None, "over-quota", 2.0)
+        j.sync_reason("ns/p", "over-quota", 2.0, since=1.0)
+        j.note_outcome("ns/p", "bound", 3.0, tenant="t", shape="shared")
+        j.carry_over("ns/p", "ns/p2")
+        assert len(j) == 0
+        assert j.get("ns/p", 3.0) is None
+        names = {s.name for s in j.samples(3.0)}
+        assert not any("pod_wait_seconds" in n for n in names)
 
 
 # ===================== wait SLO metrics ==============================
@@ -454,13 +470,14 @@ class TestWaitMetrics:
         assert doc["first_enqueue_s"] == 0.0  # backdate still landed
         assert doc["waited_s"] == pytest.approx(100.0)
 
-    def test_scheduler_flag_rejects_zero_capacity_cleanly(self):
+    def test_scheduler_flag_rejects_negative_capacity_cleanly(self):
+        # 0 is now legal (journal disabled, PR-5); negatives are not
         from kubeshare_tpu.cmd import scheduler as scheduler_cmd
 
         with pytest.raises(SystemExit, match="explain-capacity"):
             scheduler_cmd.main([
                 "--topology", "x.yaml", "--cluster-state", "y.json",
-                "--explain-capacity", "0",
+                "--explain-capacity", "-1",
             ])
 
     def test_carry_over_preserves_first_enqueue(self):

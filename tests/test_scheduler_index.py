@@ -198,21 +198,35 @@ class TestAggregateDifferential:
         shared_fit(tree, "lite-1", "tpu-v5e", 0.5, GIB, held)
         assert (tree.filter_fast_hits, tree.filter_slow_walks) == (1, 1)
 
-    def test_rebuild_only_on_generation_move(self):
+    def test_delta_maintenance_contract(self):
+        """PR-5: accounting walks refresh the touched aggregate IN
+        PLACE (delta update, no rebuild debt); only structural events
+        (health flips, relist binds) evict for a lazy rebuild."""
         tree = build_tree()
-        tree.node_model_agg("lite-1", "tpu-v5e")
-        rebuilds = tree.agg_rebuilds
-        tree.node_model_agg("lite-1", "tpu-v5e")  # cached
-        assert tree.agg_rebuilds == rebuilds
+        agg = tree.node_model_agg("lite-1", "tpu-v5e")
+        builds = tree.agg_builds
+        assert tree.node_model_agg("lite-1", "tpu-v5e") is agg  # cached
+        assert tree.agg_builds == builds
         leaf = tree.leaves_on_node("lite-1")[0]
+        deltas = tree.agg_delta_updates
+        assert agg.multi_chip_fits(4, 0)  # all four leaves whole-free
         tree.reserve(leaf, 0.5, GIB)
-        tree.node_model_agg("lite-1", "tpu-v5e")  # gen moved
-        assert tree.agg_rebuilds == rebuilds + 1
-        # the untouched node's aggregate is NOT invalidated
-        before = tree.agg_rebuilds
+        # refreshed in place: same object, already post-reserve, no
+        # rebuild happened and none is owed
+        assert tree.agg_delta_updates == deltas + 1
+        assert tree.agg_rebuilds == 0
+        assert tree.node_model_agg("lite-1", "tpu-v5e") is agg
+        assert not agg.multi_chip_fits(4, 0)  # saw the reserve
+        # the untouched node's aggregate is a fresh cold build once
+        before = tree.agg_builds
         tree.node_model_agg("lite-2", "tpu-v5e")
         tree.node_model_agg("lite-2", "tpu-v5e")
-        assert tree.agg_rebuilds == before + 1
+        assert tree.agg_builds == before + 1
+        # a health flip is structural: evicts (rebuild debt) and the
+        # next read builds anew
+        tree.set_node_health("lite-1", False)
+        assert tree.agg_rebuilds == 1
+        assert tree.node_model_agg("lite-1", "tpu-v5e") is not agg
 
 
 SCHED_TOPO = {

@@ -1,27 +1,45 @@
 #!/usr/bin/env python
 """Scheduler-engine performance floor: placements/sec at cluster scale.
 
-Runs the virtual-clock simulator (no JAX, no chips, pure engine hot
-path: PreFilter -> Filter over all nodes -> Score -> Reserve -> bind)
-over a synthetic Poisson trace at 32, 128, 512, 1024, and 2048 nodes
-(8192 chips) and writes ENGINE_BENCH.json at the repo root.
-tests/test_engine_bench.py asserts a regression floor against a fresh
-in-process run, and that this artifact stays in sync with the tool.
+Three modes (``--mode idle|backlog|gang|all``), one artifact
+(ENGINE_BENCH.json at the repo root), regression floors asserted by
+tests/test_engine_bench.py:
 
-The 512-node row is what the feasible-node sampling exists for
-(plugin.py percentage_of_nodes_to_score): without it the engine's
-per-pod cost is O(nodes) and 512 nodes ran at ~125 placements/s.
-The incremental feasibility index + score memo (cell.py NodeModelAgg,
-plugin.py _score_cache) is what flattens the residual slope sampling
-left: the artifact's ``scaling_ratio_1024_over_32`` line is the
-headline — 1.0 means per-pod cost no longer grows with cluster size.
-Each row carries the index counters (fast hits vs slow walks, score
-cache hits/misses, invalidations/rebuilds) so a silently-disabled
-fast path shows up in the artifact, not just in wall time.
+- **idle** — the PR-1 headline: a Poisson trace against an unloaded
+  32..2048-node cluster, pure engine hot path (PreFilter -> Filter ->
+  Score -> Reserve -> bind). ``scaling_ratio_1024_over_32`` is the
+  flat-scaling claim: 1.0 means per-pod cost no longer grows with
+  cluster size. PR-5's delta-maintained aggregates + per-(node, shape)
+  score-cache eviction are what hold it up.
+- **backlog** — the PR-5 headline: every pod arrives at once and
+  oversubscribes the cluster, then the queue drains as capacity
+  frees. Run twice on the same commit — the sequential per-pod loop
+  vs the batched wave cycle with head-of-line backfill — and the
+  artifact records the drain-throughput speedup (the wave blocks the
+  unplaceable head, cheap-skips the equal-size tail, and backfills
+  strictly-smaller pods instead of rescanning the cluster for every
+  blocked pod every tick).
+- **gang** — gang-heavy saturation (co-scheduling barriers + backfill
+  behind blocked gang heads): same wave-vs-sequential A/B, plus the
+  proof counters ``backfill_binds`` (> 0: backfill actually fills)
+  and ``backfill_head_delays`` (== 0: it provably never delays the
+  head).
+
+Every row carries per-attempt latency percentiles (p50/p99 from the
+engine's ``attempt`` span histogram) and the index/score-cache/wave
+counters, so a silently-disabled fast path shows up in the artifact,
+not just in wall time.
+
+Measurement protocol: rows are run ``--reps`` times INTERLEAVED and
+the best (lowest-wall) rep is kept per row — CI boxes share cores, and
+a slow neighbor must not read as an engine regression. Rates are
+virtual-clock-simulator wall time; cross-commit absolute numbers are
+only comparable on the same box (the ratios are the portable claim).
 
 Regenerate: ``make engine-bench`` (or ``python tools/engine_bench.py``).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -31,11 +49,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from kubeshare_tpu.sim.simulator import Simulator  # noqa: E402
-from kubeshare_tpu.sim.trace import generate_trace  # noqa: E402
+from kubeshare_tpu.sim.trace import (  # noqa: E402
+    generate_backlog_trace, generate_gang_trace, generate_trace,
+)
 from kubeshare_tpu.utils.trace import Tracer  # noqa: E402
 
 CHIPS_PER_NODE = 4
 EVENTS = 2000
+IDLE_NODES = (32, 128, 512, 1024, 2048)
+BACKLOG_NODES = 1024
+GANG_NODES = 128
 
 
 def topology(n_nodes: int) -> dict:
@@ -55,75 +78,289 @@ def topology(n_nodes: int) -> dict:
     }
 
 
-def run(n_nodes: int, events: int = EVENTS, seed: int = 0) -> dict:
-    trace = generate_trace(count=events, seed=seed)
+def _simulate(n_nodes, trace, use_waves, backfill, explain_capacity=512):
     tracer = Tracer(keep_events=False)
     sim = Simulator(
         topology(n_nodes),
         {f"node-{i:03d}": CHIPS_PER_NODE for i in range(n_nodes)},
-        seed=seed,
+        seed=0,
         tracer=tracer,
+        use_waves=use_waves,
+        backfill=backfill,
+        explain_capacity=explain_capacity,
     )
     wall0 = time.perf_counter()
     report = sim.run(trace)
     wall = time.perf_counter() - wall0
-    attempts = tracer.histograms.get("prefilter")
+    return sim, report, tracer, wall
+
+
+def _row(n_nodes, trace, use_waves=True, backfill=False,
+         explain_capacity=512, events=None):
+    sim, report, tracer, wall = _simulate(
+        n_nodes, trace, use_waves, backfill, explain_capacity
+    )
+    attempts = tracer.histograms.get("attempt")
     engine = sim.engine
     tree = engine.tree
     return {
         "nodes": n_nodes,
         "chips": n_nodes * CHIPS_PER_NODE,
-        "events": events,
+        "events": events if events is not None else len(trace),
         "bound": report.bound,
         "wall_seconds": round(wall, 3),
         "placements_per_sec": round(report.bound / wall, 1),
         "schedule_attempts_per_sec": round(
             (attempts.count if attempts else 0) / wall, 1
         ),
+        # per-attempt latency from the engine's own span histogram
+        # (bucket upper bounds — log-spaced 10us..10s)
+        "attempt_p50_us": round(
+            (attempts.quantile(0.5) if attempts else 0.0) * 1e6, 1
+        ),
+        "attempt_p99_us": round(
+            (attempts.quantile(0.99) if attempts else 0.0) * 1e6, 1
+        ),
         "counters": {
             "filter_fast_hits": tree.filter_fast_hits,
             "filter_slow_walks": tree.filter_slow_walks,
             "index_invalidations": tree.agg_invalidations,
             "index_rebuilds": tree.agg_rebuilds,
+            "index_builds": tree.agg_builds,
+            "index_delta_updates": tree.agg_delta_updates,
             "score_cache_hits": engine.score_cache_hits,
             "score_cache_misses": engine.score_cache_misses,
+            "score_cache_evictions": engine.score_cache_evictions,
+            "waves": engine.wave_count,
+            "backfill_binds": engine.backfill_binds,
+            "backfill_head_delays": engine.backfill_head_delays,
+        },
+        "wave_phase_seconds": {
+            k: round(v, 3)
+            for k, v in engine.wave_phase_seconds.items()
         },
     }
 
 
-def main() -> None:
-    results = [run(32), run(128), run(512), run(1024), run(2048)]
-    by_nodes = {r["nodes"]: r for r in results}
+def _best_of(reps, make_rows):
+    """Run ``make_rows()`` (a list of (key, thunk) pairs) ``reps``
+    times interleaved; keep the lowest-wall row per key."""
+    best = {}
+    for _ in range(max(1, reps)):
+        for key, thunk in make_rows():
+            row = thunk()
+            if key not in best or \
+                    row["wall_seconds"] < best[key]["wall_seconds"]:
+                best[key] = row
+    return best
+
+
+def run(n_nodes: int, events: int = EVENTS, seed: int = 0,
+        use_waves: bool = True) -> dict:
+    """One idle-mode row (also the in-suite fresh-run floor entry
+    point: tests/test_engine_bench.py)."""
+    trace = generate_trace(count=events, seed=seed)
+    return _row(n_nodes, trace, use_waves=use_waves, events=events)
+
+
+def idle_mode(reps: int) -> dict:
+    def rows():
+        return [
+            (n, (lambda n=n: run(n))) for n in IDLE_NODES
+        ]
+
+    best = _best_of(reps, rows)
+    results = [best[n] for n in IDLE_NODES]
     ratio = round(
-        by_nodes[1024]["placements_per_sec"]
-        / by_nodes[32]["placements_per_sec"],
-        3,
+        best[1024]["placements_per_sec"]
+        / best[32]["placements_per_sec"], 3,
     )
+    return {"results": results, "scaling_ratio_1024_over_32": ratio}
+
+
+def backlog_mode(reps: int) -> dict:
+    """Same-commit A/B: the saturated drain through the wave cycle
+    (backfill on) vs the PR-4-style sequential loop."""
+    count = BACKLOG_NODES * 3  # ~112% of chip capacity
+    trace = generate_backlog_trace(count=count)
+
+    def rows():
+        return [
+            ("wave", lambda: _row(
+                BACKLOG_NODES, trace, use_waves=True, backfill=True,
+            )),
+            ("sequential", lambda: _row(
+                BACKLOG_NODES, trace, use_waves=False,
+            )),
+        ]
+
+    best = _best_of(reps, rows)
+    speedup = round(
+        best["wave"]["placements_per_sec"]
+        / best["sequential"]["placements_per_sec"], 2,
+    )
+    return {
+        "nodes": BACKLOG_NODES,
+        "events": count,
+        "wave": best["wave"],
+        "sequential": best["sequential"],
+        "speedup_wave_over_sequential": speedup,
+    }
+
+
+def gang_mode(reps: int) -> dict:
+    """Gang-heavy saturation: co-scheduling barriers + head-of-line
+    backfill. Gang members are x4 multi-chip guarantee pods (the
+    shape fragmentation blocks), the background is fractional churn
+    that fragments nodes — so gang heads genuinely block and the
+    fractional tail backfills behind them."""
+    trace = generate_gang_trace(
+        gangs=GANG_NODES // 2, gang_sizes=(2, 4),
+        background=GANG_NODES * 4,
+        mean_interarrival=0.5, mean_runtime=240.0, seed=0,
+        gang_chips=4.0,
+    )
+
+    def rows():
+        return [
+            ("wave", lambda: _row(
+                GANG_NODES, trace, use_waves=True, backfill=True,
+            )),
+            ("sequential", lambda: _row(
+                GANG_NODES, trace, use_waves=False,
+            )),
+        ]
+
+    best = _best_of(reps, rows)
+    speedup = round(
+        best["wave"]["placements_per_sec"]
+        / best["sequential"]["placements_per_sec"], 2,
+    )
+    return {
+        "nodes": GANG_NODES,
+        "wave": best["wave"],
+        "sequential": best["sequential"],
+        "speedup_wave_over_sequential": speedup,
+    }
+
+
+def journal_ab(reps: int) -> dict:
+    """Satellite A/B: the explain/journal feed gated off entirely
+    (--explain-capacity 0) vs on, idle trace at 1024 nodes — the
+    journal's hot-path overhead, measured not asserted."""
+    trace = generate_trace(count=EVENTS, seed=0)
+
+    def rows():
+        return [
+            ("on", lambda: _row(1024, trace, explain_capacity=512)),
+            ("off", lambda: _row(1024, trace, explain_capacity=0)),
+        ]
+
+    best = _best_of(reps, rows)
+    on = best["on"]["placements_per_sec"]
+    off = best["off"]["placements_per_sec"]
+    return {
+        "nodes": 1024,
+        "journal_on_placements_per_sec": on,
+        "journal_off_placements_per_sec": off,
+        "journal_overhead_pct": round(100.0 * (off - on) / off, 1),
+    }
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--mode", choices=("idle", "backlog", "gang", "all"),
+        default="all",
+    )
+    parser.add_argument(
+        "--reps", type=int, default=3,
+        help="interleaved repetitions per row; best (lowest-wall) "
+             "rep kept — noisy-neighbor defense on shared CI boxes",
+    )
+    parser.add_argument(
+        "--out", default=os.path.join(REPO, "ENGINE_BENCH.json"),
+    )
+    args = parser.parse_args(argv)
+
     doc = {
         "generated_by": "tools/engine_bench.py",
         "note": "virtual-clock simulator; engine hot path only "
-                "(no apiserver, no JAX). Regression floors asserted by "
-                "tests/test_engine_bench.py.",
-        "scaling_ratio_1024_over_32": ratio,
-        "results": results,
+                "(no apiserver, no JAX). Rows are best-of-N "
+                "interleaved reps (lowest wall). Regression floors "
+                "asserted by tests/test_engine_bench.py.",
     }
-    out = os.path.join(REPO, "ENGINE_BENCH.json")
-    with open(out, "w") as f:
+    if os.path.exists(args.out):
+        try:
+            doc = json.load(open(args.out))
+            doc["generated_by"] = "tools/engine_bench.py"
+        except Exception:
+            pass
+
+    if args.mode in ("idle", "all"):
+        idle = idle_mode(args.reps)
+        doc["results"] = idle["results"]
+        doc["scaling_ratio_1024_over_32"] = \
+            idle["scaling_ratio_1024_over_32"]
+        for r in idle["results"]:
+            c = r["counters"]
+            print(
+                f"idle {r['nodes']:4d} nodes: "
+                f"{r['placements_per_sec']:,.0f} placements/s "
+                f"p50={r['attempt_p50_us']:.0f}us "
+                f"p99={r['attempt_p99_us']:.0f}us  "
+                f"[fast={c['filter_fast_hits']:,} "
+                f"slow={c['filter_slow_walks']:,} "
+                f"score-hit={c['score_cache_hits']:,} "
+                f"score-miss={c['score_cache_misses']:,} "
+                f"deltas={c['index_delta_updates']:,} "
+                f"rebuilds={c['index_rebuilds']:,}]"
+            )
+        print(
+            "idle scaling ratio (1024/32): "
+            f"{doc['scaling_ratio_1024_over_32']}"
+        )
+
+    if args.mode in ("backlog", "all"):
+        doc["backlog"] = backlog_mode(args.reps)
+        b = doc["backlog"]
+        print(
+            f"backlog {b['nodes']} nodes: wave "
+            f"{b['wave']['placements_per_sec']:,.0f}/s vs sequential "
+            f"{b['sequential']['placements_per_sec']:,.0f}/s -> "
+            f"{b['speedup_wave_over_sequential']}x "
+            f"(backfill_binds={b['wave']['counters']['backfill_binds']}, "
+            f"head_delays="
+            f"{b['wave']['counters']['backfill_head_delays']})"
+        )
+
+    if args.mode in ("gang", "all"):
+        doc["gang"] = gang_mode(args.reps)
+        g = doc["gang"]
+        print(
+            f"gang {g['nodes']} nodes: wave "
+            f"{g['wave']['placements_per_sec']:,.0f}/s vs sequential "
+            f"{g['sequential']['placements_per_sec']:,.0f}/s -> "
+            f"{g['speedup_wave_over_sequential']}x "
+            f"(backfill_binds={g['wave']['counters']['backfill_binds']}, "
+            f"head_delays="
+            f"{g['wave']['counters']['backfill_head_delays']})"
+        )
+
+    if args.mode == "all":
+        doc["journal_ab"] = journal_ab(args.reps)
+        j = doc["journal_ab"]
+        print(
+            f"journal A/B @1024: on "
+            f"{j['journal_on_placements_per_sec']:,.0f}/s, off "
+            f"{j['journal_off_placements_per_sec']:,.0f}/s "
+            f"({j['journal_overhead_pct']}% overhead)"
+        )
+
+    with open(args.out, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")
-    for r in results:
-        c = r["counters"]
-        print(
-            f"{r['nodes']:4d} nodes: {r['placements_per_sec']:,.0f} "
-            f"placements/s, {r['schedule_attempts_per_sec']:,.0f} "
-            f"attempts/s  [fast={c['filter_fast_hits']:,} "
-            f"slow={c['filter_slow_walks']:,} "
-            f"score-hit={c['score_cache_hits']:,} "
-            f"score-miss={c['score_cache_misses']:,} "
-            f"rebuilds={c['index_rebuilds']:,}]"
-        )
-    print(f"scaling ratio (1024-node / 32-node placements/s): {ratio}")
-    print(f"wrote {out}")
+    print(f"wrote {args.out}")
 
 
 if __name__ == "__main__":
