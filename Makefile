@@ -38,6 +38,13 @@ autoscale-sim:
 explain-report:
 	$(PYTHON) tools/explain_report.py
 
+# closed-loop request-plane replay on a diurnal request trace ->
+# SERVING_LOOP.json (router backlog -> no-free-slot demand ->
+# replica deltas -> scheduler-placed serving pods; fixed-replica
+# baseline for the A/B)
+serving-sim:
+	$(PYTHON) tools/serving_sim.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -82,4 +89,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim explain-report dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim explain-report serving-sim dryrun images push save kind-e2e perf-evidence clean

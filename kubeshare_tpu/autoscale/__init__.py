@@ -30,12 +30,12 @@ a starved guaranteed tenant's deficit vs a fixed-capacity baseline.
 from .actuator import DryRunActuator
 from .demand import (
     REASON_FRAGMENTATION, REASON_GANG_WAITING, REASON_NO_FEASIBLE_CELL,
-    REASON_OVER_QUOTA, DemandEntry, DemandLedger,
+    REASON_NO_FREE_SLOT, REASON_OVER_QUOTA, DemandEntry, DemandLedger,
 )
 from .planner import CapacityPlanner
 from .recommend import (
     DrainCandidate, ModelCapacity, ModelPlan, PlannerSnapshot,
-    Recommendation, Recommender,
+    Recommendation, Recommender, ServingCapacity, ServingPlan,
 )
 
 __all__ = [
@@ -49,8 +49,11 @@ __all__ = [
     "PlannerSnapshot",
     "Recommendation",
     "Recommender",
+    "ServingCapacity",
+    "ServingPlan",
     "REASON_FRAGMENTATION",
     "REASON_GANG_WAITING",
     "REASON_NO_FEASIBLE_CELL",
+    "REASON_NO_FREE_SLOT",
     "REASON_OVER_QUOTA",
 ]

@@ -92,6 +92,18 @@ class DryRunActuator:
             "starved_deficit_chips": dict(
                 sorted(rec.starved_deficit_chips.items())
             ),
+            "serving": [
+                {
+                    "model": p.model,
+                    "current_replicas": p.current_replicas,
+                    "target_replicas": p.target_replicas,
+                    "delta_replicas": p.delta_replicas,
+                    "slot_deficit": p.slot_deficit,
+                    "free_slots": p.free_slots,
+                    "reasons": list(p.reasons),
+                }
+                for p in rec.serving
+            ],
         }
         if demand is not None:
             doc["demand"] = [
@@ -143,6 +155,23 @@ class DryRunActuator:
                     f"  - {json.dumps(reason)}" for reason in plan.reasons
                 ]
             docs.append("\n".join(lines))
+        for plan in rec.serving:
+            if not plan.delta_replicas:
+                continue
+            emitted += 1
+            docs.append("\n".join([
+                "---",
+                "apiVersion: kubeshare.tpu/v1alpha1",
+                "kind: ServingReplicaPatch",
+                "metadata:",
+                f"  name: serving-{plan.model}",
+                "spec:",
+                f"  model: {plan.model}",
+                f"  currentReplicas: {plan.current_replicas}",
+                f"  targetReplicas: {plan.target_replicas}",
+                f"  deltaReplicas: {plan.delta_replicas}",
+                f"  slotDeficit: {plan.slot_deficit}",
+            ]))
         if not emitted:
             docs.append("---\n# no changes recommended this round")
         return "\n".join(docs) + "\n"
@@ -206,4 +235,20 @@ class DryRunActuator:
                 "tpu_scheduler_autoscale_starved_deficit_chips",
                 {"tenant": tenant}, chips,
             ))
+        for plan in rec.serving:
+            labels = {"model": plan.model}
+            samples += [
+                expfmt.Sample(
+                    "tpu_scheduler_autoscale_serving_replicas", labels,
+                    plan.current_replicas,
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_autoscale_serving_target_replicas",
+                    labels, plan.target_replicas,
+                ),
+                expfmt.Sample(
+                    "tpu_scheduler_autoscale_serving_slot_deficit",
+                    labels, plan.slot_deficit,
+                ),
+            ]
         return samples

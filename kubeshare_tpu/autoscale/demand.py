@@ -23,6 +23,14 @@ admission math can never disagree) plus a reason code:
 - ``gang-waiting``           — reserved and parked at the Permit
   barrier waiting for gang members; capacity is held, the rest of
   the gang's demand is what is pending.
+- ``no-free-slot``           — the request plane's backlog: user
+  requests waiting because no DecodeServer replica has a free decode
+  slot (kubeshare_tpu/serving). Filed per served model by the
+  RequestRouter, sized in chips as ``queued x chips-per-slot``; the
+  recommender's slot-sizing term converts it into serving-pod
+  replicas — NOT nodes directly, which is why it joins neither
+  UNPLACED_REASONS nor the quota term (the replica pods themselves
+  file ordinary placement demand once submitted).
 
 The ledger is scheduling-thread-owned scratch state (like the defrag
 holds): it is rebuilt by the next pass after a restart, never
@@ -42,12 +50,14 @@ REASON_OVER_QUOTA = "over-quota"
 REASON_NO_FEASIBLE_CELL = "no-feasible-cell"
 REASON_FRAGMENTATION = "fragmentation-blocked"
 REASON_GANG_WAITING = "gang-waiting"
+REASON_NO_FREE_SLOT = "no-free-slot"
 
 REASONS = (
     REASON_OVER_QUOTA,
     REASON_NO_FEASIBLE_CELL,
     REASON_FRAGMENTATION,
     REASON_GANG_WAITING,
+    REASON_NO_FREE_SLOT,
 )
 
 # reasons that mean "admitted but unplaceable" — capacity the cluster
@@ -77,7 +87,10 @@ def shape_of(req) -> str:
     """Chip-shape bucket key for a requirement: whole-chip pods bucket
     by count (an x4 pod needs a very different node than an x1), all
     fractional pods share one bucket (any leaf with headroom serves
-    them)."""
+    them). Serving-plane slot demand (SlotDemand) buckets as
+    ``slots`` — it is not a chip shape at all."""
+    if getattr(req, "serving_slots", 0):
+        return "slots"
     from ..scheduler.labels import PodKind
 
     if req.kind == PodKind.MULTI_CHIP:
@@ -205,18 +218,49 @@ class DemandLedger:
 
     @staticmethod
     def resolve_models(entries: Iterable[DemandEntry],
-                       models: List[str]) -> List[DemandEntry]:
+                       models: List[str],
+                       capacity=None) -> List[DemandEntry]:
         """Rewrite model-agnostic ("*") entries to a concrete model so
-        the per-model sizing math has somewhere to put them: the only
-        model when there is one, else the first sorted model
-        (deterministic; a multi-model cluster that relies on "*"
-        demand should label its pods)."""
+        the per-model sizing math has somewhere to put them.
+
+        With ``capacity`` (a ``{model: ModelCapacity}`` map, the
+        planner snapshot's) the target is the CHEAPEST model that fits
+        the entry's shape: an ``xN`` entry needs a node template of at
+        least N chips, and among fitting models the smallest template
+        wins (fewest chips a scale-up must buy), name-sorted for a
+        deterministic tie-break. A mixed v5e/v6e fleet therefore sends
+        an x8 "*" entry to the v6e pool instead of uselessly growing
+        4-chip v5e nodes — the first-sorted-model rewrite this
+        replaces did exactly that. Entries NO model fits fall back to
+        the cheapest template (the pool-headroom clamp will surface
+        the impossibility). Without ``capacity`` the first sorted
+        model is kept for determinism with legacy callers."""
         if not models:
             return [e for e in entries if e.model != "*"]
-        target = models[0]
+
+        def template(model: str) -> int:
+            cap = capacity.get(model) if capacity else None
+            return cap.chips_per_node if cap is not None else 0
+
+        def fits(model: str, entry: DemandEntry) -> bool:
+            if capacity is None:
+                return True
+            if entry.shape.startswith("x"):
+                try:
+                    need = int(entry.shape[1:])
+                except ValueError:
+                    return True
+                return template(model) >= need
+            return template(model) > 0
+
+        ordered = sorted(models, key=lambda m: (template(m), m))
         out = []
         for e in entries:
             if e.model == "*":
+                fitting = [m for m in ordered if fits(m, e)]
+                target = fitting[0] if fitting else (
+                    ordered[0] if capacity is not None else models[0]
+                )
                 e = replace(e, model=target)
             out.append(e)
         return out

@@ -35,10 +35,16 @@ from .recommend import (
 
 class CapacityPlanner:
     def __init__(self, engine, recommender: Optional[Recommender] = None,
-                 actuator: Optional[DryRunActuator] = None):
+                 actuator: Optional[DryRunActuator] = None, router=None):
+        """``router`` (serving.RequestRouter, optional) adds the
+        request plane's fifth surface: per-served-model replica /
+        slot / backlog rows (``capacity_snapshot()``) that feed the
+        recommender's slot-sizing term alongside the ``no-free-slot``
+        entries the router files into the engine's demand ledger."""
         self.engine = engine
         self.recommender = recommender or Recommender()
         self.actuator = actuator or DryRunActuator()
+        self.router = router
 
     # -- snapshot -----------------------------------------------------
 
@@ -131,6 +137,8 @@ class CapacityPlanner:
             guaranteed_fraction=guaranteed_fraction,
             deficits=deficits,
             drains=drains,
+            serving=(self.router.capacity_snapshot()
+                     if self.router is not None else ()),
         )
 
     def _drain_candidates(

@@ -45,7 +45,9 @@ import math
 from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
-from .demand import UNPLACED_REASONS, DemandEntry, DemandLedger
+from .demand import (
+    REASON_NO_FREE_SLOT, UNPLACED_REASONS, DemandEntry, DemandLedger,
+)
 
 _EPS = 1e-9
 
@@ -71,6 +73,23 @@ class DrainCandidate:
 
 
 @dataclass(frozen=True)
+class ServingCapacity:
+    """The request plane's side of the snapshot: one row per SERVED
+    model (router capacity_snapshot()), the way ModelCapacity is one
+    row per chip model. ``model`` is the served model id — the
+    slot-sizing term matches it against ``no-free-slot`` demand
+    entries, never against chip models."""
+
+    model: str
+    replicas: int           # live registered replicas
+    slots_per_replica: int  # template (cold start: router default)
+    total_slots: int
+    free_slots: int
+    queued: int             # backlog at snapshot time
+    replica_chips: float    # chips one serving pod requests
+
+
+@dataclass(frozen=True)
 class PlannerSnapshot:
     now: float
     total_chips: float                     # cluster bound chips (quota denominator)
@@ -80,6 +99,7 @@ class PlannerSnapshot:
     guaranteed_fraction: Dict[str, float]  # tenant -> g (configured only)
     deficits: Dict[str, float]             # tenant -> guaranteed deficit chips
     drains: Tuple[DrainCandidate, ...] = ()
+    serving: Tuple[ServingCapacity, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -96,6 +116,24 @@ class ModelPlan:
 
 
 @dataclass(frozen=True)
+class ServingPlan:
+    """Slot-sizing output: serving-pod replica deltas per served
+    model. The planner does NOT create pods — whoever actuates
+    (ServingLoopSim's controller, a live Deployment-scaler) submits
+    ``delta_replicas`` serving pods and the ordinary scheduler places
+    them; their chip demand then flows through the normal quota /
+    placement terms if the pool is short."""
+
+    model: str
+    current_replicas: int
+    target_replicas: int
+    delta_replicas: int            # >0 add replicas, <0 retire
+    slot_deficit: int              # backlog slots the sizing saw
+    free_slots: int
+    reasons: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
 class Recommendation:
     at: float
     plans: Tuple[ModelPlan, ...]
@@ -103,6 +141,7 @@ class Recommendation:
     # pending guarantee demand) per tenant — 0 for a tenant that is
     # merely idle under its guarantee
     starved_deficit_chips: Dict[str, float] = field(default_factory=dict)
+    serving: Tuple[ServingPlan, ...] = ()
 
 
 class Recommender:
@@ -113,20 +152,42 @@ class Recommender:
         down_stable_s: float = 120.0,
         max_surge_nodes: int = 2,
         min_nodes: int = 1,
+        serving_up_cooldown_s: float = 30.0,
+        serving_down_cooldown_s: float = 120.0,
+        serving_down_stable_s: float = 60.0,
+        max_surge_replicas: int = 2,
+        min_replicas: int = 1,
+        serving_spare_slots: int = 0,
     ):
         if max_surge_nodes < 1:
             raise ValueError(
                 f"max_surge_nodes must be >= 1, got {max_surge_nodes}"
+            )
+        if max_surge_replicas < 1:
+            raise ValueError(
+                f"max_surge_replicas must be >= 1, got {max_surge_replicas}"
             )
         self.up_cooldown_s = up_cooldown_s
         self.down_cooldown_s = down_cooldown_s
         self.down_stable_s = down_stable_s
         self.max_surge_nodes = max_surge_nodes
         self.min_nodes = min_nodes
+        # serving (slot-sizing) knobs: replicas are cheap relative to
+        # nodes — one pod, no hardware — so the default cadence is
+        # faster in both directions
+        self.serving_up_cooldown_s = serving_up_cooldown_s
+        self.serving_down_cooldown_s = serving_down_cooldown_s
+        self.serving_down_stable_s = serving_down_stable_s
+        self.max_surge_replicas = max_surge_replicas
+        self.min_replicas = min_replicas
+        self.serving_spare_slots = serving_spare_slots
         self._last_up: Dict[str, float] = {}     # model -> last up round
         self._last_down: Dict[str, float] = {}   # model -> last down round
         self._drainable_since: Dict[str, float] = {}  # node -> first seen
         self._drain_model: Dict[str, str] = {}   # node -> model tracked under
+        self._serving_last_up: Dict[str, float] = {}
+        self._serving_last_down: Dict[str, float] = {}
+        self._surplus_since: Dict[str, float] = {}  # served model -> t0
 
     # -- sizing terms -------------------------------------------------
 
@@ -142,6 +203,10 @@ class Recommender:
             demand = sum(
                 e.chips for e in entries
                 if e.tenant == tenant and e.guarantee and e.model == model
+                # slot backlog is not chip demand: it sizes REPLICAS
+                # (the serving term); the replica pods file their own
+                # chip demand once submitted
+                and e.reason != REASON_NO_FREE_SLOT
             )
             if demand <= 0:
                 continue
@@ -165,7 +230,9 @@ class Recommender:
 
     def recommend(self, snap: PlannerSnapshot) -> Recommendation:
         models = sorted(snap.capacity)
-        entries = DemandLedger.resolve_models(list(snap.demand), models)
+        entries = DemandLedger.resolve_models(
+            list(snap.demand), models, capacity=snap.capacity
+        )
         now = snap.now
 
         plans: List[ModelPlan] = []
@@ -234,7 +301,98 @@ class Recommender:
             at=now,
             plans=tuple(plans),
             starved_deficit_chips=self._starved(snap, entries),
+            serving=self._serving_plans(snap, entries),
         )
+
+    # -- the slot-sizing term -----------------------------------------
+
+    def _serving_plans(self, snap: PlannerSnapshot,
+                       entries: List[DemandEntry]) -> Tuple[ServingPlan, ...]:
+        """Convert ``no-free-slot`` backlog into serving-pod replica
+        deltas, per served model. Scale-up: enough replicas that the
+        backlog fits in their slots (``ceil(deficit_chips /
+        replica_chips)`` — the ledger entry's chips are
+        ``slots x chips-per-slot``, so this IS ``ceil(slots /
+        slots_per_replica)``), surge-clamped and cooled down like the
+        node path. Scale-down: a replica retires only after the pool
+        has held ``slots_per_replica + serving_spare_slots`` idle
+        slots beyond the backlog continuously for
+        ``serving_down_stable_s`` (hysteresis) — and never below
+        ``min_replicas``, never in a round that scales up."""
+        now = snap.now
+        plans: List[ServingPlan] = []
+        for cap in sorted(snap.serving, key=lambda s: s.model):
+            reasons: List[str] = []
+            deficit_chips = sum(
+                e.chips for e in entries
+                if e.model == cap.model
+                and e.reason == REASON_NO_FREE_SLOT
+            )
+            slot_deficit = cap.queued
+            up = 0
+            if deficit_chips > _EPS and cap.replica_chips > 0:
+                up = math.ceil(deficit_chips / cap.replica_chips)
+                if up > self.max_surge_replicas:
+                    reasons.append(
+                        f"max-surge clamp {up}->{self.max_surge_replicas}"
+                        " replicas"
+                    )
+                    up = self.max_surge_replicas
+                last = self._serving_last_up.get(cap.model)
+                if last is not None \
+                        and now - last < self.serving_up_cooldown_s:
+                    reasons.append(
+                        "replica scale-up cooldown "
+                        f"({self.serving_up_cooldown_s:.0f}s)"
+                    )
+                    up = 0
+
+            down = 0
+            surplus_slots = (
+                cap.free_slots - cap.queued - self.serving_spare_slots
+            )
+            if (up == 0 and deficit_chips <= _EPS
+                    and cap.slots_per_replica > 0
+                    and surplus_slots >= cap.slots_per_replica):
+                since = self._surplus_since.setdefault(cap.model, now)
+                if now - since >= self.serving_down_stable_s:
+                    down = min(
+                        surplus_slots // cap.slots_per_replica,
+                        self.max_surge_replicas,
+                        max(0, cap.replicas - self.min_replicas),
+                    )
+                    last = self._serving_last_down.get(cap.model)
+                    if down > 0 and last is not None \
+                            and now - last < self.serving_down_cooldown_s:
+                        reasons.append(
+                            "replica scale-down cooldown "
+                            f"({self.serving_down_cooldown_s:.0f}s)"
+                        )
+                        down = 0
+                    elif down == 0 and cap.replicas <= self.min_replicas:
+                        reasons.append(
+                            f"min-replicas floor ({self.min_replicas})"
+                        )
+            else:
+                # a busy blip resets the hysteresis streak, exactly
+                # like the node drain tracker
+                self._surplus_since.pop(cap.model, None)
+
+            if up > 0:
+                self._serving_last_up[cap.model] = now
+            if down > 0:
+                self._serving_last_down[cap.model] = now
+            delta = up - down
+            plans.append(ServingPlan(
+                model=cap.model,
+                current_replicas=cap.replicas,
+                target_replicas=cap.replicas + delta,
+                delta_replicas=delta,
+                slot_deficit=slot_deficit,
+                free_slots=cap.free_slots,
+                reasons=tuple(reasons),
+            ))
+        return tuple(plans)
 
     def _update_drain_streaks(self, snap: PlannerSnapshot,
                               model: str) -> List[DrainCandidate]:

@@ -245,12 +245,15 @@ class SchedulerMetrics:
     (scheduler.go [Filter]/[Score]/[Reserve] Infof)."""
 
     def __init__(self, clock=time.time, tracer=None, engine=None,
-                 elector=None, planner=None):
+                 elector=None, planner=None, router=None):
         self.clock = clock
         self.tracer = tracer
         self.engine = engine
         self.elector = elector
         self.planner = planner
+        # serving.RequestRouter (optional): merges the request plane's
+        # tpu_serving_* gauges/histograms into the same exposition
+        self.router = router
         self.decisions = {"bound": 0, "waiting": 0, "unschedulable": 0}
         self.passes = 0
         self.last_pass_seconds = 0.0
@@ -299,6 +302,8 @@ class SchedulerMetrics:
             samples += self.engine.utilization_samples()
         if self.planner is not None:
             samples += self.planner.samples()
+        if self.router is not None:
+            samples += self.router.samples()
         if self.tracer is not None:
             samples += self.tracer.metric_samples("tpu_scheduler_phase")
         return expfmt.render(samples)

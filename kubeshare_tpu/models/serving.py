@@ -38,6 +38,15 @@ from .llama import (
 )
 
 
+# admit_reason() refusal codes. The request plane
+# (kubeshare_tpu/serving) uses the same strings for its shed reasons
+# (shared vocabulary, not a shared import — the router must stay
+# importable without jax): pool-full is "retry later", oversized is
+# "never".
+REFUSE_POOL_FULL = "pool-full"
+REFUSE_OVERSIZED = "oversized-prompt"
+
+
 def _bucket(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if n <= b:
@@ -146,6 +155,30 @@ class DecodeServer:
     def free_slots(self) -> int:
         return self.active.count(False)
 
+    def can_admit(self) -> bool:
+        """True when a slot is free right now — the O(S) host-side
+        probe a router checks before paying ``admit``'s prefill."""
+        return False in self.active
+
+    def admit_reason(self, prompt_len: int) -> Optional[str]:
+        """Why ``admit`` would refuse a prompt of ``prompt_len``
+        tokens, WITHOUT doing any device work: ``None`` means admit
+        would take it right now; :data:`REFUSE_OVERSIZED` means no
+        amount of waiting helps (the prompt exceeds the largest
+        compile bucket — truncate or shard it); :data:`REFUSE_POOL_FULL`
+        means retry after a slot retires. The distinction is the whole
+        point: a router that only sees ``admit() -> None`` retries
+        oversized prompts forever, which is lying to the client. A
+        non-positive length is a caller bug, like admit's empty
+        prompt."""
+        if prompt_len <= 0:
+            raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+        if prompt_len > self.buckets[-1]:
+            return REFUSE_OVERSIZED
+        if False not in self.active:
+            return REFUSE_POOL_FULL
+        return None
+
     def admit(self, prompt: Sequence[int]):
         """Prefill ``prompt`` into a free slot. Returns ``(slot,
         first_token)`` — the first generated token, sampled from the
@@ -156,8 +189,11 @@ class DecodeServer:
         admitted right now or ever — the pool is full (retry after a
         slot retires) or the prompt exceeds the largest compile bucket
         (``self.buckets[-1]``; no amount of waiting helps — truncate
-        or shard the prompt). An empty prompt is a caller bug, not a
-        load condition, and raises ValueError."""
+        or shard the prompt). ``admit_reason(len(prompt))`` tells the
+        two apart cheaply BEFORE calling admit, so a serving loop can
+        shed oversized requests immediately instead of retrying them
+        forever. An empty prompt is a caller bug, not a load
+        condition, and raises ValueError."""
         if not prompt:
             raise ValueError("empty prompt")
         true_len = len(prompt)

@@ -1,0 +1,61 @@
+"""The request plane: users -> slots -> pods -> nodes.
+
+The repo grew both halves of a multi-tenant LLM serving stack without
+a wire between them: ``models/serving.py`` DecodeServer admits prompts
+into continuous-batching slots on one chip, and the cluster planes
+(placement, quota, autoscale) decide which pods run on which nodes.
+This package is the missing layer — the TPU-native analog of the
+reference framework's aggregator plane (PAPER.md layer 3: per-pod
+requirement export feeding placement):
+
+- ``registry`` — ``ReplicaRegistry``: the live roster of DecodeServer
+  replicas per served model, registered when a serving pod binds
+  (sim or daemon) and deregistered on delete/kill, with per-replica
+  free-slot counts the router spreads over.
+- ``router``   — ``RequestRouter``: admits user requests with
+  least-loaded / join-shortest-queue spread, a bounded per-replica
+  queue, and timeout-based shedding; distinguishes "retry later"
+  (pool full / queue timeout) from "never" (oversized prompt); files
+  unserved backlog into the autoscale ``DemandLedger`` under the
+  ``no-free-slot`` reason code — the signal the recommender's
+  slot-sizing term converts into serving-pod replicas, which the
+  scheduler then places and the router picks up.
+- ``sim``      — ``ServingLoopSim``: drives diurnal request arrival
+  curves against replicas backed by bound serving pods on the real
+  engine, closing the loop end to end. ``tools/serving_sim.py``
+  (``make serving-sim``) banks SERVING_LOOP.json: autoscaled replicas
+  vs a fixed baseline with TTFT / queue-wait percentiles, shed rate,
+  and slot-occupancy traces.
+"""
+
+from .registry import Replica, ReplicaRegistry
+from .router import (
+    SHED_OVERSIZED, SHED_POOL_FULL, SHED_TIMEOUT, Request, RequestRouter,
+    RouteResult, SlotDemand,
+)
+
+
+def __getattr__(name):
+    # ServingLoopSim resolves lazily (PEP 562): it drags in the
+    # FakeCluster test double and the full scheduler plugin, which a
+    # live daemon importing just the router must not pay for.
+    if name == "ServingLoopSim":
+        from .sim import ServingLoopSim
+
+        return ServingLoopSim
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
+__all__ = [
+    "Replica",
+    "ReplicaRegistry",
+    "Request",
+    "RequestRouter",
+    "RouteResult",
+    "ServingLoopSim",
+    "SlotDemand",
+    "SHED_OVERSIZED",
+    "SHED_POOL_FULL",
+    "SHED_TIMEOUT",
+]

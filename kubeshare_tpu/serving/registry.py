@@ -1,0 +1,147 @@
+"""The replica registry: which DecodeServer replicas are alive, per
+served model, and how many free slots each one has.
+
+A *replica* is one serving pod the cluster scheduler bound: a
+DecodeServer with ``slots`` continuous-batching lanes compiled once
+(models/serving.py). The registry is the router's routing table —
+registered when the pod binds (``ServingLoopSim`` does it from the
+bind decision; a live daemon would do it from the informer), and
+deregistered on delete/kill, at which point the router requeues every
+request the replica was holding so nothing is silently lost (the
+no-lost-slot invariant tests/test_serving_router.py pins).
+
+State is plain scheduling-thread-owned bookkeeping like the demand
+ledger: rebuilt from the informer after a restart, never persisted.
+The optional ``server`` reference carries a live DecodeServer for
+in-process serving; the sim registers slot counts only.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class Replica:
+    """One bound serving pod's routing state. ``busy`` maps request id
+    -> Request for every admitted-and-decoding request; ``queue``
+    holds admitted-but-waiting requests (bounded by the router's
+    ``queue_depth``)."""
+
+    __slots__ = (
+        "pod_key", "model", "slots", "chips", "max_prompt_len",
+        "server", "registered_at", "busy", "queue",
+    )
+
+    def __init__(self, pod_key: str, model: str, slots: int,
+                 chips: float = 1.0,
+                 max_prompt_len: Optional[int] = None,
+                 server=None, registered_at: float = 0.0):
+        if slots < 1:
+            raise ValueError(f"replica needs >= 1 slot, got {slots}")
+        self.pod_key = pod_key
+        self.model = model
+        self.slots = slots
+        self.chips = chips
+        self.max_prompt_len = max_prompt_len
+        self.server = server
+        self.registered_at = registered_at
+        self.busy: Dict[str, object] = {}
+        self.queue: deque = deque()
+
+    @property
+    def free_slots(self) -> int:
+        return self.slots - len(self.busy)
+
+    def fits_prompt(self, prompt_len: int) -> bool:
+        return (self.max_prompt_len is None
+                or prompt_len <= self.max_prompt_len)
+
+
+class ReplicaRegistry:
+    def __init__(self):
+        self._by_pod: Dict[str, Replica] = {}
+        self._by_model: Dict[str, Dict[str, Replica]] = {}
+
+    # -- membership ---------------------------------------------------
+
+    def register(self, pod_key: str, model: str, slots: int,
+                 chips: float = 1.0,
+                 max_prompt_len: Optional[int] = None,
+                 server=None, now: float = 0.0) -> Replica:
+        if pod_key in self._by_pod:
+            raise ValueError(f"replica {pod_key!r} already registered")
+        replica = Replica(pod_key, model, slots, chips=chips,
+                          max_prompt_len=max_prompt_len, server=server,
+                          registered_at=now)
+        self._by_pod[pod_key] = replica
+        self._by_model.setdefault(model, {})[pod_key] = replica
+        return replica
+
+    def register_server(self, pod_key: str, model: str, server,
+                        chips: float = 1.0,
+                        now: float = 0.0) -> Replica:
+        """Register a live DecodeServer: slot count and prompt ceiling
+        come from the server itself (``server.slots``, largest compile
+        bucket), so the routing table can never disagree with what the
+        server would actually admit."""
+        return self.register(
+            pod_key, model, server.slots, chips=chips,
+            max_prompt_len=server.buckets[-1], server=server, now=now,
+        )
+
+    def deregister(self, pod_key: str) -> Optional[Replica]:
+        """Remove the replica (pod deleted / killed). Returns it so
+        the router can requeue its queued AND in-flight requests —
+        the registry only forgets the pod; the conservation of its
+        requests is the router's job."""
+        replica = self._by_pod.pop(pod_key, None)
+        if replica is None:
+            return None
+        per_model = self._by_model.get(replica.model, {})
+        per_model.pop(pod_key, None)
+        if not per_model:
+            self._by_model.pop(replica.model, None)
+        return replica
+
+    # -- reads --------------------------------------------------------
+
+    def get(self, pod_key: str) -> Optional[Replica]:
+        return self._by_pod.get(pod_key)
+
+    def models(self) -> List[str]:
+        return sorted(self._by_model)
+
+    def replicas(self, model: str) -> List[Replica]:
+        """Name-sorted for deterministic tie-breaks in the router."""
+        return [
+            self._by_model[model][k]
+            for k in sorted(self._by_model.get(model, {}))
+        ]
+
+    def replica_count(self, model: str) -> int:
+        return len(self._by_model.get(model, {}))
+
+    def total_slots(self, model: str) -> int:
+        return sum(r.slots for r in self.replicas(model))
+
+    def free_slots(self, model: str) -> int:
+        return sum(r.free_slots for r in self.replicas(model))
+
+    def queued(self, model: str) -> int:
+        return sum(len(r.queue) for r in self.replicas(model))
+
+    def max_prompt_len(self, model: str) -> Optional[int]:
+        """The largest prompt ANY replica can take — the router's
+        oversized-shed threshold: a prompt no replica will EVER fit is
+        shed immediately instead of retrying forever. ``None`` means
+        no ceiling (no replicas, or at least one replica declares no
+        limit and therefore takes anything — shedding against the max
+        of the DECLARED limits would tell a servable request 'never
+        retry')."""
+        limits = []
+        for r in self.replicas(model):
+            if r.max_prompt_len is None:
+                return None
+            limits.append(r.max_prompt_len)
+        return max(limits) if limits else None

@@ -1,0 +1,619 @@
+"""The request router: spread user requests over replicas by free
+slots, queue briefly, shed honestly, and file what's left over as
+autoscale demand.
+
+Admission policy (tests/test_serving_router.py pins each rule):
+
+- **Least-loaded spread**: a request goes to the replica with the
+  MOST free slots among replicas whose compile buckets fit its prompt
+  (deterministic pod-key tie-break). The invariant: the router never
+  admits onto a replica while another replica has more free
+  slots.
+- **Join-shortest-queue**: with every slot busy, the request waits in
+  the shortest per-replica queue, bounded at ``queue_depth`` — a
+  bounded queue turns overload into fast "retry later" feedback
+  instead of unbounded latency.
+- **Shedding, honestly classified**: ``pool-full`` and
+  ``queue-timeout`` are *retry later* (more replicas fix them —
+  exactly what the demand ledger entry asks the autoscaler for);
+  ``oversized-prompt`` is *never* (no replica's largest compile
+  bucket fits it; retrying forever would be lying to the client —
+  the same contract DecodeServer.admit_reason exposes per server).
+- **Conservation**: every submitted request ends in exactly one of
+  served / shed / in-flight (decoding or queued). Replica kill
+  requeues both its queued and in-flight requests with their ORIGINAL
+  arrival times, so disruption stays visible in the wait metrics.
+
+Backlog that survives a ``tick`` becomes a ``no-free-slot`` entry in
+the DemandLedger — key ``slots::<model>`` (the ``::`` cannot
+appear in a real pod key, so a pod named after the model can never
+resolve the backlog entry), sized in chips as
+``queued x chips-per-slot`` — which the Recommender's slot-sizing
+term converts into serving-pod replicas. That is the whole loop:
+users -> slots -> pods -> nodes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..autoscale.demand import REASON_NO_FREE_SLOT
+from ..utils import expfmt
+from ..utils.trace import Histogram
+from .registry import Replica, ReplicaRegistry
+
+# Shed reason codes. The first two are load conditions a bigger pool
+# fixes (retryable); the last is a property of the request (never).
+# String values match models/serving.py DecodeServer.admit_reason —
+# shared vocabulary, not a shared import (the router must not drag
+# jax into the scheduler process).
+SHED_POOL_FULL = "pool-full"
+SHED_TIMEOUT = "queue-timeout"
+SHED_OVERSIZED = "oversized-prompt"
+
+# Request-scale latency buckets (seconds): TTFT and queue wait live in
+# the 50ms..minutes range — the scheduler's 1s..4h pod-wait buckets
+# are far too coarse for a serving SLO.
+SERVING_BUCKETS: Tuple[float, ...] = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+    300.0,
+)
+
+
+@dataclass(frozen=True)
+class SlotDemand:
+    """The req-like object serving backlog files into the DemandLedger
+    (``shape_of`` buckets it as ``"slots"``). ``model`` is the SERVED
+    model id, not a chip model: the recommender's slot-sizing term
+    matches it against the router's capacity snapshot, and the chip
+    planes never see it because serving entries are opportunistic
+    (``is_guarantee`` False keeps them out of the quota term) and
+    ``no-free-slot`` is not an UNPLACED reason (out of the placement
+    term) — chips flow through the REAL replica pods the scheduler
+    places instead."""
+
+    tenant: str
+    model: str
+    serving_slots: int
+    is_guarantee: bool = False
+
+
+@dataclass
+class Request:
+    rid: str
+    model: str
+    prompt_len: int
+    arrival: float
+    tenant: str = "default"
+    # optional live tokens: with a registered DecodeServer the router
+    # prefills on admission and hands back the first token
+    prompt: Optional[Sequence[int]] = None
+    # when the request LAST entered a queue (router-maintained):
+    # the timeout clock. Distinct from ``arrival`` — a request
+    # requeued by a replica kill keeps its arrival for the wait
+    # metrics but must not be charged its served time against the
+    # queue timeout, or kills amplify into spurious sheds.
+    queued_since: Optional[float] = None
+
+
+@dataclass(frozen=True)
+class RouteResult:
+    status: str               # admitted | queued | shed
+    replica: str = ""         # pod key (admitted/queued on a replica)
+    reason: str = ""          # shed reason code
+    retryable: bool = True    # shed only: retry later vs never
+    first_token: Optional[int] = None  # live DecodeServer admissions
+
+
+class _ModelCounts:
+    __slots__ = ("submitted", "served", "shed", "requeued", "admitted")
+
+    def __init__(self):
+        self.submitted = 0
+        self.served = 0
+        self.shed: Dict[str, int] = {}
+        self.requeued = 0
+        self.admitted = 0
+
+    def shed_total(self) -> int:
+        return sum(self.shed.values())
+
+
+@dataclass
+class _TickOutcome:
+    admitted: List[Tuple[Request, str]] = field(default_factory=list)
+    shed: List[Tuple[Request, str]] = field(default_factory=list)
+
+
+class RequestRouter:
+    def __init__(
+        self,
+        registry: Optional[ReplicaRegistry] = None,
+        demand=None,
+        queue_depth: int = 4,
+        queue_timeout_s: float = 30.0,
+        tenant: str = "serving",
+        default_max_prompt_len: Optional[int] = None,
+        replica_slots: int = 8,
+        replica_chips: float = 1.0,
+    ):
+        if queue_depth < 0:
+            raise ValueError(f"queue_depth must be >= 0, got {queue_depth}")
+        self.registry = registry or ReplicaRegistry()
+        self.demand = demand
+        self.queue_depth = queue_depth
+        self.queue_timeout_s = queue_timeout_s
+        self.tenant = tenant
+        self.default_max_prompt_len = default_max_prompt_len
+        # cold-start sizing defaults: what one serving pod would bring,
+        # used for demand conversion while no replica is live yet
+        self.replica_slots = replica_slots
+        self.replica_chips = replica_chips
+        # rid -> (pod_key, request, live server slot or None)
+        self._active: Dict[str, Tuple[str, Request, Optional[int]]] = {}
+        # model-level waiting room used only while NO replica
+        # exists (cold start / total kill) — bounded like one replica
+        self._unattached: Dict[str, deque] = {}
+        self._counts: Dict[str, _ModelCounts] = {}
+        self._wait_hist: Dict[str, Histogram] = {}
+        self._ttft_hist: Dict[str, Histogram] = {}
+
+    # -- membership (delegates + conservation) -----------------------
+
+    def register(self, pod_key: str, model: str, slots: int,
+                 chips: Optional[float] = None,
+                 max_prompt_len: Optional[int] = None,
+                 server=None, now: float = 0.0) -> Replica:
+        """A serving pod bound: it joins the routing table. The next
+        ``tick``/``complete`` dispatch pulls waiting requests onto it."""
+        return self.registry.register(
+            pod_key, model, slots,
+            chips=self.replica_chips if chips is None else chips,
+            max_prompt_len=(max_prompt_len
+                            if max_prompt_len is not None
+                            else self.default_max_prompt_len),
+            server=server, now=now,
+        )
+
+    def register_server(self, pod_key: str, model: str, server,
+                        chips: Optional[float] = None,
+                        now: float = 0.0) -> Replica:
+        return self.registry.register_server(
+            pod_key, model, server,
+            chips=self.replica_chips if chips is None else chips,
+            now=now,
+        )
+
+    def deregister(self, pod_key: str, now: float) -> List[str]:
+        """The replica's pod was deleted or killed. Its queued AND
+        in-flight requests are requeued (original arrival preserved —
+        the disruption must stay visible in the wait metrics); returns
+        the interrupted in-flight rids so the caller can cancel their
+        completions. Overflow that no surviving queue can hold is shed
+        ``pool-full`` — accounted, never lost."""
+        replica = self.registry.deregister(pod_key)
+        if replica is None:
+            return []
+        interrupted: List[str] = []
+        displaced: List[Request] = []
+        for rid in list(replica.busy):
+            entry = self._active.pop(rid, None)
+            if entry is None:
+                continue
+            interrupted.append(rid)
+            displaced.append(entry[1])
+        displaced.extend(replica.queue)
+        replica.busy.clear()
+        replica.queue.clear()
+        for req in displaced:
+            counts = self._model_counts(req.model)
+            counts.requeued += 1
+            # queue-only placement: admission happens at the next
+            # tick/complete dispatch, whose results the caller SEES —
+            # admitting here would start streams nobody schedules
+            # completions for
+            if self._enqueue(req, now=now) is None:
+                counts.shed[SHED_POOL_FULL] = (
+                    counts.shed.get(SHED_POOL_FULL, 0) + 1
+                )
+        return interrupted
+
+    # -- admission ----------------------------------------------------
+
+    def submit(self, req: Request, now: float) -> RouteResult:
+        counts = self._model_counts(req.model)
+        counts.submitted += 1
+        if self.registry.replica_count(req.model):
+            # live replicas define the ceiling; None = some replica
+            # takes anything, so "never" would be a lie
+            limit = self.registry.max_prompt_len(req.model)
+        else:
+            limit = self.default_max_prompt_len
+        if limit is not None and req.prompt_len > limit:
+            # "never": no replica's largest compile bucket fits it —
+            # shed immediately instead of retrying forever
+            counts.shed[SHED_OVERSIZED] = (
+                counts.shed.get(SHED_OVERSIZED, 0) + 1
+            )
+            return RouteResult("shed", reason=SHED_OVERSIZED,
+                               retryable=False)
+        result = self._route(req, now, counts)
+        if result is not None:
+            return result
+        counts.shed[SHED_POOL_FULL] = counts.shed.get(SHED_POOL_FULL, 0) + 1
+        return RouteResult("shed", reason=SHED_POOL_FULL, retryable=True)
+
+    def _route(self, req: Request, now: float,
+               counts: _ModelCounts) -> Optional[RouteResult]:
+        """Admit or queue ``req``; None = nowhere to put it (caller
+        decides what a refusal means — submit sheds, deregister
+        counts it against the kill)."""
+        fitting = [
+            r for r in self.registry.replicas(req.model)
+            if r.fits_prompt(req.prompt_len)
+        ]
+        if fitting:
+            best = min(fitting, key=lambda r: (-r.free_slots, r.pod_key))
+            if best.free_slots > 0:
+                return self._admit(best, req, now, counts)
+        placed = self._enqueue(req, fitting, now=now)
+        if placed is not None:
+            return RouteResult("queued", replica=placed)
+        return None
+
+    def _enqueue(self, req: Request,
+                 fitting: Optional[List[Replica]] = None,
+                 now: Optional[float] = None) -> Optional[str]:
+        """Queue ``req`` without admitting: shortest fitting bounded
+        queue (JSQ), else the cold-start waiting room. Returns the
+        chosen replica's pod key ("" for the waiting room), or None
+        when everything is full — the ONE queue-placement policy both
+        submit and the deregister requeue go through. Stamps
+        ``queued_since`` so the timeout clock starts at THIS
+        enqueue, not at first arrival."""
+        if now is not None:
+            req.queued_since = now
+        if fitting is None:
+            fitting = [
+                r for r in self.registry.replicas(req.model)
+                if r.fits_prompt(req.prompt_len)
+            ]
+        if fitting:
+            shortest = min(
+                fitting, key=lambda r: (len(r.queue), r.pod_key)
+            )
+            if len(shortest.queue) < self.queue_depth:
+                shortest.queue.append(req)
+                return shortest.pod_key
+            return None
+        waiting = self._unattached.setdefault(req.model, deque())
+        if len(waiting) < self.queue_depth:
+            waiting.append(req)
+            return ""
+        return None
+
+    def _admit(self, replica: Replica, req: Request, now: float,
+               counts: _ModelCounts) -> RouteResult:
+        wait = max(0.0, now - req.arrival)
+        self._hist(self._wait_hist, req.model).observe(wait)
+        first = None
+        slot = None
+        if replica.server is not None and req.prompt is not None:
+            import time
+
+            t0 = time.perf_counter()
+            out = replica.server.admit(list(req.prompt))
+            if out is None:
+                # the probe said yes but the server refused: treat as
+                # pool-full so the request stays accounted (defensive —
+                # the registry's slot mirror makes this unreachable)
+                counts.shed[SHED_POOL_FULL] = (
+                    counts.shed.get(SHED_POOL_FULL, 0) + 1
+                )
+                return RouteResult("shed", reason=SHED_POOL_FULL,
+                                   retryable=True)
+            slot, first = out
+            # a live admit prefills and samples the first token right
+            # here: TTFT = queue wait + the MEASURED prefill (the sim
+            # path adds its modeled prefill the same way — the two
+            # estimators must mean the same thing)
+            self.observe_ttft(
+                req.model, wait + (time.perf_counter() - t0)
+            )
+            if not replica.server.active[slot]:
+                # the server auto-retired at admit (eos first token /
+                # max_new=1): forget the slot NOW — by complete() time
+                # it may belong to another request, and retiring it
+                # there would kill that stream mid-decode
+                slot = None
+        replica.busy[req.rid] = req
+        self._active[req.rid] = (replica.pod_key, req, slot)
+        counts.admitted += 1
+        return RouteResult("admitted", replica=replica.pod_key,
+                           first_token=first)
+
+    # -- completion / dispatch ----------------------------------------
+
+    def complete(self, rid: str, now: float) -> List[Tuple[Request, str]]:
+        """The request's stream finished: free its slot and dispatch
+        waiting work onto the freed capacity. Returns the newly
+        admitted ``(request, pod_key)`` pairs (the sim schedules their
+        completions from this)."""
+        entry = self._active.pop(rid, None)
+        if entry is None:
+            return []
+        pod_key, req, slot = entry
+        self._model_counts(req.model).served += 1
+        replica = self.registry.get(pod_key)
+        if replica is not None:
+            replica.busy.pop(rid, None)
+            if (replica.server is not None and slot is not None
+                    and replica.server.active[slot]):
+                replica.server.retire(slot)
+        return self._dispatch(req.model, now)
+
+    def _dispatch(self, model: str, now: float) -> List[Tuple[Request, str]]:
+        """Fill free slots from the queues, least-loaded first. A
+        replica with free slots drains its own queue, then steals from
+        the LONGEST same-model queue (keeps JSQ balanced after a
+        retire burst), then the unattached waiting room."""
+        admitted: List[Tuple[Request, str]] = []
+        counts = self._model_counts(model)
+        while True:
+            open_replicas = [
+                r for r in self.registry.replicas(model) if r.free_slots > 0
+            ]
+            if not open_replicas:
+                return admitted
+            progress = False
+            for replica in sorted(
+                open_replicas, key=lambda r: (-r.free_slots, r.pod_key)
+            ):
+                req = self._take_for(replica, model)
+                if req is None:
+                    continue
+                result = self._admit(replica, req, now, counts)
+                if result.status == "admitted":
+                    admitted.append((req, replica.pod_key))
+                progress = True
+                break
+            if not progress:
+                return admitted
+
+    def _take_for(self, replica: Replica, model: str) -> Optional[Request]:
+        sources: List[deque] = [replica.queue]
+        sources += [
+            r.queue for r in sorted(
+                self.registry.replicas(model),
+                key=lambda r: (-len(r.queue), r.pod_key),
+            )
+            if r.pod_key != replica.pod_key
+        ]
+        waiting = self._unattached.get(model)
+        if waiting is not None:
+            sources.append(waiting)
+        for queue in sources:
+            for i, req in enumerate(queue):
+                if replica.fits_prompt(req.prompt_len):
+                    del queue[i]
+                    return req
+        return None
+
+    # -- the periodic tick --------------------------------------------
+
+    def tick(self, now: float) -> _TickOutcome:
+        """Dispatch onto any free capacity (e.g. replicas registered
+        since the last event), shed what waiting cannot fix, and
+        reconcile the demand ledger: per model, the surviving backlog
+        becomes ONE ``no-free-slot`` entry sized in chips; a drained
+        backlog resolves it.
+
+        Order matters: dispatch FIRST — a request a free slot can
+        take right now must never be timeout-shed while that slot
+        idles. Then the fleet-fit recheck: a queued request NO current
+        replica's bucket fits (it slipped into the cold-start waiting
+        room before replicas existed, or the one big-bucket replica
+        deregistered) sheds ``oversized-prompt``, non-retryable —
+        ``_take_for`` would skip it forever while it inflated the
+        backlog into pointless replica scale-up. Last the timeout,
+        against ``queued_since`` (time in THIS queue), not arrival —
+        a kill-requeued request is not charged its served time."""
+        out = _TickOutcome()
+        for model in self._models_tracked():
+            counts = self._model_counts(model)
+            out.admitted.extend(self._dispatch(model, now))
+            fleet = self.registry.replicas(model)
+            for queue in self._queues(model):
+                kept: List[Request] = []
+                for req in queue:
+                    if fleet and not any(
+                        r.fits_prompt(req.prompt_len) for r in fleet
+                    ):
+                        reason = SHED_OVERSIZED
+                    elif now - (
+                        req.queued_since if req.queued_since is not None
+                        else req.arrival
+                    ) >= self.queue_timeout_s:
+                        reason = SHED_TIMEOUT
+                    else:
+                        kept.append(req)
+                        continue
+                    counts.shed[reason] = counts.shed.get(reason, 0) + 1
+                    out.shed.append((req, reason))
+                queue.clear()
+                queue.extend(kept)
+            self._file_demand(model, now)
+        return out
+
+    def _file_demand(self, model: str, now: float) -> None:
+        if self.demand is None:
+            return
+        key = f"slots::{model}"
+        backlog = self.backlog(model)
+        if backlog > 0:
+            self.demand.note(
+                key,
+                SlotDemand(tenant=self.tenant, model=model,
+                           serving_slots=backlog),
+                REASON_NO_FREE_SLOT, now,
+                backlog * self.chips_per_slot(model), 0,
+            )
+        else:
+            self.demand.resolve(key)
+
+    # -- planner surface ----------------------------------------------
+
+    def chips_per_slot(self, model: str) -> float:
+        """Fleet-wide chips/slots ratio (totals, not replicas[0]): a
+        heterogeneous pool must not price its backlog off whichever
+        replica happens to sort first."""
+        replicas = self.registry.replicas(model)
+        total_slots = sum(r.slots for r in replicas)
+        if total_slots:
+            return sum(r.chips for r in replicas) / total_slots
+        return self.replica_chips / max(1, self.replica_slots)
+
+    def backlog(self, model: str) -> int:
+        return (self.registry.queued(model)
+                + len(self._unattached.get(model, ())))
+
+    def capacity_snapshot(self):
+        """Per-model ``ServingCapacity`` rows for PlannerSnapshot —
+        models with a backlog but no replica yet (cold start) report
+        with the configured replica template so the slot-sizing term
+        can size the FIRST replica too."""
+        from ..autoscale.recommend import ServingCapacity
+
+        rows = []
+        for model in self._models_tracked():
+            replicas = self.registry.replicas(model)
+            # fleet means (order-independent): what the NEXT replica
+            # of this pool is expected to bring
+            slots_per = (
+                max(1, round(sum(r.slots for r in replicas)
+                             / len(replicas)))
+                if replicas else self.replica_slots
+            )
+            chips = (
+                sum(r.chips for r in replicas) / len(replicas)
+                if replicas else self.replica_chips
+            )
+            rows.append(ServingCapacity(
+                model=model,
+                replicas=len(replicas),
+                slots_per_replica=slots_per,
+                total_slots=self.registry.total_slots(model),
+                free_slots=self.registry.free_slots(model),
+                queued=self.backlog(model),
+                replica_chips=chips,
+            ))
+        return tuple(sorted(rows, key=lambda r: r.model))
+
+    # -- accounting ---------------------------------------------------
+
+    def in_flight(self, model: str) -> int:
+        active = sum(
+            1 for (_, req, _) in self._active.values()
+            if req.model == model
+        )
+        return active + self.backlog(model)
+
+    def counts(self, model: str) -> dict:
+        c = self._model_counts(model)
+        return {
+            "submitted": c.submitted,
+            "served": c.served,
+            "shed": dict(sorted(c.shed.items())),
+            "shed_total": c.shed_total(),
+            "requeued": c.requeued,
+            "admitted": c.admitted,
+            "in_flight": self.in_flight(model),
+        }
+
+    def conservation(self, model: str) -> Tuple[int, int]:
+        """(submitted, served + shed + in-flight) — equal at all times
+        or the router lost a request (the property test's invariant)."""
+        c = self._model_counts(model)
+        return (c.submitted,
+                c.served + c.shed_total() + self.in_flight(model))
+
+    def observe_ttft(self, model: str, seconds: float) -> None:
+        """Time-to-first-token for one request. Live admissions call
+        this inline (prefill happens inside ``admit``); the sim adds
+        its modeled prefill on top of the queue wait."""
+        self._hist(self._ttft_hist, model).observe(seconds)
+
+    # -- metrics ------------------------------------------------------
+
+    def samples(self) -> List["expfmt.Sample"]:
+        samples: List[expfmt.Sample] = []
+        for model in self._models_tracked():
+            labels = {"model": model}
+            c = self._model_counts(model)
+            total = self.registry.total_slots(model)
+            free = self.registry.free_slots(model)
+            samples += [
+                expfmt.Sample("tpu_serving_replicas", labels,
+                              self.registry.replica_count(model)),
+                expfmt.Sample("tpu_serving_slots", labels, total),
+                expfmt.Sample("tpu_serving_slots_free", labels, free),
+                expfmt.Sample(
+                    "tpu_serving_slot_occupancy", labels,
+                    round((total - free) / total, 4) if total else 0.0,
+                ),
+                expfmt.Sample("tpu_serving_queue_depth", labels,
+                              self.backlog(model)),
+                expfmt.Sample("tpu_serving_requests_total",
+                              {**labels, "outcome": "served"}, c.served),
+                expfmt.Sample("tpu_serving_requests_total",
+                              {**labels, "outcome": "admitted"},
+                              c.admitted),
+                expfmt.Sample("tpu_serving_requeued_total", labels,
+                              c.requeued),
+            ]
+            for reason in (SHED_POOL_FULL, SHED_TIMEOUT, SHED_OVERSIZED):
+                samples.append(expfmt.Sample(
+                    "tpu_serving_shed_total",
+                    {**labels, "reason": reason},
+                    c.shed.get(reason, 0),
+                ))
+        for model, hist in sorted(self._wait_hist.items()):
+            samples += hist.samples(
+                "tpu_serving_queue_wait_seconds", {"model": model}
+            )
+        for model, hist in sorted(self._ttft_hist.items()):
+            samples += hist.samples(
+                "tpu_serving_ttft_seconds", {"model": model}
+            )
+        return samples
+
+    # -- internals ----------------------------------------------------
+
+    def _model_counts(self, model: str) -> _ModelCounts:
+        counts = self._counts.get(model)
+        if counts is None:
+            counts = self._counts[model] = _ModelCounts()
+        return counts
+
+    def _models_tracked(self) -> List[str]:
+        return sorted(
+            set(self.registry.models())
+            | set(self._counts)
+            | set(self._unattached)
+        )
+
+    def _queues(self, model: str) -> List[deque]:
+        queues = [r.queue for r in self.registry.replicas(model)]
+        waiting = self._unattached.get(model)
+        if waiting is not None:
+            queues.append(waiting)
+        return queues
+
+    @staticmethod
+    def _hist(store: Dict[str, Histogram], model: str) -> Histogram:
+        hist = store.get(model)
+        if hist is None:
+            hist = store[model] = Histogram(SERVING_BUCKETS)
+        return hist

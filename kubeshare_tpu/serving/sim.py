@@ -1,0 +1,411 @@
+"""The closed serving loop on a virtual clock: user requests ->
+decode slots -> serving pods -> nodes.
+
+``ServingLoopSim`` wires all five layers together against the REAL
+scheduler engine (TpuShareScheduler over a FakeCluster), the way
+sim/simulator.py does for batch pods:
+
+- serving pods are ordinary guarantee-class pods the engine places
+  onto node cells; when one BINDS, its replica registers with the
+  router's ReplicaRegistry (slots, chips, prompt ceiling) — the
+  request plane only ever routes onto capacity the cluster actually
+  granted;
+- user requests (sim/trace.RequestEvent rows, e.g. the diurnal curve)
+  flow through the RequestRouter: least-loaded admission, bounded
+  queues, timeout shedding; slot hold time is modeled as
+  ``prefill_s + decode_len x decode_s_per_token`` and TTFT as queue
+  wait + prefill;
+- the router's surviving backlog files ``no-free-slot`` entries into
+  the ENGINE's demand ledger (so /explain timelines and demand gauges
+  see them), and in autoscale mode a CapacityPlanner round every
+  ``plan_interval`` converts them into serving-pod replica deltas —
+  new pods are submitted, the scheduler places them, the router picks
+  them up; idle replicas retire through the same plans (pod deleted,
+  capacity freed);
+- ``kill_replica`` models a pod loss: the replica deregisters, its
+  in-flight and queued requests requeue with their original arrival
+  times (no request is ever lost — the conservation invariant
+  tests/test_serving_router.py and the banked artifact both pin).
+
+``tools/serving_sim.py`` (``make serving-sim``) replays the diurnal
+trace twice — fixed replicas vs the closed loop — and banks
+SERVING_LOOP.json with TTFT / queue-wait percentiles, shed rates, and
+slot-occupancy traces.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional
+
+from ..autoscale import CapacityPlanner, Recommender
+from ..cells.cell import ChipInfo
+from ..cluster.api import Pod
+from ..cluster.fake import FakeCluster
+from ..scheduler import constants as C
+from ..scheduler.plugin import TpuShareScheduler
+from ..sim.trace import RequestEvent
+from ..utils.stats import percentile
+from .router import Request, RequestRouter
+
+
+class ServingLoopSim:
+    def __init__(
+        self,
+        topology,
+        nodes: Dict[str, int],
+        model: str = "llama-7b",
+        chip_model: str = "tpu-v5e",
+        chip_memory: int = 16 << 30,
+        slots_per_replica: int = 8,
+        replica_chips: float = 1.0,
+        max_prompt_len: int = 512,
+        queue_depth: int = 8,
+        queue_timeout_s: float = 30.0,
+        prefill_s: float = 0.25,
+        decode_s_per_token: float = 0.03,
+        replica_priority: int = 80,
+        tenants=None,
+    ):
+        self.cluster = FakeCluster()
+        for node, n_chips in nodes.items():
+            self.cluster.add_node(node, [
+                ChipInfo(f"{node}-chip-{i}", chip_model, chip_memory, i)
+                for i in range(n_chips)
+            ])
+        self.clock_now = 0.0
+        self.engine = TpuShareScheduler(
+            topology, self.cluster, clock=lambda: self.clock_now,
+            tenants=tenants,
+        )
+        self.model = model
+        self.slots_per_replica = slots_per_replica
+        self.replica_chips = replica_chips
+        self.max_prompt_len = max_prompt_len
+        self.prefill_s = prefill_s
+        self.decode_s_per_token = decode_s_per_token
+        self.replica_priority = replica_priority
+        # the router files backlog into the ENGINE's demand ledger:
+        # one ledger for chips and slots, so the explain plane and the
+        # planner read serving starvation from the same place as
+        # placement starvation
+        self.router = RequestRouter(
+            demand=self.engine.demand,
+            queue_depth=queue_depth,
+            queue_timeout_s=queue_timeout_s,
+            replica_slots=slots_per_replica,
+            replica_chips=replica_chips,
+            default_max_prompt_len=max_prompt_len,
+        )
+        self._pod_seq = 0
+        self._pending_pods: List[Pod] = []
+        self._live_pods: Dict[str, Pod] = {}  # bound replica pods
+        self.replicas_added = 0
+        self.replicas_removed = 0
+        self.replicas_killed = 0
+        self._events: Dict[str, RequestEvent] = {}  # rid -> row
+        # rid -> admission generation. Every (re)admission bumps it
+        # and stamps its finish event; a kill bumps it WITHOUT a new
+        # finish, orphaning the interrupted admission's event. Only a
+        # finish whose generation still matches may complete — a mere
+        # cancelled-set (or counter) mis-fires when a short
+        # re-admission finishes BEFORE the long stale finish pops.
+        self._gen: Dict[str, int] = {}
+        self._finishes: List = []  # heap of (t, rid, generation)
+        self.waits: List[float] = []
+        self.ttfts: List[float] = []
+        self.occupancy: List[dict] = []
+        self.pool_exhausted_rounds = 0
+
+    # -- serving pods -------------------------------------------------
+
+    def submit_replica_pod(self) -> Pod:
+        """One serving pod enters the queue; the next scheduling pass
+        places it and the bind registers the replica."""
+        self._pod_seq += 1
+        name = f"serve-{self.model}-{self._pod_seq}"
+        chips = self.replica_chips
+        labels = {
+            C.LABEL_TPU_REQUEST: str(chips),
+            C.LABEL_TPU_LIMIT_ALIASES[1]: str(max(chips, 1.0)),
+            C.LABEL_PRIORITY: str(self.replica_priority),
+        }
+        pod = Pod(name=name, namespace="serving", labels=labels,
+                  scheduler_name=C.SCHEDULER_NAME)
+        self.cluster.create_pod(pod)
+        self._pending_pods.append(pod)
+        return pod
+
+    def _schedule_pass(self) -> None:
+        if not self._pending_pods:
+            return
+        decisions = self.engine.schedule_wave(list(self._pending_pods))
+        by_key = {d.pod_key: d for d in decisions}
+        still: List[Pod] = []
+        for pod in self._pending_pods:
+            decision = by_key.get(pod.key)
+            if decision is not None and decision.status == "bound":
+                self._live_pods[pod.key] = pod
+                self.router.register(
+                    pod.key, self.model, self.slots_per_replica,
+                    chips=self.replica_chips,
+                    max_prompt_len=self.max_prompt_len,
+                    now=self.clock_now,
+                )
+                self.replicas_added += 1
+            elif decision is not None and decision.status == \
+                    "unschedulable" and not decision.retryable:
+                self.cluster.delete_pod(pod.key)  # malformed: drop
+            else:
+                still.append(pod)
+        self._pending_pods = still
+        self.engine.tick()
+
+    def retire_replica(self, pod_key: str) -> bool:
+        """Graceful scale-down of an IDLE replica: deregister (nothing
+        to requeue by choice of victim) and delete the pod so the
+        engine frees its leaves."""
+        replica = self.router.registry.get(pod_key)
+        if replica is None or replica.busy or replica.queue:
+            return False
+        self.router.deregister(pod_key, self.clock_now)
+        self._live_pods.pop(pod_key, None)
+        self.cluster.delete_pod(pod_key)
+        self.engine.tick()
+        self.replicas_removed += 1
+        return True
+
+    def kill_replica(self, pod_key: str) -> List[str]:
+        """Pod loss mid-flight: requests requeue (original arrivals),
+        interrupted streams' completions are cancelled, the pod leaves
+        the cluster."""
+        interrupted = self.router.deregister(pod_key, self.clock_now)
+        for rid in interrupted:
+            self._gen[rid] = self._gen.get(rid, 0) + 1
+        if self._live_pods.pop(pod_key, None) is not None:
+            self.cluster.delete_pod(pod_key)
+            self.engine.tick()
+            self.replicas_killed += 1
+        return interrupted
+
+    def replica_pods(self) -> List[str]:
+        return sorted(self._live_pods)
+
+    # -- request service model ----------------------------------------
+
+    def _service_s(self, event: RequestEvent) -> float:
+        return self.prefill_s + event.decode_len * self.decode_s_per_token
+
+    def _on_admitted(self, req: Request, now: float) -> None:
+        event = self._events[req.rid]
+        wait = max(0.0, now - req.arrival)
+        self.waits.append(wait)
+        ttft = wait + self.prefill_s
+        self.ttfts.append(ttft)
+        self.router.observe_ttft(req.model, ttft)
+        gen = self._gen.get(req.rid, 0) + 1
+        self._gen[req.rid] = gen
+        heapq.heappush(
+            self._finishes, (now + self._service_s(event), req.rid, gen)
+        )
+
+    def _drain_finishes(self, upto: float) -> None:
+        while self._finishes and self._finishes[0][0] <= upto:
+            t, rid, gen = heapq.heappop(self._finishes)
+            if gen != self._gen.get(rid):
+                continue  # orphaned by a kill or a later re-admission
+            self.clock_now = t
+            for nreq, _pod in self.router.complete(rid, t):
+                self._on_admitted(nreq, t)
+
+    def _sample_occupancy(self, now: float) -> None:
+        total = self.router.registry.total_slots(self.model)
+        free = self.router.registry.free_slots(self.model)
+        self.occupancy.append({
+            "t": round(now, 1),
+            "replicas": self.router.registry.replica_count(self.model),
+            "pending_pods": len(self._pending_pods),
+            "slots": total,
+            "busy": total - free,
+            "queued": self.router.backlog(self.model),
+        })
+
+    # -- the run ------------------------------------------------------
+
+    def run(
+        self,
+        requests: List[RequestEvent],
+        horizon: float,
+        initial_replicas: int = 2,
+        autoscale: bool = False,
+        recommender: Optional[Recommender] = None,
+        max_replicas: int = 0,
+        plan_interval: float = 30.0,
+        tick_interval: float = 5.0,
+        occupancy_interval: float = 30.0,
+    ) -> dict:
+        """Replay ``requests`` to ``horizon``. ``initial_replicas``
+        serving pods are submitted at t=0 (both modes — the A/B
+        differs only in whether the planner may move the count).
+        ``max_replicas`` caps autoscale growth (0 = the node pool is
+        the only cap)."""
+        for _ in range(initial_replicas):
+            self.submit_replica_pod()
+        self._schedule_pass()
+        planner = None
+        if autoscale:
+            planner = CapacityPlanner(
+                self.engine,
+                recommender=recommender or Recommender(),
+                router=self.router,
+            )
+
+        arrivals = sorted(requests, key=lambda e: e.start)
+        i = 0
+        next_tick = 0.0
+        next_plan = plan_interval
+        next_occ = 0.0
+        while True:
+            candidates = [next_tick]
+            if i < len(arrivals):
+                candidates.append(arrivals[i].start)
+            if self._finishes:
+                candidates.append(self._finishes[0][0])
+            if planner is not None:
+                candidates.append(next_plan)
+            next_t = max(self.clock_now, min(candidates))
+            if next_t > horizon:
+                break
+            self._drain_finishes(next_t)
+            self.clock_now = next_t
+
+            while i < len(arrivals) and arrivals[i].start <= next_t:
+                event = arrivals[i]
+                i += 1
+                rid = f"r{i}"
+                self._events[rid] = event
+                req = Request(
+                    rid=rid, model=event.model,
+                    prompt_len=event.prompt_len, arrival=event.start,
+                    tenant=event.tenant,
+                )
+                result = self.router.submit(req, next_t)
+                if result.status == "admitted":
+                    self._on_admitted(req, next_t)
+
+            if next_tick <= next_t:
+                outcome = self.router.tick(next_t)
+                for req, _pod in outcome.admitted:
+                    self._on_admitted(req, next_t)
+                self._schedule_pass()
+                while next_tick <= next_t:
+                    next_tick += tick_interval
+
+            if planner is not None and next_plan <= next_t:
+                self._plan_round(planner, max_replicas)
+                while next_plan <= next_t:
+                    next_plan += plan_interval
+
+            if next_occ <= next_t:
+                self._sample_occupancy(next_t)
+                while next_occ <= next_t:
+                    next_occ += occupancy_interval
+
+        self.clock_now = horizon
+        self._sample_occupancy(horizon)
+        return self.report(horizon)
+
+    def _plan_round(self, planner: CapacityPlanner,
+                    max_replicas: int) -> None:
+        rec, _snap = planner.plan()
+        for plan in rec.serving:
+            if plan.model != self.model:
+                continue
+            if plan.delta_replicas > 0:
+                # pods already submitted but not yet bound count
+                # against the delta: the planner sized from REGISTERED
+                # replicas, and resubmitting the same deficit every
+                # round would grow the pending queue without bound on
+                # a full node pool
+                budget = max(
+                    0, plan.delta_replicas - len(self._pending_pods)
+                )
+                if max_replicas:
+                    committed = (len(self._live_pods)
+                                 + len(self._pending_pods))
+                    headroom = max(0, max_replicas - committed)
+                    if budget > headroom:
+                        self.pool_exhausted_rounds += 1
+                    budget = min(budget, headroom)
+                for _ in range(budget):
+                    self.submit_replica_pod()
+                if budget:
+                    self._schedule_pass()
+            elif plan.delta_replicas < 0:
+                # retire the idlest replicas; skip any that picked up
+                # work since the snapshot (retire_replica refuses)
+                idle = sorted(
+                    (r.pod_key
+                     for r in self.router.registry.replicas(self.model)
+                     if not r.busy and not r.queue),
+                )
+                for pod_key in idle[:-plan.delta_replicas]:
+                    self.retire_replica(pod_key)
+
+    # -- reporting ----------------------------------------------------
+
+    def report(self, horizon: float) -> dict:
+        counts = self.router.counts(self.model)
+        submitted, accounted = self.router.conservation(self.model)
+        occ_busy = [o["busy"] for o in self.occupancy if o["slots"]]
+        occ_ratio = [
+            o["busy"] / o["slots"] for o in self.occupancy if o["slots"]
+        ]
+        return {
+            "model": self.model,
+            "horizon_s": horizon,
+            "requests": submitted,
+            "served": counts["served"],
+            "shed": counts["shed"],
+            "shed_total": counts["shed_total"],
+            "shed_rate": round(
+                counts["shed_total"] / submitted, 4
+            ) if submitted else 0.0,
+            "in_flight_at_horizon": counts["in_flight"],
+            "requeued": counts["requeued"],
+            "conservation": {
+                "submitted": submitted,
+                "accounted": accounted,
+                "exact": submitted == accounted,
+            },
+            "queue_wait_s": {
+                "p50": percentile(self.waits, 0.50),
+                "p90": percentile(self.waits, 0.90),
+                "p99": percentile(self.waits, 0.99),
+                "mean": round(
+                    sum(self.waits) / len(self.waits), 3
+                ) if self.waits else 0.0,
+            },
+            "ttft_s": {
+                "p50": percentile(self.ttfts, 0.50),
+                "p90": percentile(self.ttfts, 0.90),
+                "p99": percentile(self.ttfts, 0.99),
+            },
+            "replicas": {
+                "final": self.router.registry.replica_count(self.model),
+                "peak": max(
+                    (o["replicas"] for o in self.occupancy), default=0
+                ),
+                "added": self.replicas_added,
+                "removed": self.replicas_removed,
+                "killed": self.replicas_killed,
+                "pending_at_horizon": len(self._pending_pods),
+            },
+            "slot_occupancy": {
+                "mean": round(
+                    sum(occ_ratio) / len(occ_ratio), 4
+                ) if occ_ratio else 0.0,
+                "peak_busy_slots": max(occ_busy, default=0),
+                "trace": self.occupancy,
+            },
+        }

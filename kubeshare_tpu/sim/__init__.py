@@ -1,12 +1,15 @@
 from .trace import (
-    TraceEvent, generate_gang_trace, generate_sec_trace, generate_trace,
-    load_trace, save_trace,
+    RequestEvent, TraceEvent, generate_diurnal_request_trace,
+    generate_gang_trace, generate_sec_trace, generate_trace, load_trace,
+    save_trace,
 )
 from .simulator import FaultEvent, SimReport, Simulator
 
 __all__ = [
+    "RequestEvent",
     "TraceEvent",
     "generate_trace",
+    "generate_diurnal_request_trace",
     "generate_gang_trace",
     "generate_sec_trace",
     "load_trace",

@@ -40,6 +40,22 @@ class TraceEvent:
         return self.chips < 1.0
 
 
+@dataclass(frozen=True)
+class RequestEvent:
+    """One user request against the request plane
+    (kubeshare_tpu/serving): a prompt of ``prompt_len`` tokens asking
+    for ``decode_len`` generated tokens from ``model``'s replica pool.
+    The serving sim models its slot hold time as
+    ``prefill + decode_len x per-token``; TraceEvent stays the
+    POD-arrival row — requests are a layer above pods."""
+
+    start: float
+    model: str
+    prompt_len: int
+    decode_len: int
+    tenant: str = "default"
+
+
 def load_trace(path: str) -> List[TraceEvent]:
     events: List[TraceEvent] = []
     with open(path) as f:
@@ -252,6 +268,69 @@ def generate_starvation_trace(
             70, 1, "ci",
         ))
     events.sort(key=lambda e: e.start)
+    return events
+
+
+def generate_diurnal_request_trace(
+    span_s: float = 1200.0,
+    cycles: int = 2,
+    mean_rps: float = 2.0,
+    amplitude: float = 0.9,
+    model: str = "llama-7b",
+    prompt_len_range=(8, 480),
+    oversized_ratio: float = 0.01,
+    oversized_len: int = 4096,
+    decode_len_range=(16, 160),
+    seed: int = 0,
+) -> List[RequestEvent]:
+    """Diurnal user-request arrivals for the serving-loop evidence
+    (tools/serving_sim.py): a nonhomogeneous Poisson process whose
+    rate swings sinusoidally through ``cycles`` day-analogs over
+    ``span_s`` —
+
+        rate(t) = mean_rps * (1 + amplitude*sin(2*pi*cycles*t/span - pi/2))
+
+    starting at the trough, peaking mid-cycle at
+    ``mean_rps*(1+amplitude)``. A fixed replica pool sized for the
+    mean drowns at the peak (queue timeouts, pool-full sheds) and
+    idles at the trough — exactly the regime the slot-sizing loop
+    exists for. Arrivals are generated by thinning against the peak
+    rate (exact for a sinusoid; no discretization of the curve).
+
+    ``oversized_ratio`` of requests carry ``oversized_len`` prompts —
+    beyond any replica's largest compile bucket — pinning the "shed
+    never, immediately" path: a router that queues these wastes slots
+    on requests that can never be admitted. Prompt lengths are drawn
+    log-uniform over ``prompt_len_range`` (most prompts short, a fat
+    tail near the bucket ceiling); decode lengths uniform over
+    ``decode_len_range``."""
+    rng = random.Random(seed)
+    peak = mean_rps * (1.0 + amplitude)
+    lo_p, hi_p = prompt_len_range
+    lo_d, hi_d = decode_len_range
+    events: List[RequestEvent] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak)
+        if t >= span_s:
+            break
+        rate = mean_rps * (1.0 + amplitude * math.sin(
+            2.0 * math.pi * cycles * t / span_s - math.pi / 2.0
+        ))
+        if rng.random() * peak > rate:
+            continue  # thinned: the trough keeps few arrivals
+        if rng.random() < oversized_ratio:
+            prompt_len = oversized_len
+        else:
+            prompt_len = int(round(math.exp(rng.uniform(
+                math.log(lo_p), math.log(hi_p)
+            ))))
+        events.append(RequestEvent(
+            start=round(t, 3),
+            model=model,
+            prompt_len=prompt_len,
+            decode_len=rng.randint(lo_d, hi_d),
+        ))
     return events
 
 

@@ -1,6 +1,7 @@
 from .bitmap import Bitmap, RRBitmap
 from .containers import LockedSet, Queue, Stack
 from .logger import get_logger
+from .stats import percentile
 from .signals import setup_signal_handler
 
 __all__ = [
@@ -10,5 +11,6 @@ __all__ = [
     "Queue",
     "Stack",
     "get_logger",
+    "percentile",
     "setup_signal_handler",
 ]

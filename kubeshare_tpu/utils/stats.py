@@ -1,0 +1,18 @@
+"""Small shared statistics helpers for evidence tooling."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def percentile(values: Sequence[float], q: float,
+               ndigits: int = 3) -> float:
+    """Nearest-rank percentile over ``values`` (monotone in ``q`` by
+    construction; 0.0 on empty) — the ONE estimator every banked
+    artifact's percentiles share (EXPLAIN.json, SERVING_LOOP.json),
+    so their numbers stay comparable."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return round(ordered[idx], ndigits)

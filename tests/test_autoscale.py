@@ -705,3 +705,258 @@ class TestReclaimBudgetLane:
     def test_invalid_share_rejected(self):
         with pytest.raises(ValueError, match="reclaim_share"):
             make_env(defrag=True, defrag_reclaim_share=1.0)
+
+
+# ============ multi-model "*" attribution (cheapest-that-fits) =======
+
+
+class TestResolveModels:
+    """The mixed-fleet fix: "*" entries resolve to the CHEAPEST model
+    whose node template fits the entry's shape, not blindly to the
+    first sorted model."""
+
+    @staticmethod
+    def caps(**chips_per_node):
+        return {
+            model: ModelCapacity(
+                model=model, chips_per_node=n, pool_nodes=4,
+                bound_nodes=2, bound_chips=2 * n, free_chips=0.0,
+            )
+            for model, n in chips_per_node.items()
+        }
+
+    @staticmethod
+    def entry(shape, model="*", chips=1.0):
+        from kubeshare_tpu.autoscale.demand import DemandEntry
+
+        return DemandEntry(
+            pod_key="t/p", tenant="t", model=model, shape=shape,
+            guarantee=True, chips=chips, mem=0,
+            reason=REASON_NO_FEASIBLE_CELL, since=0.0, updated=0.0,
+        )
+
+    def test_mixed_fleet_x8_goes_to_the_big_model(self):
+        """Regression (ROADMAP mixed-fleet item): v5e sorts before
+        v6e, but an x8 entry cannot fit a 4-chip v5e node — the old
+        first-sorted rewrite sent it there anyway, growing the wrong
+        pool."""
+        capacity = self.caps(**{"tpu-v5e": 4, "tpu-v6e": 8})
+        [resolved] = DemandLedger.resolve_models(
+            [self.entry("x8", chips=8.0)],
+            sorted(capacity), capacity=capacity,
+        )
+        assert resolved.model == "tpu-v6e"
+
+    def test_shared_and_small_shapes_take_the_cheapest_template(self):
+        capacity = self.caps(**{"tpu-v5e": 4, "tpu-v6e": 8})
+        resolved = DemandLedger.resolve_models(
+            [self.entry("shared"), self.entry("x2"), self.entry("x4")],
+            sorted(capacity), capacity=capacity,
+        )
+        assert [e.model for e in resolved] == ["tpu-v5e"] * 3
+
+    def test_tie_breaks_by_name(self):
+        capacity = self.caps(**{"tpu-v6e": 4, "tpu-v5e": 4})
+        [resolved] = DemandLedger.resolve_models(
+            [self.entry("x2")], sorted(capacity), capacity=capacity,
+        )
+        assert resolved.model == "tpu-v5e"
+
+    def test_unfittable_entry_falls_back_deterministically(self):
+        capacity = self.caps(**{"tpu-v5e": 4})
+        [resolved] = DemandLedger.resolve_models(
+            [self.entry("x16", chips=16.0)],
+            sorted(capacity), capacity=capacity,
+        )
+        assert resolved.model == "tpu-v5e"
+
+    def test_legacy_no_capacity_keeps_first_sorted(self):
+        [resolved] = DemandLedger.resolve_models(
+            [self.entry("x8")], ["tpu-v5e", "tpu-v6e"],
+        )
+        assert resolved.model == "tpu-v5e"
+
+    def test_concrete_models_untouched(self):
+        capacity = self.caps(**{"tpu-v5e": 4, "tpu-v6e": 8})
+        [resolved] = DemandLedger.resolve_models(
+            [self.entry("x8", model="tpu-v5e")],
+            sorted(capacity), capacity=capacity,
+        )
+        assert resolved.model == "tpu-v5e"
+
+    def test_recommend_routes_star_demand_by_fit(self):
+        """End to end through recommend(): an x8 "*" guarantee entry
+        on a mixed fleet scales the v6e pool, not v5e."""
+        from kubeshare_tpu.autoscale.demand import DemandEntry
+
+        entry = DemandEntry(
+            pod_key="prod/p", tenant="prod", model="*", shape="x8",
+            guarantee=True, chips=8.0, mem=0,
+            reason=REASON_NO_FEASIBLE_CELL, since=0.0, updated=0.0,
+        )
+        snap = PlannerSnapshot(
+            now=0.0, total_chips=24.0,
+            capacity=self.caps(**{"tpu-v5e": 4, "tpu-v6e": 8}),
+            demand=(entry,),
+            guarantee_used={"prod": 0.0},
+            guaranteed_fraction={"prod": 1.0},
+            deficits={"prod": 8.0},
+        )
+        rec = Recommender(max_surge_nodes=8).recommend(snap)
+        by_model = {p.model: p for p in rec.plans}
+        assert by_model["tpu-v6e"].delta_nodes > 0
+        assert by_model["tpu-v5e"].delta_nodes == 0
+
+
+# ================ serving slot-sizing term ===========================
+
+
+def mk_serving(model="llama-7b", replicas=2, slots=8, free=0, queued=0,
+               chips=1.0):
+    from kubeshare_tpu.autoscale import ServingCapacity
+
+    return ServingCapacity(
+        model=model, replicas=replicas, slots_per_replica=slots,
+        total_slots=replicas * slots, free_slots=free, queued=queued,
+        replica_chips=chips,
+    )
+
+
+def mk_slot_entry(model="llama-7b", slots=8, chips=1.0):
+    from kubeshare_tpu.autoscale.demand import (
+        REASON_NO_FREE_SLOT, DemandEntry,
+    )
+
+    return DemandEntry(
+        pod_key=f"slots::{model}", tenant="serving", model=model,
+        shape="slots", guarantee=False, chips=chips, mem=0,
+        reason=REASON_NO_FREE_SLOT, since=0.0, updated=0.0,
+    )
+
+
+def serving_snap(now=0.0, serving=(), demand=()):
+    return PlannerSnapshot(
+        now=now, total_chips=8.0,
+        capacity={
+            "tpu-v5e": ModelCapacity(
+                model="tpu-v5e", chips_per_node=4, pool_nodes=4,
+                bound_nodes=2, bound_chips=8, free_chips=4.0,
+            ),
+        },
+        demand=tuple(demand),
+        guarantee_used={}, guaranteed_fraction={}, deficits={},
+        serving=tuple(serving),
+    )
+
+
+class TestServingSlotSizing:
+    def test_backlog_sizes_replica_scale_up(self):
+        # 12 queued slots at 1 chip / 8 slots = 1.5 chips of backlog
+        # -> ceil(1.5 / 1 chip per replica) = 2 replicas
+        snap = serving_snap(
+            serving=[mk_serving(queued=12)],
+            demand=[mk_slot_entry(chips=1.5)],
+        )
+        [plan] = Recommender().recommend(snap).serving
+        assert plan.delta_replicas == 2
+        assert plan.target_replicas == 4
+        assert plan.slot_deficit == 12
+
+    def test_surge_clamp_and_cooldown(self):
+        rec = Recommender(max_surge_replicas=2,
+                          serving_up_cooldown_s=30.0)
+        snap = serving_snap(
+            serving=[mk_serving(queued=64)],
+            demand=[mk_slot_entry(chips=8.0)],
+        )
+        [plan] = rec.recommend(snap).serving
+        assert plan.delta_replicas == 2  # clamped from 8
+        assert any("max-surge" in r for r in plan.reasons)
+        # 10s later: still inside the cooldown, no further scale-up
+        snap2 = serving_snap(
+            now=10.0,
+            serving=[mk_serving(queued=64)],
+            demand=[mk_slot_entry(chips=8.0)],
+        )
+        [plan2] = rec.recommend(snap2).serving
+        assert plan2.delta_replicas == 0
+        assert any("cooldown" in r for r in plan2.reasons)
+
+    def test_no_backlog_no_delta(self):
+        snap = serving_snap(serving=[mk_serving(free=4)])
+        [plan] = Recommender().recommend(snap).serving
+        assert plan.delta_replicas == 0
+
+    def test_scale_down_needs_stable_surplus(self):
+        rec = Recommender(serving_down_stable_s=60.0,
+                          serving_down_cooldown_s=0.0)
+        # a whole replica's worth of slots idle beyond the backlog
+        def surplus(now):
+            return serving_snap(
+                now=now, serving=[mk_serving(replicas=3, free=16)],
+            )
+
+        [p0] = rec.recommend(surplus(0.0)).serving
+        assert p0.delta_replicas == 0          # streak just started
+        [p1] = rec.recommend(surplus(59.0)).serving
+        assert p1.delta_replicas == 0
+        [p2] = rec.recommend(surplus(61.0)).serving
+        assert p2.delta_replicas == -2         # 16 free / 8 per replica
+        assert p2.target_replicas == 1
+
+    def test_busy_blip_resets_the_streak(self):
+        rec = Recommender(serving_down_stable_s=60.0)
+        [_] = rec.recommend(serving_snap(
+            serving=[mk_serving(replicas=3, free=16)],
+        )).serving
+        # a burst consumes the surplus mid-streak
+        [_] = rec.recommend(serving_snap(
+            now=30.0, serving=[mk_serving(replicas=3, free=2)],
+        )).serving
+        [plan] = rec.recommend(serving_snap(
+            now=70.0, serving=[mk_serving(replicas=3, free=16)],
+        )).serving
+        assert plan.delta_replicas == 0  # streak restarted at t=70
+
+    def test_never_below_min_replicas(self):
+        rec = Recommender(serving_down_stable_s=0.0, min_replicas=1)
+        [plan] = rec.recommend(serving_snap(
+            now=100.0, serving=[mk_serving(replicas=1, free=8)],
+        )).serving
+        assert plan.delta_replicas == 0
+
+    def test_slot_backlog_never_leaks_into_node_terms(self):
+        """no-free-slot entries size REPLICAS; the chip-model plans
+        must not see them (the replica pods file their own placement
+        demand once submitted)."""
+        snap = serving_snap(
+            serving=[mk_serving(queued=64)],
+            demand=[mk_slot_entry(chips=100.0)],
+        )
+        rec = Recommender(max_surge_nodes=8).recommend(snap)
+        [node_plan] = rec.plans
+        assert node_plan.delta_nodes == 0
+        assert node_plan.chips_needed == 0
+        [serving_plan] = rec.serving
+        assert serving_plan.delta_replicas > 0
+
+    def test_actuator_renders_serving_plans(self):
+        snap = serving_snap(
+            serving=[mk_serving(queued=12)],
+            demand=[mk_slot_entry(chips=1.5)],
+        )
+        rec = Recommender().recommend(snap)
+        doc = DryRunActuator.render_doc(rec, snap)
+        [srow] = doc["serving"]
+        assert srow["delta_replicas"] == 2
+        manifest = DryRunActuator.render_manifest(rec)
+        assert "kind: ServingReplicaPatch" in manifest
+        assert "deltaReplicas: 2" in manifest
+        names = {s.name for s in self._actuated_samples(rec, snap)}
+        assert "tpu_scheduler_autoscale_serving_target_replicas" in names
+
+    @staticmethod
+    def _actuated_samples(rec, snap):
+        actuator = DryRunActuator()
+        actuator.actuate(rec, snap)
+        return actuator.samples()

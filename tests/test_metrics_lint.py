@@ -86,10 +86,33 @@ def scraped():
     engine.schedule_one(pod("weird", 0.5, ns=WEIRD_TENANT))
     clock[0] = 10.0
 
+    # the request plane rides the same exposition: a router with a
+    # served request, a queued backlog, and every shed class
+    from kubeshare_tpu.serving import Request, RequestRouter
+
+    router = RequestRouter(demand=engine.demand, queue_depth=1,
+                           queue_timeout_s=5.0)
+    router.register("serving/rep-a", "llama-7b", 1, max_prompt_len=128)
+    router.submit(Request(rid="r0", model="llama-7b", prompt_len=16,
+                          arrival=0.0), 0.0)
+    router.submit(Request(rid="r1", model="llama-7b", prompt_len=16,
+                          arrival=0.0), 0.0)             # queued
+    router.submit(Request(rid="r2", model="llama-7b", prompt_len=16,
+                          arrival=0.0), 0.0)             # pool-full
+    router.submit(Request(rid="r3", model="llama-7b", prompt_len=999,
+                          arrival=0.0), 0.0)             # oversized
+    router.observe_ttft("llama-7b", 0.4)
+    router.tick(6.0)                                     # r1 times out
+    router.submit(Request(rid="r4", model="llama-7b", prompt_len=16,
+                          arrival=7.0), 7.0)             # queued again
+    router.tick(7.0)       # backlog -> no-free-slot demand entry
+    router.complete("r0", 8.0)                           # serves r0
+
     tracer = Tracer()
     with tracer.span("pass"):
         pass
-    metrics = SchedulerMetrics(tracer=tracer, engine=engine)
+    metrics = SchedulerMetrics(tracer=tracer, engine=engine,
+                               router=router)
     metrics.record_pass(0.01, 4)
 
     server = MetricServer(host="127.0.0.1", port=0)
@@ -165,6 +188,15 @@ class TestExpositionHygiene:
             ("tpu_scheduler_explain_journal_evictions_total", "gauge"),
             ("tpu_scheduler_pod_wait_seconds", "histogram"),
             ("tpu_scheduler_phase_pass_seconds", "histogram"),
+            ("tpu_serving_replicas", "gauge"),
+            ("tpu_serving_slots", "gauge"),
+            ("tpu_serving_slots_free", "gauge"),
+            ("tpu_serving_slot_occupancy", "gauge"),
+            ("tpu_serving_queue_depth", "gauge"),
+            ("tpu_serving_requests_total", "gauge"),
+            ("tpu_serving_shed_total", "gauge"),
+            ("tpu_serving_queue_wait_seconds", "histogram"),
+            ("tpu_serving_ttft_seconds", "histogram"),
         ]:
             assert kinds.get(fam) == kind, (fam, kinds.get(fam))
 
@@ -233,6 +265,19 @@ class TestExpositionHygiene:
             return got[0].value
 
         assert value("tpu_scheduler_queue_depth", tenant="alpha") == 1
+        # the request plane's families carry real values: one served,
+        # one shed per class, TTFT observed
+        assert value("tpu_serving_requests_total", model="llama-7b",
+                     outcome="served") == 1
+        for reason in ("pool-full", "queue-timeout", "oversized-prompt"):
+            assert value("tpu_serving_shed_total", model="llama-7b",
+                         reason=reason) == 1
+        assert value("tpu_serving_ttft_seconds_count",
+                     model="llama-7b") == 1
+        # router backlog files into the SAME demand ledger families
+        assert value("tpu_scheduler_demand_pods", tenant="serving",
+                     model="llama-7b", shape="slots",
+                     reason="no-free-slot") == 1
         assert value(
             "tpu_scheduler_pod_wait_seconds_count",
             tenant="alpha", shape="shared", outcome="bound",
@@ -241,4 +286,6 @@ class TestExpositionHygiene:
             "tpu_scheduler_pod_wait_seconds_count",
             tenant="alpha", outcome="unschedulable",
         ) == 1
-        assert value("tpu_scheduler_explain_journal_pods") == 4
+        # 4 pods + the slots::llama-7b pseudo-entry the router's
+        # no-free-slot transition filed through the ledger hook
+        assert value("tpu_scheduler_explain_journal_pods") == 5

@@ -1,0 +1,490 @@
+"""The request plane's router (kubeshare_tpu/serving): least-loaded /
+join-shortest-queue admission, bounded queues, honest shedding, and
+the three pinned invariants — request conservation (every request
+ends exactly one of served / shed / in-flight), the least-loaded
+routing rule (never admit onto a replica while another replica
+has more free slots), and no-lost-slot accounting across
+replica kill / re-register."""
+
+import random
+
+import pytest
+
+from kubeshare_tpu.autoscale.demand import (
+    REASON_NO_FREE_SLOT, DemandLedger, shape_of,
+)
+from kubeshare_tpu.serving import (
+    SHED_OVERSIZED, SHED_POOL_FULL, SHED_TIMEOUT, ReplicaRegistry,
+    Request, RequestRouter,
+)
+
+
+def make_router(**kwargs):
+    kwargs.setdefault("queue_depth", 2)
+    kwargs.setdefault("queue_timeout_s", 30.0)
+    return RequestRouter(**kwargs)
+
+
+def req(rid, prompt_len=16, arrival=0.0, model="m"):
+    return Request(rid=rid, model=model, prompt_len=prompt_len,
+                   arrival=arrival)
+
+
+class TestRegistry:
+    def test_register_and_deregister(self):
+        reg = ReplicaRegistry()
+        reg.register("s/a", "m", 4, max_prompt_len=128)
+        reg.register("s/b", "m", 8, max_prompt_len=512)
+        assert reg.models() == ["m"]
+        assert reg.replica_count("m") == 2
+        assert reg.total_slots("m") == 12
+        assert reg.free_slots("m") == 12
+        assert reg.max_prompt_len("m") == 512
+        gone = reg.deregister("s/b")
+        assert gone.pod_key == "s/b"
+        assert reg.total_slots("m") == 4
+        assert reg.max_prompt_len("m") == 128
+        assert reg.deregister("s/b") is None
+
+    def test_duplicate_register_rejected(self):
+        reg = ReplicaRegistry()
+        reg.register("s/a", "m", 4)
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register("s/a", "m", 4)
+
+    def test_zero_slots_rejected(self):
+        with pytest.raises(ValueError, match=">= 1 slot"):
+            ReplicaRegistry().register("s/a", "m", 0)
+
+
+class TestAdmission:
+    def test_least_loaded_spread(self):
+        router = make_router()
+        router.register("s/a", "m", 2)
+        router.register("s/b", "m", 4)
+        # b has more free slots: first two land there
+        assert router.submit(req("r1"), 0.0).replica == "s/b"
+        assert router.submit(req("r2"), 0.0).replica == "s/b"
+        # now tied at 2 free each: deterministic pod-key tie-break
+        assert router.submit(req("r3"), 0.0).replica == "s/a"
+
+    def test_join_shortest_queue_when_full(self):
+        router = make_router()
+        router.register("s/a", "m", 1)
+        router.register("s/b", "m", 1)
+        for i in range(2):
+            assert router.submit(req(f"r{i}"), 0.0).status == "admitted"
+        q1 = router.submit(req("q1"), 0.0)
+        assert q1.status == "queued"
+        q2 = router.submit(req("q2"), 0.0)
+        assert q2.status == "queued"
+        assert {q1.replica, q2.replica} == {"s/a", "s/b"}
+
+    def test_pool_full_shed_is_retryable(self):
+        router = make_router(queue_depth=1)
+        router.register("s/a", "m", 1)
+        router.submit(req("r0"), 0.0)
+        router.submit(req("r1"), 0.0)       # fills the queue
+        shed = router.submit(req("r2"), 0.0)
+        assert shed.status == "shed"
+        assert shed.reason == SHED_POOL_FULL
+        assert shed.retryable
+
+    def test_oversized_shed_is_never(self):
+        router = make_router()
+        router.register("s/a", "m", 4, max_prompt_len=128)
+        shed = router.submit(req("big", prompt_len=129), 0.0)
+        assert shed.status == "shed"
+        assert shed.reason == SHED_OVERSIZED
+        assert not shed.retryable
+        # free slots untouched: the oversized request never queued
+        assert router.registry.free_slots("m") == 4
+
+    def test_oversized_uses_largest_bucket_across_replicas(self):
+        router = make_router()
+        router.register("s/a", "m", 1, max_prompt_len=128)
+        router.register("s/b", "m", 1, max_prompt_len=512)
+        ok = router.submit(req("r0", prompt_len=300), 0.0)
+        assert ok.status == "admitted" and ok.replica == "s/b"
+        assert router.submit(
+            req("r1", prompt_len=600), 0.0
+        ).reason == SHED_OVERSIZED
+
+    def test_unlimited_replica_beats_declared_ceilings(self):
+        """A replica with NO prompt ceiling takes anything: a prompt
+        over every DECLARED limit must not be shed 'never' while an
+        unlimited replica could serve it."""
+        router = make_router()
+        router.register("s/a", "m", 1, max_prompt_len=128)
+        router.register("s/b", "m", 1, max_prompt_len=None)
+        ok = router.submit(req("huge", prompt_len=100_000), 0.0)
+        assert ok.status == "admitted" and ok.replica == "s/b"
+
+    def test_default_ceiling_only_applies_before_replicas_exist(self):
+        router = make_router(default_max_prompt_len=64)
+        # cold start: the configured default is all we know
+        assert router.submit(
+            req("r0", prompt_len=65), 0.0
+        ).reason == SHED_OVERSIZED
+        # a live replica's larger bucket supersedes the default
+        router.register("s/a", "m", 1, max_prompt_len=256)
+        assert router.submit(
+            req("r1", prompt_len=200), 0.0
+        ).status == "admitted"
+
+    def test_cold_start_queues_in_waiting_room(self):
+        router = make_router(queue_depth=2)
+        assert router.submit(req("r0"), 0.0).status == "queued"
+        assert router.submit(req("r1"), 0.0).status == "queued"
+        assert router.submit(req("r2"), 0.0).reason == SHED_POOL_FULL
+        # a replica registering picks the waiting room up at dispatch
+        router.register("s/a", "m", 4)
+        out = router.tick(1.0)
+        assert len(out.admitted) == 2
+        assert router.backlog("m") == 0
+
+
+class TestCompletionAndTimeout:
+    def test_complete_frees_slot_and_dispatches(self):
+        router = make_router()
+        router.register("s/a", "m", 1)
+        router.submit(req("r0"), 0.0)
+        router.submit(req("r1"), 0.0)   # queued
+        admitted = router.complete("r0", 5.0)
+        assert [r.rid for r, _ in admitted] == ["r1"]
+        assert router.counts("m")["served"] == 1
+        sub, acc = router.conservation("m")
+        assert sub == acc == 2
+
+    def test_queue_timeout_shed(self):
+        router = make_router(queue_timeout_s=10.0)
+        router.register("s/a", "m", 1)
+        router.submit(req("r0", arrival=0.0), 0.0)
+        router.submit(req("r1", arrival=0.0), 0.0)   # queued
+        out = router.tick(9.0)
+        assert not out.shed
+        out = router.tick(10.0)
+        assert [(r.rid, reason) for r, reason in out.shed] == \
+            [("r1", SHED_TIMEOUT)]
+        assert router.counts("m")["shed"][SHED_TIMEOUT] == 1
+
+
+    def test_timeout_clock_is_queue_time_not_age(self):
+        """A kill-requeued request's served time must not count
+        against the queue timeout: with a free slot elsewhere it is
+        re-admitted, never shed — kills must not amplify into
+        spurious sheds."""
+        router = make_router(queue_timeout_s=20.0)
+        router.register("s/a", "m", 1)
+        router.register("s/b", "m", 1)
+        router.submit(req("r0", arrival=0.0), 0.0)   # admitted on a
+        router.submit(req("r1", arrival=0.0), 0.0)   # admitted on b
+        router.complete("r1", 25.0)                  # b now idle
+        router.deregister("s/a", 25.0)               # r0 requeued
+        out = router.tick(25.0)
+        assert not out.shed
+        assert [r.rid for r, _ in out.admitted] == ["r0"]
+        # and the timeout still runs from the REQUEUE, not arrival
+        router.deregister("s/b", 26.0)
+        router.register("s/c", "m", 1)
+        out = router.tick(45.9)     # 19.9s after the 26.0 requeue
+        assert [r.rid for r, _ in out.admitted] == ["r0"]
+
+    def test_tick_dispatches_before_timeout_shedding(self):
+        """A request a free slot can take right now is admitted, not
+        timeout-shed while the slot idles."""
+        router = make_router(queue_timeout_s=10.0)
+        router.register("s/a", "m", 1)
+        router.submit(req("r0", arrival=0.0), 0.0)
+        router.submit(req("r1", arrival=0.0), 0.0)   # queued
+        router.complete("r0", 15.0)  # frees the slot AND dispatches
+        assert router.counts("m")["shed"] == {}
+        sub, acc = router.conservation("m")
+        assert sub == acc == 2
+
+    def test_waiting_room_oversized_shed_once_fleet_known(self):
+        """An oversized request that slipped into the cold-start
+        waiting room (no replicas yet = no ceiling to check) sheds
+        'never' as soon as a fleet exists that cannot fit it — not
+        'retry later' at timeout, and it must not keep inflating the
+        no-free-slot backlog."""
+        demand = DemandLedger()
+        router = make_router(demand=demand, queue_depth=4)
+        assert router.submit(
+            req("big", prompt_len=10_000), 0.0
+        ).status == "queued"
+        router.register("s/a", "m", 2, max_prompt_len=512)
+        out = router.tick(1.0)
+        assert [(r.rid, reason) for r, reason in out.shed] == \
+            [("big", SHED_OVERSIZED)]
+        assert len(demand) == 0
+        sub, acc = router.conservation("m")
+        assert sub == acc == 1
+
+    def test_ceiling_shrink_sheds_stranded_queue_entries(self):
+        """The one big-bucket replica deregisters while a big prompt
+        waits: no surviving replica fits it — shed oversized, not
+        skipped forever."""
+        router = make_router(queue_depth=4)
+        router.register("s/a", "m", 1, max_prompt_len=512)
+        router.register("s/b", "m", 1, max_prompt_len=128)
+        router.submit(req("r0", prompt_len=16), 0.0)
+        router.submit(req("r1", prompt_len=16), 0.0)
+        assert router.submit(
+            req("big", prompt_len=300, arrival=0.0), 0.0
+        ).status == "queued"
+        router.deregister("s/a", 1.0)
+        out = router.tick(2.0)
+        assert ("big", SHED_OVERSIZED) in [
+            (r.rid, reason) for r, reason in out.shed
+        ]
+        sub, acc = router.conservation("m")
+        assert sub == acc == 3
+
+
+class TestDemandFiling:
+    def test_backlog_files_no_free_slot_and_resolves(self):
+        demand = DemandLedger()
+        router = make_router(demand=demand, queue_depth=4)
+        router.register("s/a", "m", 2, chips=1.0)
+        for i in range(4):
+            router.submit(req(f"r{i}"), 0.0)
+        router.tick(1.0)
+        entries = {e.pod_key: e for e in demand.entries()}
+        entry = entries["slots::m"]
+        assert entry.reason == REASON_NO_FREE_SLOT
+        assert entry.shape == "slots"
+        assert not entry.guarantee
+        # 2 queued x (1 chip / 2 slots)
+        assert entry.chips == pytest.approx(1.0)
+        # drain the backlog: the entry resolves
+        router.complete("r0", 2.0)
+        router.complete("r1", 2.0)
+        router.tick(3.0)
+        assert len(demand) == 0
+
+    def test_cold_start_demand_uses_replica_template(self):
+        demand = DemandLedger()
+        router = make_router(demand=demand, queue_depth=8,
+                             replica_slots=4, replica_chips=2.0)
+        for i in range(3):
+            router.submit(req(f"r{i}"), 0.0)
+        router.tick(1.0)
+        entry = demand.entries()[0]
+        assert entry.chips == pytest.approx(3 * 2.0 / 4)
+
+    def test_heterogeneous_fleet_prices_by_totals(self):
+        """chips-per-slot and the planner template come from fleet
+        TOTALS/means, not whichever replica sorts first."""
+        router = make_router()
+        router.register("s/a", "m", 8, chips=4.0)
+        router.register("s/z", "m", 8, chips=1.0)
+        assert router.chips_per_slot("m") == pytest.approx(5.0 / 16)
+        [cap] = router.capacity_snapshot()
+        assert cap.replica_chips == pytest.approx(2.5)
+        assert cap.slots_per_replica == 8
+
+    def test_slot_demand_shape(self):
+        from kubeshare_tpu.serving import SlotDemand
+
+        assert shape_of(
+            SlotDemand(tenant="t", model="m", serving_slots=3)
+        ) == "slots"
+
+
+class TestKillAndReRegister:
+    def test_kill_requeues_inflight_and_queued(self):
+        router = make_router(queue_depth=4)
+        router.register("s/a", "m", 2)
+        router.register("s/b", "m", 2)
+        for i in range(5):
+            router.submit(req(f"r{i}"), 0.0)
+        # 4 admitted (2+2), 1 queued
+        interrupted = router.deregister("s/a", 1.0)
+        assert len(interrupted) == 2
+        # nothing lost: the two in-flight plus the queued one are all
+        # accounted (requeued into b's queue / waiting room or shed)
+        sub, acc = router.conservation("m")
+        assert sub == acc == 5
+        assert router.counts("m")["requeued"] == 3
+
+    def test_reregister_picks_backlog_up(self):
+        router = make_router(queue_depth=8)
+        router.register("s/a", "m", 2)
+        for i in range(4):
+            router.submit(req(f"r{i}"), 0.0)
+        router.deregister("s/a", 1.0)
+        assert router.backlog("m") == 4
+        router.register("s/a2", "m", 4)
+        out = router.tick(2.0)
+        assert len(out.admitted) == 4
+        sub, acc = router.conservation("m")
+        assert sub == acc == 4
+
+    def test_requeue_preserves_arrival_but_restarts_timeout(self):
+        """Two clocks: the wait metrics keep the ORIGINAL arrival (the
+        disruption stays visible), but the queue timeout restarts at
+        the requeue — time spent being served is not queue time."""
+        router = make_router(queue_timeout_s=10.0)
+        router.register("s/a", "m", 1)
+        router.submit(req("r0", arrival=0.0), 0.0)
+        router.deregister("s/a", 8.0)   # requeued at t=8
+        out = router.tick(11.0)          # 3s in queue: kept
+        assert not out.shed
+        out = router.tick(18.0)          # 10s in queue: shed
+        assert [(r.rid, reason) for r, reason in out.shed] == \
+            [("r0", SHED_TIMEOUT)]
+        # the request object still carries its first arrival
+        assert out.shed[0][0].arrival == 0.0
+
+
+class TestProperties:
+    """Randomized op sequences; the three invariants hold after every
+    single operation."""
+
+    OVERSIZE = 10_000
+
+    def _check(self, router, models):
+        for model in models:
+            sub, acc = router.conservation(model)
+            assert sub == acc, f"{model}: {sub} != {acc}"
+        for model in models:
+            for r in router.registry.replicas(model):
+                assert 0 <= len(r.busy) <= r.slots
+                assert r.free_slots == r.slots - len(r.busy)
+
+    def test_random_ops_conserve_requests(self):
+        rng = random.Random(7)
+        router = make_router(queue_depth=3, queue_timeout_s=25.0)
+        models = ["m"]
+        now = 0.0
+        active = set()
+        seq = 0
+        pod_seq = 0
+        live_pods = []
+        for r in range(3):
+            pod_seq += 1
+            live_pods.append(f"s/p{pod_seq}")
+            router.register(live_pods[-1], "m", rng.randint(1, 4),
+                            max_prompt_len=512)
+        for step in range(2000):
+            now += rng.random() * 2.0
+            op = rng.random()
+            if op < 0.45:
+                seq += 1
+                prompt = (self.OVERSIZE if rng.random() < 0.05
+                          else rng.randint(1, 512))
+                fitting = [
+                    rep for rep in router.registry.replicas("m")
+                    if rep.fits_prompt(prompt)
+                ]
+                best_free = max(
+                    (rep.free_slots for rep in fitting), default=0
+                )
+                result = router.submit(
+                    req(f"r{seq}", prompt_len=prompt, arrival=now), now
+                )
+                if result.status == "admitted":
+                    # least-loaded invariant: the chosen replica had
+                    # the maximum free-slot count available
+                    chosen = next(
+                        rep for rep in fitting
+                        if rep.pod_key == result.replica
+                    )
+                    assert chosen.free_slots + 1 == best_free
+                    active.add(f"r{seq}")
+            elif op < 0.70 and active:
+                rid = rng.choice(sorted(active))
+                active.discard(rid)
+                for nreq, _pod in router.complete(rid, now):
+                    active.add(nreq.rid)
+            elif op < 0.85:
+                out = router.tick(now)
+                for nreq, _pod in out.admitted:
+                    active.add(nreq.rid)
+            elif op < 0.93 and live_pods:
+                victim = rng.choice(live_pods)
+                live_pods.remove(victim)
+                for rid in router.deregister(victim, now):
+                    active.discard(rid)
+                # kill requeues WITHOUT admitting (the caller must see
+                # every admission to schedule its completion): nothing
+                # new is busy until the next tick/complete dispatch
+                busy_now = set()
+                for rep in router.registry.replicas("m"):
+                    busy_now.update(rep.busy)
+                assert busy_now <= active
+            else:
+                pod_seq += 1
+                live_pods.append(f"s/p{pod_seq}")
+                router.register(live_pods[-1], "m",
+                                rng.randint(1, 4), max_prompt_len=512)
+            self._check(router, models)
+        counts = router.counts("m")
+        # the run must actually exercise every path
+        assert counts["served"] > 100
+        assert counts["shed"].get(SHED_OVERSIZED, 0) > 0
+        assert counts["requeued"] > 0
+
+    def test_random_ops_with_demand_ledger(self):
+        rng = random.Random(11)
+        demand = DemandLedger()
+        router = make_router(demand=demand, queue_depth=4)
+        router.register("s/a", "m", 2)
+        now = 0.0
+        seq = 0
+        active = set()
+        for _ in range(400):
+            now += 1.0
+            if rng.random() < 0.6:
+                seq += 1
+                result = router.submit(
+                    req(f"r{seq}", arrival=now), now
+                )
+                if result.status == "admitted":
+                    active.add(f"r{seq}")
+            elif active:
+                rid = rng.choice(sorted(active))
+                active.discard(rid)
+                for nreq, _pod in router.complete(rid, now):
+                    active.add(nreq.rid)
+            router.tick(now)
+            # ledger mirrors the backlog exactly: one entry iff
+            # backlog, sized backlog x chips-per-slot
+            backlog = router.backlog("m")
+            entries = demand.entries()
+            if backlog:
+                assert len(entries) == 1
+                assert entries[0].chips == pytest.approx(
+                    backlog * router.chips_per_slot("m")
+                )
+            else:
+                assert not entries
+
+
+class TestMetrics:
+    def test_samples_families(self):
+        router = make_router()
+        router.register("s/a", "m", 2)
+        router.submit(req("r0"), 0.0)
+        router.submit(req("big", prompt_len=10_000), 0.0)
+        router.observe_ttft("m", 0.3)
+        names = {s.name for s in router.samples()}
+        for name in [
+            "tpu_serving_replicas", "tpu_serving_slots",
+            "tpu_serving_slots_free", "tpu_serving_slot_occupancy",
+            "tpu_serving_queue_depth", "tpu_serving_requests_total",
+            "tpu_serving_shed_total", "tpu_serving_requeued_total",
+            "tpu_serving_queue_wait_seconds_bucket",
+            "tpu_serving_ttft_seconds_bucket",
+        ]:
+            assert name in names, name
+
+    def test_shed_reasons_always_exported(self):
+        router = make_router()
+        router.register("s/a", "m", 2)
+        reasons = {
+            s.labels["reason"] for s in router.samples()
+            if s.name == "tpu_serving_shed_total"
+        }
+        assert reasons == {SHED_POOL_FULL, SHED_TIMEOUT, SHED_OVERSIZED}

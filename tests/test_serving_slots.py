@@ -132,6 +132,133 @@ class TestDecodeServer:
             want = solo_stream(prompt, len(streams[slot]))
             assert streams[slot] == want, (slot, streams[slot], want)
 
+    def test_admit_reason_probe_matches_admit(self):
+        """admit_reason is the cheap router-facing probe: whatever it
+        predicts, admit does — None predicts success, pool-full /
+        oversized-prompt predict the two None cases a serving loop
+        must treat differently (retry later vs shed forever)."""
+        from kubeshare_tpu.models.serving import (
+            REFUSE_OVERSIZED, REFUSE_POOL_FULL,
+        )
+
+        server = DecodeServer(PARAMS, CFG, slots=1,
+                              prompt_buckets=(8, 16))
+        # oversized: permanent, and admit agrees
+        assert server.admit_reason(17) == REFUSE_OVERSIZED
+        assert server.admit([1] * 17) is None
+        # admittable right now
+        assert server.admit_reason(16) is None
+        assert server.can_admit()
+        assert server.admit([5, 9]) is not None
+        # pool full: transient, and admit agrees
+        assert not server.can_admit()
+        assert server.admit_reason(2) == REFUSE_POOL_FULL
+        assert server.admit([1, 2]) is None
+        # oversized WINS over pool-full: waiting cannot fix the
+        # prompt, so the router must not be told to retry
+        assert server.admit_reason(99) == REFUSE_OVERSIZED
+        # a retire flips the probe back without device work
+        server.retire(0)
+        assert server.admit_reason(2) is None
+
+    def test_admit_reason_rejects_nonpositive_length(self):
+        server = DecodeServer(PARAMS, CFG, slots=1, prompt_buckets=(8,))
+        with pytest.raises(ValueError):
+            server.admit_reason(0)
+        with pytest.raises(ValueError):
+            server.admit_reason(-3)
+
+    def test_router_sheds_oversized_via_registry_probe(self):
+        """The request plane consumes the probe through the registry:
+        register_server pins the replica's prompt ceiling to the
+        server's largest bucket, so the router sheds an oversized
+        request immediately — non-retryable — instead of queueing it
+        behind a pool that can never take it."""
+        from kubeshare_tpu.serving import (
+            SHED_OVERSIZED, Request, RequestRouter,
+        )
+
+        server = DecodeServer(PARAMS, CFG, slots=2,
+                              prompt_buckets=(8, 16))
+        router = RequestRouter()
+        router.register_server("serving/pod-a", "toy", server)
+        replica = router.registry.get("serving/pod-a")
+        assert replica.slots == server.slots
+        assert replica.max_prompt_len == 16
+        shed = router.submit(
+            Request(rid="big", model="toy", prompt_len=17,
+                    arrival=0.0, prompt=[1] * 17), 0.0,
+        )
+        assert shed.status == "shed"
+        assert shed.reason == SHED_OVERSIZED
+        assert not shed.retryable
+        # an in-bounds request admits THROUGH the live server and
+        # hands back a real first token
+        ok = router.submit(
+            Request(rid="ok", model="toy", prompt_len=3,
+                    arrival=0.0, prompt=[5, 9, 13]), 0.0,
+        )
+        assert ok.status == "admitted"
+        assert ok.first_token is not None
+        assert server.free_slots() == server.slots - 1
+        # completion retires the slot on the live server too
+        router.complete("ok", 1.0)
+        assert server.free_slots() == server.slots
+
+    def test_router_complete_never_retires_a_reused_slot(self):
+        """max_new=1: the server auto-retires the slot inside admit
+        itself. If a second request is then granted the SAME slot, the
+        first request's router-side complete() must not retire it out
+        from under the new stream."""
+        from kubeshare_tpu.serving import Request, RequestRouter
+
+        server = DecodeServer(PARAMS, CFG, slots=1,
+                              prompt_buckets=(8,), max_new=1)
+        router = RequestRouter()
+        router.register_server("serving/pod-a", "toy", server)
+        r1 = router.submit(
+            Request(rid="r1", model="toy", prompt_len=2,
+                    arrival=0.0, prompt=[5, 9]), 0.0,
+        )
+        assert r1.status == "admitted"
+        assert not server.active[0]  # auto-retired at admit
+        # r1's stream is done from the server's view; the router
+        # serves it out, freeing the ROUTER slot for r2
+        router.complete("r1", 1.0)
+        r2 = router.submit(
+            Request(rid="r2", model="toy", prompt_len=2,
+                    arrival=1.0, prompt=[7, 11]), 1.0,
+        )
+        assert r2.status == "admitted"
+        # now a stale complete for r1 must be a no-op (double call),
+        # and r2's retire must come only from ITS completion
+        router.complete("r1", 2.0)
+        assert not server.active[0]  # r2 also max_new=1 auto-retired
+        sub, acc = router.conservation("toy")
+        assert sub == acc == 2
+
+    def test_router_complete_with_live_midstream_second_tenant(self):
+        """Variant without max_new: R1 hits eos at admit (auto-retire)
+        while R2 decodes on the reused slot; R1's late complete()
+        leaves R2's stream alive."""
+        from kubeshare_tpu.serving import Request, RequestRouter
+
+        server = DecodeServer(PARAMS, CFG, slots=1, prompt_buckets=(8,),
+                              max_new=1)
+        router = RequestRouter()
+        router.register_server("serving/pod-a", "toy", server)
+        router.submit(Request(rid="r1", model="toy", prompt_len=2,
+                              arrival=0.0, prompt=[5, 9]), 0.0)
+        # r1's slot auto-retired; give the slot to a LONG stream by a
+        # second server-level tenant before r1's complete arrives
+        server.max_new = 0
+        out = server.admit([21, 3, 7])
+        assert out is not None and out[0] == 0
+        assert server.active[0]
+        router.complete("r1", 1.0)   # stale: must not kill slot 0
+        assert server.active[0], "live stream retired by stale complete"
+        assert server.step()         # still decoding
+
     def test_slot_reuse_after_retire(self):
         server = DecodeServer(PARAMS, CFG, slots=1, prompt_buckets=(8,))
         s, _ = server.admit([5, 9])

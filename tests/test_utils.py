@@ -222,3 +222,21 @@ class TestMetricServer:
                 urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/nope")
         finally:
             srv.stop()
+
+
+class TestPercentile:
+    def test_nearest_rank_properties(self):
+        from kubeshare_tpu.utils.stats import percentile
+
+        assert percentile([], 0.5) == 0.0
+        assert percentile([3.0], 0.99) == 3.0
+        values = [5.0, 1.0, 3.0, 2.0, 4.0]
+        assert percentile(values, 0.0) == 1.0
+        assert percentile(values, 1.0) == 5.0
+        # monotone in q
+        qs = [0.0, 0.25, 0.5, 0.75, 0.9, 1.0]
+        out = [percentile(values, q) for q in qs]
+        assert out == sorted(out)
+        # rounding knob (EXPLAIN.json banks 1-digit percentiles)
+        assert percentile([1.2345], 0.5) == 1.234
+        assert percentile([1.2345], 0.5, ndigits=1) == 1.2

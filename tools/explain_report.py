@@ -38,18 +38,11 @@ from kubeshare_tpu.scheduler import constants as C  # noqa: E402
 from kubeshare_tpu.sim.simulator import Simulator  # noqa: E402
 from kubeshare_tpu.sim.trace import generate_starvation_trace  # noqa: E402
 
+from kubeshare_tpu.utils.stats import percentile  # noqa: E402
+
 OUT = os.path.join(REPO, "EXPLAIN.json")
 
 TERMINALS = ("bound", "unschedulable", "deleted", "pending")
-
-
-def percentile(values, q: float) -> float:
-    """Nearest-rank percentile; monotone in q by construction."""
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
-    return round(ordered[idx], 1)
 
 
 def tenant_wait_rows(pods: dict) -> dict:
@@ -76,12 +69,12 @@ def tenant_wait_rows(pods: dict) -> dict:
             "bound": len(row["bound"]),
             "pending_at_horizon": len(row["pending"]),
             "other_terminal": row["other"],
-            "p50_bound_wait_s": percentile(row["bound"], 0.50),
-            "p90_bound_wait_s": percentile(row["bound"], 0.90),
-            "p99_bound_wait_s": percentile(row["bound"], 0.99),
-            "p50_censored_wait_s": percentile(censored, 0.50),
-            "p90_censored_wait_s": percentile(censored, 0.90),
-            "p99_censored_wait_s": percentile(censored, 0.99),
+            "p50_bound_wait_s": percentile(row["bound"], 0.50, ndigits=1),
+            "p90_bound_wait_s": percentile(row["bound"], 0.90, ndigits=1),
+            "p99_bound_wait_s": percentile(row["bound"], 0.99, ndigits=1),
+            "p50_censored_wait_s": percentile(censored, 0.50, ndigits=1),
+            "p90_censored_wait_s": percentile(censored, 0.90, ndigits=1),
+            "p99_censored_wait_s": percentile(censored, 0.99, ndigits=1),
         }
     return out
 
