@@ -45,6 +45,14 @@ explain-report:
 serving-sim:
 	$(PYTHON) tools/serving_sim.py
 
+# 128-node chaos gauntlet -> CHAOS.json (node flaps, pod kills, API
+# error drizzle + flake outages, scheduler crash/restarts incl. one
+# armed mid-pass; graded by hard invariants: zero double-binds, exact
+# conservation, ledger rebuild == continued, bounded recovery,
+# goodput floor, /explain served from the journal spool)
+chaos-sim:
+	$(PYTHON) tools/chaos_sim.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -89,4 +97,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim explain-report serving-sim dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim explain-report serving-sim chaos-sim dryrun images push save kind-e2e perf-evidence clean

@@ -42,7 +42,7 @@ an immutable copy.
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
-from typing import Dict, Iterable, List, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from ..utils import expfmt
 
@@ -110,12 +110,20 @@ class DemandLedger:
         self.on_transition = on_transition
 
     def note(self, pod_key: str, req, reason: str, now: float,
-             chips: float, mem: int) -> DemandEntry:
+             chips: float, mem: int,
+             since_hint: Optional[float] = None) -> DemandEntry:
         """File or refresh the pod's pending-demand entry; returns it
         (the decision journal reconciles against the entry's ``since``
         to survive its own LRU evictions). ``since`` survives reason
         changes — a pod that moved from over-quota to
-        fragmentation-blocked has been starving the whole time."""
+        fragmentation-blocked has been starving the whole time.
+
+        ``since_hint`` (crash recovery): a FIRST filing may backdate
+        ``since`` to the pod's creation time mapped onto the engine
+        clock — a restarted scheduler rebuilds this ledger empty, and
+        without the hint every pre-crash pod's wait clock would reset
+        to the restart. An existing entry's ``since`` always wins (it
+        is at least as old as any hint the same process can offer)."""
         prior = self._entries.get(pod_key)
         if self.on_transition is not None and (
             prior is None or prior.reason != reason
@@ -124,6 +132,12 @@ class DemandLedger:
                 pod_key, prior.reason if prior is not None else None,
                 reason, now,
             )
+        if prior is not None:
+            since = prior.since
+        elif since_hint is not None:
+            since = min(now, since_hint)
+        else:
+            since = now
         entry = DemandEntry(
             pod_key=pod_key,
             tenant=req.tenant,
@@ -133,7 +147,7 @@ class DemandLedger:
             chips=chips,
             mem=mem,
             reason=reason,
-            since=prior.since if prior is not None else now,
+            since=since,
             updated=now,
         )
         self._entries[pod_key] = entry
@@ -141,16 +155,19 @@ class DemandLedger:
 
     def note_batch(self, items, resolver) -> List[DemandEntry]:
         """File a wave's buffered notes in one pass: ``items`` is a
-        sequence of ``(pod_key, req, reason, now)`` and ``resolver``
-        maps a requirement to its resolved ``(chips, mem)`` (the quota
-        plane's ``demand`` — resolution happens at flush time so the
-        gate and the ledger still share one answer). Returns the
-        filed entries in order, for the journal reconciliation that
-        rides each one's ``since``."""
-        return [
-            self.note(pod_key, req, reason, now, *resolver(req))
-            for pod_key, req, reason, now in items
-        ]
+        sequence of ``(pod_key, req, reason, now[, since_hint])`` and
+        ``resolver`` maps a requirement to its resolved ``(chips,
+        mem)`` (the quota plane's ``demand`` — resolution happens at
+        flush time so the gate and the ledger still share one answer).
+        Returns the filed entries in order, for the journal
+        reconciliation that rides each one's ``since``."""
+        out = []
+        for item in items:
+            pod_key, req, reason, now = item[:4]
+            hint = item[4] if len(item) > 4 else None
+            out.append(self.note(pod_key, req, reason, now,
+                                 *resolver(req), since_hint=hint))
+        return out
 
     def resolve(self, pod_key: str) -> None:
         """The pod bound or left the cluster — either way it no longer

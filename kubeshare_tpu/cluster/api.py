@@ -47,6 +47,11 @@ class Pod:
     phase: PodPhase = PodPhase.PENDING
     scheduler_name: str = ""
     containers: List[Container] = field(default_factory=lambda: [Container()])
+    # metadata.creationTimestamp (epoch seconds; 0.0 = unknown). Crash
+    # recovery backdates a pending pod's wait clock to this instead of
+    # resetting it at the restarted scheduler's first attempt — the
+    # user has been waiting since creation, not since the restart.
+    created_at: float = 0.0
 
     @property
     def key(self) -> str:

@@ -26,6 +26,10 @@ class FakeCluster:
         self._uid_counter = itertools.count(1)
         self.evictions: List[str] = []  # defrag evict() calls, in order
         self.events: List[tuple] = []   # post_event records
+        # bind() calls that tried to move an ALREADY-BOUND pod to a
+        # different node — the chaos gauntlet's hardest invariant
+        # (must stay 0; a real apiserver would 409 these)
+        self.double_binds: List[tuple] = []
 
     # ---- ClusterAPI ------------------------------------------------
 
@@ -46,6 +50,10 @@ class FakeCluster:
 
     def bind(self, pod_key: str, node_name: str) -> None:
         pod = self._pods[pod_key]
+        if pod.node_name and pod.node_name != node_name:
+            # recorded, not raised: the invariant check must observe
+            # the violation even on code paths that swallow Conflict
+            self.double_binds.append((pod_key, pod.node_name, node_name))
         pod.node_name = node_name
         pod.phase = PodPhase.RUNNING
 
@@ -68,6 +76,16 @@ class FakeCluster:
 
     def on_node_event(self, update) -> None:
         self._node_handlers.append(update)
+
+    def reset_handlers(self) -> None:
+        """Detach every registered informer handler — the crash-
+        recovery path: a 'restarted' engine registers fresh handlers
+        against the same cluster, and the dead engine must stop
+        receiving events (a real restart tears its watches down with
+        the process)."""
+        self._pod_add_handlers = []
+        self._pod_delete_handlers = []
+        self._node_handlers = []
 
     # ---- test-side verbs -------------------------------------------
 

@@ -1,0 +1,137 @@
+"""Seeded fault injection over any ClusterAPI — the chaos gauntlet's
+error source.
+
+``FaultInjector`` wraps a cluster adapter and makes its WRITE verbs
+(``bind`` / ``patch_pod`` / ``evict``) and, during a flake window, its
+read verbs fail deterministically (explicit seed, no wall clock):
+
+- **error rate** — each intercepted call independently raises
+  ``ApiFault`` with probability ``error_rate`` (a steady drizzle of
+  429/5xx-shaped failures, exercising retry paths and the engine's
+  reserve-rollback / bind-retry recovery);
+- **conflict rate** — ``bind`` raises ``cluster.api.Conflict`` with
+  probability ``conflict_rate`` (a peer replica winning the race; the
+  engine must unreserve and requeue, never leak the reservation);
+- **flake window** — ``start_flake(duration)`` makes EVERY intercepted
+  verb fail until the injected clock passes the deadline (the
+  apiserver is down; scheduling passes fail whole and the control
+  plane must degrade, not wedge);
+- **crash point** — ``arm_crash(after_binds=N)`` raises ``SimCrash``
+  out of the Nth subsequent ``bind`` (after the bind LANDED — the
+  worst spot: cluster state moved, the process died before observing
+  it). The simulator catches it and rebuilds the engine from relist.
+
+Everything not intercepted delegates to the wrapped adapter, so an
+injector with zero rates is decision-for-decision transparent —
+committed artifacts replay unchanged through it.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional
+
+
+class ApiFault(RuntimeError):
+    """An injected API failure (429/5xx/transport-shaped). Carries
+    ``code`` like ``kube.KubeError`` so handling code can treat both
+    uniformly."""
+
+    def __init__(self, message: str, code: int = 503):
+        super().__init__(message)
+        self.code = code
+
+
+class SimCrash(RuntimeError):
+    """An injected scheduler crash point. Raised out of the cluster
+    API mid-pass; the simulator's run loop catches it and rebuilds
+    the engine from cluster state (the restart path)."""
+
+
+class FaultInjector:
+    def __init__(
+        self,
+        inner,
+        clock: Callable[[], float],
+        seed: int = 0,
+        error_rate: float = 0.0,
+        conflict_rate: float = 0.0,
+    ):
+        self.inner = inner
+        self.clock = clock
+        self.rng = random.Random(seed)
+        self.error_rate = error_rate
+        self.conflict_rate = conflict_rate
+        self.flake_until = float("-inf")
+        self._crash_after_binds: Optional[int] = None
+        self.injected_errors = 0
+        self.injected_conflicts = 0
+        self.crashes_armed = 0
+
+    # ---- fault controls (driven by sim fault events) ----------------
+
+    def start_flake(self, duration: float) -> None:
+        self.flake_until = max(self.flake_until, self.clock() + duration)
+
+    @property
+    def flaking(self) -> bool:
+        return self.clock() < self.flake_until
+
+    def arm_crash(self, after_binds: int = 1) -> None:
+        self._crash_after_binds = max(1, after_binds)
+        self.crashes_armed += 1
+
+    # ---- interception ----------------------------------------------
+
+    def _maybe_fault(self, verb: str) -> None:
+        if self.flaking:
+            self.injected_errors += 1
+            raise ApiFault(f"injected flake: {verb} unavailable")
+        if self.error_rate > 0 and self.rng.random() < self.error_rate:
+            self.injected_errors += 1
+            raise ApiFault(f"injected error: {verb} failed")
+
+    def bind(self, pod_key: str, node_name: str) -> None:
+        self._maybe_fault("bind")
+        if self.conflict_rate > 0 and self.rng.random() < self.conflict_rate:
+            from .api import Conflict
+
+            self.injected_conflicts += 1
+            raise Conflict(
+                f"injected conflict: {pod_key} bound by a peer replica"
+            )
+        self.inner.bind(pod_key, node_name)
+        if self._crash_after_binds is not None:
+            self._crash_after_binds -= 1
+            if self._crash_after_binds <= 0:
+                # AFTER the bind landed: the cluster moved, the
+                # scheduler dies before recording it — the exact gap
+                # restart resync must close without double-binding
+                self._crash_after_binds = None
+                raise SimCrash(f"injected crash after binding {pod_key}")
+
+    def patch_pod(self, pod_key, annotations=None, env=None) -> None:
+        self._maybe_fault("patch_pod")
+        self.inner.patch_pod(pod_key, annotations=annotations, env=env)
+
+    def evict(self, pod_key: str) -> None:
+        self._maybe_fault("evict")
+        self.inner.evict(pod_key)
+
+    def list_pods(self, namespace=None):
+        if self.flaking:  # reads fail only while the apiserver is down
+            self.injected_errors += 1
+            raise ApiFault("injected flake: list_pods unavailable")
+        return self.inner.list_pods(namespace)
+
+    def list_nodes(self):
+        if self.flaking:
+            self.injected_errors += 1
+            raise ApiFault("injected flake: list_nodes unavailable")
+        return self.inner.list_nodes()
+
+    def __getattr__(self, name):
+        # everything else (get_pod/get_node, informer registration,
+        # chips_on_node, the fake's test-side verbs, counters) passes
+        # straight through to the wrapped adapter
+        return getattr(self.inner, name)

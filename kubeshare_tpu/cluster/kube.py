@@ -30,10 +30,12 @@ Prometheus-backed ``getGPUByNode`` (pkg/scheduler/gpu.go:22-53).
 
 from __future__ import annotations
 
+import calendar
 import datetime
 import json
 import os
 import queue
+import random
 import ssl
 import threading
 import time
@@ -45,6 +47,11 @@ from .api import Conflict, Container, Node, Pod, PodPhase
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+# HTTP statuses worth retrying: throttling and server-side failures.
+# Everything else 4xx is a semantic answer (403 RBAC, 404 gone, 409
+# conflict, 422 invalid) that a retry can only repeat.
+RETRYABLE_CODES = frozenset({429, 500, 502, 503, 504})
+
 
 class KubeError(RuntimeError):
     def __init__(self, message: str, code: int = 0):
@@ -55,6 +62,19 @@ class KubeError(RuntimeError):
 class KubeConflict(KubeError, Conflict):
     """HTTP 409 — catchable either as a KubeError (transport layer) or
     as the adapter-neutral ``cluster.api.Conflict`` (engine layer)."""
+
+
+def _parse_k8s_time(stamp: str) -> float:
+    """RFC3339 ``creationTimestamp`` -> epoch seconds (0.0 on any
+    parse trouble — the wait-clock recovery it feeds is best-effort)."""
+    if not stamp:
+        return 0.0
+    try:
+        return float(calendar.timegm(
+            time.strptime(stamp, "%Y-%m-%dT%H:%M:%SZ")
+        ))
+    except (ValueError, TypeError):
+        return 0.0
 
 
 def pod_from_k8s(obj: dict) -> Pod:
@@ -82,6 +102,7 @@ def pod_from_k8s(obj: dict) -> Pod:
         phase=PodPhase(status.get("phase", "Pending")),
         scheduler_name=spec.get("schedulerName", "") or "",
         containers=containers,
+        created_at=_parse_k8s_time(meta.get("creationTimestamp", "")),
     )
 
 
@@ -102,51 +123,103 @@ def node_from_k8s(obj: dict) -> Node:
 
 
 class _WatchChannel:
-    """Background reader of one ``?watch=true`` stream.
+    """Background reader of one ``?watch=true`` stream, with
+    reconnect-and-backoff.
 
     The reader thread only does IO + JSON parsing into ``events``;
     nothing fires handlers here — the scheduler thread drains via
     ``KubeCluster.poll()``, preserving the engine's single-threaded
-    discipline. ``alive`` flips False on EOF/timeout/error; the next
-    poll() relists and reopens (reflector resync)."""
+    discipline.
 
-    def __init__(self, open_stream: Callable, path: str):
+    A dropped stream that had DELIVERED something is a routine watch
+    expiry: the reader reopens it itself from the caller's current
+    resourceVersion (``path_for`` re-renders the URL per attempt) after
+    a jittered exponential backoff, bumping ``reconnects`` (and the
+    caller's counter via ``on_reconnect``) instead of silently dying —
+    the bare-``except``-then-die shape this replaces turned every
+    stream hiccup into a full relist. ``alive`` flips False only when
+    reconnecting would be wrong: close(), an ERROR/410 event (the
+    caller forces it — resuming from a compacted resourceVersion
+    would spin), the FIRST connection dying barren, or
+    ``BARREN_STREAK`` consecutive reconnects yielding nothing (the
+    open path itself is failing — 403 after an RBAC change, cert
+    rotation); the next poll() then relists and reopens."""
+
+    BARREN_STREAK = 3
+
+    def __init__(self, open_stream: Callable, path_for: Callable[[], str],
+                 on_reconnect: Optional[Callable[[], None]] = None,
+                 backoff_base: float = 0.25, backoff_max: float = 8.0,
+                 rng: Optional[random.Random] = None):
         self.events: "queue.Queue" = queue.Queue()
         self.pending: List[dict] = []  # drained but not yet applied
+        self.head_failures = 0  # poison-pill quarantine (see _drain_apply)
         self.alive = True
         self.delivered = False  # saw at least one event (incl. bookmarks)
-        self.path = path
+        self.path_for = path_for
+        self.path = path_for()  # first URL, kept for debugging
+        self.reconnects = 0
+        self.on_reconnect = on_reconnect
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._rng = rng or random.Random()
         self._resp = None
         self._closed = False
+        self._stop = threading.Event()  # interrupts the backoff sleep
         self._thread = threading.Thread(
             target=self._run, args=(open_stream,), daemon=True
         )
         self._thread.start()
 
     def _run(self, open_stream):
-        resp = None
-        try:
-            resp = open_stream(self.path)
-            self._resp = resp
-            if self._closed:
-                return  # close() raced the connect; don't read on
-            for raw in resp:
-                if self._closed:
-                    break
-                line = raw.strip()
-                if not line:
-                    continue
-                self.delivered = True
-                self.events.put(json.loads(line))
-        except Exception:
-            pass  # dropped stream: alive=False below triggers relist
-        finally:
-            self.alive = False
+        delay = self.backoff_base
+        barren_streak = 0
+        while not self._closed:
+            resp = None
+            conn_delivered = False
             try:
-                if resp is not None:
-                    resp.close()
+                resp = open_stream(self.path_for())
+                self._resp = resp
+                if self._closed:
+                    break  # close() raced the connect; don't read on
+                for raw in resp:
+                    if self._closed:
+                        break
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    conn_delivered = True
+                    self.delivered = True
+                    delay = self.backoff_base  # healthy stream: reset
+                    ev = json.loads(line)
+                    self.events.put(ev)
+                    if isinstance(ev, dict) and ev.get("type") == "ERROR":
+                        # 410 Gone and friends: the stream's
+                        # resourceVersion is unusable — reconnecting
+                        # from it would hot-loop ERROR->reopen until
+                        # the next poll; die now so poll() relists
+                        self.alive = False
+                        break
             except Exception:
-                pass
+                pass  # dropped stream: reconnect (or die) below
+            finally:
+                self._resp = None
+                try:
+                    if resp is not None:
+                        resp.close()
+                except Exception:
+                    pass
+            if self._closed or not self.alive:
+                break  # closed, or the caller forced death (ERROR/410)
+            barren_streak = 0 if conn_delivered else barren_streak + 1
+            if not self.delivered or barren_streak >= self.BARREN_STREAK:
+                break  # the open path itself is failing: poll() relists
+            self.reconnects += 1
+            if self.on_reconnect is not None:
+                self.on_reconnect()
+            self._stop.wait(self._rng.uniform(0.0, delay))  # full jitter
+            delay = min(delay * 2.0, self.backoff_max)
+        self.alive = False
 
     def drain(self) -> List[dict]:
         out = []
@@ -175,6 +248,7 @@ class _WatchChannel:
         import socket as _socket
 
         self._closed = True
+        self._stop.set()  # a channel asleep in backoff exits now
         resp = self._resp
         if resp is None:
             return
@@ -216,6 +290,9 @@ class KubeCluster:
         timeout: float = 10.0,
         use_watch: bool = False,
         watch_timeout: float = 120.0,
+        retry_budget: int = 4,
+        backoff_base: float = 0.25,
+        backoff_max: float = 8.0,
     ):
         if not api_server:
             host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
@@ -258,6 +335,27 @@ class KubeCluster:
         self._event_sent: Dict[tuple, float] = {}  # dedup (see post_event)
         self._event_errors = 0          # consecutive failures
         self._event_breaker_until = 0.0  # circuit breaker deadline
+        # ---- fault-tolerance knobs + health counters ----------------
+        # retry_budget: RETRIES after the first attempt (429/5xx/
+        # transport errors only); full-jitter exponential backoff
+        # between attempts. 0 restores the old fail-fast behavior.
+        self.retry_budget = max(0, retry_budget)
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._rng = random.Random()
+        self._sleep = time.sleep  # injectable for tests
+        self.api_retries = 0          # retried attempts, cumulative
+        self.api_errors = 0           # requests that failed ALL attempts
+        self.watch_reconnects = 0     # streams reopened in place
+        self.poison_events = 0        # quarantined informer events
+        # degraded: the last API request exhausted its retry budget on
+        # a retryable failure — the apiserver is unreachable/unhealthy.
+        # The scheduler keeps serving /metrics + /explain and queues
+        # decisions (pods stay pending; RESERVED pods whose bind verb
+        # failed are retried by the engine); the first successful
+        # request clears the flag AND forces a relist so the cache
+        # resyncs whatever the outage swallowed.
+        self.degraded = False
 
     # ---- HTTP plumbing ---------------------------------------------
 
@@ -265,27 +363,72 @@ class KubeCluster:
         self, method: str, path: str, body: Optional[dict] = None,
         content_type: str = "application/json",
     ) -> dict:
+        """One API call with a retry budget: throttling (429), server
+        errors (5xx), and transport failures (URLError/OSError) retry
+        up to ``retry_budget`` times with full-jitter exponential
+        backoff; semantic 4xx answers (403/404/409/422) surface
+        immediately — a retry can only repeat them. Retrying a
+        non-idempotent POST whose first attempt actually landed (the
+        response was lost) draws a 409, which callers already treat
+        as a lost race — conservative, never a double-apply.
+
+        Exhausting the budget on a retryable failure marks the
+        adapter ``degraded``; the next success clears it and forces a
+        relist so the cache resyncs whatever the outage swallowed."""
         url = self.base + path
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        if data is not None:
-            req.add_header("Content-Type", content_type)
-        try:
-            with urllib.request.urlopen(
-                req, timeout=self.timeout, context=self._ctx
-            ) as resp:
-                payload = resp.read().decode()
-        except urllib.error.HTTPError as e:
-            cls = KubeConflict if e.code == 409 else KubeError
-            raise cls(
-                f"{method} {path}: HTTP {e.code} {e.read().decode()[:300]}",
-                code=e.code,
-            ) from e
-        except (urllib.error.URLError, OSError) as e:
-            raise KubeError(f"{method} {path}: {e}") from e
-        return json.loads(payload) if payload else {}
+        attempt = 0
+        delay = self.backoff_base
+        while True:
+            req = urllib.request.Request(url, data=data, method=method)
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout, context=self._ctx
+                ) as resp:
+                    payload = resp.read().decode()
+            except urllib.error.HTTPError as e:
+                if e.code in RETRYABLE_CODES and attempt < self.retry_budget:
+                    attempt += 1
+                    self.api_retries += 1
+                    self._sleep(self._rng.uniform(0.0, delay))
+                    delay = min(delay * 2.0, self.backoff_max)
+                    continue
+                if e.code in RETRYABLE_CODES:
+                    self.api_errors += 1
+                    self.degraded = True
+                elif self.degraded:
+                    # a semantic 4xx is still an ANSWER: the apiserver
+                    # is reachable again — recover (and resync) even
+                    # when the first post-outage requests happen to be
+                    # 404/409s from a behind informer
+                    self.degraded = False
+                    self._watch_expired = True
+                cls = KubeConflict if e.code == 409 else KubeError
+                raise cls(
+                    f"{method} {path}: HTTP {e.code} "
+                    f"{e.read().decode()[:300]}",
+                    code=e.code,
+                ) from e
+            except (urllib.error.URLError, OSError) as e:
+                if attempt < self.retry_budget:
+                    attempt += 1
+                    self.api_retries += 1
+                    self._sleep(self._rng.uniform(0.0, delay))
+                    delay = min(delay * 2.0, self.backoff_max)
+                    continue
+                self.api_errors += 1
+                self.degraded = True
+                raise KubeError(f"{method} {path}: {e}") from e
+            if self.degraded:
+                # back from an outage: resync via relist — watch
+                # streams may have silently missed the outage window
+                self.degraded = False
+                self._watch_expired = True
+            return json.loads(payload) if payload else {}
 
     # ---- ClusterAPI ------------------------------------------------
 
@@ -599,12 +742,21 @@ class KubeCluster:
             return
         self._drain_apply()
 
+    POISON_RETRIES = 5
+
     def _drain_apply(self) -> None:
         """Apply queued events on the caller's thread. A handler
         exception leaves the failed event (and everything after it) in
         ``pending`` for the next poll — the cache is only committed
         after its handlers ran, so a blip never desyncs the engine
-        (the scheduler loop catches and retries, cmd/scheduler.py)."""
+        (the scheduler loop catches and retries, cmd/scheduler.py).
+
+        Poison-pill quarantine: an event whose handlers raise on
+        ``POISON_RETRIES`` consecutive polls is dropped (counted on
+        ``poison_events``, logged, and — for pod events — posted as a
+        Warning against the pod) so one malformed object can no
+        longer wedge the informer queue forever while every event
+        behind it goes stale."""
         for ch, apply in (
             (self._node_watch, self._apply_node_event),
             (self._pod_watch, self._apply_pod_event),
@@ -613,11 +765,82 @@ class KubeCluster:
                 continue
             ch.pending.extend(ch.drain())
             while ch.pending:
-                apply(ch.pending[0])  # may raise; event stays queued
+                try:
+                    apply(ch.pending[0])
+                except Exception as e:
+                    ch.head_failures += 1
+                    if ch.head_failures < self.POISON_RETRIES:
+                        raise  # event stays queued; next poll retries
+                    poisoned = ch.pending.pop(0)
+                    ch.head_failures = 0
+                    self.poison_events += 1
+                    # a dropped event desyncs the cache — for DELETED
+                    # it is the object's TERMINAL event and nothing
+                    # will ever re-deliver it (the engine would keep
+                    # its capacity reserved forever). Kill the channel
+                    # and force a relist so the diff repairs the cache
+                    # within one poll cycle.
+                    ch.alive = False
+                    self._watch_expired = True
+                    self._report_poison(poisoned, e)
+                    continue
                 ch.pending.pop(0)
+                ch.head_failures = 0
+
+    def _report_poison(self, ev: dict, err: Exception) -> None:
+        import logging
+
+        meta = (ev.get("object") or {}).get("metadata") or {}
+        what = f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+        logging.getLogger("kubeshare.kube").error(
+            "quarantined poison %s event for %s after %d failed "
+            "applies: %s", ev.get("type", "?"), what, self.POISON_RETRIES,
+            err,
+        )
+        kind = (ev.get("object") or {}).get("kind") or ""
+        if kind in ("", "Pod") and meta.get("name"):
+            try:
+                self.post_event(
+                    f"{meta.get('namespace', 'default')}/{meta['name']}",
+                    "EventQuarantined",
+                    f"scheduler quarantined a {ev.get('type', '?')} watch "
+                    f"event after {self.POISON_RETRIES} failed applies: "
+                    f"{err}",
+                    "Warning",
+                )
+            except Exception:
+                pass  # best-effort observability
 
     def close(self) -> None:
         self._close_watches()
+
+    def samples(self):
+        """API-health gauges for the scheduler's /metrics (merged by
+        ``SchedulerMetrics`` when it is handed the cluster): retry and
+        exhausted-budget counters, watch reconnects, quarantined
+        poison events, and the degraded flag — the signals a fleet
+        alert fires on before pods start visibly not scheduling."""
+        from ..utils import expfmt
+
+        return [
+            expfmt.Sample(
+                "tpu_scheduler_api_retries_total", {}, self.api_retries
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_api_errors_total", {}, self.api_errors
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_watch_reconnects_total", {},
+                self.watch_reconnects,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_poison_events_total", {},
+                self.poison_events,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_degraded", {}, 1 if self.degraded else 0
+            ),
+        ]
 
     def _open_stream(self, path: str):
         req = urllib.request.Request(self.base + path)
@@ -627,17 +850,36 @@ class KubeCluster:
             req, timeout=self.watch_timeout, context=self._ctx
         )
 
+    def _note_watch_reconnect(self) -> None:
+        self.watch_reconnects += 1
+
     def _open_watches(self) -> None:
         q = "?watch=true&allowWatchBookmarks=true"
-        pod_q = q + (f"&resourceVersion={self._pod_rv}" if self._pod_rv else "")
-        node_q = q + (
-            f"&resourceVersion={self._node_rv}" if self._node_rv else ""
-        )
+
+        # path factories, not baked paths: a channel reconnecting in
+        # place resumes from the CURRENT resourceVersion (advanced as
+        # poll() applies events), not the one at first open — resuming
+        # from a stale rv re-delivers at best and draws 410 at worst
+        def pod_path() -> str:
+            return (
+                self._pods_path(self.ns_selector or None) + q
+                + (f"&resourceVersion={self._pod_rv}"
+                   if self._pod_rv else "")
+            )
+
+        def node_path() -> str:
+            return "/api/v1/nodes" + q + (
+                f"&resourceVersion={self._node_rv}"
+                if self._node_rv else ""
+            )
+
         self._pod_watch = _WatchChannel(
-            self._open_stream, self._pods_path(self.ns_selector or None) + pod_q
+            self._open_stream, pod_path,
+            on_reconnect=self._note_watch_reconnect,
         )
         self._node_watch = _WatchChannel(
-            self._open_stream, "/api/v1/nodes" + node_q
+            self._open_stream, node_path,
+            on_reconnect=self._note_watch_reconnect,
         )
 
     def _close_watches(self) -> None:

@@ -59,6 +59,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="tpu_capacity endpoint for chip inventory in --kube mode "
              "(collector service or Prometheus federate)",
     )
+    parser.add_argument(
+        "--api-retries", type=int, default=4,
+        help="--kube mode: retries per API request on 429/5xx/"
+             "transport failures, with full-jitter exponential "
+             "backoff (0 = fail fast). Exhausting the budget marks "
+             "the adapter degraded (tpu_scheduler_degraded=1): the "
+             "loop keeps serving /metrics and /explain, pods queue, "
+             "and the first success after the outage forces a relist "
+             "resync",
+    )
     parser.add_argument("--interval", type=float, default=1.0,
                         help="seconds between scheduling passes")
     parser.add_argument(
@@ -178,6 +188,25 @@ def build_parser() -> argparse.ArgumentParser:
              "/explain and the wait-SLO histograms",
     )
     parser.add_argument(
+        "--journal-spool", default="", metavar="PATH",
+        help="durable explain spool: append every pod's TERMINAL "
+             "journal document (bound / permanent-reject / deleted) "
+             "as one JSONL line here, rotating at --journal-spool-"
+             "max-mb across --journal-spool-files files. /explain "
+             "falls back to the spool on a miss, so provenance for "
+             "pods bound by a PREVIOUS scheduler incarnation (or "
+             "LRU-evicted from the in-memory journal) survives "
+             "restarts. '' = off (in-memory journal only)",
+    )
+    parser.add_argument(
+        "--journal-spool-max-mb", type=float, default=16.0,
+        help="rotate the journal spool's active file past this size",
+    )
+    parser.add_argument(
+        "--journal-spool-files", type=int, default=4,
+        help="rotated spool files kept (disk bound is max-mb x files)",
+    )
+    parser.add_argument(
         "--wave-size", type=int, default=0, metavar="K",
         help="drain the queue as ONE batched wave of up to K "
              "attempts per pass (engine.schedule_wave: one inventory "
@@ -245,7 +274,7 @@ class SchedulerMetrics:
     (scheduler.go [Filter]/[Score]/[Reserve] Infof)."""
 
     def __init__(self, clock=time.time, tracer=None, engine=None,
-                 elector=None, planner=None, router=None):
+                 elector=None, planner=None, router=None, cluster=None):
         self.clock = clock
         self.tracer = tracer
         self.engine = engine
@@ -254,6 +283,11 @@ class SchedulerMetrics:
         # serving.RequestRouter (optional): merges the request plane's
         # tpu_serving_* gauges/histograms into the same exposition
         self.router = router
+        # cluster adapter (optional): any adapter exposing samples()
+        # (KubeCluster) merges its API-health families — retry /
+        # exhausted-budget counters, watch reconnects, quarantined
+        # poison events, the degraded flag
+        self.cluster = cluster
         self.decisions = {"bound": 0, "waiting": 0, "unschedulable": 0}
         self.passes = 0
         self.last_pass_seconds = 0.0
@@ -298,6 +332,8 @@ class SchedulerMetrics:
                 "tpu_scheduler_last_render_timestamp_seconds", {}, now
             ),
         ]
+        if self.cluster is not None and hasattr(self.cluster, "samples"):
+            samples += self.cluster.samples()
         if self.engine is not None:
             samples += self.engine.utilization_samples()
         if self.planner is not None:
@@ -392,7 +428,9 @@ def _run_pass_inner(engine, cluster, journal, metrics, started,
         if p.scheduler_name == C.SCHEDULER_NAME
         and not p.is_bound
         and not p.is_completed
-        and engine.status.get(p.key) is None
+        # needs_offer, not "no status": a RESERVED pod whose bind verb
+        # failed must be re-offered so the engine retries the bind
+        and engine.needs_offer(p.key)
     ]
     pending.sort(key=engine.queue_sort_key)
     if requeue:
@@ -508,7 +546,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise SystemExit(
                 "--kube requires --capacity-url (chip inventory source)"
             )
-        cluster = KubeCluster(api_server=args.api_server, use_watch=args.watch)
+        cluster = KubeCluster(api_server=args.api_server,
+                              use_watch=args.watch,
+                              retry_budget=args.api_retries)
         inventory = CapacityInventory(args.capacity_url, log=log)
     else:
         cluster = SnapshotCluster(args.cluster_state)
@@ -520,6 +560,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # events only matter when a trace file is requested; metrics
         # alone just needs the histograms
         tracer = Tracer(keep_events=bool(args.trace_out))
+    spool = None
+    if args.journal_spool:
+        from ..explain.spool import JournalSpool
+
+        spool = JournalSpool(
+            args.journal_spool,
+            max_bytes=int(args.journal_spool_max_mb * (1 << 20)),
+            max_files=args.journal_spool_files,
+            log=log,
+        )
+        log.info("journal spool at %s (%.0f MiB x %d files)",
+                 args.journal_spool, args.journal_spool_max_mb,
+                 args.journal_spool_files)
     engine = TpuShareScheduler(
         topology=args.topology,
         cluster=cluster,
@@ -527,6 +580,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         permit_wait_base=args.permit_wait_base,
         log=log,
         tracer=tracer,
+        journal_spool=spool,
         defrag=args.defrag,
         defrag_max_victims=args.defrag_max_victims,
         defrag_hold_ttl=args.defrag_hold_ttl,
@@ -582,7 +636,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
 
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
-                               elector=elector, planner=planner)
+                               elector=elector, planner=planner,
+                               cluster=cluster if args.kube else None)
     metrics_server = None
     if args.metrics_port:
         from ..utils.httpserv import MetricServer
@@ -663,7 +718,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 planner.run_once()
                 planner_ran_at = time.monotonic()
         except Exception as e:  # apiserver blips must not kill the loop
-            log.error("scheduling pass failed: %s", e)
+            # degraded mode: the loop keeps serving /metrics and
+            # /explain while the apiserver is away; pods queue, and
+            # the adapter forces a relist resync on recovery
+            log.error(
+                "scheduling pass failed%s: %s",
+                " (API degraded; decisions queued until recovery)"
+                if getattr(cluster, "degraded", False) else "",
+                e,
+            )
         if args.trace_out and metrics.passes - trace_written_at >= 100:
             tracer.write_chrome_trace(args.trace_out)
             trace_written_at = metrics.passes

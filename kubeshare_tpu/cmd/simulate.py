@@ -38,7 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--faults", default="",
         help="fault-injection file: lines 'time kind [target [chips]]' "
              "with kind in node_down|node_up|pod_kill|node_add|"
-             "node_remove; chips only for node_add (# comments allowed)",
+             "node_remove|scheduler_crash|api_flake; chips only for "
+             "node_add ('time scheduler_crash [after_binds]' arms a "
+             "mid-pass crash, 'time api_flake [duration_s]' takes the "
+             "API down; both control-plane kinds auto-enable fault "
+             "injection; # comments allowed)",
     )
     parser.add_argument(
         "--defrag", action="store_true",
@@ -68,8 +72,21 @@ def load_faults(path: str):
                     f"{path}:{line_no}: expected 'time kind "
                     f"[target [chips]]'"
                 )
+            kind = parts[1]
+            if kind == "api_flake":
+                faults.append(FaultEvent(
+                    time=float(parts[0]), kind=kind,
+                    duration=float(parts[2]) if len(parts) >= 3 else 30.0,
+                ))
+                continue
+            if kind == "scheduler_crash":
+                faults.append(FaultEvent(
+                    time=float(parts[0]), kind=kind,
+                    chips=int(parts[2]) if len(parts) >= 3 else 0,
+                ))
+                continue
             faults.append(FaultEvent(
-                time=float(parts[0]), kind=parts[1],
+                time=float(parts[0]), kind=kind,
                 target=parts[2] if len(parts) >= 3 else "",
                 chips=int(parts[3]) if len(parts) == 4 else 0,
             ))
@@ -100,17 +117,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from ..utils.trace import Tracer
 
         tracer = Tracer(keep_events=False)
+    faults = load_faults(args.faults) if args.faults else None
+    # mid-pass crash points and flake windows need the injector wrap
+    inject = bool(faults) and any(
+        f.kind == "api_flake" or (f.kind == "scheduler_crash"
+                                  and f.chips > 0)
+        for f in faults
+    )
     sim = Simulator(
         args.topology, nodes,
         priority_ratio=args.priority_ratio, seed=args.seed, tracer=tracer,
-        defrag=args.defrag,
+        defrag=args.defrag, inject_faults=inject, fault_seed=args.seed,
     )
     import time as _time
 
     wall0 = _time.perf_counter()
-    report = sim.run(
-        events, faults=load_faults(args.faults) if args.faults else None
-    )
+    report = sim.run(events, faults=faults)
     wall = _time.perf_counter() - wall0
     doc = report.to_dict()
     if args.bench:

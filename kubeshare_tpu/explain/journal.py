@@ -165,7 +165,7 @@ class DecisionJournal:
     ``/explain`` are empty in that mode (documented trade)."""
 
     def __init__(self, capacity: int = 512, attempts_per_pod: int = 8,
-                 log=None):
+                 log=None, spool=None):
         if capacity < 0:
             raise ValueError(
                 f"journal capacity must be >= 0 (0 disables), got {capacity}"
@@ -173,6 +173,11 @@ class DecisionJournal:
         self.capacity = capacity
         self.attempts_per_pod = attempts_per_pod
         self.log = log
+        # optional durable spool (explain/spool.py): every terminal
+        # outcome appends the pod's full document as one JSONL line,
+        # and get() falls back to it on a miss — /explain answers for
+        # pre-restart (and LRU-evicted) pods survive the process
+        self.spool = spool
         self.evictions = 0
         self._entries: "OrderedDict[str, PodJournal]" = OrderedDict()
         self._lock = threading.Lock()
@@ -346,6 +351,19 @@ class DecisionJournal:
                 if hist is None:
                     hist = self._wait_hist[key] = Histogram(WAIT_BUCKETS)
                 hist.observe(max(0.0, now - entry.first_seen))
+            if self.spool is not None:
+                # the terminal is the one durable point worth paying
+                # for: a pending pod's journal is rebuilt by its next
+                # attempt, a terminal pod never attempts again
+                try:
+                    self.spool.append({
+                        "t": "pod", "pod": pod_key,
+                        "at": round(now, 3),
+                        "doc": entry.to_dict(now),
+                    })
+                except Exception as e:  # durability must not fail a bind
+                    if self.log is not None:
+                        self.log.error("journal spool append: %s", e)
 
     def carry_over(self, old_key: str, new_key: str) -> None:
         """A pod was resubmitted under a new name (fault kill / defrag
@@ -386,7 +404,17 @@ class DecisionJournal:
     def get(self, pod_key: str, now: float) -> Optional[dict]:
         with self._lock:
             entry = self._entries.get(pod_key)
-            return None if entry is None else entry.to_dict(now)
+            if entry is not None:
+                return entry.to_dict(now)
+        if self.spool is not None:
+            # restart / LRU-eviction fallback: the durable spool keeps
+            # every terminal document — /explain answers for pods a
+            # previous incarnation of this scheduler bound
+            doc = self.spool.recover(pod_key)
+            if doc is not None:
+                doc["recovered"] = True
+                return doc
+        return None
 
     def current_reason(self, pod_key: str) -> str:
         """The pod's latest timeline state ("" if unjournaled) — the
@@ -470,6 +498,21 @@ class DecisionJournal:
                     self.evictions,
                 ),
             ]
+            if self.spool is not None:
+                samples += [
+                    expfmt.Sample(
+                        "tpu_scheduler_explain_spool_appends_total", {},
+                        self.spool.appends,
+                    ),
+                    expfmt.Sample(
+                        "tpu_scheduler_explain_spool_rotations_total", {},
+                        self.spool.rotations,
+                    ),
+                    expfmt.Sample(
+                        "tpu_scheduler_explain_spool_recoveries_total", {},
+                        self.spool.recoveries,
+                    ),
+                ]
             for (tenant, shape, outcome), hist in sorted(
                 self._wait_hist.items()
             ):

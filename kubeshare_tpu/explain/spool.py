@@ -1,0 +1,178 @@
+"""Durable journal spool: append-only rotating JSONL under the
+decision journal.
+
+The in-memory ``DecisionJournal`` is bounded scratch — a restart (or
+an LRU eviction) loses a pod's provenance, and ``/explain`` answers
+404 for work the scheduler demonstrably did. The spool closes that
+gap at the cheapest durable point: every TERMINAL outcome (bound /
+permanent unschedulable / deleted) appends the pod's full journal
+document as one JSON line. Terminals are the only records worth
+persisting — a pending pod's journal is rebuilt live by its next
+attempt, but a bound pod never attempts again, so its provenance
+exists nowhere else after a restart.
+
+Line format (one JSON object per line)::
+
+    {"t": "pod", "pod": "<ns>/<name>", "at": <ts>, "doc": {...}}
+
+``doc`` is exactly ``PodJournal.to_dict()`` at outcome time: tenant,
+shape, attempts ring, reason timeline, outcome, waited_s.
+
+Rotation: when the active file exceeds ``max_bytes`` it shifts to
+``<path>.1`` (existing ``.1`` -> ``.2``, …; at most ``max_files``
+kept, oldest deleted), so disk use is bounded at roughly
+``max_bytes * max_files`` regardless of uptime. Recovery scans
+newest-first and returns the LAST record for the pod (a reused pod
+name's latest incarnation wins, matching the in-memory journal's
+replacement rule). A torn final line (crash mid-append) is skipped,
+never fatal.
+
+Thread-safety: appends come from the scheduling thread (under the
+journal's lock), recoveries from the metrics thread. The spool's own
+lock covers the write handle and rotation; SCANS deliberately run
+unlocked so a long /explain read can never stall the bind path. A
+rotation racing a scan is tolerated, not prevented: scans snapshot
+the file list and skip files that vanish mid-scan, so the worst
+cases are a record in the ABOUT-TO-BE-DELETED oldest file going
+unseen (equivalent to the rotation landing just before the scan) or
+``replay()`` yielding a just-rotated record twice — never a torn
+read of the newest data, which lives in the active file scanned
+first. A ``known``-keys index (rebuilt from one startup scan, grown
+on append, pruned only by full re-scan) makes misses O(1): arbitrary
+keys thrown at ``/explain`` cost a set probe, not a re-parse of the
+whole spool.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Iterator, Optional
+
+
+class JournalSpool:
+    def __init__(self, path: str, max_bytes: int = 16 << 20,
+                 max_files: int = 4, log=None):
+        if max_files < 1:
+            raise ValueError(f"max_files must be >= 1, got {max_files}")
+        self.path = path
+        self.max_bytes = max_bytes
+        self.max_files = max_files
+        self.log = log
+        self.appends = 0
+        self.rotations = 0
+        self.recoveries = 0       # /explain answers served from disk
+        self._closed = False
+        self._lock = threading.Lock()
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+        self._size = self._fh.tell()
+        # keys that MAY be in the spool (superset: rotation can drop
+        # the oldest file's keys without pruning this). Misses answer
+        # from the set without touching disk — /explain probes for
+        # never-journaled pods must not cost a full spool re-parse.
+        self._known = {
+            rec.get("pod")
+            for path_ in reversed(list(self._files_newest_first()))
+            for rec in self._iter_file(path_)
+            if rec.get("t") == "pod"
+        }
+        self._known.discard(None)
+
+    # ---- writes (scheduling thread, under the journal lock) ---------
+
+    def append(self, record: dict) -> None:
+        line = json.dumps(record, separators=(",", ":")) + "\n"
+        with self._lock:
+            if self._closed:
+                return  # shutdown race: durability is best-effort
+            self._fh.write(line)
+            self._fh.flush()
+            self._size += len(line)
+            if self._size >= self.max_bytes:
+                self._rotate_locked()
+        if record.get("t") == "pod" and record.get("pod"):
+            self._known.add(record["pod"])
+        self.appends += 1
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        oldest = f"{self.path}.{self.max_files - 1}"
+        if self.max_files == 1:
+            # single-file budget: truncate in place
+            self._fh = open(self.path, "w", encoding="utf-8")
+            self._size = 0
+            self.rotations += 1
+            return
+        try:
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.max_files - 2, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError as e:
+            if self.log is not None:
+                self.log.error("journal spool rotation failed: %s", e)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._size = self._fh.tell()
+        self.rotations += 1
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._fh.close()
+
+    # ---- reads (any thread) -----------------------------------------
+
+    def _files_newest_first(self):
+        yield self.path
+        for i in range(1, self.max_files):
+            yield f"{self.path}.{i}"
+
+    def _iter_file(self, path: str) -> Iterator[dict]:
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except ValueError:
+                        continue  # torn line (crash mid-append): skip
+        except OSError:
+            return
+
+    def recover(self, pod_key: str) -> Optional[dict]:
+        """The pod's most recent terminal journal document, or None.
+        Newest file first; within a file the LAST matching record wins
+        (latest incarnation of a reused name). Keys the spool has
+        never seen answer from the in-memory index without touching
+        disk."""
+        if pod_key not in self._known:
+            return None
+        with self._lock:
+            if not self._closed:  # a read racing shutdown is a miss,
+                self._fh.flush()  # never a serving-thread exception
+        for path in self._files_newest_first():
+            found = None
+            for rec in self._iter_file(path):
+                if rec.get("t") == "pod" and rec.get("pod") == pod_key:
+                    found = rec
+            if found is not None:
+                self.recoveries += 1
+                return dict(found.get("doc") or {})
+        return None
+
+    def replay(self) -> Iterator[dict]:
+        """Every spooled record, oldest first (offline analysis / the
+        explain CLI's --journal mode feeding from a spool)."""
+        with self._lock:
+            if not self._closed:
+                self._fh.flush()
+        for path in reversed(list(self._files_newest_first())):
+            yield from self._iter_file(path)

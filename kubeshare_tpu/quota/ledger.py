@@ -70,6 +70,22 @@ class UsageLedger:
 
     # -- reads --------------------------------------------------------
 
+    def snapshot(self) -> Dict[str, tuple]:
+        """Deterministic per-tenant usage digest ``{tenant: (chips,
+        mem, guarantee_chips, guarantee_mem)}``, floats rounded so a
+        ledger REBUILT from relist (different charge order, same
+        pods) compares equal to the continued one — the crash-recovery
+        invariant."""
+        return {
+            t: (
+                round(self._chips.get(t, 0.0), 9),
+                self._mem.get(t, 0),
+                round(self._gchips.get(t, 0.0), 9),
+                self._gmem.get(t, 0),
+            )
+            for t in sorted(self._chips)
+        }
+
     def chips_used(self, tenant: str) -> float:
         return self._chips.get(tenant, 0.0)
 

@@ -94,6 +94,8 @@ class TpuShareScheduler:
         min_feasible_nodes: int = 48,
         tenants: Union[None, str, dict, "TenantRegistry"] = None,
         explain_capacity: int = 512,
+        journal_spool=None,
+        wall_clock: Optional[Callable[[], float]] = None,
     ):
         # function-scope import: quota depends on scheduler.labels /
         # scheduler.constants, so a module-level import here would be
@@ -134,7 +136,19 @@ class TpuShareScheduler:
         # counted, queryable over /explain and the CLI. Also owns the
         # per-(tenant, shape, outcome) wait-SLO histograms.
         self.explain = DecisionJournal(capacity=explain_capacity,
-                                       log=self.log)
+                                       log=self.log, spool=journal_spool)
+        # Wall clock for creation-timestamp backdating: pods carry an
+        # epoch created_at while the engine clock may be monotonic (the
+        # daemon) or virtual (the sim). With the default monotonic
+        # clock, wall time maps created_at onto the engine axis; a
+        # custom clock (the sim) stamps created_at in its own units, so
+        # it doubles as its own wall clock.
+        if wall_clock is not None:
+            self.wall = wall_clock
+        elif clock is _time.monotonic:
+            self.wall = _time.time
+        else:
+            self.wall = clock
         # Demand ledger (autoscale plane): every schedule_one that
         # falls short of a bind files/refreshes one entry with a
         # reason code; binds and deletes resolve it. Scheduling-thread
@@ -293,6 +307,21 @@ class TpuShareScheduler:
         self._last_demand_reason = ""
         self._wave_demand: Optional[List[tuple]] = None  # buffered notes
 
+        # Crash recovery: binds retried after an API failure, and the
+        # half-gang watchlist (a gang some of whose members bound
+        # before a crash/API outage while the rest hold nothing — each
+        # gets one barrier-budget grace to complete, then the bound
+        # members are evicted so the gang requeues WHOLE instead of
+        # stranding chips forever).
+        self.bind_retries = 0
+        self.gang_recoveries = 0
+        self._half_gangs: Dict[str, float] = {}  # group_key -> deadline
+        # groups whose liveness census failed at a member's delete
+        # (API outage): retried from tick() until it answers, so a
+        # fully-deleted group is eventually marked deleted instead of
+        # leaking its registry entry until restart
+        self._stale_group_census: Set[str] = set()
+
         cluster.on_pod_event(self._on_pod_add, self._on_pod_delete)
         cluster.on_node_event(self._on_node_update)
         # replay pre-existing cluster state (scheduler restart)
@@ -300,6 +329,8 @@ class TpuShareScheduler:
             self._on_node_update(node)
         for pod in cluster.list_pods():
             self._on_pod_add(pod)
+        # restart reconciliation: gangs the crash left partially bound
+        self._sweep_half_gangs()
 
     def reload_topology(
         self, topology: Union[str, dict, TopologyConfig]
@@ -359,10 +390,13 @@ class TpuShareScheduler:
         self._defrag_inflight = set()
         self._defrag_blocked = {}
         self._defrag_holds = {}
+        self._half_gangs = {}
+        self._stale_group_census = set()
         for node in self.cluster.list_nodes():
             self._on_node_update(node)
         for pod in self.cluster.list_pods():
             self._on_pod_add(pod)
+        self._sweep_half_gangs()
         post = getattr(self.cluster, "post_event", None)
         for key in dropped:
             self.log.info(
@@ -508,15 +542,198 @@ class TpuShareScheduler:
                     self._waiting.pop(status.group_key, None)
         group_key = status.group_key if status else ""
         if group_key:
-            remaining = self._count_group_pods(
-                pod.namespace, group_key.split("/", 1)[1], exclude=pod.key
-            )
-            if remaining <= 0:
+            group_name = group_key.split("/", 1)[1]
+            # ONE namespace list answers both the liveness count and
+            # the completed-sibling question (a second full LIST per
+            # delete would double the informer-path API cost). A
+            # census failure is bookkeeping trouble, never poison:
+            # assume members remain and let groups.gc / the reconcile
+            # deadline's own re-census sort it out.
+            try:
+                members = [
+                    p for p in self.cluster.list_pods(pod.namespace)
+                    if p.key != pod.key
+                    and p.labels.get(C.LABEL_GROUP_NAME) == group_name
+                ]
+            except Exception as e:
+                self.log.warning(
+                    "group census for %s unavailable: %s", group_key, e
+                )
+                members = None
+                # the verdict is deferred, not dropped: tick() retries
+                # until the census answers (a leaked group entry would
+                # otherwise pollute a same-name gang resubmitted later)
+                self._stale_group_census.add(group_key)
+            if members is not None and not any(
+                not p.is_completed for p in members
+            ):
                 self.groups.mark_deleted(group_key)
+            elif not pod.is_completed:
+                # a member KILLED (not completed) out of a running gang
+                # leaves the rest half-bound: watchlist it — either the
+                # controller's replacement rejoins within the barrier
+                # budget, or the remainder is requeued whole
+                self._note_half_gang(
+                    group_key,
+                    completed=None if members is None else any(
+                        p.is_completed for p in members
+                    ),
+                )
         # gc on the informer delete path too, not just tick(): a quiet
         # cluster (no scheduling passes) must still reclaim expired
         # deleted-group entries instead of letting them linger
         self.groups.gc()
+
+    # ---- half-gang reconciliation (crash recovery) ------------------
+
+    def _gang_holders(self, group_key: str) -> int:
+        """Members currently HOLDING capacity for the gang — statuses
+        in RESERVED/WAITING/BOUND plus cluster-bound members parked in
+        ``_bound_queue`` awaiting their node's inventory sync. The
+        latter matter at restart: a collector briefly unreachable for
+        one node must not make that node's healthy gang members look
+        missing and trip the half-gang reconcile into evicting the
+        rest."""
+        held = self.status.held_in_group(group_key)
+        if self._bound_queue:
+            namespace, _, group_name = group_key.partition("/")
+            for pods in self._bound_queue.values():
+                for pod in pods:
+                    if (pod.namespace == namespace
+                            and pod.labels.get(C.LABEL_GROUP_NAME)
+                            == group_name):
+                        held += 1
+        return held
+
+    def _gang_completed_census(self, group_key: str) -> Optional[bool]:
+        """Does the cluster hold a COMPLETED member of this gang?
+        True/False, or None when the listing failed (API outage)."""
+        namespace, _, group_name = group_key.partition("/")
+        try:
+            return any(
+                pod.is_completed
+                and pod.labels.get(C.LABEL_GROUP_NAME) == group_name
+                for pod in self.cluster.list_pods(namespace)
+            )
+        except Exception:
+            return None
+
+    def _note_half_gang(self, group_key: str,
+                        completed: Optional[bool] = None) -> None:
+        """Watchlist a gang that currently has BOUND members but fewer
+        holders than its barrier threshold — the state a crash (bound
+        some, lost the rest's reservations) or a member kill leaves
+        behind. The deadline is the same budget the Permit barrier
+        gives a forming gang; tick() resolves it: completed (enough
+        holders again) or requeued whole (bound members evicted, their
+        controllers recreate them).
+
+        A SUCCEEDED sibling in the cluster means the gang fully
+        started and is completing naturally (the barrier releases all
+        members together, so one finishing implies all ran) — the
+        survivors are healthy runners winding down, not a crash gap;
+        such gangs are never armed. Matters most for the restart
+        sweep, which cannot see the delete-event phase the live path
+        discriminates on. ``completed`` may be passed by a caller that
+        already holds the census; an UNAVAILABLE census (None) still
+        arms — losing the arming would strand the gang until the next
+        restart, and the reconcile deadline re-runs the census before
+        it ever evicts."""
+        if group_key in self._half_gangs:
+            return
+        group = self.groups.get(group_key)
+        if group is None:
+            return
+        members = self.status.in_group(group_key)
+        if not any(s.state == PodState.BOUND for s in members):
+            return  # nothing stranded: the barrier handles the rest
+        if self._gang_holders(group_key) >= group.min_available:
+            return  # whole (or still above threshold): healthy
+        if completed is None:
+            completed = self._gang_completed_census(group_key)
+        if completed:
+            return  # winding down naturally: survivors are healthy
+        self._half_gangs[group_key] = (
+            self.clock() + self.permit_wait_base * group.headcount
+        )
+        self.log.info(
+            "gang %s is partially bound (%d/%d holders); reconcile "
+            "deadline armed", group_key,
+            self._gang_holders(group_key), group.min_available,
+        )
+
+    def _sweep_half_gangs(self) -> None:
+        """Restart-time sweep: arm the watchlist for every group the
+        relist restored in a partially-bound state."""
+        seen: Set[str] = set()
+        for status in self.status.values():
+            if status.group_key and status.group_key not in seen:
+                seen.add(status.group_key)
+                self._note_half_gang(status.group_key)
+
+    def _reconcile_half_gangs(self, now: float) -> None:
+        evict = getattr(self.cluster, "evict", None)
+        post = getattr(self.cluster, "post_event", None)
+        for group_key in list(self._half_gangs):
+            deadline = self._half_gangs[group_key]
+            group = self.groups.get(group_key)
+            bound = [
+                s for s in self.status.in_group(group_key)
+                if s.state == PodState.BOUND
+            ]
+            if group is None or not bound:
+                self._half_gangs.pop(group_key, None)
+                continue
+            if self._gang_holders(group_key) >= group.min_available:
+                self._half_gangs.pop(group_key, None)  # completed
+                continue
+            if deadline > now:
+                continue  # grace still running: members may rejoin
+            # final census before the irreversible step: a sibling
+            # that COMPLETED since arming means the gang is winding
+            # down (never evict); an unavailable census postpones —
+            # never evict on uncertainty, never lose the watch either
+            completed = self._gang_completed_census(group_key)
+            if completed:
+                self._half_gangs.pop(group_key, None)
+                continue
+            if completed is None:
+                self._half_gangs[group_key] = (
+                    now + self.permit_wait_base
+                )
+                continue
+            self._half_gangs.pop(group_key, None)
+            if evict is None:
+                self.log.warning(
+                    "gang %s stranded half-bound but the cluster "
+                    "adapter has no evict verb; leaving as-is",
+                    group_key,
+                )
+                continue
+            self.gang_recoveries += 1
+            for status in bound:
+                try:
+                    evict(status.key)
+                except Exception as e:
+                    self.log.error(
+                        "half-gang requeue evict %s: %s", status.key, e
+                    )
+                    continue
+                if post is not None:
+                    try:
+                        post(
+                            status.key, "GangReconciled",
+                            f"evicted: gang {group_key} stranded below "
+                            f"min_available past the barrier budget; "
+                            f"requeueing the gang whole",
+                            "Warning",
+                        )
+                    except Exception:
+                        pass  # best-effort observability
+            self.log.info(
+                "half-gang %s requeued whole (%d bound members evicted)",
+                group_key, len(bound),
+            )
 
     def _restore_bound_pod(self, pod: Pod) -> None:
         """Rebuild reservation state from annotations after a restart."""
@@ -741,7 +958,31 @@ class TpuShareScheduler:
             else req.request
         )
         status.charged_mem = status.memory
-        self.cluster.patch_pod(pod.key, annotations=annotations, env=env)
+        try:
+            self.cluster.patch_pod(pod.key, annotations=annotations, env=env)
+        except Exception:
+            # roll the reservation back before re-raising: the leaves
+            # were already taken from the tree above, and a patch_pod
+            # failure escapes reserve() with no PodStatus stored — the
+            # informer delete path could never find this capacity, so
+            # without the rollback an API blip here leaks chips until
+            # the next restart
+            for leaf in leaves:
+                try:
+                    if req.kind == PodKind.MULTI_CHIP:
+                        self.tree.reclaim(leaf, 1.0, leaf.full_memory)
+                    else:
+                        self.tree.reclaim(leaf, req.request, status.memory)
+                except ValueError as e:
+                    self.log.error("reserve rollback %s: %s", pod.key, e)
+            if status.port:
+                pool = self._node_ports(node_name)
+                pool.clear(status.port - C.POD_MANAGER_PORT_START)
+                self._note_port_state(node_name, pool)
+            # the rollback RETURNS capacity mid-wave: void the
+            # backfill failure memo's monotone-loss premise
+            self.capacity_releases += 1
+            raise
         # ledger charge only after the last fallible step: a patch_pod
         # failure escapes reserve() with no PodStatus stored, so a
         # charge made before it could never be credited back — the
@@ -831,10 +1072,74 @@ class TpuShareScheduler:
         if existing is not None and existing.state != PodState.PENDING:
             # already reserved/waiting/bound — a requeue race must not
             # double-reserve
-            state = "waiting" if existing.state == PodState.WAITING else "bound"
-            return Decision(state, pod.key, node=existing.node_name,
-                            message="already scheduled")
+            return self._handle_existing(pod, existing)
         return self._attempt(pod, self.explain.enabled)
+
+    def needs_offer(self, pod_key: str) -> bool:
+        """Should the queue drain offer this pending cluster pod to
+        ``schedule_one``? Yes when the engine holds no state for it —
+        and ALSO when it is RESERVED: outside an attempt that state
+        means the bind verb failed (API error / crash), and the
+        recovery path (``_handle_existing``) only runs if the pod is
+        re-offered. WAITING (parked at the gang barrier) and BOUND
+        pods are not offered."""
+        status = self.status.get(pod_key)
+        return status is None or status.state == PodState.RESERVED
+
+    def _handle_existing(self, pod: Pod, existing: PodStatus) -> Decision:
+        """A pod re-offered while already holding state. WAITING and
+        BOUND are the requeue-race no-ops they always were. RESERVED
+        outside an attempt means the BIND VERB failed after the
+        reservation succeeded (API error, or a crash between reserve
+        and bind): the leaves and the annotation patch are already in
+        place, so the recovery re-runs Permit (the quota re-check and
+        the gang barrier — an API failure mid-barrier-release leaves
+        siblings parked WAITING, and only the barrier binds them) and
+        then retries exactly the missing bind verb — re-running the
+        whole cycle would double-reserve, and the old short circuit
+        lied "bound" while the pod stayed Pending in the cluster
+        forever."""
+        if existing.state == PodState.WAITING:
+            return Decision("waiting", pod.key, node=existing.node_name,
+                            message="already scheduled")
+        if existing.state != PodState.RESERVED:
+            return Decision("bound", pod.key, node=existing.node_name,
+                            message="already scheduled")
+        action, extra = self.permit(pod, existing)
+        if action == "deny":
+            self.unreserve(pod.key, reject_group=False)
+            # same ledger note the _attempt deny path files: a retried
+            # pod blocked on quota must stay visible to the autoscale
+            # planner and the explain timeline
+            self._note_demand(pod.key, existing.requirements,
+                              D.REASON_OVER_QUOTA,
+                              created_at=pod.created_at)
+            return Decision("unschedulable", pod.key, retryable=True,
+                            message=extra)
+        if action == "wait":
+            # the crash/outage cost the gang its other reservations:
+            # park at the barrier again instead of binding a half-gang
+            self._note_demand(pod.key, existing.requirements,
+                              D.REASON_GANG_WAITING,
+                              created_at=pod.created_at)
+            return Decision(
+                "waiting", pod.key, node=existing.node_name,
+                message=f"gang barrier, timeout {extra}s",
+            )
+        try:
+            self._bind(pod.key, existing.node_name)
+        except Conflict:
+            self.unreserve(pod.key, reject_group=False)
+            return Decision(
+                "unschedulable", pod.key, retryable=True,
+                message="bind conflict on retry (another replica "
+                        "acted); requeued",
+            )
+        self.bind_retries += 1
+        return Decision("bound", pod.key, node=existing.node_name,
+                        bound_with=extra,
+                        message="bind retried after earlier API "
+                                "failure")
 
     def _attempt(self, pod: Pod, journal_on: bool,
                  batch: Optional[list] = None) -> Decision:
@@ -967,14 +1272,9 @@ class TpuShareScheduler:
                 existing = self.status.get(pod.key)
                 if existing is not None and \
                         existing.state != PodState.PENDING:
-                    state = (
-                        "waiting" if existing.state == PodState.WAITING
-                        else "bound"
-                    )
-                    decisions.append(Decision(
-                        state, pod.key, node=existing.node_name,
-                        message="already scheduled",
-                    ))
+                    # same short circuit schedule_one gives them — a
+                    # RESERVED survivor retries its failed bind verb
+                    decisions.append(self._handle_existing(pod, existing))
                     continue
                 if head_key is not None:
                     # head-of-line: only strictly-smaller pods may
@@ -1017,7 +1317,8 @@ class TpuShareScheduler:
                         # its head is — a scan-free decision must not
                         # make queued demand invisible (the sequential
                         # loop filed a note per blocked pod per pass)
-                        self._note_demand(pod.key, req0, head_reason)
+                        self._note_demand(pod.key, req0, head_reason,
+                                          created_at=pod.created_at)
                         decisions.append(Decision(
                             "unschedulable", pod.key, retryable=True,
                             message=(
@@ -1256,7 +1557,8 @@ class TpuShareScheduler:
                 quota_detail["why"] = why
             rec["quota"] = quota_detail
         if not admitted:
-            self._note_demand(pod.key, req, D.REASON_OVER_QUOTA)
+            self._note_demand(pod.key, req, D.REASON_OVER_QUOTA,
+                              created_at=pod.created_at)
             return Decision("unschedulable", pod.key, message=why,
                             retryable=True)
 
@@ -1307,6 +1609,7 @@ class TpuShareScheduler:
                 pod.key, req,
                 D.REASON_FRAGMENTATION if agg_fits
                 else D.REASON_NO_FEASIBLE_CELL,
+                created_at=pod.created_at,
             )
             if evicted:
                 return Decision(
@@ -1447,7 +1750,8 @@ class TpuShareScheduler:
             # (concurrent reservations); release only THIS pod — gang
             # siblings keep waiting and the barrier decides their fate
             self.unreserve(pod.key, reject_group=False)
-            self._note_demand(pod.key, req, D.REASON_OVER_QUOTA)
+            self._note_demand(pod.key, req, D.REASON_OVER_QUOTA,
+                              created_at=pod.created_at)
             return Decision("unschedulable", pod.key, retryable=True,
                             message=extra)
         if action == "allow":
@@ -1462,7 +1766,8 @@ class TpuShareScheduler:
             return Decision("bound", pod.key, node=best, bound_with=extra)
         # parked at the Permit barrier: capacity is held, the rest of
         # the gang's demand is what the cluster still owes
-        self._note_demand(pod.key, req, D.REASON_GANG_WAITING)
+        self._note_demand(pod.key, req, D.REASON_GANG_WAITING,
+                          created_at=pod.created_at)
         return Decision(
             "waiting", pod.key, node=best,
             message=f"gang barrier, timeout {extra}s",
@@ -1743,23 +2048,42 @@ class TpuShareScheduler:
             return reason[len(prefix):]
         return reason.replace(f"node {node}", "node", 1)
 
-    def _note_demand(self, pod_key: str, req, reason: str) -> None:
+    def _since_hint(self, created_at: float) -> Optional[float]:
+        """Map a pod's creation timestamp (wall epoch, or the sim's
+        virtual clock) onto the engine clock axis: crash recovery —
+        a restarted scheduler's empty ledgers must not reset every
+        pre-crash pod's wait clock to the restart instant. None when
+        the pod carries no stamp (created_at 0.0 is the 'unknown'
+        sentinel — epoch 0 is never a real creation time, and the sim
+        nudges genuine t=0 stamps off exact zero)."""
+        if not created_at:
+            return None
+        return self.clock() - max(0.0, self.wall() - created_at)
+
+    def _note_demand(self, pod_key: str, req, reason: str,
+                     created_at: float = 0.0) -> None:
         """File/refresh the pod's pending-demand entry with the same
         RESOLVED chips/HBM the quota gate uses, so planner sizing and
         admission can never disagree about what a pod costs. During a
         wave the note is buffered and flushed once at wave end (or
         eagerly by any mid-wave reader of the ledger — defrag's
         reclaim lane), so a K-pod wave pays one batched pass instead
-        of K journal reconciliations."""
+        of K journal reconciliations. ``created_at`` backdates a FIRST
+        filing's wait clock to the pod's creation (crash recovery);
+        an existing entry's ``since`` always wins."""
         if req.kind == PodKind.REGULAR:
             return  # consumes no TPU capacity; not capacity demand
         self._last_demand_reason = reason
+        hint = self._since_hint(created_at)
         if self._wave_demand is not None:
-            self._wave_demand.append((pod_key, req, reason, self.clock()))
+            self._wave_demand.append(
+                (pod_key, req, reason, self.clock(), hint)
+            )
             return
         chips, mem = self.quota.demand(req)
         now = self.clock()
-        entry = self.demand.note(pod_key, req, reason, now, chips, mem)
+        entry = self.demand.note(pod_key, req, reason, now, chips, mem,
+                                 since_hint=hint)
         # reconcile the journal against the ledger: the transition
         # hook only fires on reason CHANGES, so a journal entry
         # rebuilt after an LRU eviction (more pending pods than
@@ -1778,7 +2102,7 @@ class TpuShareScheduler:
             return
         items, buf[:] = list(buf), []
         sync = self.explain.sync_reason
-        for (pod_key, req, reason, now), entry in zip(
+        for (pod_key, req, reason, now, _hint), entry in zip(
             items, self.demand.note_batch(items, self.quota.demand)
         ):
             sync(pod_key, reason, now, since=entry.since)
@@ -2031,8 +2355,145 @@ class TpuShareScheduler:
             if any(w.deadline <= now for w in waiters.values()):
                 first = next(iter(waiters.values()))
                 rejected.extend(self.unreserve(first.pod_key, reject_group=True))
+        # crash recovery: gangs stranded partially bound past their
+        # grace are requeued whole (bound members evicted)
+        self._reconcile_half_gangs(now)
+        # deferred group-liveness verdicts (census failed at delete
+        # time): retry until the API answers
+        for group_key in list(self._stale_group_census):
+            namespace, _, group_name = group_key.partition("/")
+            try:
+                alive = any(
+                    not p.is_completed
+                    and p.labels.get(C.LABEL_GROUP_NAME) == group_name
+                    for p in self.cluster.list_pods(namespace)
+                )
+            except Exception:
+                continue  # still down: keep the verdict pending
+            self._stale_group_census.discard(group_key)
+            if not alive:
+                self.groups.mark_deleted(group_key)
         self.groups.gc()
         return rejected
+
+    def recovery_fingerprint(self) -> dict:
+        """Deterministic digest of the state a restart must rebuild
+        from the cluster: durable placements (node, chip uuids,
+        charged chips/HBM, tenant) plus per-tenant usage summed over
+        exactly those placements.
+
+        Durable means BOUND — or RESERVED with the bind already
+        LANDED in the cluster (the crash hit between ``cluster.bind``
+        succeeding and the ack: the cluster is ahead of the process,
+        and the continued engine would promote the pod from its next
+        informer delivery, which is precisely what the rebuilt one
+        does at restore). Un-landed RESERVED/WAITING reservations are
+        deliberately excluded: they are process state, a crash drops
+        them and the pods requeue with their wait clocks recovered
+        from creation timestamps. Tenant usage is summed from the
+        same placements (not read off the live ledger, which rightly
+        still carries the in-flight charges a crash forfeits);
+        ``ledger_drift()`` separately pins the live ledger against
+        ALL held charges. The crash-recovery differential suite pins
+        ``rebuilt == continued`` on exactly this digest."""
+        get_pod = getattr(self.cluster, "get_pod", None)
+        pods = {}
+        durable: List[PodStatus] = []
+        for status in self.status.values():
+            if status.state != PodState.BOUND:
+                if status.state != PodState.RESERVED or get_pod is None:
+                    continue
+                pod = get_pod(status.key)
+                if pod is None or pod.node_name != status.node_name:
+                    continue  # reservation only: forfeited by a crash
+            durable.append(status)
+            pods[status.key] = {
+                "node": status.node_name,
+                "uuids": sorted(status.uuids),
+                "chips": round(status.charged_chips, 9),
+                "mem": status.charged_mem,
+                "tenant": status.tenant,
+                "guarantee": status.requirements.is_guarantee,
+            }
+        # tenant sums derived FROM the pod docs (not the raw statuses):
+        # a stale digest pruned of mid-outage deletions (the sim's
+        # crash-during-flake path) recomputes tenants over the same
+        # rounded inputs, so pruned-then-summed compares exactly
+        return {"pods": pods, "tenants": self.fingerprint_tenants(pods)}
+
+    @staticmethod
+    def fingerprint_tenants(pods: Dict[str, dict]) -> Dict[str, tuple]:
+        """Per-tenant usage digest over ``recovery_fingerprint`` pod
+        docs — kept separate so a caller can prune the pod set (e.g.
+        pods deleted while a crashed scheduler was down) and re-derive
+        comparable tenant sums. ``_sum_charges`` is the status-side
+        twin (the ledger_drift expectation): a change to charge
+        semantics must land in both."""
+        totals: Dict[str, List[float]] = {}
+        for doc in pods.values():
+            tenant = doc["tenant"]
+            if not tenant or (doc["chips"] <= 0 and doc["mem"] <= 0):
+                continue
+            agg = totals.setdefault(tenant, [0.0, 0, 0.0, 0])
+            agg[0] += doc["chips"]
+            agg[1] += doc["mem"]
+            if doc["guarantee"]:
+                agg[2] += doc["chips"]
+                agg[3] += doc["mem"]
+        return {
+            t: (round(v[0], 6), v[1], round(v[2], 6), v[3])
+            for t, v in sorted(totals.items())
+        }
+
+    @staticmethod
+    def _sum_charges(statuses) -> Dict[str, List[float]]:
+        """Per-tenant ``[chips, mem, guarantee_chips, guarantee_mem]``
+        summed over PodStatus charges — the ``ledger_drift`` oracle's
+        expectation. ``fingerprint_tenants`` is its doc-side twin
+        (same skip-empty rule, accumulating the fingerprint's rounded
+        pod docs instead of statuses); a change to charge semantics
+        must land in BOTH or the two crash-recovery oracles diverge.
+        Mirrors the ledger's own rule: empty tenants and empty
+        charges are skipped."""
+        totals: Dict[str, List[float]] = {}
+        for status in statuses:
+            if not status.tenant or (
+                status.charged_chips <= 0 and status.charged_mem <= 0
+            ):
+                continue
+            agg = totals.setdefault(status.tenant, [0.0, 0, 0.0, 0])
+            agg[0] += status.charged_chips
+            agg[1] += status.charged_mem
+            if status.requirements.is_guarantee:
+                agg[2] += status.charged_chips
+                agg[3] += status.charged_mem
+        return totals
+
+    def ledger_drift(self) -> dict:
+        """Usage-ledger consistency oracle: the live ledger must equal
+        the sum of charges over every capacity-holding PodStatus
+        (RESERVED / WAITING / BOUND) — every charge has exactly one
+        holder and every holder exactly one charge. Returns ``{}``
+        when consistent, else ``{tenant: {"ledger": (...),
+        "expected": (...)}}``; the chaos gauntlet asserts it empty at
+        every crash and at the end of every run."""
+        expected = self._sum_charges(
+            status for status in self.status.values()
+            if status.state in (
+                PodState.RESERVED, PodState.WAITING, PodState.BOUND
+            )
+        )
+        snap = self.quota.ledger.snapshot()
+        drift = {}
+        for tenant in sorted(set(snap) | set(expected)):
+            want = expected.get(tenant, [0.0, 0, 0.0, 0])
+            got = snap.get(tenant, (0.0, 0, 0.0, 0))
+            if any(abs(a - b) > 1e-6 for a, b in zip(want, got)):
+                drift[tenant] = {
+                    "ledger": tuple(got),
+                    "expected": tuple(round(v, 9) for v in want),
+                }
+        return drift
 
     def utilization_samples(self) -> List["expfmt.Sample"]:
         """Per-node occupancy gauges for the scheduler's /metrics:
@@ -2139,6 +2600,17 @@ class TpuShareScheduler:
             expfmt.Sample(
                 "tpu_scheduler_backfill_head_delays_total", {},
                 self.backfill_head_delays,
+            ),
+            # crash-recovery activity: bind verbs retried for
+            # reservations an API failure stranded, and half-gangs
+            # requeued whole after the barrier-budget grace
+            expfmt.Sample(
+                "tpu_scheduler_bind_retries_total", {},
+                self.bind_retries,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_gang_recoveries_total", {},
+                self.gang_recoveries,
             ),
         ]
         # where wave wall time goes, cumulative per phase: sync vs

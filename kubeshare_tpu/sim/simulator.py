@@ -14,12 +14,14 @@ delete path.
 from __future__ import annotations
 
 import heapq
+import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ..cells.cell import ChipInfo
 from ..cluster.api import Pod
 from ..cluster.fake import FakeCluster
+from ..cluster.faultinject import ApiFault, FaultInjector, SimCrash
 from ..scheduler import constants as C
 from ..scheduler.plugin import TpuShareScheduler
 from .trace import TraceEvent
@@ -44,12 +46,27 @@ class FaultEvent:
     drives the same verbs through ``Simulator.add_node`` /
     ``remove_node`` from its controller hook instead of a pre-scripted
     fault list.
+
+    Control-plane faults (PR-8): ``scheduler_crash`` kills and
+    restarts the scheduler — all in-memory state (engine, quota +
+    demand ledgers, wait clocks, in-flight reservations) is dropped
+    and rebuilt from the cluster via the relist path; ``chips`` > 0
+    arms the crash MID-PASS instead, after that many further binds
+    land (requires fault injection; the worst spot — cluster state
+    moved, the process died before recording it). ``api_flake`` makes
+    every cluster API verb fail for ``duration`` virtual seconds
+    (requires fault injection): scheduling passes fail whole and the
+    control plane must degrade and recover, never wedge or leak.
     """
 
     time: float
-    kind: str         # node_down | node_up | pod_kill | node_add | node_remove
+    kind: str         # node_down | node_up | pod_kill | node_add |
+                      # node_remove | scheduler_crash | api_flake
     target: str = ""
-    chips: int = 0    # node_add only: chips the new node brings (0 = default)
+    chips: int = 0    # node_add: chips the new node brings (0 = default)
+                      # scheduler_crash: crash after N more binds (0 =
+                      # crash between passes, at this tick)
+    duration: float = 0.0  # api_flake: seconds the API stays down
 
 
 @dataclass
@@ -91,6 +108,24 @@ class SimReport:
     # elastic capacity: node-add/node-remove events applied
     nodes_added: int = 0
     nodes_removed: int = 0
+    # control-plane chaos (PR-8): scheduler crash/restarts applied,
+    # wall-clock seconds each restart took to rebuild from relist,
+    # restarts whose rebuilt ledger/placement digest did NOT equal the
+    # continued engine's (must stay 0 — the recovery invariant), and
+    # scheduling passes lost whole to injected API failures
+    crashes: int = 0
+    recovery_seconds: List[float] = field(default_factory=list)
+    ledger_rebuild_mismatches: int = 0
+    failed_passes: int = 0
+    # gang members evicted by the engine's half-gang reconcile (the
+    # gang requeues whole); kept separate from defrag_evicted so the
+    # chaos artifact never attributes recovery churn to defrag
+    gang_requeued: int = 0
+    # end-of-run population (exact pod conservation: submitted ==
+    # completed + unschedulable + killed + defrag_evicted +
+    # gang_requeued + running_at_end + pending_at_end)
+    running_at_end: int = 0
+    pending_at_end: int = 0
 
     @property
     def mean_wait(self) -> float:
@@ -149,6 +184,14 @@ class SimReport:
             },
             "nodes_added": self.nodes_added,
             "nodes_removed": self.nodes_removed,
+            "gang_requeued": self.gang_requeued,
+            "crashes": self.crashes,
+            "max_recovery_s": round(max(self.recovery_seconds), 4)
+            if self.recovery_seconds else 0.0,
+            "ledger_rebuild_mismatches": self.ledger_rebuild_mismatches,
+            "failed_passes": self.failed_passes,
+            "running_at_end": self.running_at_end,
+            "pending_at_end": self.pending_at_end,
         }
 
 
@@ -181,12 +224,17 @@ class Simulator:
         wave_size: int = 0,
         backfill: bool = False,
         explain_capacity: int = 512,
+        inject_faults: bool = False,
+        fault_seed: int = 0,
+        api_error_rate: float = 0.0,
+        api_conflict_rate: float = 0.0,
+        journal_spool=None,
     ):
         import random
 
-        self.cluster = FakeCluster()
+        raw = FakeCluster()
         for node, n_chips in nodes.items():
-            self.cluster.add_node(
+            raw.add_node(
                 node,
                 [
                     ChipInfo(f"{node}-chip-{i}", chip_model, chip_memory, i)
@@ -194,12 +242,38 @@ class Simulator:
                 ],
             )
         self.clock_now = 0.0
-        self.engine = TpuShareScheduler(
-            topology, self.cluster, clock=lambda: self.clock_now,
+        # Fault injection (PR-8): the engine talks to the cluster
+        # through a seeded FaultInjector when chaos is requested —
+        # error drizzle / bind conflicts / flake windows / mid-pass
+        # crash points. With injection off the engine keeps the bare
+        # FakeCluster (committed artifacts replay byte-identically).
+        self.injector: Optional[FaultInjector] = None
+        if inject_faults or api_error_rate > 0 or api_conflict_rate > 0:
+            self.injector = FaultInjector(
+                raw, clock=lambda: self.clock_now, seed=fault_seed,
+                error_rate=api_error_rate,
+                conflict_rate=api_conflict_rate,
+            )
+        self.cluster = self.injector if self.injector is not None else raw
+        # engine construction is a named path so scheduler_crash can
+        # rebuild an identical engine from the same cluster (the
+        # restart: all in-memory state dropped, relist resync only)
+        self._engine_kwargs = dict(
             tracer=tracer, defrag=defrag,
             defrag_eviction_rate=defrag_eviction_rate,
             tenants=tenants, explain_capacity=explain_capacity,
+            journal_spool=journal_spool,
         )
+        # parse the topology ONCE: a rebuild must see the exact config
+        # the crashed engine ran, not whatever the path resolves to at
+        # restart time
+        from ..cells.spec import TopologyConfig, load_topology
+
+        self._topology = (
+            topology if isinstance(topology, TopologyConfig)
+            else load_topology(topology)
+        )
+        self.engine = self._make_engine()
         # Wave-driven run loop (PR-5): each tick's scheduling pass is
         # one engine.schedule_wave over the pending queue instead of a
         # sim-side sort + per-pod schedule_one loop. With backfill off
@@ -228,8 +302,83 @@ class Simulator:
         self._jobs: Optional[Dict[str, _Job]] = None
         self._pending: Optional[List[_Job]] = None
         self._report: Optional[SimReport] = None
+        self._crash_pending = False  # crash hit during an API outage
+        self._pre_crash_fp: Optional[dict] = None  # continued digest
         self.priority_ratio = priority_ratio
         self._rng = random.Random(seed)
+
+    def _make_engine(self) -> TpuShareScheduler:
+        return TpuShareScheduler(
+            self._topology, self.cluster, clock=lambda: self.clock_now,
+            **self._engine_kwargs,
+        )
+
+    def crash_restart(self) -> tuple:
+        """The scheduler dies and restarts: every byte of in-memory
+        state — engine, quota + demand ledgers, wait clocks, score
+        caches, in-flight reservations, defrag holds — is dropped,
+        informer handlers are torn down with the process, and a fresh
+        engine rebuilds purely from cluster state (the relist +
+        annotation-restore path a real restart takes). Returns the
+        (continued, rebuilt) recovery fingerprints; the recovery
+        invariant is that they are EQUAL — bound placements and the
+        usage ledger are fully reconstructible — and any mismatch is
+        counted on the report, never silent."""
+        pre = self.engine.recovery_fingerprint()
+        return self._finish_crash(pre)
+
+    def _finish_crash(self, pre: dict) -> tuple:
+        # detach handlers before EVERY construction attempt: the engine
+        # registers its informer handlers before the relist that can
+        # raise mid-flake, so a failed attempt would otherwise leave a
+        # zombie subscriber behind per retry
+        self.cluster.reset_handlers()
+        t0 = _time.perf_counter()
+        self.engine = self._make_engine()  # raises while the API flakes
+        elapsed = _time.perf_counter() - t0
+        post = self.engine.recovery_fingerprint()
+        # the continued digest was taken at the moment of death; pods
+        # that COMPLETED or were killed while the scheduler was down
+        # (a crash-loop inside a flake window) are legitimately absent
+        # from the rebuilt engine — the continued one would have
+        # dropped them from its next informer delivery too. Prune them
+        # and re-derive tenant sums over the same rounded pod docs so
+        # the comparison stays exact.
+        live_pods = {
+            key: doc for key, doc in pre["pods"].items()
+            if (pod := self.cluster.get_pod(key)) is not None
+            and pod.is_bound and not pod.is_completed
+        }
+        if len(live_pods) != len(pre["pods"]):
+            pre = {
+                "pods": live_pods,
+                "tenants": TpuShareScheduler.fingerprint_tenants(live_pods),
+            }
+        if self._report is not None:
+            self._report.crashes += 1
+            self._report.recovery_seconds.append(elapsed)
+            if pre != post:
+                self._report.ledger_rebuild_mismatches += 1
+        return pre, post
+
+    def _try_crash(self) -> None:
+        """crash_restart, crash-loop aware: a restart during an API
+        flake fails its relist (the real scheduler would crash-loop
+        until the apiserver answers) — the continued fingerprint is
+        snapshotted ONCE at the moment of death (the detached old
+        engine sees no further events, so a later snapshot would be
+        stale), handlers are torn down once, and the rebuild retries
+        until the apiserver answers; no scheduling passes run in
+        between."""
+        if not self._crash_pending:
+            self._pre_crash_fp = self.engine.recovery_fingerprint()
+            self._crash_pending = True
+        try:
+            self._finish_crash(self._pre_crash_fp)
+        except ApiFault:
+            return  # still down: retry next tick
+        self._crash_pending = False
+        self._pre_crash_fp = None
 
     def _pod_for(self, event: TraceEvent, idx: int,
                  member: int = 0) -> Pod:
@@ -262,6 +411,10 @@ class Simulator:
             namespace=event.tenant or "default",
             labels=labels,
             scheduler_name=C.SCHEDULER_NAME,
+            # creation stamp on the sim clock: a scheduler_crash
+            # rebuild recovers pending-pod wait clocks from it
+            # (nudged off exact 0.0 — the 'unknown stamp' sentinel)
+            created_at=self.clock_now or 1e-9,
         )
 
     def _record_gang_hops(self, keys, report: SimReport) -> None:
@@ -313,6 +466,7 @@ class Simulator:
             namespace=job.pod.namespace,  # tenant survives the requeue
             labels=dict(job.pod.labels),
             scheduler_name=C.SCHEDULER_NAME,
+            created_at=job.submitted_at or 1e-9,  # wait clock survives
         )
         self.cluster.create_pod(clone)
         # the clone keeps the ORIGINAL arrival time: a killed job's
@@ -360,6 +514,26 @@ class Simulator:
             return
         if fault.kind == "node_remove":
             self.remove_node(fault.target)
+            return
+        if fault.kind == "scheduler_crash":
+            if fault.chips > 0:
+                # arm a mid-pass crash point: the injector raises
+                # SimCrash out of the Nth further bind, AFTER it
+                # landed in the cluster — the run loop catches it and
+                # restarts here
+                if self.injector is None:
+                    raise ValueError(
+                        "mid-pass scheduler_crash needs "
+                        "inject_faults=True"
+                    )
+                self.injector.arm_crash(after_binds=fault.chips)
+            else:
+                self._try_crash()  # between passes, at this tick
+            return
+        if fault.kind == "api_flake":
+            if self.injector is None:
+                raise ValueError("api_flake needs inject_faults=True")
+            self.injector.start_flake(fault.duration or 30.0)
             return
         raise ValueError(f"unknown fault kind {fault.kind!r}")
 
@@ -451,6 +625,11 @@ class Simulator:
         # caps runaway replays
         end = horizon or float("inf")
         i = 0
+        # evictions consumed so far — RUN-scoped, not pass-scoped:
+        # gang-reconcile evictions happen in engine.tick() AFTER the
+        # pass's drain, and a pass lost whole to an API flake leaves
+        # its pre-crash evictions undrained; both must still resubmit
+        evictions_seen = len(self.cluster.evictions)
         # pending retries normally wait for the next arrival/finish, but
         # a defrag eviction must retry the beneficiary PROMPTLY: in the
         # live engine the victim's DELETE watch event requeues pending
@@ -521,12 +700,22 @@ class Simulator:
                 controller(self, report)
                 next_ctrl += controller_interval
 
+            # a scheduler_crash that hit during an API outage keeps
+            # crash-looping until its relist succeeds; the control
+            # plane is down, so no scheduling pass runs this tick
+            if self._crash_pending:
+                self._try_crash()
+                if self._crash_pending:
+                    report.failed_passes += 1
+                    retry_at = self.clock_now + 1.0
+                    continue
+
             # one scheduling pass over the queue (queue-sorted)
             still_pending: List[_Job] = []
-            evictions_seen = evictions_at_pass_start = len(
-                self.cluster.evictions
-            )
+            evictions_at_pass_start = evictions_seen
             gang_bound: set = set()  # keys bound via a sibling's Permit
+            crashed = False   # SimCrash raised mid-pass (injected)
+            pass_failed = False  # ApiFault lost the pass whole
 
             def mark_bound(job: _Job) -> None:
                 job.bound_at = self.clock_now
@@ -559,10 +748,12 @@ class Simulator:
                     report.tenant_chip_seconds.get(ns, 0.0) + job.credited
                 )
 
-            def drain_evictions() -> None:
-                # defrag victims: the engine evicted them through the
-                # cluster (FakeCluster deletes synchronously); their
-                # controller resubmits them as fresh arrivals
+            def drain_evictions(cause: str = "defrag") -> None:
+                # engine-evicted pods (defrag victims, or a half-gang
+                # requeued whole by tick()): the cluster deleted them
+                # synchronously; their controller resubmits them as
+                # fresh arrivals. ``cause`` routes the accounting so
+                # recovery churn never masquerades as defrag churn.
                 nonlocal evictions_seen
                 while evictions_seen < len(self.cluster.evictions):
                     victim_key = self.cluster.evictions[evictions_seen]
@@ -571,13 +762,17 @@ class Simulator:
                     if victim is None:
                         continue
                     self._uncredit(victim, report)
-                    report.defrag_evicted += 1
+                    if cause == "gang":
+                        report.gang_requeued += 1
+                    else:
+                        report.defrag_evicted += 1
                     self._resubmits += 1
                     clone = Pod(
                         name=f"{victim.pod.name}-d{self._resubmits}",
                         namespace=victim.pod.namespace,  # tenant survives
                         labels=dict(victim.pod.labels),
                         scheduler_name=C.SCHEDULER_NAME,
+                        created_at=victim.submitted_at or 1e-9,  # wait clock
                     )
                     self.cluster.create_pod(clone)
                     # original arrival time, as in _kill_job: the
@@ -619,11 +814,23 @@ class Simulator:
             if self.use_waves:
                 # wave-driven pass: the engine sorts the queue (with
                 # per-wave ledger memos), reconciles inventory once,
-                # and drains the backlog as one batched cycle
-                decisions = self.engine.schedule_wave(
-                    [j.pod for j in pending], limit=self.wave_size,
-                    backfill=self.backfill,
-                )
+                # and drains the backlog as one batched cycle. An
+                # injected crash or flake aborts the pass the way a
+                # real process death / failed apiserver call would:
+                # decisions already applied to the CLUSTER stand
+                # (binds landed), undelivered decisions are simply
+                # lost — the next pass re-observes everything.
+                try:
+                    decisions = self.engine.schedule_wave(
+                        [j.pod for j in pending], limit=self.wave_size,
+                        backfill=self.backfill,
+                    )
+                except SimCrash:
+                    crashed = True
+                    decisions = []
+                except ApiFault:
+                    pass_failed = True
+                    decisions = []
                 drain_evictions()
                 handled = set()
                 for decision in decisions:
@@ -632,8 +839,9 @@ class Simulator:
                     if job is None or decision.pod_key in gang_bound:
                         continue
                     handle(job, decision)
-                # a wave limit can leave an undrained tail with no
-                # decision this tick: it stays queued
+                # a wave limit (or an aborted pass) can leave an
+                # undrained tail with no decision this tick: it stays
+                # queued
                 for job in pending:
                     if (job.pod.key not in handled
                             and job.pod.key not in gang_bound
@@ -644,22 +852,59 @@ class Simulator:
                 # sequential per-pod loop — kept as the same-commit
                 # A/B baseline and the wave differential oracle
                 pending.sort(key=lambda j: self.engine.queue_sort_key(j.pod))
-                for job in pending:
+                for idx, job in enumerate(pending):
                     if job.pod.key in gang_bound:
                         continue  # bound this pass via a sibling's Permit
-                    decision = self.engine.schedule_one(job.pod)
+                    try:
+                        decision = self.engine.schedule_one(job.pod)
+                    except SimCrash:
+                        crashed = True
+                        still_pending.extend(
+                            j for j in pending[idx:]
+                            if j.pod.key not in gang_bound
+                        )
+                        break
+                    except ApiFault:
+                        pass_failed = True
+                        still_pending.append(job)
+                        continue
                     drain_evictions()
                     handle(job, decision)
             # drop members that a LATER sibling's Permit release bound
             # after they were already parked in still_pending this pass
-            # (slice-assign: remove_node holds a reference to THIS list)
+            # (slice-assign: remove_node holds a reference to THIS
+            # list). The jobs/bound_at filter guards the crash tail:
+            # a pod whose bind LANDED before the crash is not pending
+            # (the restarted engine restores it; its decision arrives
+            # as "already scheduled" next pass)
             pending[:] = [
-                j for j in still_pending if j.pod.key not in gang_bound
+                j for j in still_pending
+                if j.pod.key not in gang_bound
+                and j.pod.key in jobs and j.bound_at is None
             ]
             if evictions_seen > evictions_at_pass_start and pending:
                 retry_at = self.clock_now + 1.0  # requeue-on-delete
             report.peak_pending = max(report.peak_pending, len(pending))
+            if crashed:
+                self.crash_restart()
+            if pass_failed:
+                report.failed_passes += 1
+                if pending:
+                    retry_at = self.clock_now + 1.0  # flakes retry soon
             self.engine.tick()
+            # gang reconcile (and anything else tick() evicted):
+            # resubmit through the same controller path as defrag
+            # victims, or the evicted pods would vanish from the books
+            if len(self.cluster.evictions) > evictions_seen:
+                still_pending = []
+                drain_evictions(cause="gang")
+                fresh = [
+                    j for j in still_pending
+                    if j.pod.key not in gang_bound and j.pod.key in jobs
+                ]
+                if fresh:
+                    pending.extend(fresh)
+                    retry_at = self.clock_now + 1.0
 
             if (i >= len(arrivals) and not finishes and pending
                     and fi >= len(fault_queue) and controller is None):
@@ -669,6 +914,7 @@ class Simulator:
                 for job in pending:
                     report.unschedulable += 1
                     self.cluster.delete_pod(job.pod.key)
+                    jobs.pop(job.pod.key, None)
                 pending.clear()
 
         span = end if end != float("inf") else self.clock_now
@@ -676,5 +922,15 @@ class Simulator:
         report.chip_seconds_capacity = (
             self._cap_integral if self._cap_integral > 0
             else self.total_chips * 1e-9
+        )
+        # end-of-run population for the conservation invariant:
+        # submitted == completed + unschedulable + killed +
+        # defrag_evicted + gang_requeued + running_at_end +
+        # pending_at_end
+        report.running_at_end = sum(
+            1 for j in jobs.values() if j.bound_at is not None
+        )
+        report.pending_at_end = sum(
+            1 for j in jobs.values() if j.bound_at is None
         )
         return report

@@ -1,0 +1,698 @@
+"""Crash recovery: rebuilt-from-relist state must equal the continued
+engine's, binds stranded by API failures must complete, reservations
+must never leak, wait clocks must survive restarts, and half-bound
+gangs must complete or requeue whole — never strand chips.
+
+The differential property suite randomizes traces and kill points;
+the unit tests pin each recovery mechanism in isolation.
+"""
+
+import random
+
+import pytest
+
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.cluster.faultinject import ApiFault, FaultInjector
+from kubeshare_tpu.explain.spool import JournalSpool
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+from kubeshare_tpu.scheduler.state import PodState
+from kubeshare_tpu.sim.simulator import FaultEvent, Simulator
+from kubeshare_tpu.sim.trace import TraceEvent, generate_trace
+
+GIB = 1 << 30
+
+
+def topo(n, chips=4):
+    return {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": chips,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": f"n{i:02d}"}
+            for i in range(n)
+        ],
+    }
+
+
+def make_cluster(n, chips=4):
+    cluster = FakeCluster()
+    for i in range(n):
+        cluster.add_node(f"n{i:02d}", [
+            ChipInfo(f"n{i:02d}-c{j}", "tpu-v5e", 16 * GIB, j)
+            for j in range(chips)
+        ])
+    return cluster
+
+
+def make_pod(name, request, ns="default", prio=0, group="", headcount=1,
+             created_at=0.0):
+    labels = {
+        C.LABEL_TPU_REQUEST: str(request),
+        C.LABEL_TPU_LIMIT_ALIASES[1]: str(max(float(request), 1.0)),
+    }
+    if prio:
+        labels[C.LABEL_PRIORITY] = str(prio)
+    if group:
+        labels[C.LABEL_GROUP_NAME] = group
+        labels[C.LABEL_GROUP_HEADCOUNT] = str(headcount)
+        labels[C.LABEL_GROUP_THRESHOLD] = "1.0"
+    return Pod(name=name, namespace=ns, labels=labels,
+               scheduler_name=C.SCHEDULER_NAME, created_at=created_at)
+
+
+TENANTS = {"tenants": {
+    "alpha": {"weight": 2.0, "guaranteed": 0.25},
+    "beta": {"weight": 1.0},
+}}
+
+
+class TestRebuildEqualsContinued:
+    def test_property_randomized_ops(self):
+        """Randomized create/schedule/finish/kill churn; at random
+        points a fresh engine is rebuilt from the same cluster — its
+        recovery fingerprint must equal the continued engine's, its
+        ledger must not drift, and no cluster-bound pod may be lost
+        or double-bound."""
+        rng = random.Random(42)
+        for trial in range(4):
+            cluster = make_cluster(4)
+            clock = [0.0]
+            engine = TpuShareScheduler(
+                topo(4), cluster, clock=lambda: clock[0],
+                tenants=TENANTS,
+            )
+            live = []
+            for step in range(60):
+                clock[0] += rng.uniform(0.5, 3.0)
+                op = rng.random()
+                if op < 0.55:
+                    ns = rng.choice(["alpha", "beta"])
+                    shape = rng.choice([0.25, 0.5, 1, 2])
+                    pod = make_pod(
+                        f"t{trial}-p{step}", shape, ns=ns,
+                        prio=rng.choice([0, 0, 50]),
+                        created_at=clock[0],
+                    )
+                    cluster.create_pod(pod)
+                    live.append(pod.key)
+                elif op < 0.75 and live:
+                    key = live.pop(rng.randrange(len(live)))
+                    if cluster.get_pod(key) is not None:
+                        cluster.finish_pod(key)
+                elif live:
+                    key = live.pop(rng.randrange(len(live)))
+                    cluster.delete_pod(key)
+                # a scheduling pass over whatever is pending
+                pending = [
+                    p for p in cluster.list_pods()
+                    if not p.is_bound and not p.is_completed
+                    and engine.status.get(p.key) is None
+                ]
+                for decision in engine.schedule_wave(pending):
+                    pass
+                engine.tick()
+                if rng.random() < 0.25:
+                    continued = engine.recovery_fingerprint()
+                    assert engine.ledger_drift() == {}
+                    cluster.reset_handlers()
+                    engine = TpuShareScheduler(
+                        topo(4), cluster, clock=lambda: clock[0],
+                        tenants=TENANTS,
+                    )
+                    rebuilt = engine.recovery_fingerprint()
+                    assert rebuilt == continued
+                    assert engine.ledger_drift() == {}
+                    # no pod lost: every cluster-bound non-completed
+                    # pod has a BOUND status on its bound node
+                    for pod in cluster.list_pods():
+                        if pod.is_bound and not pod.is_completed:
+                            status = engine.status.get(pod.key)
+                            assert status is not None, pod.key
+                            assert status.state == PodState.BOUND
+                            assert status.node_name == pod.node_name
+            assert not cluster.double_binds
+
+    def test_sim_crash_differential_uncontended(self):
+        """With ample capacity, a run with scheduler crashes ends in
+        exactly the never-crashed run's state: same binds, same
+        placements, same ledger."""
+        events = generate_trace(count=60, seed=5, mean_interarrival=4.0,
+                                mean_runtime=600.0)
+        nodes = {f"n{i:02d}": 4 for i in range(16)}
+        plain = Simulator(topo(16), dict(nodes), seed=2, tenants=TENANTS)
+        r1 = plain.run(list(events), horizon=300.0)
+        for crash_seed in (1, 2):
+            rng = random.Random(crash_seed)
+            faults = [
+                FaultEvent(rng.uniform(20.0, 280.0), "scheduler_crash")
+                for _ in range(3)
+            ]
+            crashed = Simulator(topo(16), dict(nodes), seed=2,
+                                tenants=TENANTS)
+            r2 = crashed.run(list(events), horizon=300.0, faults=faults)
+            assert r2.crashes == 3
+            assert r2.ledger_rebuild_mismatches == 0
+            assert (r2.submitted, r2.bound, r2.completed) == (
+                r1.submitted, r1.bound, r1.completed
+            )
+            assert (crashed.engine.recovery_fingerprint()
+                    == plain.engine.recovery_fingerprint())
+            assert crashed.engine.ledger_drift() == {}
+            assert not crashed.cluster.double_binds
+
+    def test_crash_during_flake_with_completions_no_false_mismatch(self):
+        """A scheduler_crash inside an api_flake window crash-loops
+        until the API answers; pods that COMPLETE while the scheduler
+        is down are legitimately absent from the rebuilt engine and
+        must not be graded as a rebuild mismatch (the continued
+        engine would have dropped them from its next informer
+        delivery too)."""
+        nodes = {f"n{i:02d}": 4 for i in range(4)}
+        events = [
+            TraceEvent(1.0, 1.0, 30.0),    # completes at ~31, mid-outage
+            TraceEvent(2.0, 1.0, 200.0),   # outlives the outage
+            TraceEvent(3.0, 0.5, 200.0),
+            TraceEvent(60.0, 1.0, 50.0),   # arrives after recovery
+        ]
+        sim = Simulator(topo(4), nodes, seed=1, inject_faults=True)
+        report = sim.run(
+            list(events), horizon=150.0,
+            faults=[
+                FaultEvent(20.0, "api_flake", duration=25.0),
+                FaultEvent(25.0, "scheduler_crash"),  # inside the flake
+            ],
+        )
+        assert report.crashes == 1
+        assert report.ledger_rebuild_mismatches == 0
+        assert report.failed_passes > 0  # the crash-loop was real
+        assert report.bound == 4 and report.completed >= 2
+        assert sim.engine.ledger_drift() == {}
+        # exactly ONE live subscriber: failed rebuild attempts during
+        # the flake must not leave zombie engines attached
+        assert len(sim.cluster._pod_add_handlers) == 1
+        assert len(sim.cluster._node_handlers) == 1
+
+    def test_sim_crash_saturated_invariants(self):
+        """Under saturation placement order may legitimately shift
+        across a crash (pending-pod re-sort), so the differential is
+        the invariant set: rebuilt == continued at every crash, exact
+        conservation, zero double-binds, zero ledger drift."""
+        events = generate_trace(count=220, seed=9, mean_interarrival=1.0,
+                                mean_runtime=400.0)
+        nodes = {f"n{i:02d}": 4 for i in range(8)}
+        rng = random.Random(7)
+        faults = sorted(
+            [FaultEvent(rng.uniform(10.0, 380.0), "scheduler_crash")
+             for _ in range(4)],
+            key=lambda f: f.time,
+        )
+        sim = Simulator(topo(8), dict(nodes), seed=3, tenants=TENANTS,
+                        defrag=True)
+        report = sim.run(list(events), horizon=400.0, faults=faults)
+        assert report.crashes == 4
+        assert report.ledger_rebuild_mismatches == 0
+        assert report.submitted == (
+            report.completed + report.unschedulable + report.killed
+            + report.defrag_evicted + report.gang_requeued
+            + report.running_at_end + report.pending_at_end
+        )
+        assert sim.engine.ledger_drift() == {}
+        assert not sim.cluster.double_binds
+
+
+class FlakyBindCluster(FakeCluster):
+    """bind() fails with an API error the first ``fail`` times."""
+
+    def __init__(self, fail=1):
+        super().__init__()
+        self.fail = fail
+
+    def bind(self, pod_key, node_name):
+        if self.fail > 0:
+            self.fail -= 1
+            raise ApiFault("bind unavailable")
+        super().bind(pod_key, node_name)
+
+
+class FlakyPatchCluster(FakeCluster):
+    def __init__(self, fail=1):
+        super().__init__()
+        self.fail = fail
+
+    def patch_pod(self, pod_key, annotations=None, env=None):
+        if self.fail > 0:
+            self.fail -= 1
+            raise ApiFault("patch unavailable")
+        super().patch_pod(pod_key, annotations=annotations, env=env)
+
+
+class TestBindRetry:
+    def test_failed_bind_retried_next_pass(self):
+        cluster = FlakyBindCluster(fail=1)
+        for i in range(2):
+            cluster.add_node(f"n{i:02d}", [
+                ChipInfo(f"n{i:02d}-c{j}", "tpu-v5e", 16 * GIB, j)
+                for j in range(4)
+            ])
+        engine = TpuShareScheduler(topo(2), cluster)
+        pod = cluster.create_pod(make_pod("p1", 0.5))
+        with pytest.raises(ApiFault):
+            engine.schedule_one(pod)
+        # the reservation survived the failed verb — leaves held,
+        # pod NOT bound in the cluster (the old short circuit lied
+        # "bound" here forever)
+        status = engine.status.get(pod.key)
+        assert status is not None and status.state == PodState.RESERVED
+        assert not cluster.get_pod(pod.key).is_bound
+        decision = engine.schedule_one(pod)
+        assert decision.status == "bound"
+        assert "retried" in decision.message
+        assert engine.bind_retries == 1
+        assert cluster.get_pod(pod.key).node_name == decision.node
+        assert engine.ledger_drift() == {}
+
+    def test_needs_offer_reoffers_reserved_only(self):
+        # the daemon's queue drain filters on needs_offer: a RESERVED
+        # survivor (failed bind) must be re-offered, WAITING and BOUND
+        # pods must not
+        cluster = FlakyBindCluster(fail=1)
+        cluster.add_node("n00", [
+            ChipInfo(f"n00-c{j}", "tpu-v5e", 16 * GIB, j)
+            for j in range(4)
+        ])
+        engine = TpuShareScheduler(topo(1), cluster)
+        pod = cluster.create_pod(make_pod("p1", 0.5))
+        assert engine.needs_offer(pod.key)  # no state yet
+        with pytest.raises(ApiFault):
+            engine.schedule_one(pod)
+        assert engine.needs_offer(pod.key)  # RESERVED: retry the bind
+        assert engine.schedule_one(pod).status == "bound"
+        assert not engine.needs_offer(pod.key)  # BOUND: done
+
+    def test_bind_retry_in_wave(self):
+        cluster = FlakyBindCluster(fail=1)
+        cluster.add_node("n00", [
+            ChipInfo(f"n00-c{j}", "tpu-v5e", 16 * GIB, j)
+            for j in range(4)
+        ])
+        engine = TpuShareScheduler(topo(1), cluster)
+        pod = cluster.create_pod(make_pod("p1", 1))
+        with pytest.raises(ApiFault):
+            engine.schedule_wave([pod])
+        decisions = engine.schedule_wave([pod])
+        assert [d.status for d in decisions] == ["bound"]
+        assert engine.bind_retries == 1
+
+
+class TestMidBarrierRecovery:
+    def test_failed_barrier_release_resumes_whole_gang(self):
+        """An API failure during the Permit barrier release (binding
+        the parked sibling) must not strand the gang: the re-offer
+        re-runs Permit, which releases the barrier again and co-binds
+        the sibling."""
+        cluster = FlakyBindCluster(fail=1)
+        for i in range(2):
+            cluster.add_node(f"n{i:02d}", [
+                ChipInfo(f"n{i:02d}-c{j}", "tpu-v5e", 16 * GIB, j)
+                for j in range(4)
+            ])
+        engine = TpuShareScheduler(topo(2), cluster)
+        a = cluster.create_pod(make_pod("g-m0", 1, prio=50, group="g",
+                                        headcount=2))
+        b = cluster.create_pod(make_pod("g-m1", 1, prio=50, group="g",
+                                        headcount=2))
+        assert engine.schedule_one(a).status == "waiting"
+        # b's permit releases the barrier; the FIRST bind (a, the
+        # parked sibling) fails — the whole attempt aborts
+        with pytest.raises(ApiFault):
+            engine.schedule_one(b)
+        assert engine.status.get(b.key).state == PodState.RESERVED
+        assert engine.status.get(a.key).state == PodState.WAITING
+        # re-offer: permit re-releases, sibling and self both bind
+        decision = engine.schedule_one(b)
+        assert decision.status == "bound"
+        assert decision.bound_with == [a.key]
+        assert engine.bind_retries == 1
+        for pod in (a, b):
+            assert cluster.get_pod(pod.key).is_bound
+            assert engine.status.get(pod.key).state == PodState.BOUND
+        assert engine.ledger_drift() == {}
+
+
+class TestReserveRollback:
+    def test_patch_failure_leaks_nothing(self):
+        cluster = FlakyPatchCluster(fail=1)
+        cluster.add_node("n00", [
+            ChipInfo(f"n00-c{j}", "tpu-v5e", 16 * GIB, j)
+            for j in range(4)
+        ])
+        engine = TpuShareScheduler(topo(1), cluster,
+                                   tenants=TENANTS)
+        pod = cluster.create_pod(make_pod("p1", 0.5, ns="alpha"))
+        with pytest.raises(ApiFault):
+            engine.schedule_one(pod)
+        # rollback: no status, no ledger charge, all leaves whole-free
+        # again, port pool empty
+        assert engine.status.get(pod.key) is None
+        assert engine.quota.ledger.snapshot() == {}
+        frees = [
+            leaf for leaf in engine.tree.leaves_view("n00", None)
+            if leaf.is_whole_free
+        ]
+        assert len(frees) == 4
+        ports = engine.ports.get("n00")
+        assert ports is None or ports.count() == 0
+        # and the pod schedules cleanly once the API recovers
+        decision = engine.schedule_one(pod)
+        assert decision.status == "bound"
+        assert engine.ledger_drift() == {}
+
+
+class TestWaitClockRecovery:
+    def test_demand_since_backdated_to_creation(self):
+        cluster = make_cluster(1, chips=2)
+        clock = [100.0]
+        engine = TpuShareScheduler(topo(1, chips=2), cluster,
+                                   clock=lambda: clock[0])
+        # an unplaceable pod created long before this (restarted)
+        # engine existed
+        pod = cluster.create_pod(make_pod("p-old", 4, created_at=5.0))
+        decision = engine.schedule_one(pod)
+        assert decision.status == "unschedulable" and decision.retryable
+        entries = {e.pod_key: e for e in engine.demand.entries()}
+        assert entries[pod.key].since == pytest.approx(5.0)
+        # the journal inherits the backdated wait via sync_reason
+        doc = engine.explain.get(pod.key, clock[0])
+        assert doc["first_enqueue_s"] == pytest.approx(5.0)
+        assert doc["waited_s"] == pytest.approx(95.0)
+
+    def test_no_creation_stamp_keeps_old_behavior(self):
+        cluster = make_cluster(1, chips=2)
+        clock = [100.0]
+        engine = TpuShareScheduler(topo(1, chips=2), cluster,
+                                   clock=lambda: clock[0])
+        pod = cluster.create_pod(make_pod("p-new", 4))
+        engine.schedule_one(pod)
+        entries = {e.pod_key: e for e in engine.demand.entries()}
+        assert entries[pod.key].since == pytest.approx(100.0)
+
+    def test_sim_restart_recovers_wait_clock(self):
+        # an unplaceable-for-capacity pod arrives at t~0, a crash at
+        # t=50 rebuilds everything — its demand entry must still say
+        # it has waited since (nearly) the start
+        nodes = {"n00": 2}
+        events = [
+            TraceEvent(0.1, 1.0, 200.0, 0),   # occupant outlives horizon
+            TraceEvent(0.5, 4.0, 100.0, 80),  # can never fit (2-chip node)
+        ]
+        sim = Simulator(topo(1, chips=2), nodes, seed=0)
+        sim.run(list(events), horizon=120.0,
+                faults=[FaultEvent(50.0, "scheduler_crash")])
+        entries = [e for e in sim.engine.demand.entries()
+                   if e.shape == "x4"]
+        assert entries, "the pod should still be filed as demand"
+        assert entries[0].since == pytest.approx(0.5, abs=1e-6)
+
+
+class TestGangReconcile:
+    def _bind_gang(self, engine, cluster, name="g1", members=2):
+        pods = [
+            cluster.create_pod(make_pod(
+                f"{name}-m{i}", 1, prio=50, group=name,
+                headcount=members,
+            ))
+            for i in range(members)
+        ]
+        for pod in pods:
+            engine.schedule_one(pod)
+        for pod in pods:
+            status = engine.status.get(pod.key)
+            assert status is not None and status.state == PodState.BOUND
+        return pods
+
+    def test_killed_member_requeues_gang_whole(self):
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(topo(2), cluster,
+                                   clock=lambda: clock[0])
+        pods = self._bind_gang(engine, cluster)
+        cluster.delete_pod(pods[0].key)  # killed, NOT completed
+        assert engine._half_gangs  # watchlist armed
+        # within grace: nothing evicted yet
+        engine.tick()
+        assert cluster.evictions == []
+        clock[0] += engine.permit_wait_base * 2 + 1.0
+        engine.tick()
+        assert cluster.evictions == [pods[1].key]
+        assert engine.gang_recoveries == 1
+        assert engine._half_gangs == {}
+
+    def test_replacement_rejoins_within_grace(self):
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(topo(2), cluster,
+                                   clock=lambda: clock[0])
+        pods = self._bind_gang(engine, cluster)
+        cluster.delete_pod(pods[0].key)
+        assert engine._half_gangs
+        replacement = cluster.create_pod(make_pod(
+            "g1-m0r", 1, prio=50, group="g1", headcount=2,
+        ))
+        decision = engine.schedule_one(replacement)
+        assert decision.status == "bound"
+        clock[0] += engine.permit_wait_base * 2 + 1.0
+        engine.tick()
+        assert cluster.evictions == []  # gang whole again: no requeue
+        assert engine.gang_recoveries == 0
+        assert engine._half_gangs == {}
+
+    def test_completed_member_never_arms_watchlist(self):
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(topo(2), cluster,
+                                   clock=lambda: clock[0])
+        pods = self._bind_gang(engine, cluster, name="g2")
+        cluster.finish_pod(pods[0].key)  # natural completion
+        assert engine._half_gangs == {}
+        clock[0] += engine.permit_wait_base * 4 + 1.0
+        engine.tick()
+        assert cluster.evictions == []
+        assert engine.gang_recoveries == 0
+
+    def test_census_outage_arms_but_never_evicts_blind(self):
+        """A member killed while the apiserver is flaking must still
+        arm the watchlist (losing the arming would strand the gang
+        until the next restart) — but the reconcile deadline re-runs
+        the census and POSTPONES rather than evicting blind."""
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(topo(2), cluster,
+                                   clock=lambda: clock[0])
+        pods = self._bind_gang(engine, cluster, name="g5")
+        real_list = cluster.list_pods
+        cluster.list_pods = lambda ns=None: (_ for _ in ()).throw(
+            ApiFault("flake")
+        )
+        cluster.delete_pod(pods[0].key)  # killed during the outage
+        assert engine._half_gangs  # armed despite the failed census
+        clock[0] += engine.permit_wait_base * 2 + 1.0
+        engine.tick()  # deadline passed, census still down: postponed
+        assert cluster.evictions == []
+        assert engine._half_gangs  # still watching
+        cluster.list_pods = real_list  # API recovers
+        clock[0] += engine.permit_wait_base + 1.0
+        engine.tick()
+        assert cluster.evictions == [pods[1].key]
+        assert engine.gang_recoveries == 1
+
+    def test_restart_never_evicts_completing_gang(self):
+        """A gang whose members already started COMPLETING (a
+        Succeeded sibling exists) is winding down, not crash-stranded:
+        a restart's sweep must not evict the healthy survivors — the
+        continued engine never would have."""
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(topo(2), cluster,
+                                   clock=lambda: clock[0])
+        pods = self._bind_gang(engine, cluster, name="g4")
+        cluster.finish_pod(pods[0].key)  # Succeeded, stays visible
+        cluster.reset_handlers()
+        rebuilt = TpuShareScheduler(topo(2), cluster,
+                                    clock=lambda: clock[0])
+        assert rebuilt._half_gangs == {}
+        clock[0] += rebuilt.permit_wait_base * 4 + 1.0
+        rebuilt.tick()
+        assert cluster.evictions == []
+        assert rebuilt.gang_recoveries == 0
+
+    def test_unsynced_node_members_count_as_holders(self):
+        """Restart while the inventory collector is unreachable for
+        one node: that node's bound gang members sit in _bound_queue
+        (no PodStatus yet), but they are HOLDERS — the sweep must not
+        arm, and the reconcile must never evict the healthy rest."""
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(topo(2), cluster,
+                                   clock=lambda: clock[0])
+        pods = self._bind_gang(engine, cluster, name="g6")
+        down_node = engine.status.get(pods[0].key).node_name
+        cluster.reset_handlers()
+        real_chips = cluster.chips_on_node
+
+        def flaky_inventory(node):
+            if node == down_node:
+                raise OSError("collector unreachable")
+            return real_chips(node)
+
+        rebuilt = TpuShareScheduler(topo(2), cluster,
+                                    clock=lambda: clock[0],
+                                    inventory=flaky_inventory)
+        # the member on the unsynced node is queued, not lost
+        assert any(
+            p.key == pods[0].key
+            for queued in rebuilt._bound_queue.values() for p in queued
+        )
+        assert rebuilt._half_gangs == {}
+        clock[0] += rebuilt.permit_wait_base * 4 + 1.0
+        rebuilt.tick()
+        assert cluster.evictions == []
+        assert rebuilt.gang_recoveries == 0
+
+    def test_failed_last_member_census_retried_from_tick(self):
+        """A census failure at the LAST member's delete must not leak
+        the group registry entry forever: the verdict defers to
+        tick(), which retries until the API answers and then marks
+        the group deleted."""
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(topo(2), cluster,
+                                   clock=lambda: clock[0])
+        pods = self._bind_gang(engine, cluster, name="g7")
+        group_key = engine.status.get(pods[0].key).group_key
+        cluster.delete_pod(pods[0].key)
+        real_list = cluster.list_pods
+        cluster.list_pods = lambda ns=None: (_ for _ in ()).throw(
+            ApiFault("flake")
+        )
+        cluster.delete_pod(pods[1].key)  # last member, census down
+        assert group_key in engine._stale_group_census
+        assert engine.groups.get(group_key).deletion_timestamp is None
+        engine.tick()  # still down: verdict stays pending
+        assert group_key in engine._stale_group_census
+        cluster.list_pods = real_list
+        engine.tick()
+        assert group_key not in engine._stale_group_census
+        assert engine.groups.get(group_key).deletion_timestamp is not None
+
+    def test_restart_sweep_arms_watchlist(self):
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(topo(2), cluster,
+                                   clock=lambda: clock[0])
+        pods = self._bind_gang(engine, cluster, name="g3")
+        # simulate the crash gap: one member's binding vanished (its
+        # node kept the pod but the POD object was killed), then the
+        # scheduler restarts and must notice the stranded half
+        cluster._pods.pop(pods[0].key)  # vanish without events
+        cluster.reset_handlers()
+        rebuilt = TpuShareScheduler(topo(2), cluster,
+                                    clock=lambda: clock[0])
+        assert rebuilt._half_gangs
+        clock[0] += rebuilt.permit_wait_base * 2 + 1.0
+        rebuilt.tick()
+        assert cluster.evictions == [pods[1].key]
+        assert rebuilt.gang_recoveries == 1
+
+
+class TestInjectorTransparency:
+    def test_zero_rate_injector_is_decision_identical(self):
+        events = generate_trace(count=80, seed=4, mean_interarrival=1.5,
+                                mean_runtime=200.0)
+        nodes = {f"n{i:02d}": 4 for i in range(4)}
+        plain = Simulator(topo(4), dict(nodes), seed=1)
+        r1 = plain.run(list(events), horizon=250.0)
+        wrapped = Simulator(topo(4), dict(nodes), seed=1,
+                            inject_faults=True, fault_seed=99)
+        r2 = wrapped.run(list(events), horizon=250.0)
+        assert isinstance(wrapped.cluster, FaultInjector)
+        assert (plain.engine.recovery_fingerprint()
+                == wrapped.engine.recovery_fingerprint())
+        assert (r1.submitted, r1.bound, r1.completed, r1.mean_wait) == (
+            r2.submitted, r2.bound, r2.completed, r2.mean_wait
+        )
+
+    def test_injected_conflicts_never_leak_reservations(self):
+        events = generate_trace(count=120, seed=6, mean_interarrival=1.0,
+                                mean_runtime=150.0)
+        nodes = {f"n{i:02d}": 4 for i in range(4)}
+        sim = Simulator(topo(4), dict(nodes), seed=1, inject_faults=True,
+                        fault_seed=3, api_conflict_rate=0.1)
+        report = sim.run(list(events), horizon=300.0)
+        assert sim.injector.injected_conflicts > 0
+        assert sim.engine.ledger_drift() == {}
+        assert not sim.cluster.double_binds
+        assert report.submitted == (
+            report.completed + report.unschedulable + report.killed
+            + report.defrag_evicted + report.gang_requeued
+            + report.running_at_end + report.pending_at_end
+        )
+
+
+class TestJournalSpool:
+    def test_append_recover_rotate(self, tmp_path):
+        path = str(tmp_path / "spool.jsonl")
+        spool = JournalSpool(path, max_bytes=400, max_files=3)
+        for i in range(40):
+            spool.append({"t": "pod", "pod": f"ns/p{i}", "at": float(i),
+                          "doc": {"outcome": "bound", "i": i}})
+        assert spool.rotations > 0
+        # the newest record for a pod wins; old files bounded
+        import glob
+        assert len(glob.glob(path + "*")) <= 3
+        doc = spool.recover("ns/p39")
+        assert doc == {"outcome": "bound", "i": 39}
+        assert spool.recover("ns/does-not-exist") is None
+        spool.close()
+
+    def test_torn_line_skipped(self, tmp_path):
+        path = str(tmp_path / "spool.jsonl")
+        spool = JournalSpool(path)
+        spool.append({"t": "pod", "pod": "ns/a", "doc": {"ok": 1}})
+        spool.close()
+        with open(path, "a") as f:
+            f.write('{"t": "pod", "pod": "ns/b", "doc": {"tr')  # torn
+        spool2 = JournalSpool(path)
+        assert spool2.recover("ns/a") == {"ok": 1}
+        assert spool2.recover("ns/b") is None
+        spool2.close()
+
+    def test_explain_survives_restart(self, tmp_path):
+        path = str(tmp_path / "spool.jsonl")
+        cluster = make_cluster(2)
+        clock = [0.0]
+        engine = TpuShareScheduler(
+            topo(2), cluster, clock=lambda: clock[0],
+            journal_spool=JournalSpool(path),
+        )
+        pod = cluster.create_pod(make_pod("p1", 0.5))
+        assert engine.schedule_one(pod).status == "bound"
+        # the restart: fresh engine, same spool file
+        cluster.reset_handlers()
+        rebuilt = TpuShareScheduler(
+            topo(2), cluster, clock=lambda: clock[0],
+            journal_spool=JournalSpool(path),
+        )
+        doc = rebuilt.explain.get(pod.key, clock[0])
+        assert doc is not None and doc["recovered"] is True
+        assert doc["outcome"] == "bound"
+        assert doc["node"] == cluster.get_pod(pod.key).node_name
+        # pods never journaled stay honest 404s
+        assert rebuilt.explain.get("ns/never", clock[0]) is None

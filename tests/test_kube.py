@@ -21,6 +21,7 @@ class StubApiServer:
         self.secrets = {}  # (ns, name) -> Secret dict
         self.evictions = []  # pod keys POSTed to the eviction subresource
         self.events_posted = []  # v1 Event objects POSTed
+        self.fail_codes = []  # HTTP codes to inject, one per request
         self.bindings = []
         self.patches = []
         self.auth_headers = []
@@ -45,6 +46,19 @@ class StubApiServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _maybe_fail(self):
+                """Injected failures for the retry/backoff tests: pop
+                one queued HTTP code per request (no effect when the
+                queue is empty)."""
+                with stub._lock:
+                    code = (stub.fail_codes.pop(0)
+                            if stub.fail_codes else 0)
+                if code:
+                    self._send({"message": "injected failure"},
+                               code=code)
+                    return True
+                return False
 
             def _stream_watch(self, kind):
                 stub.watch_opens[kind] += 1
@@ -92,6 +106,8 @@ class StubApiServer:
                 stub.auth_headers.append(self.headers.get("Authorization"))
                 parts = [p for p in self.path.split("/") if p]
                 path, _, query = self.path.partition("?")
+                if "watch=true" not in query and self._maybe_fail():
+                    return
                 lease_key = self._lease_key()
                 if lease_key is not None:
                     ns, name = lease_key
@@ -132,6 +148,11 @@ class StubApiServer:
                     self._send({"message": "bad path"}, code=404)
 
             def do_POST(self):
+                if self._maybe_fail():
+                    self.rfile.read(
+                        int(self.headers.get("Content-Length", "0"))
+                    )
+                    return
                 length = int(self.headers.get("Content-Length", "0"))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 lease_key = self._lease_key()
@@ -676,3 +697,253 @@ class TestSchedulerKubeMode:
         assert stub.bindings
         [(_, _, patch)] = stub.patches
         assert "sharedtpu/chip_uuid" in patch["metadata"]["annotations"]
+
+
+class TestApiRetryBackoff:
+    """PR-8: jittered-exponential-backoff retries for retryable API
+    failures (429/5xx/transport), degraded mode on budget exhaustion,
+    relist resync on recovery."""
+
+    def _cluster(self, stub, **kw):
+        cluster = KubeCluster(
+            api_server=f"http://127.0.0.1:{stub.port}", token="t", **kw
+        )
+        cluster._sleep = lambda s: None  # no real backoff in tests
+        return cluster
+
+    def test_retryable_5xx_retried_to_success(self, stub):
+        stub.add_pod("p1")
+        stub.fail_codes.extend([503, 502])
+        cluster = self._cluster(stub)
+        pods = cluster.list_pods()
+        assert [p.name for p in pods] == ["p1"]
+        assert cluster.api_retries == 2
+        assert cluster.api_errors == 0
+        assert cluster.degraded is False
+
+    def test_429_throttling_retried(self, stub):
+        stub.add_pod("p1")
+        stub.fail_codes.append(429)
+        cluster = self._cluster(stub)
+        assert [p.name for p in cluster.list_pods()] == ["p1"]
+        assert cluster.api_retries == 1
+
+    def test_budget_exhaustion_marks_degraded_then_recovers(self, stub):
+        stub.add_pod("p1")
+        cluster = self._cluster(stub, retry_budget=1)
+        stub.fail_codes.extend([503, 503])  # first try + only retry
+        with pytest.raises(KubeError):
+            cluster.list_pods()
+        assert cluster.degraded is True
+        assert cluster.api_errors == 1
+        assert cluster.api_retries == 1
+        # recovery: the next success clears the flag AND forces a
+        # relist so watch mode resyncs whatever the outage swallowed
+        assert [p.name for p in cluster.list_pods()] == ["p1"]
+        assert cluster.degraded is False
+        assert cluster._watch_expired is True
+
+    def test_semantic_4xx_clears_degraded(self, stub):
+        # a 404/409 after an outage is still an ANSWER: the apiserver
+        # is reachable — the degraded flag must not stay latched just
+        # because the first post-outage requests aren't 2xx
+        stub.add_pod("p1")
+        cluster = self._cluster(stub, retry_budget=0)
+        stub.fail_codes.append(503)
+        with pytest.raises(KubeError):
+            cluster.list_pods()
+        assert cluster.degraded is True
+        assert cluster.get_pod("default/missing") is None  # 404
+        assert cluster.degraded is False
+        assert cluster._watch_expired is True
+
+    def test_semantic_4xx_not_retried(self, stub):
+        stub.add_pod("p1")
+        cluster = self._cluster(stub)
+        stub.fail_codes.append(403)
+        with pytest.raises(KubeError) as err:
+            cluster.list_pods()
+        assert err.value.code == 403
+        assert cluster.api_retries == 0
+        assert cluster.degraded is False  # a semantic answer, not an outage
+
+    def test_conflict_not_retried(self, stub):
+        stub.add_pod("p1")
+        cluster = self._cluster(stub)
+        stub.fail_codes.append(409)
+        from kubeshare_tpu.cluster.kube import KubeConflict
+
+        with pytest.raises(KubeConflict):
+            cluster.bind("default/p1", "node-a")
+        assert cluster.api_retries == 0
+
+    def test_zero_budget_fails_fast(self, stub):
+        stub.add_pod("p1")
+        cluster = self._cluster(stub, retry_budget=0)
+        stub.fail_codes.append(503)
+        with pytest.raises(KubeError):
+            cluster.list_pods()
+        assert cluster.api_retries == 0
+        assert cluster.degraded is True
+
+    def test_samples_expose_health_counters(self, stub):
+        cluster = self._cluster(stub)
+        cluster.api_retries = 3
+        cluster.watch_reconnects = 2
+        cluster.poison_events = 1
+        cluster.degraded = True
+        by_name = {s.name: s.value for s in cluster.samples()}
+        assert by_name["tpu_scheduler_api_retries_total"] == 3
+        assert by_name["tpu_scheduler_watch_reconnects_total"] == 2
+        assert by_name["tpu_scheduler_poison_events_total"] == 1
+        assert by_name["tpu_scheduler_degraded"] == 1
+
+
+class TestWatchReconnect:
+    """PR-8 satellite: a dropped-but-previously-live stream reconnects
+    in place with backoff (counted), instead of dying in a bare
+    except and forcing the relist path every time."""
+
+    def _watching_cluster(self, stub):
+        cluster = KubeCluster(
+            api_server=f"http://127.0.0.1:{stub.port}", token="t",
+            use_watch=True, watch_timeout=5.0,
+        )
+        return cluster
+
+    def test_reconnect_counted_and_stream_stays_alive(self, stub):
+        stub.add_pod("p1", uid="u1")
+        cluster = self._watching_cluster(stub)
+        adds = []
+        cluster.on_pod_event(lambda p: adds.append(p.uid), lambda p: None)
+        cluster.on_node_event(lambda n: None)
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            bookmark = {"metadata": {"resourceVersion": "9"}}
+            stub.push_watch("pods", "BOOKMARK", bookmark)
+            deadline_poll(cluster, lambda: cluster._pod_watch.delivered)
+            pod_channel = cluster._pod_watch
+            stub.end_watch("pods")  # routine drop of a LIVE stream
+            deadline_poll(
+                cluster, lambda: stub.watch_opens["pods"] >= 2
+            )
+            # the CHANNEL reconnected itself: same object, still alive,
+            # reconnect counted on the cluster
+            assert cluster._pod_watch is pod_channel
+            assert pod_channel.alive
+            assert cluster.watch_reconnects >= 1
+            # and events on the reconnected stream still apply
+            stub.wait_watches(kinds=("pods",))
+            stub.push_watch("pods", "ADDED", pod_obj("p2", uid="u2"))
+            deadline_poll(cluster, lambda: "u2" in adds)
+        finally:
+            cluster.close()
+
+
+class TestPoisonPillQuarantine:
+    """PR-8 satellite: an event whose handler raises repeatedly is
+    quarantined after POISON_RETRIES polls — counted, logged, posted —
+    and the events behind it keep applying."""
+
+    def test_poison_event_quarantined_rest_applied(self, stub):
+        stub.add_node("node-a")
+        cluster = KubeCluster(
+            api_server=f"http://127.0.0.1:{stub.port}", token="t",
+            use_watch=True, watch_timeout=5.0,
+        )
+        adds = []
+
+        def picky_add(pod):
+            if pod.uid == "poison":
+                raise ValueError("malformed pod wedges the handler")
+            adds.append(pod.uid)
+
+        cluster.on_pod_event(picky_add, lambda p: None)
+        cluster.on_node_event(lambda n: None)
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            stub.push_watch("pods", "ADDED", pod_obj("bad", uid="poison"))
+            stub.push_watch("pods", "ADDED", pod_obj("ok", uid="good"))
+            # present on the apiserver but never delivered via watch:
+            # only the quarantine-forced relist can discover it
+            stub.add_pod("relisted", uid="relisted-uid")
+            import time
+
+            # each poll retries the head event; until quarantine the
+            # exception escapes (the scheduler loop logs and retries)
+            deadline = time.time() + 5.0
+            raises = 0
+            while time.time() < deadline and cluster.poison_events == 0:
+                try:
+                    cluster.poll()
+                except ValueError:
+                    raises += 1
+                if "good" in adds:
+                    break
+                time.sleep(0.02)
+            assert cluster.poison_events == 1
+            assert raises == cluster.POISON_RETRIES - 1
+            # the event BEHIND the poison one applied
+            deadline_poll(cluster, lambda: "good" in adds)
+            # quarantine posted a Warning against the pod
+            assert any(
+                e.get("reason") == "EventQuarantined"
+                for e in stub.events_posted
+            )
+            # dropping an event desyncs the cache: quarantine must
+            # force a relist so the diff repairs it (a quarantined
+            # DELETED would otherwise leak the pod's capacity forever)
+            deadline_poll(cluster, lambda: "relisted-uid" in adds)
+        finally:
+            cluster.close()
+
+    def test_healthy_handlers_never_quarantine(self, stub):
+        stub.add_node("node-a")
+        cluster = KubeCluster(
+            api_server=f"http://127.0.0.1:{stub.port}", token="t",
+            use_watch=True, watch_timeout=5.0,
+        )
+        adds = []
+        cluster.on_pod_event(lambda p: adds.append(p.uid), lambda p: None)
+        cluster.on_node_event(lambda n: None)
+        try:
+            cluster.poll()
+            stub.wait_watches()
+            for i in range(8):
+                stub.push_watch("pods", "ADDED",
+                                pod_obj(f"p{i}", uid=f"u{i}"))
+            deadline_poll(cluster, lambda: len(adds) >= 8)
+            assert cluster.poison_events == 0
+        finally:
+            cluster.close()
+
+
+class TestCreationTimestamp:
+    def test_creation_timestamp_parsed_to_epoch(self):
+        from kubeshare_tpu.cluster.kube import pod_from_k8s
+
+        pod = pod_from_k8s({
+            "metadata": {"name": "p1", "namespace": "ns",
+                         "creationTimestamp": "2026-01-02T03:04:05Z"},
+            "spec": {}, "status": {},
+        })
+        import calendar
+        import time as _t
+
+        want = calendar.timegm(_t.strptime(
+            "2026-01-02T03:04:05Z", "%Y-%m-%dT%H:%M:%SZ"
+        ))
+        assert pod.created_at == want
+
+    def test_missing_or_bad_timestamp_is_zero(self):
+        from kubeshare_tpu.cluster.kube import pod_from_k8s
+
+        assert pod_from_k8s({
+            "metadata": {"name": "p"}, "spec": {}, "status": {},
+        }).created_at == 0.0
+        assert pod_from_k8s({
+            "metadata": {"name": "p", "creationTimestamp": "garbage"},
+            "spec": {}, "status": {},
+        }).created_at == 0.0

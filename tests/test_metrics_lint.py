@@ -33,7 +33,7 @@ WEIRD_TENANT = 'we"ird\\ten\nant'
 
 
 @pytest.fixture(scope="module")
-def scraped():
+def scraped(tmp_path_factory):
     topo = {
         "cell_types": {
             "v5e-node": {
@@ -55,10 +55,16 @@ def scraped():
             for j in range(4)
         ])
     clock = [0.0]
+    from kubeshare_tpu.explain.spool import JournalSpool
+
+    spool = JournalSpool(str(
+        tmp_path_factory.mktemp("spool") / "explain.jsonl"
+    ))
     engine = TpuShareScheduler(
         topo, cluster, clock=lambda: clock[0],
         tenants={"tenants": {"alpha": {"weight": 2.0,
                                        "guaranteed": 0.25}}},
+        journal_spool=spool,
     )
 
     def pod(name, request, limit=None, prio=0, ns="alpha"):
@@ -108,11 +114,22 @@ def scraped():
     router.tick(7.0)       # backlog -> no-free-slot demand entry
     router.complete("r0", 8.0)                           # serves r0
 
+    # the API-health families ride a real KubeCluster's samples()
+    # (no apiserver needed — the counters are plain attributes)
+    from kubeshare_tpu.cluster.kube import KubeCluster
+
+    kube = KubeCluster(api_server="http://127.0.0.1:9")
+    kube.api_retries = 3
+    kube.api_errors = 1
+    kube.watch_reconnects = 2
+    kube.poison_events = 1
+    kube.degraded = True
+
     tracer = Tracer()
     with tracer.span("pass"):
         pass
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
-                               router=router)
+                               router=router, cluster=kube)
     metrics.record_pass(0.01, 4)
 
     server = MetricServer(host="127.0.0.1", port=0)
@@ -197,6 +214,17 @@ class TestExpositionHygiene:
             ("tpu_serving_shed_total", "gauge"),
             ("tpu_serving_queue_wait_seconds", "histogram"),
             ("tpu_serving_ttft_seconds", "histogram"),
+            # PR-8: API robustness + crash-recovery + spool families
+            ("tpu_scheduler_api_retries_total", "gauge"),
+            ("tpu_scheduler_api_errors_total", "gauge"),
+            ("tpu_scheduler_watch_reconnects_total", "gauge"),
+            ("tpu_scheduler_poison_events_total", "gauge"),
+            ("tpu_scheduler_degraded", "gauge"),
+            ("tpu_scheduler_bind_retries_total", "gauge"),
+            ("tpu_scheduler_gang_recoveries_total", "gauge"),
+            ("tpu_scheduler_explain_spool_appends_total", "gauge"),
+            ("tpu_scheduler_explain_spool_rotations_total", "gauge"),
+            ("tpu_scheduler_explain_spool_recoveries_total", "gauge"),
         ]:
             assert kinds.get(fam) == kind, (fam, kinds.get(fam))
 
@@ -289,3 +317,11 @@ class TestExpositionHygiene:
         # 4 pods + the slots::llama-7b pseudo-entry the router's
         # no-free-slot transition filed through the ledger hook
         assert value("tpu_scheduler_explain_journal_pods") == 5
+        # PR-8 families carry the values staged in the fixture: the
+        # degraded flag and API-health counters from the cluster
+        # adapter, and the spool append for the one bound terminal
+        assert value("tpu_scheduler_degraded") == 1
+        assert value("tpu_scheduler_api_retries_total") == 3
+        assert value("tpu_scheduler_watch_reconnects_total") == 2
+        assert value("tpu_scheduler_poison_events_total") == 1
+        assert value("tpu_scheduler_explain_spool_appends_total") >= 1
