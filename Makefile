@@ -53,6 +53,14 @@ serving-sim:
 chaos-sim:
 	$(PYTHON) tools/chaos_sim.py
 
+# incident flight-recorder gauntlet -> INCIDENTS.json (fault-free
+# baseline vs scheduler crash / API flake / node flap with the alert
+# plane + black-box recorder attached; invariants: zero baseline
+# false positives, exact fault->rule classification, pre-window
+# contains the fault onset, rate-limit + spool round-trip bounds)
+incident-report:
+	$(PYTHON) tools/incident_report.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

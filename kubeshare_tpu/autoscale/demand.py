@@ -83,18 +83,34 @@ class DemandEntry:
     updated: float      # last attempt that refreshed the entry
 
 
+_MULTI_CHIP = None           # lazy PodKind.MULTI_CHIP (circular import)
+_SHAPE_MEMO: dict = {}       # chip_count -> "xN" (bounded: real counts)
+
+
 def shape_of(req) -> str:
     """Chip-shape bucket key for a requirement: whole-chip pods bucket
     by count (an x4 pod needs a very different node than an x1), all
     fractional pods share one bucket (any leaf with headroom serves
     them). Serving-plane slot demand (SlotDemand) buckets as
-    ``slots`` — it is not a chip shape at all."""
+    ``slots`` — it is not a chip shape at all.
+
+    Called twice per bound pod on the journal-on hot path (the
+    attempt record and the bind's terminal note), so the PodKind
+    import is hoisted to first use and the tiny ``xN`` string set is
+    memoized instead of re-formatted."""
     if getattr(req, "serving_slots", 0):
         return "slots"
-    from ..scheduler.labels import PodKind
+    global _MULTI_CHIP
+    if _MULTI_CHIP is None:
+        from ..scheduler.labels import PodKind
 
-    if req.kind == PodKind.MULTI_CHIP:
-        return f"x{req.chip_count}"
+        _MULTI_CHIP = PodKind.MULTI_CHIP
+    if req.kind is _MULTI_CHIP:
+        count = req.chip_count
+        shape = _SHAPE_MEMO.get(count)
+        if shape is None:
+            shape = _SHAPE_MEMO[count] = f"x{count}"
+        return shape
     return "shared"
 
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 COMPONENTS = {
     "scheduler": "kubeshare_tpu.cmd.scheduler",
     "explain": "kubeshare_tpu.cmd.explain",
+    "incidents": "kubeshare_tpu.cmd.incidents",
     "collector": "kubeshare_tpu.cmd.collector",
     "aggregator": "kubeshare_tpu.cmd.aggregator",
     "nodeconfig": "kubeshare_tpu.cmd.nodeconfig",

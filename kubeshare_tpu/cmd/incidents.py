@@ -1,0 +1,179 @@
+"""Browse the scheduler's incident flight-recorder bundles.
+
+``python -m kubeshare_tpu incidents`` lists incident summaries from
+the live scheduler's metrics server (the same port as ``/metrics``,
+serving ``/incidents``); with an incident id it prints the full
+bundle — triggering rule + context, the pre/post snapshot window, the
+embedded Chrome trace's span count, and the implicated pods'
+decision journals. ``--spool`` reads a rotated incident spool file
+offline instead (the ``--incident-spool`` store, readable after the
+daemon is gone).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Optional, Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-incidents", description=__doc__
+    )
+    parser.add_argument(
+        "incident", nargs="?", default="",
+        help="incident id (e.g. inc-0001-api-error-rate); omit to list",
+    )
+    parser.add_argument(
+        "--url", default="http://127.0.0.1:9006",
+        help="scheduler metrics server base URL (the --metrics-port "
+             "endpoint serving /incidents)",
+    )
+    parser.add_argument(
+        "--spool", default="", metavar="PATH",
+        help="read bundles from an incident spool file offline "
+             "(the --incident-spool path) instead of a live server",
+    )
+    parser.add_argument(
+        "--rule", default="",
+        help="listing mode: only this rule's incidents",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="emit the raw JSON instead of rendering",
+    )
+    return parser
+
+
+def _render_summary(rows) -> str:
+    if not rows:
+        return "no incidents recorded"
+    lines = [f"{'ID':34} {'RULE':22} {'AT':>10} {'LEVEL':>7} "
+             f"{'PRE':>4} {'POST':>4}  CONTEXT"]
+    for row in rows:
+        context = json.dumps(row.get("context") or {},
+                             separators=(",", ":"))
+        if len(context) > 40:
+            context = context[:37] + "..."
+        lines.append(
+            f"{row.get('id', ''):34} {row.get('rule', ''):22} "
+            f"{row.get('at', 0.0):10.1f} {row.get('level', 0.0):7.2f} "
+            f"{row.get('pre_snapshots', 0):4d} "
+            f"{row.get('post_snapshots', 0):4d}  {context}"
+        )
+    return "\n".join(lines)
+
+
+def _render_bundle(bundle: dict) -> str:
+    pre = bundle.get("pre") or []
+    post = bundle.get("post") or []
+    lines = [
+        f"incident {bundle.get('id', '')}",
+        f"  rule      {bundle.get('rule', '')}"
+        f"{'  [CRITICAL]' if bundle.get('critical') else ''}",
+        f"  fired at  {bundle.get('at', 0.0)} "
+        f"(level {bundle.get('level', 0.0)})",
+        f"  context   "
+        + json.dumps(bundle.get('context') or {}, sort_keys=True),
+        f"  window    {len(pre)} pre / {len(post)} post snapshots"
+        + (f" ({pre[0]['t']} .. "
+           f"{(post or pre)[-1]['t']})" if pre else ""),
+    ]
+    trace = bundle.get("trace") or {}
+    events = trace.get("traceEvents") or []
+    if events:
+        lines.append(f"  trace     {len(events)} span events embedded")
+    pods = bundle.get("pods") or []
+    if pods:
+        lines.append("  implicated pods:")
+        for doc in pods:
+            lines.append(
+                f"    {doc.get('pod', ''):32} tenant={doc.get('tenant', '')}"
+                f" waited={doc.get('waited_s', 0.0)}s"
+                f" reason={(doc.get('timeline') or [{}])[-1].get('state', '')}"
+            )
+    return "\n".join(lines)
+
+
+def _fetch(url: str):
+    try:
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except (ValueError, OSError):
+            return e.code, {"error": f"HTTP {e.code}"}
+    except (urllib.error.URLError, OSError) as e:
+        raise SystemExit(
+            f"cannot reach scheduler metrics server at {url}: {e}\n"
+            f"(is the scheduler running with --metrics-port, or did "
+            f"you mean --spool <path>?)"
+        )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.spool:
+        import os
+
+        from ..explain.spool import JournalSpool
+
+        if not os.path.exists(args.spool):
+            # JournalSpool opens append-mode (it is a writer first);
+            # a read-only browse of a mistyped path must not create
+            # an empty spool as a side effect
+            print(f"no incident spool at {args.spool}", file=sys.stderr)
+            return 1
+        spool = JournalSpool(args.spool, kind="incident", key_field="id")
+        bundles = [
+            rec.get("doc") or {}
+            for rec in spool.replay() if rec.get("t") == "incident"
+        ]
+        spool.close()
+        if args.incident:
+            match = [b for b in bundles if b.get("id") == args.incident]
+            if not match:
+                print(f"no incident {args.incident!r} in {args.spool}",
+                      file=sys.stderr)
+                return 1
+            bundle = match[-1]
+            print(json.dumps(bundle, indent=1) if args.json
+                  else _render_bundle(bundle))
+            return 0
+        from ..obs.recorder import _summary
+
+        rows = [_summary(b) for b in reversed(bundles)]
+        if args.rule:
+            rows = [r for r in rows if r.get("rule") == args.rule]
+        print(json.dumps(rows, indent=1) if args.json
+              else _render_summary(rows))
+        return 0
+
+    base = args.url.rstrip("/")
+    if args.incident:
+        status, doc = _fetch(f"{base}/incidents/{args.incident}")
+        if status != 200:
+            print(doc.get("error", f"HTTP {status}"), file=sys.stderr)
+            return 1
+        print(json.dumps(doc, indent=1) if args.json
+              else _render_bundle(doc))
+        return 0
+    query = f"?rule={args.rule}" if args.rule else ""
+    status, doc = _fetch(f"{base}/incidents{query}")
+    if status != 200:
+        print(doc.get("error", f"HTTP {status}"), file=sys.stderr)
+        return 1
+    rows = doc.get("incidents", [])
+    print(json.dumps(rows, indent=1) if args.json
+          else _render_summary(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

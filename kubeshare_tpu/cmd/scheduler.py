@@ -175,8 +175,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--metrics-port", type=int, default=0,
         help="serve scheduler self-metrics (tpu_scheduler_*) on this "
              "port (0 = off); the same server answers /explain "
-             "decision-provenance queries (see "
-             "`python -m kubeshare_tpu explain`)",
+             "decision-provenance queries, /healthz (503 while a "
+             "critical alert is active), and /incidents flight-"
+             "recorder bundles (see `python -m kubeshare_tpu "
+             "explain` / `... incidents`)",
     )
     parser.add_argument(
         "--explain-capacity", type=int, default=512,
@@ -229,6 +231,43 @@ def build_parser() -> argparse.ArgumentParser:
         help="write a Chrome/Perfetto trace of scheduling phases here "
              "on exit (and refresh it every 100 passes)",
     )
+    parser.add_argument(
+        "--trace-ring", type=int, default=65536, metavar="N",
+        help="span-event ring size (the history an incident bundle's "
+             "embedded Chrome trace carries; occupancy exported as "
+             "tpu_scheduler_phase_events next to the dropped counter)",
+    )
+    parser.add_argument(
+        "--alerts", action=argparse.BooleanOptionalAction, default=True,
+        help="incident plane: evaluate the burn-rate/error/drift alert "
+             "rules on every pass, serve /healthz + /incidents on the "
+             "metrics port, and cut flight-recorder bundles when a "
+             "rule fires (on by default whenever --metrics-port or "
+             "--incident-spool is set)",
+    )
+    parser.add_argument(
+        "--incident-spool", default="", metavar="PATH",
+        help="durable incident store: write each finalized incident "
+             "bundle as one JSONL line here (JournalSpool rotation — "
+             "same bounds as --journal-spool), so GET /incidents "
+             "answers for bundles a previous incarnation wrote. "
+             "'' = in-memory bundles only",
+    )
+    parser.add_argument(
+        "--incident-spool-max-mb", type=float, default=16.0,
+        help="rotate the incident spool's active file past this size",
+    )
+    parser.add_argument(
+        "--incident-spool-files", type=int, default=4,
+        help="rotated incident spool files kept",
+    )
+    parser.add_argument(
+        "--slo-wait-seconds", type=float, default=60.0,
+        help="wait-time SLO the burn-rate alert burns against: a pod "
+             "should bind within this many seconds (must be one of "
+             "the tpu_scheduler_pod_wait_seconds bucket bounds to "
+             "alert exactly)",
+    )
     return parser
 
 
@@ -274,12 +313,16 @@ class SchedulerMetrics:
     (scheduler.go [Filter]/[Score]/[Reserve] Infof)."""
 
     def __init__(self, clock=time.time, tracer=None, engine=None,
-                 elector=None, planner=None, router=None, cluster=None):
+                 elector=None, planner=None, router=None, cluster=None,
+                 obs=None):
         self.clock = clock
         self.tracer = tracer
         self.engine = engine
         self.elector = elector
         self.planner = planner
+        # obs.IncidentPlane (optional): merges the alert-state gauges
+        # + fired counters and the flight-recorder health counters
+        self.obs = obs
         # serving.RequestRouter (optional): merges the request plane's
         # tpu_serving_* gauges/histograms into the same exposition
         self.router = router
@@ -340,6 +383,8 @@ class SchedulerMetrics:
             samples += self.planner.samples()
         if self.router is not None:
             samples += self.router.samples()
+        if self.obs is not None:
+            samples += self.obs.samples()
         if self.tracer is not None:
             samples += self.tracer.metric_samples("tpu_scheduler_phase")
         return expfmt.render(samples)
@@ -553,13 +598,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         cluster = SnapshotCluster(args.cluster_state)
         inventory = None
+    # one enablement decision shared by the tracer (whose event ring
+    # the plane embeds into bundles) and the plane construction below
+    # — two copies of this condition would desynchronize
+    obs_enabled = args.alerts and bool(
+        args.metrics_port or args.incident_spool
+    )
     tracer = None
-    if args.trace_out or args.metrics_port:
+    if args.trace_out or args.metrics_port or args.incident_spool:
         from ..utils.trace import Tracer
 
-        # events only matter when a trace file is requested; metrics
+        # events matter when a trace file is requested OR the
+        # incident plane will embed the ring into bundles; metrics
         # alone just needs the histograms
-        tracer = Tracer(keep_events=bool(args.trace_out))
+        tracer = Tracer(
+            keep_events=bool(args.trace_out or obs_enabled),
+            max_events=max(1, args.trace_ring),
+        )
     spool = None
     if args.journal_spool:
         from ..explain.spool import JournalSpool
@@ -635,9 +690,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ),
         )
 
+    # incident plane: burn-rate/error/drift alert rules evaluated on
+    # every pass + the flight recorder cutting bundles when one fires;
+    # serves /healthz + /incidents on the metrics port below
+    obs_plane = None
+    if obs_enabled:
+        from ..obs import AlertConfig, build_plane
+
+        incident_spool = None
+        if args.incident_spool:
+            from ..explain.spool import JournalSpool
+
+            incident_spool = JournalSpool(
+                args.incident_spool,
+                max_bytes=int(args.incident_spool_max_mb * (1 << 20)),
+                max_files=args.incident_spool_files,
+                log=log, kind="incident", key_field="id",
+            )
+            log.info("incident spool at %s (%.0f MiB x %d files)",
+                     args.incident_spool, args.incident_spool_max_mb,
+                     args.incident_spool_files)
+        obs_plane = build_plane(
+            lambda: engine,
+            cluster=cluster if args.kube else None,
+            tracer=tracer,
+            spool=incident_spool,
+            config=AlertConfig(slo_wait_seconds=args.slo_wait_seconds),
+            log=log,
+        )
+
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
                                elector=elector, planner=planner,
-                               cluster=cluster if args.kube else None)
+                               cluster=cluster if args.kube else None,
+                               obs=obs_plane)
     metrics_server = None
     if args.metrics_port:
         from ..utils.httpserv import MetricServer
@@ -650,9 +735,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from ..explain.http import register_explain
 
         register_explain(metrics_server, engine)
+        if obs_plane is not None:
+            from ..obs.http import register_obs
+
+            register_obs(metrics_server, obs_plane)
         metrics_server.start()
-        log.info("self-metrics on :%d/metrics (+ /explain)",
-                 metrics_server.port)
+        log.info(
+            "self-metrics on :%d/metrics (+ /explain%s)",
+            metrics_server.port,
+            " + /healthz + /incidents" if obs_plane is not None else "",
+        )
 
     # guard: re-proves (and when due, renews) leadership before every
     # bind; None when election is off
@@ -671,6 +763,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             sync()
             run_pass(engine, cluster, journal, metrics, guard,
                      wave_size=args.wave_size, backfill=args.backfill)
+            if obs_plane is not None:
+                obs_plane.tick(engine.clock())
+                obs_plane.flush()
             if planner is not None:
                 planner.run_once()
         finally:
@@ -711,6 +806,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                      requeue=requeue, wave_size=args.wave_size,
                      backfill=args.backfill)
             requeue = []
+            if obs_plane is not None:
+                # evaluated on the scheduler tick — the alert plane
+                # reads the in-process surface, no scrape round-trip
+                obs_plane.tick(engine.clock())
             if planner is not None and (
                 time.monotonic() - planner_ran_at
                 >= max(args.autoscale_interval, args.interval)
@@ -727,6 +826,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 if getattr(cluster, "degraded", False) else "",
                 e,
             )
+            if obs_plane is not None:
+                # failed passes are exactly when the degraded latch
+                # and api-error-rate rules must still be evaluated
+                obs_plane.tick(engine.clock())
         if args.trace_out and metrics.passes - trace_written_at >= 100:
             tracer.write_chrome_trace(args.trace_out)
             trace_written_at = metrics.passes
@@ -734,6 +837,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         stop.wait(max(0.05, args.interval - elapsed))
     if elector is not None:
         elector.release()
+    if obs_plane is not None:
+        # bundles still collecting their post window land with what
+        # they have — a shutdown must not lose captured evidence
+        obs_plane.flush()
     if args.trace_out:
         tracer.write_chrome_trace(args.trace_out)
     if metrics_server is not None:

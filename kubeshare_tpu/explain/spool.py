@@ -52,14 +52,25 @@ from typing import Iterator, Optional
 
 
 class JournalSpool:
+    """``kind``/``key_field`` generalize the record schema: the
+    explain journal spools ``{"t": "pod", "pod": <key>, ...}`` (the
+    defaults), the incident flight recorder reuses the same rotation/
+    bounds/recovery machinery for ``{"t": "incident", "id": <id>,
+    ...}`` bundles. Everything else — atomic line appends, bounded
+    rotation, torn-line-tolerant newest-first recovery, the known-keys
+    miss index — is shared."""
+
     def __init__(self, path: str, max_bytes: int = 16 << 20,
-                 max_files: int = 4, log=None):
+                 max_files: int = 4, log=None,
+                 kind: str = "pod", key_field: str = "pod"):
         if max_files < 1:
             raise ValueError(f"max_files must be >= 1, got {max_files}")
         self.path = path
         self.max_bytes = max_bytes
         self.max_files = max_files
         self.log = log
+        self.kind = kind
+        self.key_field = key_field
         self.appends = 0
         self.rotations = 0
         self.recoveries = 0       # /explain answers served from disk
@@ -74,10 +85,10 @@ class JournalSpool:
         # from the set without touching disk — /explain probes for
         # never-journaled pods must not cost a full spool re-parse.
         self._known = {
-            rec.get("pod")
+            rec.get(key_field)
             for path_ in reversed(list(self._files_newest_first()))
             for rec in self._iter_file(path_)
-            if rec.get("t") == "pod"
+            if rec.get("t") == kind
         }
         self._known.discard(None)
 
@@ -93,8 +104,8 @@ class JournalSpool:
             self._size += len(line)
             if self._size >= self.max_bytes:
                 self._rotate_locked()
-        if record.get("t") == "pod" and record.get("pod"):
-            self._known.add(record["pod"])
+        if record.get("t") == self.kind and record.get(self.key_field):
+            self._known.add(record[self.key_field])
         self.appends += 1
 
     def _rotate_locked(self) -> None:
@@ -148,8 +159,8 @@ class JournalSpool:
             return
 
     def recover(self, pod_key: str) -> Optional[dict]:
-        """The pod's most recent terminal journal document, or None.
-        Newest file first; within a file the LAST matching record wins
+        """The key's most recent spooled document, or None. Newest
+        file first; within a file the LAST matching record wins
         (latest incarnation of a reused name). Keys the spool has
         never seen answer from the in-memory index without touching
         disk."""
@@ -161,7 +172,8 @@ class JournalSpool:
         for path in self._files_newest_first():
             found = None
             for rec in self._iter_file(path):
-                if rec.get("t") == "pod" and rec.get("pod") == pod_key:
+                if rec.get("t") == self.kind \
+                        and rec.get(self.key_field) == pod_key:
                     found = rec
             if found is not None:
                 self.recoveries += 1

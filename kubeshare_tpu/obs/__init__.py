@@ -1,0 +1,159 @@
+"""Incident plane: burn-rate alerts + black-box flight recorder.
+
+The package closes the gap between *exporting* a metric surface and
+*watching* it: :mod:`alerts` evaluates a rule set on the scheduler
+tick (multi-window SLO burn, API/watch storms, degraded latch, queue
+spikes, shed rate, ledger drift, restart and capacity-drop pulses),
+:mod:`recorder` keeps a near-free ring of state snapshots and dumps a
+rate-limited incident bundle to a rotating spool when a rule fires,
+and :mod:`http` serves ``/incidents`` + ``/healthz`` on the metrics
+server. :class:`IncidentPlane` is the one object the daemon, the sim,
+and the gauntlet wire in; :func:`build_plane` assembles it against a
+live engine/adapter/router.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from .alerts import (  # noqa: F401
+    AlertConfig, AlertEvaluator, AlertRule, WindowSeries,
+    RULE_API_ERRORS, RULE_CAPACITY_DROP, RULE_DEGRADED,
+    RULE_LEDGER_DRIFT, RULE_QUEUE_SPIKE, RULE_RESTART, RULE_SHED_RATE,
+    RULE_SLO_BURN, RULE_WATCH_STORM, standard_rules,
+)
+from .recorder import FlightRecorder, IncidentStore  # noqa: F401
+
+
+class IncidentPlane:
+    """Evaluator + recorder + store under one ``tick()``. Snapshots
+    land BEFORE the evaluation, so the bundle a firing rule cuts
+    includes the very snapshot that tripped it at the end of its pre
+    window."""
+
+    def __init__(self, evaluator: AlertEvaluator,
+                 recorder: FlightRecorder):
+        self.evaluator = evaluator
+        self.recorder = recorder
+        evaluator.on_fire = recorder.fire
+
+    def tick(self, now: float) -> List[str]:
+        self.recorder.tick(now)
+        return self.evaluator.evaluate(now)
+
+    def flush(self, now: Optional[float] = None) -> None:
+        self.recorder.flush(now)
+
+    # ---- read surface (metrics thread) ------------------------------
+
+    def samples(self):
+        return self.evaluator.samples() + self.recorder.samples()
+
+    def incidents(self) -> List[dict]:
+        return self.recorder.store.list()
+
+    def incident(self, incident_id: str) -> Optional[dict]:
+        return self.recorder.store.get(incident_id)
+
+    def healthz(self):
+        """``(status_code, doc)``: 503 while any critical rule is
+        active, 200 otherwise — the liveness/readiness contract."""
+        critical = self.evaluator.critical_active()
+        active = self.evaluator.active()
+        doc = {
+            "status": "fail" if critical else "ok",
+            "degraded": RULE_DEGRADED in active,
+            "active_alerts": active,
+            "critical_active": critical,
+            "alerts_fired": sum(
+                self.evaluator.state(r.name).fired_total
+                for r in self.evaluator.rules
+            ),
+            "incidents_written": self.recorder.written,
+        }
+        return (503 if critical else 200), doc
+
+
+def default_snapshot(engine_ref: Callable, cluster=None, router=None):
+    """The flight-recorder ring's per-interval snapshot: cheap reads
+    only — counters, per-tenant depth/usage maps, node count. No
+    histogram rendering, no tree walks."""
+
+    def snap(now: float) -> dict:
+        engine = engine_ref()
+        doc = {
+            "nodes": engine.healthy_node_count,
+            "counters": {
+                "filter_attempts": engine.filter_attempts,
+                "filter_scans": engine.filter_scans,
+                "waves": engine.wave_count,
+                "bind_retries": engine.bind_retries,
+                "gang_recoveries": engine.gang_recoveries,
+                "defrag_evictions": engine.defrag_evictions,
+                "backfill_binds": engine.backfill_binds,
+                "capacity_releases": engine.capacity_releases,
+            },
+            "queue_depth": engine.explain.queue_depths(),
+            "tenant_usage": {
+                tenant: list(usage)
+                for tenant, usage in
+                engine.quota.ledger.snapshot().items()
+            },
+            "demand_pods": len(engine.demand),
+            "wave_phase_seconds": {
+                phase: round(seconds, 4)
+                for phase, seconds in engine.wave_phase_seconds.items()
+            },
+        }
+        if cluster is not None:
+            doc["api"] = {
+                "errors": (getattr(cluster, "api_errors", 0) or 0)
+                + (getattr(cluster, "injected_errors", 0) or 0),
+                "watch_reconnects":
+                    getattr(cluster, "watch_reconnects", 0) or 0,
+                "degraded": bool(getattr(cluster, "degraded", False)),
+            }
+        if router is not None:
+            submitted, shed = router.request_totals()
+            doc["serving"] = {"submitted": submitted, "shed": shed}
+        return doc
+
+    return snap
+
+
+def build_plane(
+    engine_ref: Callable,
+    cluster=None,
+    router=None,
+    tracer=None,
+    config: Optional[AlertConfig] = None,
+    spool=None,
+    ring: int = 120,
+    post_snapshots: int = 3,
+    min_interval: float = 300.0,
+    max_bundles: int = 32,
+    log=None,
+) -> IncidentPlane:
+    """Wire the standard incident plane: rules from
+    :func:`standard_rules`, a recorder snapshotting at the alert
+    evaluation cadence, bundles persisted to ``spool`` (a
+    ``JournalSpool(kind="incident", key_field="id")``) when given."""
+    cfg = config or AlertConfig()
+    evaluator = AlertEvaluator(
+        standard_rules(engine_ref, cluster=cluster, router=router,
+                       cfg=cfg),
+        eval_interval=cfg.eval_interval, log=log,
+    )
+    recorder = FlightRecorder(
+        default_snapshot(engine_ref, cluster=cluster, router=router),
+        store=IncidentStore(spool=spool),
+        interval=cfg.eval_interval,
+        ring=ring,
+        post_snapshots=post_snapshots,
+        min_interval=min_interval,
+        max_bundles=max_bundles,
+        tracer=tracer,
+        journal_ref=lambda: engine_ref().explain,
+        log=log,
+    )
+    return IncidentPlane(evaluator, recorder)

@@ -1,0 +1,526 @@
+"""SLO burn-rate alert plane: a rule engine on the scheduler tick.
+
+The rebuild exports a rich metric surface (wait-SLO histograms,
+demand-ledger reason gauges, PR-8's api/watch/degraded counters, the
+serving router's shed accounting) but until now nothing WATCHED it —
+an SLO burn or a reconnect storm was only discovered by a human
+reading ``/metrics`` after the fact. This module evaluates a small
+rule set directly against the live in-process surface (no scrape
+round-trip, no Prometheus dependency) every ``eval_interval`` seconds
+of scheduler time:
+
+- **slo-burn-rate** — Google-SRE multi-window burn rate over the
+  journal's ``tpu_scheduler_pod_wait_seconds`` histograms: periodic
+  ``(total, good)`` snapshots give windowed deltas; the burn rate is
+  ``bad_fraction / error_budget`` and the rule fires only when BOTH
+  the fast (~5 min) and slow (~1 h) windows exceed the threshold —
+  fast confirms the burn is current, slow that it is material
+  (Beyer et al., *Site Reliability Workbook* ch. 5).
+- **api-error-rate** / **watch-reconnect-storm** — windowed deltas
+  over PR-8's adapter counters.
+- **degraded** — the adapter's degraded flag, latched as a CRITICAL
+  rule (``/healthz`` answers 503 while it holds).
+- **queue-depth-spike** — per-tenant pending depth vs a slow EWMA
+  baseline: a sudden multiple fires, a slowly-grown queue does not.
+- **shed-rate** — serving refusals / submissions over the fast window.
+- **ledger-drift** — ``engine.ledger_drift() != 0``, the hard
+  CRITICAL consistency rule (any drift is a bug, not load).
+- **scheduler-restart** — monotonic engine counters moving BACKWARD
+  (the Prometheus counter-reset idiom): a crash/restart rebuilt the
+  engine and every in-memory counter restarted from zero.
+- **node-capacity-drop** — the healthy-node count fell since the last
+  evaluation (flap/drain/partition; capacity loss is always worth an
+  incident bundle even before queues feel it).
+
+Alert states export as ``tpu_scheduler_alert_active{rule}`` gauges
+plus ``tpu_scheduler_alerts_fired_total{rule}`` counters. Firing is
+edge-triggered with hysteresis: a rule activates when its level
+crosses ``threshold`` (the recorder cuts one incident bundle at that
+edge), stays active while the level holds, and clears only after
+``clear_after`` consecutive evaluations at or below ``clear_ratio x
+threshold`` — a level hovering at the threshold cannot flap bundles.
+
+Zero idle cost: ``evaluate()`` early-returns until ``eval_interval``
+has passed, so the per-tick cost between evaluations is one float
+compare; every source callable is only invoked at evaluation cadence.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils import expfmt
+
+# rule names (shared with the flight recorder, /healthz, and the
+# incident-report gauntlet's expected-classification table)
+RULE_SLO_BURN = "slo-burn-rate"
+RULE_API_ERRORS = "api-error-rate"
+RULE_WATCH_STORM = "watch-reconnect-storm"
+RULE_DEGRADED = "degraded"
+RULE_QUEUE_SPIKE = "queue-depth-spike"
+RULE_SHED_RATE = "shed-rate"
+RULE_LEDGER_DRIFT = "ledger-drift"
+RULE_RESTART = "scheduler-restart"
+RULE_CAPACITY_DROP = "node-capacity-drop"
+
+
+@dataclass
+class AlertConfig:
+    """Window and threshold knobs, in the clock units the evaluator is
+    ticked with (wall seconds in the daemon, virtual seconds in the
+    sim — the gauntlet shrinks the windows to its horizon)."""
+
+    eval_interval: float = 5.0
+    fast_window: float = 300.0      # ~5 min: "is it burning NOW"
+    slow_window: float = 3600.0     # ~1 h: "is it material"
+    slo_wait_seconds: float = 60.0  # a pod should bind within this
+    slo_objective: float = 0.95     # fraction of binds inside the SLO
+    burn_threshold: float = 6.0     # x error budget, both windows
+    burn_min_events: int = 10       # windowed binds below this: no verdict
+    api_error_threshold: float = 10.0    # errors per fast window
+    watch_reconnect_threshold: float = 5.0  # reconnects per fast window
+    queue_spike_factor: float = 4.0      # depth vs EWMA baseline
+    queue_spike_min_depth: int = 16      # spikes below this never fire
+    queue_baseline_alpha: float = 0.1    # EWMA step per evaluation
+    shed_rate_threshold: float = 0.2     # shed / submitted, fast window
+    shed_min_requests: int = 20          # windowed submissions floor
+    clear_after: int = 2                 # clean evals before clearing
+    clear_ratio: float = 0.5             # "clean" = level <= ratio x thr
+
+
+class WindowSeries:
+    """Bounded ``(t, values)`` samples of cumulative counters, giving
+    windowed increases without a TSDB: ``delta(now, w)`` subtracts the
+    newest sample at or before ``now - w`` (falling back to the oldest
+    held — a partially-covered window reports the increase over what
+    it has, like PromQL ``increase`` over a short range). A counter
+    moving backward (process restart) clears the series: deltas across
+    a reset would read as huge negatives, not rates."""
+
+    __slots__ = ("horizon", "_samples")
+
+    def __init__(self, horizon: float):
+        self.horizon = horizon
+        self._samples: deque = deque()  # (t, tuple-of-floats)
+
+    def observe(self, now: float, values: Tuple[float, ...]) -> None:
+        if self._samples and any(
+            v < pv for v, pv in zip(values, self._samples[-1][1])
+        ):
+            self._samples.clear()  # counter reset: history is void
+        self._samples.append((now, tuple(values)))
+        # keep ONE sample older than the horizon so a full window
+        # always has a base to subtract from
+        while len(self._samples) >= 2 \
+                and self._samples[1][0] <= now - self.horizon:
+            self._samples.popleft()
+
+    def delta(self, now: float, window: float) -> Tuple[float, ...]:
+        """Componentwise increase over ``[now - window, now]``."""
+        if not self._samples:
+            return ()
+        newest = self._samples[-1][1]
+        base = self._samples[0][1]
+        cutoff = now - window
+        for t, values in self._samples:
+            if t > cutoff:
+                break
+            base = values
+        return tuple(n - b for n, b in zip(newest, base))
+
+
+@dataclass
+class AlertRule:
+    """``level(now) -> (float level, dict context)``; the rule is
+    firing while ``level >= threshold``. ``context`` rides into the
+    incident bundle (and may carry ``tenant`` for pod implication)."""
+
+    name: str
+    level: Callable[[float], Tuple[float, dict]]
+    threshold: float = 1.0
+    critical: bool = False
+    clear_ratio: float = 0.5
+    clear_after: int = 2
+
+
+@dataclass
+class _RuleState:
+    active: bool = False
+    fired_total: int = 0
+    clear_streak: int = 0
+    last_level: float = 0.0
+    last_context: dict = field(default_factory=dict)
+    fired_at: float = 0.0
+
+
+class AlertEvaluator:
+    """Evaluates the rule set at ``eval_interval`` cadence; edge
+    transitions invoke ``on_fire(rule, now, level, context)`` (the
+    flight recorder). Reads (``samples``, ``active``) come from the
+    metrics thread, writes from the scheduling tick — state mutations
+    are plain attribute stores on per-rule objects, and the exported
+    numbers are monotonic counters plus 0/1 gauges, so a torn read is
+    at worst one evaluation stale, never corrupt."""
+
+    def __init__(self, rules: List[AlertRule],
+                 eval_interval: float = 5.0,
+                 on_fire: Optional[Callable] = None, log=None):
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rule names: {names}")
+        self.rules = list(rules)
+        self.eval_interval = eval_interval
+        self.on_fire = on_fire
+        self.log = log
+        self.evaluations = 0
+        self.rule_errors = 0
+        self._state: Dict[str, _RuleState] = {
+            r.name: _RuleState() for r in rules
+        }
+        self._last_eval = float("-inf")
+
+    def state(self, name: str) -> _RuleState:
+        return self._state[name]
+
+    def evaluate(self, now: float, force: bool = False) -> List[str]:
+        """Run every rule if the interval elapsed; returns the names
+        that FIRED (inactive -> active) this evaluation."""
+        if not force and now - self._last_eval < self.eval_interval:
+            return []
+        self._last_eval = now
+        self.evaluations += 1
+        fired: List[str] = []
+        for rule in self.rules:
+            try:
+                level, context = rule.level(now)
+            except Exception as e:  # a broken source must not kill
+                self.rule_errors += 1  # the scheduling tick
+                if self.log is not None:
+                    self.log.error("alert rule %s: %s", rule.name, e)
+                continue
+            st = self._state[rule.name]
+            st.last_level = level
+            if not st.active:
+                if level >= rule.threshold:
+                    st.active = True
+                    st.fired_total += 1
+                    st.clear_streak = 0
+                    st.last_context = context
+                    st.fired_at = now
+                    fired.append(rule.name)
+                    if self.on_fire is not None:
+                        self.on_fire(rule, now, level, context)
+            else:
+                st.last_context = context or st.last_context
+                if level <= rule.threshold * rule.clear_ratio:
+                    st.clear_streak += 1
+                    if st.clear_streak >= rule.clear_after:
+                        st.active = False
+                        st.clear_streak = 0
+                else:
+                    st.clear_streak = 0
+        return fired
+
+    def active(self) -> List[str]:
+        return [r.name for r in self.rules if self._state[r.name].active]
+
+    def critical_active(self) -> List[str]:
+        return [
+            r.name for r in self.rules
+            if r.critical and self._state[r.name].active
+        ]
+
+    def samples(self) -> List["expfmt.Sample"]:
+        out: List[expfmt.Sample] = []
+        for rule in self.rules:
+            st = self._state[rule.name]
+            out.append(expfmt.Sample(
+                "tpu_scheduler_alert_active", {"rule": rule.name},
+                1 if st.active else 0,
+            ))
+        for rule in self.rules:
+            st = self._state[rule.name]
+            out.append(expfmt.Sample(
+                "tpu_scheduler_alerts_fired_total", {"rule": rule.name},
+                st.fired_total,
+            ))
+        out.append(expfmt.Sample(
+            "tpu_scheduler_alert_evaluations_total", {}, self.evaluations,
+        ))
+        out.append(expfmt.Sample(
+            "tpu_scheduler_alert_rule_errors_total", {}, self.rule_errors,
+        ))
+        return out
+
+
+# ===================== rule factories ================================
+# Standalone so tests can drive each with synthetic sources; the
+# plane's build step assembles them against the live engine/adapter.
+
+
+def burn_rate_rule(wait_totals: Callable[[], Tuple[int, int]],
+                   cfg: AlertConfig) -> AlertRule:
+    """Multi-window SLO burn over ``(total, good)`` bind counts.
+    Level is ``min(fast_burn, slow_burn)`` — both windows must burn —
+    and a window with fewer than ``burn_min_events`` new binds yields
+    no verdict (burn 0): six bad binds overnight is noise, six bad
+    binds out of six hundred is a page."""
+    series = WindowSeries(cfg.slow_window)
+    budget = max(1e-9, 1.0 - cfg.slo_objective)
+
+    def level(now: float) -> Tuple[float, dict]:
+        total, good = wait_totals()
+        series.observe(now, (float(total), float(good)))
+
+        def burn(window: float) -> float:
+            d = series.delta(now, window)
+            if not d or d[0] < cfg.burn_min_events:
+                return 0.0
+            bad_fraction = (d[0] - d[1]) / d[0]
+            return bad_fraction / budget
+
+        fast = burn(cfg.fast_window)
+        slow = burn(cfg.slow_window)
+        return min(fast, slow), {
+            "fast_burn": round(fast, 2), "slow_burn": round(slow, 2),
+            "slo_wait_s": cfg.slo_wait_seconds,
+            "objective": cfg.slo_objective,
+        }
+
+    return AlertRule(RULE_SLO_BURN, level, threshold=cfg.burn_threshold,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def counter_window_rule(name: str, counter: Callable[[], float],
+                        threshold: float, window: float,
+                        cfg: AlertConfig,
+                        critical: bool = False) -> AlertRule:
+    """Generic "N increments within the window" rule (api errors,
+    watch reconnects). Level = windowed delta."""
+    series = WindowSeries(window)
+
+    def level(now: float) -> Tuple[float, dict]:
+        series.observe(now, (float(counter() or 0),))
+        d = series.delta(now, window)
+        delta = d[0] if d else 0.0
+        return delta, {"window_s": window, "delta": round(delta, 1)}
+
+    return AlertRule(name, level, threshold=threshold, critical=critical,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def degraded_rule(flag: Callable[[], bool], cfg: AlertConfig) -> AlertRule:
+    """CRITICAL latch on the adapter's degraded flag (API retry budget
+    exhausted — PR-8). Clears with the flag, after hysteresis."""
+
+    def level(now: float) -> Tuple[float, dict]:
+        return (1.0 if flag() else 0.0), {}
+
+    return AlertRule(RULE_DEGRADED, level, threshold=1.0, critical=True,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def queue_spike_rule(depths: Callable[[], Dict[str, int]],
+                     cfg: AlertConfig) -> AlertRule:
+    """Per-tenant pending-depth spike vs a slow EWMA baseline. Level
+    is the worst tenant's ``depth / baseline`` ratio (0 until a tenant
+    has both a baseline and at least ``queue_spike_min_depth``
+    pending). The baseline updates AFTER the ratio is read, so a
+    sudden burst fires before the baseline absorbs it; a queue that
+    GREW into its depth never fires. The ratio's denominator is
+    floored at ``queue_spike_min_depth``: a tenant whose queue idled
+    at zero decays its baseline toward zero, and without the floor
+    any routine morning batch would divide by a vanishing baseline
+    and page — from idle, only a burst of ``factor x min_depth`` pods
+    is a spike."""
+    baselines: Dict[str, float] = {}
+
+    def level(now: float) -> Tuple[float, dict]:
+        current = depths()
+        worst, worst_tenant, worst_depth, worst_base = 0.0, "", 0, 0.0
+        for tenant, depth in current.items():
+            base = baselines.get(tenant)
+            if base is not None \
+                    and depth >= cfg.queue_spike_min_depth:
+                ratio = depth / max(base, cfg.queue_spike_min_depth)
+                if ratio > worst:
+                    worst, worst_tenant = ratio, tenant
+                    worst_depth, worst_base = depth, base
+            if base is None:
+                baselines[tenant] = float(depth)
+            else:
+                baselines[tenant] = base + cfg.queue_baseline_alpha \
+                    * (depth - base)
+        # drained tenants decay toward zero rather than vanishing, so
+        # a re-burst still has a baseline to compare against
+        for tenant in list(baselines):
+            if tenant not in current:
+                baselines[tenant] *= (1.0 - cfg.queue_baseline_alpha)
+        context = {}
+        if worst_tenant:
+            context = {"tenant": worst_tenant, "depth": worst_depth,
+                       "baseline": round(worst_base, 1)}
+        return worst, context
+
+    return AlertRule(RULE_QUEUE_SPIKE, level,
+                     threshold=cfg.queue_spike_factor,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def shed_rate_rule(totals: Callable[[], Tuple[int, int]],
+                   cfg: AlertConfig) -> AlertRule:
+    """Serving refusal fraction over the fast window: ``(submitted,
+    shed)`` cumulative totals from the router; level = windowed shed /
+    windowed submitted (0 below ``shed_min_requests``)."""
+    series = WindowSeries(cfg.fast_window)
+
+    def level(now: float) -> Tuple[float, dict]:
+        submitted, shed = totals()
+        series.observe(now, (float(submitted), float(shed)))
+        d = series.delta(now, cfg.fast_window)
+        if not d or d[0] < cfg.shed_min_requests:
+            return 0.0, {}
+        rate = d[1] / d[0]
+        return rate, {"submitted": int(d[0]), "shed": int(d[1]),
+                      "rate": round(rate, 3)}
+
+    return AlertRule(RULE_SHED_RATE, level,
+                     threshold=cfg.shed_rate_threshold,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def ledger_drift_rule(drift: Callable[[], dict],
+                      cfg: AlertConfig) -> AlertRule:
+    """Hard CRITICAL rule: the usage ledger disagreeing with the sum
+    of held charges is a consistency bug, never load. Level = drifted
+    tenant count."""
+
+    def level(now: float) -> Tuple[float, dict]:
+        d = drift()
+        context = {}
+        if d:
+            context = {"tenants": sorted(d)[:8]}
+        return float(len(d)), context
+
+    return AlertRule(RULE_LEDGER_DRIFT, level, threshold=1.0,
+                     critical=True, clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def counter_reset_rule(counters: Callable[[], Dict[str, float]],
+                       cfg: AlertConfig) -> AlertRule:
+    """Monotonic engine counters moving backward = the process (or the
+    sim's engine) restarted and rebuilt from relist — the counter-
+    reset idiom Prometheus uses to detect restarts, applied in
+    process. Pulse rule: fires at the reset, clears after the
+    hysteresis window."""
+    prev: Dict[str, float] = {}
+
+    def level(now: float) -> Tuple[float, dict]:
+        current = counters()
+        reset = sorted(
+            name for name, value in current.items()
+            if name in prev and value < prev[name]
+        )
+        prev.clear()
+        prev.update(current)
+        return (1.0 if reset else 0.0), (
+            {"reset_counters": reset[:8]} if reset else {}
+        )
+
+    return AlertRule(RULE_RESTART, level, threshold=1.0,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def capacity_drop_rule(node_count: Callable[[], int],
+                       cfg: AlertConfig) -> AlertRule:
+    """Healthy-node count fell since the last evaluation (flap, drain,
+    partition). Level = nodes lost this step; a sustained outage fires
+    once at the drop (pulse), scale-UP never fires."""
+    prev: List[Optional[int]] = [None]
+
+    def level(now: float) -> Tuple[float, dict]:
+        current = int(node_count())
+        before = prev[0]
+        prev[0] = current
+        if before is None or current >= before:
+            return 0.0, {}
+        return float(before - current), {"nodes_before": before,
+                                         "nodes_now": current}
+
+    return AlertRule(RULE_CAPACITY_DROP, level, threshold=1.0,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def standard_rules(engine_ref: Callable, cluster=None, router=None,
+                   cfg: Optional[AlertConfig] = None) -> List[AlertRule]:
+    """The full rule set against a live engine (via ``engine_ref`` —
+    a callable, because the sim REBUILDS the engine on an injected
+    crash and the rules must follow the replacement), an optional
+    cluster adapter (KubeCluster's api/watch/degraded counters; the
+    sim's FaultInjector counts ``injected_errors`` and satisfies the
+    same reads), and an optional serving router."""
+    cfg = cfg or AlertConfig()
+
+    def wait_totals():
+        return engine_ref().explain.wait_slo_totals(cfg.slo_wait_seconds)
+
+    def queue_depths():
+        return engine_ref().explain.queue_depths()
+
+    def drift():
+        return engine_ref().ledger_drift()
+
+    def engine_counters():
+        engine = engine_ref()
+        return {
+            "filter_attempts": engine.filter_attempts,
+            "filter_scans": engine.filter_scans,
+            "waves": engine.wave_count,
+            "capacity_releases": engine.capacity_releases,
+            "bind_retries": engine.bind_retries,
+        }
+
+    def node_count():
+        return engine_ref().healthy_node_count
+
+    rules = [
+        burn_rate_rule(wait_totals, cfg),
+        queue_spike_rule(queue_depths, cfg),
+        ledger_drift_rule(drift, cfg),
+        counter_reset_rule(engine_counters, cfg),
+        capacity_drop_rule(node_count, cfg),
+    ]
+    if cluster is not None:
+        def api_errors():
+            # KubeCluster counts api_errors; the sim's FaultInjector
+            # counts injected_errors — either (or both) feed the rule
+            return (getattr(cluster, "api_errors", 0) or 0) \
+                + (getattr(cluster, "injected_errors", 0) or 0)
+
+        rules += [
+            counter_window_rule(
+                RULE_API_ERRORS, api_errors, cfg.api_error_threshold,
+                cfg.fast_window, cfg,
+            ),
+            counter_window_rule(
+                RULE_WATCH_STORM,
+                lambda: getattr(cluster, "watch_reconnects", 0) or 0,
+                cfg.watch_reconnect_threshold, cfg.fast_window, cfg,
+            ),
+            degraded_rule(
+                lambda: bool(getattr(cluster, "degraded", False)), cfg,
+            ),
+        ]
+    if router is not None:
+        rules.append(shed_rate_rule(router.request_totals, cfg))
+    return rules

@@ -1,0 +1,57 @@
+"""``/incidents`` and ``/healthz`` on the scheduler's metrics server.
+
+- ``GET /incidents`` — bundle summaries, newest first (optionally
+  ``?rule=<name>``);
+- ``GET /incidents/<id>`` — one full bundle (404 with an error body
+  when unknown; restarted daemons answer from the incident spool);
+- ``GET /healthz`` — 200 with a JSON summary of the degraded flag and
+  active alerts, 503 while any CRITICAL rule (ledger-drift, degraded)
+  is active — the shape a Kubernetes liveness/readiness probe
+  consumes, so the alert plane gates rollout health, not just
+  dashboards.
+
+Handlers run on the metrics thread; the store's lock and the
+evaluator's torn-read-tolerant state make that safe against the
+scheduling tick's writes.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+
+def incidents_handler(plane):
+    def handle(rest: str, params: Dict[str, List[str]]
+               ) -> Tuple[int, str, str]:
+        if rest:
+            bundle = plane.incident(rest)
+            if bundle is None:
+                return 404, "application/json", json.dumps(
+                    {"error": f"no incident {rest!r}"}
+                ) + "\n"
+            return 200, "application/json", \
+                json.dumps(bundle, indent=1) + "\n"
+        rule = (params.get("rule") or [""])[0] or None
+        rows = plane.incidents()
+        if rule is not None:
+            rows = [r for r in rows if r.get("rule") == rule]
+        return 200, "application/json", json.dumps(
+            {"rule": rule, "incidents": rows}, indent=1
+        ) + "\n"
+
+    return handle
+
+
+def healthz_handler(plane):
+    def handle(rest: str, params: Dict[str, List[str]]
+               ) -> Tuple[int, str, str]:
+        code, doc = plane.healthz()
+        return code, "application/json", json.dumps(doc, indent=1) + "\n"
+
+    return handle
+
+
+def register_obs(server, plane) -> None:
+    server.route_prefix("/incidents", incidents_handler(plane))
+    server.route_prefix("/healthz", healthz_handler(plane))

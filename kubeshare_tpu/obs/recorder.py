@@ -1,0 +1,298 @@
+"""Incident flight recorder: always-on cheap snapshots, dump-on-anomaly.
+
+Dapper's lesson (Sigelman et al., 2010) applied to the scheduler: keep
+a bounded ring of always-on, near-free state snapshots, and only when
+an alert rule fires pay to assemble and persist a full **incident
+bundle** — the evidence that is otherwise gone by the time a human
+looks:
+
+- the triggering rule, its level, and its context;
+- the PRE window: every ring snapshot up to the firing instant
+  (engine counters, per-tenant usage and queue depths, node count,
+  router occupancy, wave-phase seconds);
+- a short POST window: the next few snapshots after the fire, so the
+  bundle shows whether the anomaly resolved or kept burning;
+- the Tracer's Chrome-trace event ring as of the fire (the scheduling
+  phases leading INTO the anomaly, loadable in Perfetto);
+- the decision journals of implicated pods (the firing tenant's
+  longest-waiting pending pods).
+
+Bundles are rate-limited (per-rule ``min_interval`` + a global
+``max_bundles`` cap) and deduplicated (a rule with a bundle still
+collecting its post window never opens a second), then written
+atomically as single JSONL lines to a rotating :class:`JournalSpool`
+(``kind="incident"``) — the same bounded-disk, torn-line-tolerant
+store the explain journal uses, so a restarted daemon still serves its
+predecessor's incidents over ``GET /incidents``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, Optional
+
+from ..utils import expfmt
+
+
+class IncidentStore:
+    """Bundle persistence + the ``/incidents`` read surface. Recent
+    bundles stay in memory (bounded ``keep``); everything appended to
+    the spool survives restarts and LRU eviction — ``get()`` falls
+    back to ``spool.recover(id)`` exactly like ``/explain`` does for
+    pods. Writes come from the scheduling tick, reads from the metrics
+    thread: the lock covers the in-memory maps, the spool handles its
+    own append/scan concurrency."""
+
+    def __init__(self, spool=None, keep: int = 16):
+        self.spool = spool
+        # full bundles kept in memory (each can carry a trace tail +
+        # pod journals — hundreds of KB); older ones answer get()
+        # from the spool, so keep is a hot cache, not the retention
+        self.keep = keep
+        self.written = 0
+        # highest bundle sequence number ever seen (replayed or
+        # written): a restarted recorder resumes numbering ABOVE its
+        # predecessor, or the new daemon's inc-0001-<rule> would
+        # shadow the old one in the spool (recover keeps the last
+        # matching record) and make its evidence unreachable
+        self.last_seq = 0
+        self._lock = threading.Lock()
+        self._bundles: "OrderedDict[str, dict]" = OrderedDict()
+        self._summaries: "OrderedDict[str, dict]" = OrderedDict()
+        if spool is not None:
+            # a restarted daemon lists its predecessor's incidents:
+            # rebuild summaries (cheap rows, not full bundles) from
+            # one startup replay
+            for rec in spool.replay():
+                if rec.get("t") == "incident" and rec.get("id"):
+                    doc = rec.get("doc") or {}
+                    self._summaries[rec["id"]] = _summary(doc)
+                    self.last_seq = max(self.last_seq,
+                                        _seq_of(rec["id"]))
+                    while len(self._summaries) > 16 * self.keep:
+                        self._summaries.popitem(last=False)
+
+    def put(self, bundle: dict) -> None:
+        incident_id = bundle["id"]
+        with self._lock:
+            self.last_seq = max(self.last_seq, _seq_of(incident_id))
+            self._summaries[incident_id] = _summary(bundle)
+            self._bundles[incident_id] = bundle
+            while len(self._bundles) > self.keep:
+                self._bundles.popitem(last=False)
+            while len(self._summaries) > 16 * self.keep:
+                self._summaries.popitem(last=False)
+            self.written += 1
+        if self.spool is not None:
+            self.spool.append({
+                "t": "incident", "id": incident_id,
+                "at": bundle.get("at", 0.0), "doc": bundle,
+            })
+
+    def list(self) -> List[dict]:
+        """Summaries, newest first."""
+        with self._lock:
+            return list(reversed(self._summaries.values()))
+
+    def get(self, incident_id: str) -> Optional[dict]:
+        with self._lock:
+            bundle = self._bundles.get(incident_id)
+        if bundle is not None:
+            return bundle
+        if self.spool is not None:
+            return self.spool.recover(incident_id)
+        return None
+
+
+def _seq_of(incident_id: str) -> int:
+    """The numeric sequence inside ``inc-<seq>-<rule>`` (0 when the
+    id is foreign-shaped — such bundles still store fine, they just
+    don't advance the counter)."""
+    parts = incident_id.split("-", 2)
+    try:
+        return int(parts[1])
+    except (IndexError, ValueError):
+        return 0
+
+
+def _summary(bundle: dict) -> dict:
+    pre = bundle.get("pre") or []
+    post = bundle.get("post") or []
+    return {
+        "id": bundle.get("id", ""),
+        "rule": bundle.get("rule", ""),
+        "critical": bool(bundle.get("critical")),
+        "at": bundle.get("at", 0.0),
+        "level": bundle.get("level", 0.0),
+        "context": bundle.get("context") or {},
+        "pre_snapshots": len(pre),
+        "post_snapshots": len(post),
+        "pre_start": pre[0]["t"] if pre else None,
+    }
+
+
+class FlightRecorder:
+    """``tick(now)`` appends one ring snapshot per ``interval`` and
+    advances bundles waiting on their post window; ``fire(...)`` (the
+    evaluator's edge callback) opens a bundle unless rate-limited.
+
+    A bundle finalizes — is written to the store — once
+    ``post_snapshots`` further snapshots landed, or at ``flush()``
+    (shutdown / end of a sim run) with whatever post window it has.
+    """
+
+    def __init__(
+        self,
+        snapshot_fn: Callable[[float], dict],
+        store: Optional[IncidentStore] = None,
+        interval: float = 5.0,
+        ring: int = 120,
+        post_snapshots: int = 3,
+        min_interval: float = 300.0,
+        max_bundles: int = 32,
+        max_pods: int = 5,
+        max_trace_events: int = 2048,
+        tracer=None,
+        journal_ref: Optional[Callable] = None,
+        log=None,
+    ):
+        self.snapshot_fn = snapshot_fn
+        self.store = store or IncidentStore()
+        self.interval = interval
+        self.post_snapshots = post_snapshots
+        self.min_interval = min_interval
+        self.max_bundles = max_bundles
+        self.max_pods = max_pods
+        # bundles embed the NEWEST this-many trace spans, trimmed
+        # inside the tracer before any dicts are built — a fire on
+        # the scheduling tick must not serialize a full 64k ring
+        self.max_trace_events = max_trace_events
+        self.tracer = tracer
+        self.journal_ref = journal_ref
+        self.log = log
+        self.suppressed = 0
+        self.snapshots_taken = 0
+        self._ring: deque = deque(maxlen=ring)
+        self._pending: List[dict] = []
+        self._last_snap = float("-inf")
+        self._last_bundle_at: Dict[str, float] = {}
+        # resume numbering above anything the spool replayed, so a
+        # restart never reissues a predecessor's id
+        self._seq = self.store.last_seq
+
+    # ---- snapshot cadence (scheduling tick) -------------------------
+
+    def tick(self, now: float) -> None:
+        if now - self._last_snap < self.interval:
+            return
+        self._last_snap = now
+        try:
+            snap = dict(self.snapshot_fn(now) or {})
+        except Exception as e:  # evidence must never fail a pass
+            if self.log is not None:
+                self.log.error("flight-recorder snapshot: %s", e)
+            snap = {"error": str(e)}
+        snap["t"] = round(now, 3)
+        self._ring.append(snap)
+        self.snapshots_taken += 1
+        if not self._pending:
+            return
+        done: List[dict] = []
+        for bundle in self._pending:
+            bundle["post"].append(snap)
+            if len(bundle["post"]) >= self.post_snapshots:
+                done.append(bundle)
+        for bundle in done:
+            self._pending.remove(bundle)
+            self._finalize(bundle)
+
+    # ---- firing (the evaluator's on_fire edge) ----------------------
+
+    def fire(self, rule, now: float, level: float,
+             context: dict) -> Optional[str]:
+        """Open an incident bundle for a rule's firing edge; returns
+        the incident id, or None when suppressed (dedup/rate-limit)."""
+        name = rule.name
+        if any(b["rule"] == name for b in self._pending):
+            self.suppressed += 1  # still collecting this rule's post
+            return None
+        last = self._last_bundle_at.get(name, float("-inf"))
+        if now - last < self.min_interval:
+            self.suppressed += 1
+            return None
+        if self.store.written + len(self._pending) >= self.max_bundles:
+            self.suppressed += 1  # global budget spent: count, don't write
+            return None
+        self._seq += 1
+        self._last_bundle_at[name] = now
+        bundle = {
+            "id": f"inc-{self._seq:04d}-{name}",
+            "rule": name,
+            "critical": bool(getattr(rule, "critical", False)),
+            "at": round(now, 3),
+            "level": round(float(level), 3),
+            "context": dict(context or {}),
+            "pre": list(self._ring),
+            "post": [],
+        }
+        if self.tracer is not None:
+            # the event ring AS OF the fire — the phases leading into
+            # the anomaly; later spans would dilute exactly the
+            # evidence the ring exists to keep
+            try:
+                bundle["trace"] = self.tracer.chrome_trace(
+                    f"incident-{name}",
+                    max_events=self.max_trace_events,
+                )
+            except Exception:
+                pass
+        if self.journal_ref is not None:
+            try:
+                journal = self.journal_ref()
+                bundle["pods"] = journal.worst_pending(
+                    now, tenant=context.get("tenant") or None,
+                    limit=self.max_pods,
+                )
+            except Exception:
+                bundle["pods"] = []
+        self._pending.append(bundle)
+        return bundle["id"]
+
+    # ---- finalization -----------------------------------------------
+
+    def _finalize(self, bundle: dict) -> None:
+        try:
+            self.store.put(bundle)
+        except Exception as e:  # disk trouble must not fail the pass
+            if self.log is not None:
+                self.log.error("incident bundle %s: %s", bundle["id"], e)
+
+    def flush(self, now: Optional[float] = None) -> None:
+        """Finalize every pending bundle with whatever post window it
+        collected (shutdown, or the end of a sim run)."""
+        pending, self._pending = self._pending, []
+        for bundle in pending:
+            self._finalize(bundle)
+
+    @property
+    def written(self) -> int:
+        return self.store.written
+
+    def samples(self) -> List["expfmt.Sample"]:
+        return [
+            expfmt.Sample(
+                "tpu_scheduler_incidents_written_total", {},
+                self.store.written,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_incidents_suppressed_total", {},
+                self.suppressed,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_incident_snapshots", {}, len(self._ring),
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_incidents_pending", {}, len(self._pending),
+            ),
+        ]

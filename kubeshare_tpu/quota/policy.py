@@ -42,6 +42,39 @@ from .tenant import TenantRegistry
 _EPS = 1e-9
 
 
+class QuotaDetail:
+    """Admission-gate detail captured as plain slots during the walk
+    and rendered to the ``/explain`` dict only when the attempt
+    record is READ (the journal's lazy rendering contract — the gate
+    runs once per attempt on the hot path, the dict approximately
+    never). Unset slots render as absent keys; ``to_dict`` applies
+    the display rounding the eager build used to pay per attempt."""
+
+    # slot order IS the legacy dict's key order
+    __slots__ = (
+        "tenant", "chips_demand", "mem_demand", "gang_count",
+        "unconfigured", "capacity_chips", "capacity_mem", "chips_used",
+        "guaranteed_fraction", "quota_chips", "guarantee_chips_used",
+        "borrow_limit", "ceiling_chips", "admitted", "why",
+    )
+
+    _ROUNDED = frozenset((
+        "chips_demand", "capacity_chips", "chips_used", "quota_chips",
+        "guarantee_chips_used", "ceiling_chips",
+    ))
+
+    def to_dict(self) -> dict:
+        out = {}
+        rounded = self._ROUNDED
+        for name in self.__slots__:
+            try:
+                value = getattr(self, name)
+            except AttributeError:
+                continue
+            out[name] = round(value, 3) if name in rounded else value
+        return out
+
+
 class QuotaPlane:
     def __init__(self, registry: Optional[TenantRegistry],
                  tree: CellTree, log=None):
@@ -171,31 +204,35 @@ class QuotaPlane:
 
     def admit_detail(self, req: PodRequirements, count: int = 1,
                      with_detail: bool = True
-                     ) -> Tuple[bool, str, dict]:
+                     ) -> Tuple[bool, str, "QuotaDetail"]:
         """``admit`` plus the ledger numbers behind the verdict — the
         decision journal records these so ``/explain`` can show WHY
         the gate refused (used vs quota vs demanded, against what
-        capacity), not just that it did. The detail dict carries:
-        chips/mem demand, capacity denominators, and — when the
-        matching limit is configured — guarantee usage vs quota and
-        total usage vs borrow ceiling. ``with_detail=False`` (the
-        journal-disabled engine via ``admit``) skips building the
-        dict entirely — verdict and refusal message unchanged, the
+        capacity), not just that it did. The detail (a lazily-
+        rendered :class:`QuotaDetail`) carries: chips/mem demand,
+        capacity denominators, and — when the matching limit is
+        configured — guarantee usage vs quota and total usage vs
+        borrow ceiling. The gate stores raw attributes; the /explain
+        dict (with its rounding) is built only when the attempt
+        record is actually read. ``with_detail=False`` (the
+        journal-disabled engine via ``admit``) skips the capture
+        entirely — verdict and refusal message unchanged, the
         zero-cost journal gate stays zero-cost at the quota gate
         too."""
         chips, mem = self.demand(req, count)
-        detail: dict = {} if not with_detail else {
-            "tenant": req.tenant,
-            "chips_demand": round(chips, 3),
-            "mem_demand": mem,
-            "gang_count": count,
-        }
+        detail: Optional[QuotaDetail] = None
+        if with_detail:
+            detail = QuotaDetail()
+            detail.tenant = req.tenant
+            detail.chips_demand = chips
+            detail.mem_demand = mem
+            detail.gang_count = count
         if chips <= 0 and mem <= 0:
             return True, "", detail
         spec = self.registry.spec(req.tenant)
         if spec.guaranteed is None and spec.borrow_limit is None:
             if with_detail:
-                detail["unconfigured"] = True
+                detail.unconfigured = True
             return True, "", detail  # unconfigured tenant: seed behavior
         gang = f" (gang of {count})" if count > 1 else ""
         cap_chips, cap_mem = self.capacity()
@@ -204,18 +241,18 @@ class QuotaPlane:
         # same four dict gets as before
         t_chips, t_mem, t_gchips, t_gmem = self._usage(req.tenant)
         if with_detail:
-            detail["capacity_chips"] = round(cap_chips, 3)
-            detail["capacity_mem"] = cap_mem
-            detail["chips_used"] = round(t_chips, 3)
+            detail.capacity_chips = cap_chips
+            detail.capacity_mem = cap_mem
+            detail.chips_used = t_chips
         if req.is_guarantee and spec.guaranteed is not None:
             quota_chips = spec.guaranteed * cap_chips
             quota_mem = spec.guaranteed * cap_mem
             used = t_gchips
             used_mem = t_gmem
             if with_detail:
-                detail["guaranteed_fraction"] = spec.guaranteed
-                detail["quota_chips"] = round(quota_chips, 3)
-                detail["guarantee_chips_used"] = round(used, 3)
+                detail.guaranteed_fraction = spec.guaranteed
+                detail.quota_chips = quota_chips
+                detail.guarantee_chips_used = used
             if (used + chips > quota_chips + _EPS
                     or used_mem + mem > quota_mem + _EPS):
                 return False, (
@@ -230,8 +267,8 @@ class QuotaPlane:
             used = t_chips
             used_mem = t_mem
             if with_detail:
-                detail["borrow_limit"] = spec.borrow_limit
-                detail["ceiling_chips"] = round(ceil_chips, 3)
+                detail.borrow_limit = spec.borrow_limit
+                detail.ceiling_chips = ceil_chips
             if (used + chips > ceil_chips + _EPS
                     or used_mem + mem > ceil_mem + _EPS):
                 return False, (

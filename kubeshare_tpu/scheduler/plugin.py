@@ -27,7 +27,7 @@ from ..autoscale.demand import DemandLedger
 from ..cells.cell import _EPS, Cell, CellTree, ChipInfo
 from ..cells.spec import TopologyConfig, load_topology
 from ..cluster.api import ClusterAPI, Conflict, Node, Pod
-from ..explain.journal import DecisionJournal, RejectionAgg
+from ..explain.journal import AttemptRecord, DecisionJournal, RejectionAgg
 from ..utils import expfmt
 from ..utils.bitmap import RRBitmap
 from ..utils.logger import get_logger
@@ -1155,16 +1155,18 @@ class TpuShareScheduler:
         # exact clock, no rounding: _live_entry compares this attempt
         # start against the bind's outcome_at to tell "bound moments
         # ago in THIS attempt" from "bound by a previous incarnation",
-        # and a round-up would misfile the former as the latter
-        rec: dict = {"at": self.clock()}
+        # and a round-up would misfile the former as the latter.
+        # AttemptRecord is slots-only scratch — the /explain dict is
+        # rendered from it on read, never on this path
+        rec = AttemptRecord(self.clock())
         with maybe_span(self.tracer, "attempt", pod=pod.key):
             decision = self._schedule_attempt(pod, rec)
         req = self._last_attempt_req
-        rec["outcome"] = decision.status
+        rec.outcome = decision.status
         if decision.node:
-            rec["node"] = decision.node
+            rec.node = decision.node
         if decision.message:
-            rec["message"] = decision.message
+            rec.message = decision.message
         now = self.clock()
         if req is not None:
             shape = ("regular" if req.kind == PodKind.REGULAR
@@ -1509,20 +1511,23 @@ class TpuShareScheduler:
             )
         whole_counts[node] = whole
 
-    def _schedule_attempt(self, pod: Pod, rec: Optional[dict]) -> Decision:
+    def _schedule_attempt(self, pod: Pod,
+                          rec: Optional[AttemptRecord]) -> Decision:
         """The scheduling walk. ``rec`` accumulates phase outcomes for
         the journal — None when the journal is disabled, in which case
         no record fields (nor the journal-only runner-up scoring) are
-        built at all. The parsed requirements and last demand-reason
-        are left on ``_last_attempt_req`` / ``_last_demand_reason``
-        for the caller (schedule_one's journal wrapper, the wave
-        driver's head-of-line logic)."""
+        built at all. With the journal on, the walk sets flat slots on
+        the :class:`AttemptRecord`; the nested /explain dicts are
+        rendered only when the record is read. The parsed requirements
+        and last demand-reason are left on ``_last_attempt_req`` /
+        ``_last_demand_reason`` for the caller (schedule_one's journal
+        wrapper, the wave driver's head-of-line logic)."""
         try:
             with maybe_span(self.tracer, "prefilter", pod=pod.key):
                 req = self.pre_filter(pod)
         except Unschedulable as e:
             if rec is not None:
-                rec["prefilter"] = str(e)
+                rec.prefilter = str(e)
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
         self._last_attempt_req = req
@@ -1552,10 +1557,10 @@ class TpuShareScheduler:
             req, count=gang_pending, with_detail=rec is not None
         )
         if rec is not None:
-            quota_detail["admitted"] = admitted
+            quota_detail.admitted = admitted
             if why:
-                quota_detail["why"] = why
-            rec["quota"] = quota_detail
+                quota_detail.why = why
+            rec.quota = quota_detail
         if not admitted:
             self._note_demand(pod.key, req, D.REASON_OVER_QUOTA,
                               created_at=pod.created_at)
@@ -1587,13 +1592,11 @@ class TpuShareScheduler:
             self._filter_cursor = (start + consumed) % max(1, n_names)
             self.filter_scans += scans
         if rec is not None:
-            rec["filter"] = filter_rec = {
-                "examined": scans,
-                "feasible": len(feasible),
-                "target": target,
-            }
+            rec.filter_examined = scans
+            rec.filter_feasible = len(feasible)
+            rec.filter_target = target
             if rejections:
-                filter_rec["rejections"] = rejections.to_dict()
+                rec.rejections = rejections
         if not feasible:
             evicted = self._maybe_defrag(pod, req)
             # demand-ledger classification: an eviction in flight, or
@@ -1602,9 +1605,8 @@ class TpuShareScheduler:
             # territory); anything else is a true capacity shortfall
             agg_fits = bool(evicted) or self._aggregate_fits(req)
             if rec is not None:
-                rec["defrag"] = {
-                    "evicted": list(evicted), "aggregate_fits": agg_fits,
-                }
+                rec.defrag_evicted = tuple(evicted)
+                rec.defrag_agg_fits = agg_fits
             self._note_demand(
                 pod.key, req,
                 D.REASON_FRAGMENTATION if agg_fits
@@ -1701,17 +1703,14 @@ class TpuShareScheduler:
                 # journal: winner + runner-up with raw scores (the
                 # same values pick_best normalizes) — the "why THIS
                 # node" record. Runner-up is who would have won had
-                # the winner not existed.
-                rec["score"] = score_rec = {
-                    "candidates": len(values),
-                    "winner": {
-                        "node": best, "score": round(best_raw, 2),
-                    },
-                }
+                # the winner not existed. Raw floats; rounding waits
+                # for render().
+                rec.score_candidates = len(values)
+                rec.winner_node = best
+                rec.winner_score = best_raw
                 if runner is not None:
-                    score_rec["runner_up"] = {
-                        "node": runner, "score": round(runner_raw, 2),
-                    }
+                    rec.runner_node = runner
+                    rec.runner_score = runner_raw
 
         if req.kind == PodKind.REGULAR:
             try:
@@ -1733,16 +1732,16 @@ class TpuShareScheduler:
         with maybe_span(self.tracer, "permit", pod=pod.key):
             action, extra = self.permit(pod, status)
         if rec is not None:
-            rec["permit"] = permit_rec = {"action": action}
+            rec.permit_action = action
             if group.key:
-                permit_rec["group"] = group.key
-                permit_rec["min_available"] = group.min_available
+                rec.permit_group = group.key
+                rec.permit_min_available = group.min_available
             if action == "deny":
-                permit_rec["detail"] = extra
+                rec.permit_detail = extra
             elif action == "wait":
-                permit_rec["detail"] = f"gang barrier, timeout {extra}s"
+                rec.permit_detail = f"gang barrier, timeout {extra}s"
             elif extra:
-                permit_rec["detail"] = (
+                rec.permit_detail = (
                     f"barrier released, co-binding {len(extra)} members"
                 )
         if action == "deny":
@@ -2494,6 +2493,13 @@ class TpuShareScheduler:
                     "expected": tuple(round(v, 9) for v in want),
                 }
         return drift
+
+    @property
+    def healthy_node_count(self) -> int:
+        """Schedulable (healthy, inventoried-or-pending) nodes — the
+        alert plane's node-capacity-drop rule reads this instead of
+        reaching into the private index."""
+        return len(self._node_index)
 
     def utilization_samples(self) -> List["expfmt.Sample"]:
         """Per-node occupancy gauges for the scheduler's /metrics:

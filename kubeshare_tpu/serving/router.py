@@ -547,6 +547,16 @@ class RequestRouter:
 
     # -- metrics ------------------------------------------------------
 
+    def request_totals(self) -> Tuple[int, int]:
+        """Cumulative ``(submitted, shed)`` over every model — the
+        incident plane's shed-rate rule snapshots this pair instead of
+        re-aggregating the full per-model sample set each evaluation."""
+        submitted = shed = 0
+        for counts in self._counts.values():
+            submitted += counts.submitted
+            shed += counts.shed_total()
+        return submitted, shed
+
     def samples(self) -> List["expfmt.Sample"]:
         samples: List[expfmt.Sample] = []
         for model in self._models_tracked():

@@ -229,6 +229,7 @@ class Simulator:
         api_error_rate: float = 0.0,
         api_conflict_rate: float = 0.0,
         journal_spool=None,
+        obs_plane=None,
     ):
         import random
 
@@ -304,6 +305,16 @@ class Simulator:
         self._report: Optional[SimReport] = None
         self._crash_pending = False  # crash hit during an API outage
         self._pre_crash_fp: Optional[dict] = None  # continued digest
+        # Incident plane (PR-9): an obs.IncidentPlane ticked once per
+        # scheduling pass on the virtual clock — the same cadence the
+        # daemon gives it. It must reference the engine through a
+        # callable (obs.build_plane's engine_ref), because
+        # scheduler_crash REPLACES self.engine; the plane itself
+        # survives the restart like a real external watcher would,
+        # which is exactly how its counter-reset rule sees the crash.
+        # Assignable after construction too (the plane's engine_ref
+        # usually closes over this simulator).
+        self.obs_plane = obs_plane
         self.priority_ratio = priority_ratio
         self._rng = random.Random(seed)
 
@@ -892,6 +903,9 @@ class Simulator:
                 if pending:
                     retry_at = self.clock_now + 1.0  # flakes retry soon
             self.engine.tick()
+            if self.obs_plane is not None:
+                # evaluated on the scheduler tick, like the daemon
+                self.obs_plane.tick(self.clock_now)
             # gang reconcile (and anything else tick() evicted):
             # resubmit through the same controller path as defrag
             # victims, or the evicted pods would vanish from the books

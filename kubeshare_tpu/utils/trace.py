@@ -195,12 +195,24 @@ class Tracer:
             self._events.clear()
             self._dropped = 0
 
-    def chrome_trace(self, process_name: str = "kubeshare-tpu") -> dict:
+    def chrome_trace(self, process_name: str = "kubeshare-tpu",
+                     max_events: Optional[int] = None) -> dict:
         """``trace_event``-format dict, loadable by chrome://tracing
         and Perfetto. Timestamps are relative to tracer creation, in
-        microseconds (the format's unit)."""
+        microseconds (the format's unit). ``max_events`` keeps only
+        the NEWEST that many spans (the incident recorder embeds a
+        bounded tail into bundles — trimming here, before the dicts
+        are built, keeps a fire on the scheduling tick from
+        serializing the whole 64k ring); trimmed spans count into the
+        dropped marker."""
         with self._lock:
             dropped = self._dropped
+            span_events = self._events
+            if max_events is not None and len(span_events) > max_events:
+                dropped += len(span_events) - max_events
+                span_events = span_events[-max_events:]
+            else:
+                span_events = list(span_events)
         events: List[dict] = [
             {
                 "name": "process_name",
@@ -222,7 +234,7 @@ class Tracer:
                     "ts": 0,
                 }
             )
-        for ev in self.events():
+        for ev in span_events:
             events.append(
                 {
                     "name": ev.name,
@@ -256,9 +268,15 @@ class Tracer:
         # _count disagrees with its +Inf bucket
         with self._lock:
             dropped = self._dropped
+            held = len(self._events)
             for name, hist in sorted(self.histograms.items()):
                 metric = f"{prefix}_{name.replace('.', '_')}_seconds"
                 out.extend(hist.samples(metric))
+        # ring occupancy next to the drop counter: incident bundles
+        # snapshot this ring, so "how much history a bundle will
+        # carry" (and whether --trace-ring needs raising) is a gauge,
+        # not a guess
+        out.append(expfmt.Sample(f"{prefix}_events", {}, held))
         out.append(
             expfmt.Sample(f"{prefix}_events_dropped_total", {}, dropped)
         )

@@ -183,17 +183,21 @@ class TestCommittedArtifact:
         assert g["sequential"]["counters"]["backfill_binds"] == 0
 
     def test_journal_ab_recorded(self):
-        """PR-5 satellite: the explain/journal feed's hot-path cost
-        is measured (journal on vs --explain-capacity 0) and stays a
-        modest fraction — the gate exists so operators can buy it
-        back entirely."""
+        """PR-5 satellite, tightened by PR-9's lazy attempt-record
+        rendering: the explain/journal feed's hot-path cost is
+        measured (journal on vs --explain-capacity 0) as the median
+        of PAIRED per-rep ratios (drift-cancelling — see
+        journal_ab's docstring) and the committed figure must hold
+        the <= 8% ceiling the lazy-rendering work bought (down from
+        the 19.2% measured with eager rec-dict construction)."""
         doc = _doc()
         j = doc["journal_ab"]
         assert j["journal_on_placements_per_sec"] > 0
         assert j["journal_off_placements_per_sec"] > 0
-        # sanity bound only: a 2x regression would mean the journal
-        # feed grew a hot-path dependency it must not have
-        assert j["journal_overhead_pct"] <= 50.0
+        # the committed artifact's pinned ceiling (static check — a
+        # fresh noisy-box run is not re-graded here)
+        assert j["journal_overhead_pct"] <= 8.0
+        assert len(j["journal_overhead_pct_per_rep"]) >= 3
 
 
 class TestFreshRunFloor:

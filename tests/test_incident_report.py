@@ -1,0 +1,121 @@
+"""INCIDENTS.json invariants + a scaled-down live incident gauntlet.
+
+Two layers, mirroring test_chaos_sim.py: the committed artifact must
+hold the flight-recorder guarantees (zero baseline false positives,
+exact fault->rule classification, pre-window containing each fault's
+onset, rate-limit and spool bounds), and a small live replay proves
+the current tree still produces them — crash and baseline scenarios
+run in-process on a 16-node cluster."""
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from incident_report import (  # noqa: E402
+    EXPECTED, MIN_INTERVAL_S, run_scenario,
+)
+
+ARTIFACT = os.path.join(REPO, "INCIDENTS.json")
+
+
+def _doc():
+    return json.load(open(ARTIFACT))
+
+
+class TestCommittedArtifact:
+    def test_exists_and_well_formed(self):
+        doc = _doc()
+        assert doc["generated_by"] == "tools/incident_report.py"
+        assert set(doc["scenarios"]) == set(EXPECTED)
+        for name, row in doc["scenarios"].items():
+            assert row["scenario"] == name
+            assert row["trace_events"] > 0
+            assert row["alert_evaluations"] > 0
+            assert row["rule_errors"] == 0
+
+    def test_invariants_block_green(self):
+        inv = _doc()["invariants"]
+        assert inv["baseline_false_positives"] == 0
+        assert inv["all_faults_classified"] is True
+        assert inv["pre_windows_contain_onsets"] is True
+        assert inv["all_green"] is True
+
+    def test_baseline_zero_false_positives(self):
+        base = _doc()["scenarios"]["baseline"]
+        assert base["alerts_fired"] == {}
+        assert base["incidents"] == []
+        assert base["verdict"]["expected_bundle_written"] is True
+
+    def test_every_fault_exactly_classified(self):
+        doc = _doc()
+        for name, expected in EXPECTED.items():
+            if not expected:
+                continue
+            row = doc["scenarios"][name]
+            assert set(row["alerts_fired"]) == set(expected), name
+            matching = [
+                i for i in row["incidents"] if i["rule"] in expected
+            ]
+            assert matching, f"{name}: no bundle for {expected}"
+            onset = row["fault_onset_s"]
+            for bundle in matching:
+                # the black box captured the run-up: first ring
+                # snapshot predates the fault, the fire follows it
+                assert bundle["pre_start"] <= onset <= bundle["at"], \
+                    (name, bundle)
+                assert bundle["pre_snapshots"] > 0
+                assert bundle["post_snapshots"] > 0
+
+    def test_rate_limit_bound(self):
+        doc = _doc()
+        for name, row in doc["scenarios"].items():
+            budget = 1 + int(row["horizon_s"] // MIN_INTERVAL_S)
+            per_rule = {}
+            for inc in row["incidents"]:
+                per_rule[inc["rule"]] = per_rule.get(inc["rule"], 0) + 1
+            for rule, count in per_rule.items():
+                assert count <= budget, (name, rule, count)
+
+    def test_spool_round_trips(self):
+        doc = _doc()
+        for name, row in doc["scenarios"].items():
+            assert row["spool_ids_match"] is True, name
+
+
+class TestLiveScaledDown:
+    """The current tree still classifies: a fault-free run fires
+    nothing, a crash run cuts exactly one scheduler-restart bundle
+    whose pre-window contains the crash."""
+
+    KW = dict(n_nodes=16, trace_count=120, gangs=4, horizon=600.0)
+
+    def test_baseline_quiet(self, tmp_path):
+        row = run_scenario("baseline", spool_dir=str(tmp_path),
+                           **self.KW)
+        assert row["alerts_fired"] == {}
+        assert row["incidents"] == []
+        assert row["rule_errors"] == 0
+        assert all(v is not False for v in row["verdict"].values())
+
+    def test_crash_classified(self, tmp_path):
+        row = run_scenario("scheduler_crash", spool_dir=str(tmp_path),
+                           **self.KW)
+        assert set(row["alerts_fired"]) == {"scheduler-restart"}
+        [bundle] = row["incidents"]
+        assert bundle["rule"] == "scheduler-restart"
+        onset = row["fault_onset_s"]
+        assert bundle["pre_start"] <= onset <= bundle["at"]
+        assert row["report"]["crashes"] == 1
+        assert row["spool_ids_match"] is True
+        assert all(v is not False for v in row["verdict"].values())
+
+    def test_flap_classified(self, tmp_path):
+        row = run_scenario("node_flap", spool_dir=str(tmp_path),
+                           **self.KW)
+        assert set(row["alerts_fired"]) == {"node-capacity-drop"}
+        assert row["incidents"][0]["rule"] == "node-capacity-drop"
+        assert all(v is not False for v in row["verdict"].values())

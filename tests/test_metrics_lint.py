@@ -128,8 +128,21 @@ def scraped(tmp_path_factory):
     tracer = Tracer()
     with tracer.span("pass"):
         pass
+
+    # the incident plane rides the same exposition: alert-state
+    # gauges + fired counters + flight-recorder health, with one rule
+    # actually fired so the counters are nonzero
+    from kubeshare_tpu.obs import AlertConfig, build_plane
+
+    plane = build_plane(lambda: engine, cluster=kube, router=router,
+                        tracer=tracer,
+                        config=AlertConfig(eval_interval=0.0))
+    plane.tick(clock[0])
+    plane.tick(clock[0] + 1.0)
+
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
-                               router=router, cluster=kube)
+                               router=router, cluster=kube,
+                               obs=plane)
     metrics.record_pass(0.01, 4)
 
     server = MetricServer(host="127.0.0.1", port=0)
@@ -225,8 +238,43 @@ class TestExpositionHygiene:
             ("tpu_scheduler_explain_spool_appends_total", "gauge"),
             ("tpu_scheduler_explain_spool_rotations_total", "gauge"),
             ("tpu_scheduler_explain_spool_recoveries_total", "gauge"),
+            # PR-9: incident plane + trace-ring occupancy families
+            ("tpu_scheduler_alert_active", "gauge"),
+            ("tpu_scheduler_alerts_fired_total", "gauge"),
+            ("tpu_scheduler_alert_evaluations_total", "gauge"),
+            ("tpu_scheduler_alert_rule_errors_total", "gauge"),
+            ("tpu_scheduler_incidents_written_total", "gauge"),
+            ("tpu_scheduler_incidents_suppressed_total", "gauge"),
+            ("tpu_scheduler_incident_snapshots", "gauge"),
+            ("tpu_scheduler_incidents_pending", "gauge"),
+            ("tpu_scheduler_phase_events", "gauge"),
+            ("tpu_scheduler_phase_events_dropped_total", "gauge"),
         ]:
             assert kinds.get(fam) == kind, (fam, kinds.get(fam))
+
+    def test_alert_rules_all_exported(self, scraped):
+        """Every standard rule exports an active gauge AND a fired
+        counter (cluster + router wired -> the full rule set), and
+        the degraded latch — the fixture's kube adapter reports
+        degraded=True — is actually firing."""
+        parsed = expfmt.parse(scraped)
+        active = {
+            s.labels["rule"]: s.value for s in parsed
+            if s.name == "tpu_scheduler_alert_active"
+        }
+        fired = {
+            s.labels["rule"] for s in parsed
+            if s.name == "tpu_scheduler_alerts_fired_total"
+        }
+        expected = {
+            "slo-burn-rate", "queue-depth-spike", "ledger-drift",
+            "scheduler-restart", "node-capacity-drop",
+            "api-error-rate", "watch-reconnect-storm", "degraded",
+            "shed-rate",
+        }
+        assert set(active) == expected
+        assert fired == expected
+        assert active["degraded"] == 1
 
     def test_histogram_families_are_complete_and_cumulative(
         self, scraped

@@ -1,0 +1,712 @@
+"""Incident plane units: burn-rate window math (fires exactly at the
+threshold crossing, clears with hysteresis), windowed counter rules,
+counter-reset / capacity-drop pulses, flight-recorder dedup +
+rate-limiting, bundle atomicity under concurrent writers, the durable
+incident store round-trip, /healthz + /incidents HTTP, the trace-ring
+occupancy gauge, and the lazy attempt-record rendering contract."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeshare_tpu.explain.journal import (
+    WAIT_BUCKETS, AttemptRecord, DecisionJournal,
+)
+from kubeshare_tpu.explain.spool import JournalSpool
+from kubeshare_tpu.obs import (
+    AlertConfig, AlertEvaluator, AlertRule, FlightRecorder,
+    IncidentPlane, IncidentStore, WindowSeries,
+)
+from kubeshare_tpu.obs.alerts import (
+    RULE_API_ERRORS, burn_rate_rule, capacity_drop_rule,
+    counter_reset_rule, counter_window_rule, degraded_rule,
+    queue_spike_rule, shed_rate_rule,
+)
+from kubeshare_tpu.obs.http import register_obs
+from kubeshare_tpu.utils.httpserv import MetricServer
+from kubeshare_tpu.utils.trace import Tracer
+
+
+CFG = AlertConfig(
+    eval_interval=1.0, fast_window=60.0, slow_window=300.0,
+    slo_wait_seconds=60.0, slo_objective=0.9, burn_threshold=5.0,
+    burn_min_events=10,
+)
+
+
+def run_rule(rule, feeds):
+    """Drive one rule through an evaluator at t = 0, 1, 2, ...;
+    ``feeds`` is a list of callables invoked before each evaluation
+    (mutating the synthetic source). Returns the state after each."""
+    ev = AlertEvaluator([rule], eval_interval=0.0)
+    states = []
+    for t, feed in enumerate(feeds):
+        feed()
+        ev.evaluate(float(t), force=True)
+        st = ev.state(rule.name)
+        states.append((st.active, st.fired_total, st.last_level))
+    return states
+
+
+# ===================== window series =================================
+
+
+class TestWindowSeries:
+    def test_delta_over_window(self):
+        s = WindowSeries(horizon=100.0)
+        for t in range(0, 60, 10):
+            s.observe(float(t), (float(t * 2),))
+        # window 20 at t=50: base is the newest sample <= 30 -> 60
+        assert s.delta(50.0, 20.0) == (100.0 - 60.0,)
+        # full-history window
+        assert s.delta(50.0, 100.0) == (100.0,)
+
+    def test_partial_window_uses_oldest(self):
+        s = WindowSeries(horizon=100.0)
+        s.observe(0.0, (5.0,))
+        s.observe(10.0, (9.0,))
+        assert s.delta(10.0, 60.0) == (4.0,)
+
+    def test_counter_reset_clears_history(self):
+        s = WindowSeries(horizon=100.0)
+        s.observe(0.0, (50.0,))
+        s.observe(10.0, (2.0,))  # restart: counter went backward
+        assert s.delta(10.0, 100.0) == (0.0,)
+
+    def test_prunes_but_keeps_one_pre_horizon_sample(self):
+        s = WindowSeries(horizon=10.0)
+        for t in range(0, 100, 2):
+            s.observe(float(t), (float(t),))
+        ts = [t for t, _ in s._samples]
+        assert ts[0] <= 98 - 10  # a base older than the horizon kept
+        assert len(ts) <= 9
+
+
+# ===================== burn-rate math ================================
+
+
+class TestBurnRate:
+    """Synthetic (total, good) sequences. budget = 1 - 0.9 = 0.1, so
+    burn == bad_fraction * 10; threshold 5 means 50% bad binds."""
+
+    def make(self):
+        state = {"total": 0, "good": 0}
+        rule = burn_rate_rule(
+            lambda: (state["total"], state["good"]), CFG
+        )
+        return state, rule
+
+    def feed(self, state, total, good):
+        def f():
+            state["total"] += total
+            state["good"] += good
+        return f
+
+    def test_fires_exactly_at_threshold_crossing(self):
+        state, rule = self.make()
+        # deltas are vs the t=0 base sample: windowed binds after it
+        # ramp 40% bad (burn 4.0 < 5) then exactly 50% bad (burn 5.0
+        # == threshold -> fires on the crossing, not before)
+        states = run_rule(rule, [
+            self.feed(state, 20, 20),   # base: all good
+            self.feed(state, 20, 12),   # delta (20,12): 40% bad
+            self.feed(state, 20, 8),    # delta (40,20): 50% bad
+        ])
+        assert states[0] == (False, 0, 0.0)
+        assert states[1][0] is False and states[1][2] == pytest.approx(
+            4.0
+        )
+        assert states[2][0] is True and states[2][1] == 1
+        assert states[2][2] == pytest.approx(5.0)
+
+    def test_min_events_gate(self):
+        state, rule = self.make()
+        # 4 binds, all bad: 100% bad but under burn_min_events
+        states = run_rule(rule, [self.feed(state, 4, 0)])
+        assert states[0] == (False, 0, 0.0)
+
+    def test_both_windows_must_burn(self):
+        # a long-clean history: the slow window dilutes a fresh burst
+        # below threshold, so fast alone cannot fire
+        state = {"total": 0, "good": 0}
+        cfg = AlertConfig(
+            fast_window=2.0, slow_window=300.0, slo_objective=0.9,
+            burn_threshold=5.0, burn_min_events=10,
+        )
+        rule = burn_rate_rule(
+            lambda: (state["total"], state["good"]), cfg
+        )
+        feeds = [self.feed(state, 50, 50) for _ in range(20)]
+        feeds.append(self.feed(state, 20, 0))  # sudden 100% bad burst
+        states = run_rule(rule, feeds)
+        # fast burn is 10.0 but the slow window holds ~1000 good binds
+        assert states[-1][0] is False
+
+    def test_clears_with_hysteresis(self):
+        state, rule = self.make()
+        bad = [self.feed(state, 20, 0) for _ in range(3)]
+        # recovery: all-good evals shrink the windowed bad fraction,
+        # but the fast window still holds the burst for a while
+        good = [self.feed(state, 200, 200) for _ in range(6)]
+        states = run_rule(rule, bad + good)
+        fired_at = next(i for i, s in enumerate(states) if s[0])
+        assert states[fired_at][1] == 1
+        # once below clear_ratio x threshold for clear_after evals it
+        # clears — and never re-fires during the recovery
+        assert states[-1][0] is False
+        assert states[-1][1] == 1
+        # hysteresis: the first eval whose level dipped below the
+        # clear bar did NOT clear it alone (clear_after = 2)
+        levels = [s[2] for s in states]
+        first_low = next(
+            i for i, lv in enumerate(levels)
+            if i > fired_at and lv <= 5.0 * CFG.clear_ratio
+        )
+        assert states[first_low][0] is True
+
+
+# ===================== simple rules ==================================
+
+
+class TestSimpleRules:
+    def test_counter_window_rule(self):
+        errs = {"n": 0}
+        rule = counter_window_rule(
+            RULE_API_ERRORS, lambda: errs["n"], threshold=10.0,
+            window=30.0, cfg=CFG,
+        )
+
+        def bump(k):
+            def f():
+                errs["n"] += k
+            return f
+
+        states = run_rule(rule, [bump(0), bump(9), bump(1), bump(5)])
+        assert [s[0] for s in states] == [False, False, True, True]
+        assert states[-1][1] == 1  # one firing edge
+
+    def test_degraded_latch_and_clear(self):
+        flag = {"on": False}
+        rule = degraded_rule(lambda: flag["on"], CFG)
+        assert rule.critical
+
+        def set_flag(v):
+            def f():
+                flag["on"] = v
+            return f
+
+        states = run_rule(rule, [
+            set_flag(False), set_flag(True), set_flag(True),
+            set_flag(False), set_flag(False),
+        ])
+        assert [s[0] for s in states] == [
+            False, True, True, True, False,
+        ]
+
+    def test_counter_reset_pulse(self):
+        counters = {"a": 0.0, "b": 0.0}
+        rule = counter_reset_rule(lambda: dict(counters), CFG)
+
+        def step(a, b):
+            def f():
+                counters["a"], counters["b"] = a, b
+            return f
+
+        states = run_rule(rule, [
+            step(5, 5), step(9, 9), step(0, 1),  # restart
+            step(1, 2), step(2, 3), step(3, 4),
+        ])
+        assert [s[0] for s in states] == [
+            False, False, True, True, False, False,
+        ]
+        assert states[-1][1] == 1
+
+    def test_capacity_drop_pulse_and_no_fire_on_scale_up(self):
+        n = {"v": 16}
+        rule = capacity_drop_rule(lambda: n["v"], CFG)
+
+        def to(v):
+            def f():
+                n["v"] = v
+            return f
+
+        states = run_rule(rule, [
+            to(16), to(32), to(32), to(31), to(31), to(31), to(40),
+        ])
+        assert [s[0] for s in states] == [
+            False, False, False, True, True, False, False,
+        ]
+
+    def test_queue_spike_vs_grown_queue(self):
+        depths = {"d": {}}
+        rule = queue_spike_rule(lambda: dict(depths["d"]), CFG)
+
+        def at(**kw):
+            def f():
+                depths["d"] = dict(kw)
+            return f
+
+        # slow growth: 4 -> 40 over many evals never fires (the
+        # baseline tracks it up), then a sudden 8x burst does
+        feeds = [at(ml=4)]
+        depth = 4.0
+        for _ in range(40):
+            depth *= 1.05
+            feeds.append(at(ml=int(depth)))
+        states = run_rule(rule, feeds)
+        assert not any(s[0] for s in states)
+        burst = int(depth * 8)
+        states = run_rule(rule, [at(ml=burst)])
+        assert states[-1][0] is True
+
+    def test_queue_spike_drained_baseline_does_not_page(self):
+        """A tenant idling at zero decays its baseline toward zero;
+        the floored denominator keeps a routine burst from dividing
+        by epsilon — from idle, only factor x min_depth pods is a
+        spike (regression: the unfloored ratio fired with an
+        astronomical level on any morning batch)."""
+        depths = {"d": {}}
+        rule = queue_spike_rule(lambda: dict(depths["d"]), CFG)
+
+        def at(v):
+            def f():
+                depths["d"] = {"t": v} if v is not None else {}
+            return f
+
+        # establish, then drain for a long idle stretch
+        feeds = [at(20)] + [at(0)] * 500 + [at(None)] * 500
+        # routine batch at exactly min_depth: must NOT fire
+        feeds.append(at(CFG.queue_spike_min_depth))
+        states = run_rule(rule, feeds)
+        assert not any(s[0] for s in states)
+        # a genuine burst from idle (factor x min_depth) still fires
+        states = run_rule(rule, [at(int(
+            CFG.queue_spike_factor * CFG.queue_spike_min_depth
+        ))])
+        assert states[-1][0] is True
+
+    def test_queue_spike_min_depth_gate(self):
+        depths = {"d": {}}
+        rule = queue_spike_rule(lambda: dict(depths["d"]), CFG)
+
+        def at(v):
+            def f():
+                depths["d"] = {"t": v}
+            return f
+
+        # 1 -> 10 is a 10x spike but under queue_spike_min_depth
+        states = run_rule(rule, [at(1), at(10)])
+        assert not any(s[0] for s in states)
+
+    def test_shed_rate_rule(self):
+        totals = {"sub": 0, "shed": 0}
+        rule = shed_rate_rule(
+            lambda: (totals["sub"], totals["shed"]), CFG
+        )
+
+        def step(sub, shed):
+            def f():
+                totals["sub"] += sub
+                totals["shed"] += shed
+            return f
+
+        states = run_rule(rule, [
+            step(100, 0), step(100, 5), step(100, 40),
+        ])
+        assert [s[0] for s in states] == [False, False, True]
+
+    def test_rule_exception_counted_not_fatal(self):
+        def boom(now):
+            raise RuntimeError("source away")
+
+        ok = AlertRule("ok", lambda now: (0.0, {}))
+        ev = AlertEvaluator([AlertRule("bad", boom), ok],
+                            eval_interval=0.0)
+        ev.evaluate(0.0)
+        assert ev.rule_errors == 1
+        assert ev.state("ok").last_level == 0.0
+
+    def test_eval_interval_gates_idle_cost(self):
+        calls = {"n": 0}
+
+        def level(now):
+            calls["n"] += 1
+            return 0.0, {}
+
+        ev = AlertEvaluator([AlertRule("r", level)], eval_interval=10.0)
+        for t in range(10):
+            ev.evaluate(float(t))
+        assert calls["n"] == 1  # only the first tick evaluated
+
+
+# ===================== flight recorder ===============================
+
+
+def _rule(name="r", critical=False):
+    return AlertRule(name, lambda now: (0.0, {}), critical=critical)
+
+
+class TestFlightRecorder:
+    def make(self, **kw):
+        kw.setdefault("interval", 1.0)
+        kw.setdefault("post_snapshots", 2)
+        kw.setdefault("min_interval", 10.0)
+        store = kw.pop("store", IncidentStore())
+        rec = FlightRecorder(lambda now: {"n": int(now)}, store=store,
+                             **kw)
+        return rec, store
+
+    def test_pre_post_window_and_finalize(self):
+        rec, store = self.make(ring=5)
+        for t in range(8):
+            rec.tick(float(t))
+        iid = rec.fire(_rule(), 7.5, 3.0, {"tenant": "ml"})
+        assert iid is not None
+        assert not store.list()  # not finalized yet
+        rec.tick(8.0)
+        rec.tick(9.0)
+        [summary] = store.list()
+        bundle = store.get(summary["id"])
+        assert bundle["rule"] == "r"
+        assert len(bundle["pre"]) == 5          # bounded ring
+        assert bundle["pre"][-1]["t"] == 7.0    # up to the fire
+        assert [s["t"] for s in bundle["post"]] == [8.0, 9.0]
+        assert bundle["context"] == {"tenant": "ml"}
+
+    def test_dedup_while_pending_and_rate_limit(self):
+        rec, store = self.make(min_interval=10.0)
+        rec.tick(0.0)
+        assert rec.fire(_rule(), 0.0, 1.0, {}) is not None
+        # same rule, bundle still collecting post: suppressed
+        assert rec.fire(_rule(), 0.5, 1.0, {}) is None
+        rec.tick(1.0)
+        rec.tick(2.0)  # finalized now
+        assert len(store.list()) == 1
+        # inside min_interval: still suppressed
+        assert rec.fire(_rule(), 5.0, 1.0, {}) is None
+        # past it: a fresh bundle
+        assert rec.fire(_rule(), 11.0, 1.0, {}) is not None
+        assert rec.suppressed == 2
+
+    def test_global_budget(self):
+        rec, store = self.make(max_bundles=2, min_interval=0.0)
+        rec.tick(0.0)
+        fired = [
+            rec.fire(_rule(f"r{i}"), float(i), 1.0, {})
+            for i in range(4)
+        ]
+        assert sum(1 for f in fired if f) == 2
+        assert rec.suppressed == 2
+
+    def test_flush_lands_partial_post(self):
+        rec, store = self.make(post_snapshots=5)
+        rec.tick(0.0)
+        rec.fire(_rule(), 0.0, 1.0, {})
+        rec.tick(1.0)
+        rec.flush()
+        [summary] = store.list()
+        assert summary["post_snapshots"] == 1
+
+    def test_snapshot_exception_tolerated(self):
+        def boom(now):
+            raise RuntimeError("nope")
+
+        rec = FlightRecorder(boom, store=IncidentStore(), interval=1.0)
+        rec.tick(0.0)
+        assert rec.snapshots_taken == 1
+
+
+# ===================== incident store ================================
+
+
+class TestIncidentStore:
+    def test_spool_round_trip_and_recover(self, tmp_path):
+        path = str(tmp_path / "inc.jsonl")
+        spool = JournalSpool(path, kind="incident", key_field="id")
+        store = IncidentStore(spool=spool, keep=2)
+        for i in range(4):
+            store.put({"id": f"inc-{i}", "rule": "r", "at": float(i),
+                       "level": 1.0, "pre": [], "post": []})
+        # in-memory keeps 2, the spool keeps all 4
+        assert store.get("inc-0")["at"] == 0.0  # recovered from disk
+        assert store.get("inc-3")["at"] == 3.0
+        spool.close()
+        # a RESTARTED store lists its predecessor's incidents
+        spool2 = JournalSpool(path, kind="incident", key_field="id")
+        store2 = IncidentStore(spool=spool2)
+        assert {s["id"] for s in store2.list()} == {
+            "inc-0", "inc-1", "inc-2", "inc-3"
+        }
+        assert store2.get("inc-2")["rule"] == "r"
+        assert store2.get("nope") is None
+        spool2.close()
+
+    def test_restart_does_not_reissue_predecessor_ids(self, tmp_path):
+        """A restarted recorder resumes numbering above the spool's
+        replayed bundles — a colliding inc-0001-<rule> would shadow
+        the predecessor's evidence (recover keeps the last match)."""
+        path = str(tmp_path / "inc.jsonl")
+        spool = JournalSpool(path, kind="incident", key_field="id")
+        rec = FlightRecorder(lambda now: {}, interval=0.0,
+                             post_snapshots=1, min_interval=0.0,
+                             store=IncidentStore(spool=spool))
+        rec.tick(0.0)
+        rec.fire(_rule("api-error-rate"), 0.0, 1.0, {})
+        rec.tick(1.0)
+        first_id = rec.store.list()[0]["id"]
+        spool.close()
+        # restart: fresh store + recorder over the same spool
+        spool2 = JournalSpool(path, kind="incident", key_field="id")
+        store2 = IncidentStore(spool=spool2)
+        rec2 = FlightRecorder(lambda now: {}, interval=0.0,
+                              post_snapshots=1, min_interval=0.0,
+                              store=store2)
+        rec2.tick(10.0)
+        rec2.fire(_rule("api-error-rate"), 10.0, 1.0, {})
+        rec2.tick(11.0)
+        ids = {s["id"] for s in store2.list()}
+        assert first_id in ids and len(ids) == 2
+        # both bundles independently retrievable
+        assert store2.get(first_id)["at"] == 0.0
+        spool2.close()
+
+    def test_trace_tail_capped_in_bundle(self):
+        tracer = Tracer(max_events=4096)
+        for _ in range(100):
+            with tracer.span("x"):
+                pass
+        rec = FlightRecorder(lambda now: {}, interval=0.0,
+                             post_snapshots=1, min_interval=0.0,
+                             store=IncidentStore(), tracer=tracer,
+                             max_trace_events=10)
+        rec.tick(0.0)
+        rec.fire(_rule(), 0.0, 1.0, {})
+        rec.tick(1.0)
+        bundle = rec.store.get(rec.store.list()[0]["id"])
+        spans = [e for e in bundle["trace"]["traceEvents"]
+                 if e.get("ph") == "X"]
+        assert len(spans) == 10
+        # the trim is visible as a dropped marker, never silent
+        assert any("dropped" in e.get("name", "")
+                   for e in bundle["trace"]["traceEvents"])
+
+    def test_bundle_atomicity_under_concurrent_writers(self, tmp_path):
+        """N threads hammer put(); every spooled line must parse whole
+        (the spool's locked single-line appends are the atomicity
+        mechanism) and every id must round-trip."""
+        path = str(tmp_path / "inc.jsonl")
+        spool = JournalSpool(path, kind="incident", key_field="id")
+        store = IncidentStore(spool=spool, keep=512)
+        n_threads, per_thread = 8, 25
+        payload = {"snapshots": [{"t": float(i), "x": "y" * 50}
+                                 for i in range(20)]}
+
+        def writer(k):
+            for i in range(per_thread):
+                store.put({
+                    "id": f"inc-{k}-{i}", "rule": f"rule-{k}",
+                    "at": float(i), "level": 1.0,
+                    "pre": payload["snapshots"], "post": [],
+                })
+
+        threads = [
+            threading.Thread(target=writer, args=(k,))
+            for k in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        spool.close()
+        with open(path) as f:
+            lines = [line for line in f if line.strip()]
+        parsed = [json.loads(line) for line in lines]  # raises if torn
+        ids = {p["id"] for p in parsed}
+        assert len(parsed) == n_threads * per_thread
+        assert ids == {
+            f"inc-{k}-{i}"
+            for k in range(n_threads) for i in range(per_thread)
+        }
+        # and each bundle kept its full window intact
+        assert all(len(p["doc"]["pre"]) == 20 for p in parsed)
+
+
+# ===================== plane + HTTP ==================================
+
+
+def make_plane(critical_on=False):
+    flag = {"crit": critical_on}
+    rules = [
+        AlertRule("always", lambda now: (1.0, {"k": "v"})),
+        AlertRule("crit", lambda now: (1.0 if flag["crit"] else 0.0, {}),
+                  critical=True),
+    ]
+    ev = AlertEvaluator(rules, eval_interval=0.0)
+    rec = FlightRecorder(lambda now: {"n": 1}, store=IncidentStore(),
+                         interval=0.0, post_snapshots=1,
+                         min_interval=0.0)
+    plane = IncidentPlane(ev, rec)
+    return plane, flag
+
+
+class TestPlaneAndHttp:
+    def test_tick_fires_and_bundles(self):
+        plane, _ = make_plane()
+        fired = plane.tick(0.0)
+        assert fired == ["always"]
+        plane.tick(1.0)
+        [summary] = plane.incidents()
+        assert summary["rule"] == "always"
+        assert plane.incident(summary["id"])["context"] == {"k": "v"}
+
+    def test_healthz_codes(self):
+        plane, flag = make_plane()
+        plane.tick(0.0)
+        code, doc = plane.healthz()
+        assert code == 200 and doc["status"] == "ok"
+        assert doc["active_alerts"] == ["always"]
+        flag["crit"] = True
+        plane.tick(1.0)
+        code, doc = plane.healthz()
+        assert code == 503
+        assert doc["critical_active"] == ["crit"]
+
+    def test_http_endpoints(self):
+        plane, flag = make_plane()
+        plane.tick(0.0)
+        plane.tick(1.0)
+        server = MetricServer(host="127.0.0.1", port=0)
+        register_obs(server, plane)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with urllib.request.urlopen(f"{base}/incidents") as resp:
+                listing = json.loads(resp.read().decode())
+            [row] = listing["incidents"]
+            with urllib.request.urlopen(
+                f"{base}/incidents/{row['id']}"
+            ) as resp:
+                bundle = json.loads(resp.read().decode())
+            assert bundle["rule"] == "always"
+            with urllib.request.urlopen(f"{base}/healthz") as resp:
+                health = json.loads(resp.read().decode())
+            assert health["status"] == "ok"
+            # unknown incident: 404 with an error body
+            try:
+                urllib.request.urlopen(f"{base}/incidents/nope")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+            # critical active flips /healthz to 503
+            flag["crit"] = True
+            plane.tick(2.0)
+            try:
+                urllib.request.urlopen(f"{base}/healthz")
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                doc = json.loads(e.read().decode())
+                assert doc["critical_active"] == ["crit"]
+        finally:
+            server.stop()
+
+    def test_alert_samples_families(self):
+        plane, _ = make_plane()
+        plane.tick(0.0)
+        names = {s.name for s in plane.samples()}
+        assert {
+            "tpu_scheduler_alert_active",
+            "tpu_scheduler_alerts_fired_total",
+            "tpu_scheduler_incidents_written_total",
+            "tpu_scheduler_incidents_suppressed_total",
+            "tpu_scheduler_incident_snapshots",
+            "tpu_scheduler_incidents_pending",
+        } <= names
+        active = {
+            s.labels["rule"]: s.value for s in plane.samples()
+            if s.name == "tpu_scheduler_alert_active"
+        }
+        assert active == {"always": 1, "crit": 0}
+
+
+# ===================== trace ring gauge ==============================
+
+
+class TestTraceRingGauge:
+    def test_events_gauge_next_to_dropped(self):
+        tracer = Tracer(max_events=8)
+        for _ in range(3):
+            with tracer.span("x"):
+                pass
+        by_name = {
+            s.name: s.value for s in tracer.metric_samples("tpu_trace")
+        }
+        assert by_name["tpu_trace_events"] == 3
+        assert by_name["tpu_trace_events_dropped_total"] == 0
+
+
+# ===================== lazy attempt records ==========================
+
+
+class TestLazyAttemptRecords:
+    def test_stored_as_slots_rendered_on_read(self):
+        journal = DecisionJournal(capacity=8)
+        rec = AttemptRecord(1.0)
+        rec.outcome = "bound"
+        rec.node = "n00"
+        rec.score_candidates = 2
+        rec.winner_node = "n00"
+        rec.winner_score = 1.23456
+        journal.record_attempt("default/p", 1.0, rec, tenant="t",
+                               shape="x1")
+        entry = journal._entries["default/p"]
+        [stored] = entry.attempts
+        assert isinstance(stored, AttemptRecord)  # no dict yet
+        doc = journal.get("default/p", 2.0)
+        [rendered] = doc["attempt_log"]
+        assert rendered == {
+            "at": 1.0,
+            "score": {
+                "candidates": 2,
+                "winner": {"node": "n00", "score": 1.23},
+            },
+            "outcome": "bound",
+            "node": "n00",
+        }
+
+    def test_legacy_dict_records_still_accepted(self):
+        journal = DecisionJournal(capacity=8)
+        journal.record_attempt("default/p", 1.0, {"at": 1.0,
+                                                  "outcome": "bound"})
+        doc = journal.get("default/p", 2.0)
+        assert doc["attempt_log"] == [{"at": 1.0, "outcome": "bound"}]
+
+    def test_wait_slo_totals(self):
+        journal = DecisionJournal(capacity=8)
+        now = 0.0
+        # three binds: waits 10s, 10s, 3000s; one permanent reject
+        for name, wait in (("a", 10.0), ("b", 10.0), ("c", 3000.0)):
+            journal.record_attempt(f"default/{name}", now,
+                                   AttemptRecord(now), tenant="t")
+            journal.note_outcome(f"default/{name}", "bound", wait,
+                                 tenant="t", shape="x1")
+        journal.note_outcome("default/bad", "unschedulable", 1.0,
+                             tenant="t", shape="x1")
+        total, good = journal.wait_slo_totals(60.0)
+        assert (total, good) == (3, 2)  # rejects excluded, slow bind bad
+        assert 60.0 in WAIT_BUCKETS
+
+    def test_queue_depths_and_worst_pending(self):
+        journal = DecisionJournal(capacity=8)
+        for i, tenant in enumerate(("ml", "ml", "batch")):
+            journal.record_attempt(
+                f"default/p{i}", float(i), AttemptRecord(float(i)),
+                tenant=tenant,
+            )
+        journal.note_outcome("default/p1", "bound", 5.0)
+        assert journal.queue_depths() == {"ml": 1, "batch": 1}
+        worst = journal.worst_pending(10.0, tenant="ml", limit=5)
+        assert [d["pod"] for d in worst] == ["default/p0"]

@@ -247,30 +247,48 @@ def gang_mode(reps: int) -> dict:
 def journal_ab(reps: int) -> dict:
     """Satellite A/B: the explain/journal feed gated off entirely
     (--explain-capacity 0) vs on, idle trace at 1024 nodes — the
-    journal's hot-path overhead, measured not asserted."""
+    journal's hot-path overhead, measured not asserted.
+
+    The overhead is the MEDIAN of per-rep PAIRED ratios (each rep
+    runs on and off back-to-back and the ratio is taken inside the
+    rep), not best-of-on vs best-of-off: CI boxes drift on a minutes
+    scale, and independently-best rates can land in different
+    throttle windows, swinging an independent A/B by more than the
+    effect being measured. The headline rates still report the best
+    rep of each arm for cross-row comparison."""
     trace = generate_trace(count=EVENTS, seed=0)
-
-    def rows():
-        return [
-            ("on", lambda: _row(1024, trace, explain_capacity=512)),
-            ("off", lambda: _row(1024, trace, explain_capacity=0)),
-        ]
-
-    best = _best_of(reps, rows)
-    on = best["on"]["placements_per_sec"]
-    off = best["off"]["placements_per_sec"]
+    pairs = []
+    best = {}
+    for _ in range(max(1, reps)):
+        rep_pair = {}
+        for key, cap in (("on", 512), ("off", 0)):
+            row = _row(1024, trace, explain_capacity=cap)
+            rep_pair[key] = row["placements_per_sec"]
+            if key not in best or \
+                    row["wall_seconds"] < best[key]["wall_seconds"]:
+                best[key] = row
+        pairs.append(
+            100.0 * (rep_pair["off"] - rep_pair["on"]) / rep_pair["off"]
+        )
+    pairs.sort()
+    median = pairs[len(pairs) // 2] if len(pairs) % 2 else (
+        (pairs[len(pairs) // 2 - 1] + pairs[len(pairs) // 2]) / 2
+    )
     return {
         "nodes": 1024,
-        "journal_on_placements_per_sec": on,
-        "journal_off_placements_per_sec": off,
-        "journal_overhead_pct": round(100.0 * (off - on) / off, 1),
+        "journal_on_placements_per_sec":
+            best["on"]["placements_per_sec"],
+        "journal_off_placements_per_sec":
+            best["off"]["placements_per_sec"],
+        "journal_overhead_pct": round(median, 1),
+        "journal_overhead_pct_per_rep": [round(p, 1) for p in pairs],
     }
 
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
-        "--mode", choices=("idle", "backlog", "gang", "all"),
+        "--mode", choices=("idle", "backlog", "gang", "journal", "all"),
         default="all",
     )
     parser.add_argument(
@@ -347,7 +365,7 @@ def main(argv=None) -> None:
             f"{g['wave']['counters']['backfill_head_delays']})"
         )
 
-    if args.mode == "all":
+    if args.mode in ("journal", "all"):
         doc["journal_ab"] = journal_ab(args.reps)
         j = doc["journal_ab"]
         print(
