@@ -61,6 +61,14 @@ chaos-sim:
 incident-report:
 	$(PYTHON) tools/incident_report.py
 
+# cost-attribution & profiling evidence -> PROFILE.json (sub-phase +
+# per-class attribution at 32/256/1024 nodes within the 5% coverage
+# band, sampling-profiler overhead <= 3% via the paired-ratio A/B,
+# and the perf-regression sentinel firing exactly on an injected
+# hot-path slowdown while staying silent fault-free)
+profile-report:
+	$(PYTHON) tools/profile_report.py
+
 dryrun:
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -105,4 +113,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim explain-report serving-sim chaos-sim dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim explain-report serving-sim chaos-sim incident-report profile-report dryrun images push save kind-e2e perf-evidence clean

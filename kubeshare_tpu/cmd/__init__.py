@@ -13,6 +13,7 @@ COMPONENTS = {
     "scheduler": "kubeshare_tpu.cmd.scheduler",
     "explain": "kubeshare_tpu.cmd.explain",
     "incidents": "kubeshare_tpu.cmd.incidents",
+    "profile": "kubeshare_tpu.cmd.profile",
     "collector": "kubeshare_tpu.cmd.collector",
     "aggregator": "kubeshare_tpu.cmd.aggregator",
     "nodeconfig": "kubeshare_tpu.cmd.nodeconfig",

@@ -1,0 +1,134 @@
+"""Capture a sampling profile from a live scheduler.
+
+``python -m kubeshare_tpu profile`` asks the scheduler's metrics
+server (the ``--metrics-port`` endpoint, which also serves
+``/profile``) to run its stdlib sampling profiler for ``--seconds``
+and prints the result: folded-stack text by default (pipe it straight
+into ``flamegraph.pl`` or load it in speedscope), ``--format chrome``
+for a chrome://tracing / Perfetto document, ``--format json`` for the
+summary + stack counts. ``--top N`` renders a quick terminal summary
+of the heaviest stacks instead of raw output. ``--local`` profiles
+THIS process instead of a server — mostly a self-test, but it proves
+the profiler end to end with no daemon running.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional, Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kubeshare-tpu-profile", description=__doc__
+    )
+    parser.add_argument(
+        "--url", default="http://127.0.0.1:9006",
+        help="scheduler metrics server base URL (the --metrics-port "
+             "endpoint serving /profile)",
+    )
+    parser.add_argument(
+        "--seconds", type=float, default=5.0,
+        help="how long to sample",
+    )
+    parser.add_argument(
+        "--hz", type=float, default=0.0,
+        help="sampling rate (0 = the server's --profile-hz default)",
+    )
+    parser.add_argument(
+        "--format", choices=("folded", "chrome", "json"),
+        default="folded", dest="fmt",
+        help="folded: flamegraph.pl collapsed stacks; chrome: "
+             "trace_event JSON for Perfetto; json: summary + counts",
+    )
+    parser.add_argument(
+        "--out", default="", metavar="PATH",
+        help="write the profile here instead of stdout",
+    )
+    parser.add_argument(
+        "--top", type=int, default=0, metavar="N",
+        help="render the N heaviest stacks as a terminal summary "
+             "instead of emitting the raw profile",
+    )
+    parser.add_argument(
+        "--local", action="store_true",
+        help="profile THIS process instead of a server (self-test; "
+             "no daemon needed)",
+    )
+    return parser
+
+
+def _top_summary(folded: str, top: int) -> str:
+    rows = []
+    total = 0
+    for line in folded.splitlines():
+        stack, _, count = line.rpartition(" ")
+        try:
+            n = int(count)
+        except ValueError:
+            continue
+        total += n
+        rows.append((n, stack))
+    rows.sort(key=lambda r: (-r[0], r[1]))
+    lines = [f"{'SAMPLES':>8} {'SHARE':>6}  HOTTEST FRAME (full stack below)"]
+    for n, stack in rows[:top]:
+        leaf = stack.rsplit(";", 1)[-1]
+        share = 100.0 * n / total if total else 0.0
+        lines.append(f"{n:8d} {share:5.1f}%  {leaf}")
+        lines.append(f"{'':15}  {stack}")
+    lines.append(f"{total:8d} 100.0%  total samples over {len(rows)} stacks")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.local:
+        from ..obs.profile import DEFAULT_HZ, profile, render_profile
+
+        prof = profile(args.seconds, hz=args.hz or DEFAULT_HZ)
+        _, body = render_profile(prof, args.fmt)
+    else:
+        query = {"seconds": repr(args.seconds), "format": args.fmt}
+        if args.hz > 0:
+            query["hz"] = repr(args.hz)
+        url = (f"{args.url.rstrip('/')}/profile?"
+               f"{urllib.parse.urlencode(query)}")
+        try:
+            with urllib.request.urlopen(
+                url, timeout=args.seconds + 30.0
+            ) as resp:
+                body = resp.read().decode()
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read().decode()).get("error", "")
+            except (ValueError, OSError):
+                detail = ""
+            print(f"HTTP {e.code} from {url}: {detail}", file=sys.stderr)
+            return 1
+        except (urllib.error.URLError, OSError) as e:
+            print(
+                f"cannot reach scheduler metrics server at {args.url}: "
+                f"{e}\n(is the scheduler running with --metrics-port?)",
+                file=sys.stderr,
+            )
+            return 1
+
+    if args.top and args.fmt == "folded":
+        body = _top_summary(body, args.top) + "\n"
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(body)
+        print(f"wrote {args.out}", file=sys.stderr)
+    else:
+        sys.stdout.write(body)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

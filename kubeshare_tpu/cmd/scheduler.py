@@ -268,6 +268,15 @@ def build_parser() -> argparse.ArgumentParser:
              "the tpu_scheduler_pod_wait_seconds bucket bounds to "
              "alert exactly)",
     )
+    parser.add_argument(
+        "--profile-hz", type=float, default=67.0,
+        help="default sampling rate for GET /profile?seconds=N on the "
+             "metrics port (stdlib sampling profiler: folded-stack "
+             "text for flamegraph.pl, ?format=chrome for Perfetto; "
+             "overridable per request via ?hz=, capped at 1000). "
+             "Overhead while sampling is bounded <= 3%% by "
+             "PROFILE.json's paired A/B",
+    )
     return parser
 
 
@@ -314,7 +323,7 @@ class SchedulerMetrics:
 
     def __init__(self, clock=time.time, tracer=None, engine=None,
                  elector=None, planner=None, router=None, cluster=None,
-                 obs=None):
+                 obs=None, profiler=None):
         self.clock = clock
         self.tracer = tracer
         self.engine = engine
@@ -323,6 +332,9 @@ class SchedulerMetrics:
         # obs.IncidentPlane (optional): merges the alert-state gauges
         # + fired counters and the flight-recorder health counters
         self.obs = obs
+        # obs.ProfilerHub (optional): merges the sampling profiler's
+        # run/sample/busy counters (the /profile surface's health)
+        self.profiler = profiler
         # serving.RequestRouter (optional): merges the request plane's
         # tpu_serving_* gauges/histograms into the same exposition
         self.router = router
@@ -385,6 +397,8 @@ class SchedulerMetrics:
             samples += self.router.samples()
         if self.obs is not None:
             samples += self.obs.samples()
+        if self.profiler is not None:
+            samples += self.profiler.samples()
         if self.tracer is not None:
             samples += self.tracer.metric_samples("tpu_scheduler_phase")
         return expfmt.render(samples)
@@ -715,14 +729,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             cluster=cluster if args.kube else None,
             tracer=tracer,
             spool=incident_spool,
-            config=AlertConfig(slo_wait_seconds=args.slo_wait_seconds),
+            # cost_rules: the daemon's steady traffic is what the
+            # perf-regression sentinel models, so it opts in (bursty
+            # offline gauntlets grading exact classification do not)
+            config=AlertConfig(slo_wait_seconds=args.slo_wait_seconds,
+                               cost_rules=True),
             log=log,
         )
+
+    # sampling profiler behind GET /profile (continuous-profiling
+    # surface): one hub for the daemon's lifetime so its counters
+    # stay monotonic across individual runs
+    profiler_hub = None
+    if args.metrics_port:
+        from ..obs.profile import ProfilerHub
+
+        profiler_hub = ProfilerHub(hz=args.profile_hz)
 
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
                                elector=elector, planner=planner,
                                cluster=cluster if args.kube else None,
-                               obs=obs_plane)
+                               obs=obs_plane, profiler=profiler_hub)
     metrics_server = None
     if args.metrics_port:
         from ..utils.httpserv import MetricServer
@@ -739,9 +766,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             from ..obs.http import register_obs
 
             register_obs(metrics_server, obs_plane)
+        from ..obs.profile import register_profile
+
+        register_profile(metrics_server, profiler_hub)
         metrics_server.start()
         log.info(
-            "self-metrics on :%d/metrics (+ /explain%s)",
+            "self-metrics on :%d/metrics (+ /explain + /profile%s)",
             metrics_server.port,
             " + /healthz + /incidents" if obs_plane is not None else "",
         )
@@ -752,100 +782,114 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if elector is not None:
         guard = lambda: elector.tick() and elector.held()  # noqa: E731
 
-    if args.once:
-        if elector is not None and not elector.tick():
-            log.error(
-                "not leader (lease held by %s); refusing the pass",
-                elector.leader_identity,
-            )
-            return 1
-        try:
-            sync()
-            run_pass(engine, cluster, journal, metrics, guard,
-                     wave_size=args.wave_size, backfill=args.backfill)
-            if obs_plane is not None:
-                obs_plane.tick(engine.clock())
-                obs_plane.flush()
-            if planner is not None:
-                planner.run_once()
-        finally:
-            # a raised pass must still vacate the lease, or the next
-            # --once run is locked out for the full lease duration
-            if elector is not None:
-                elector.release()
+    def dump_trace() -> None:
+        """The ONE exit-path trace dump: --once, loop shutdown, and
+        any raising path all land the Chrome trace through main()'s
+        outer ``finally`` below — a future exit path cannot silently
+        skip the dump the way three inline copies could. Also the
+        loop's periodic refresh."""
         if args.trace_out:
             tracer.write_chrome_trace(args.trace_out)
-        return 0
 
-    # Topology hot-reload: the reference watches its cell file and
-    # exits the process on change (config.go:122-136); we rebuild the
-    # tree in place and keep the old one on a bad edit.
-    watcher = TopologyWatcher(args.topology, engine, log)
-
-    stop = setup_signal_handler()
-    log.info("scheduler loop started (interval %.1fs)", args.interval)
-    trace_written_at = 0
-    planner_ran_at = -1e18  # first planner round on the first pass
-    # reservations dropped by a hot-reload, carried until a pass
-    # actually runs with them: poll() consumes the file's mtime, so a
-    # sync()/run_pass() failure in the same iteration must not lose
-    # the head-of-queue promotion (it would never come back)
-    requeue: list = []
-    while not stop.is_set():
-        started = time.monotonic()
-        try:
+    try:
+        if args.once:
             if elector is not None and not elector.tick():
-                # standby replica: no sync, no pass — the engine's view
-                # is rebuilt fresh (informer resync of bound pods) once
-                # leadership arrives
-                stop.wait(max(0.05, args.interval))
-                continue
-            requeue.extend(watcher.poll() or ())
-            sync()
-            run_pass(engine, cluster, journal, metrics, guard,
-                     requeue=requeue, wave_size=args.wave_size,
-                     backfill=args.backfill)
-            requeue = []
-            if obs_plane is not None:
-                # evaluated on the scheduler tick — the alert plane
-                # reads the in-process surface, no scrape round-trip
-                obs_plane.tick(engine.clock())
-            if planner is not None and (
-                time.monotonic() - planner_ran_at
-                >= max(args.autoscale_interval, args.interval)
-            ):
-                planner.run_once()
-                planner_ran_at = time.monotonic()
-        except Exception as e:  # apiserver blips must not kill the loop
-            # degraded mode: the loop keeps serving /metrics and
-            # /explain while the apiserver is away; pods queue, and
-            # the adapter forces a relist resync on recovery
-            log.error(
-                "scheduling pass failed%s: %s",
-                " (API degraded; decisions queued until recovery)"
-                if getattr(cluster, "degraded", False) else "",
-                e,
-            )
-            if obs_plane is not None:
-                # failed passes are exactly when the degraded latch
-                # and api-error-rate rules must still be evaluated
-                obs_plane.tick(engine.clock())
-        if args.trace_out and metrics.passes - trace_written_at >= 100:
-            tracer.write_chrome_trace(args.trace_out)
-            trace_written_at = metrics.passes
-        elapsed = time.monotonic() - started
-        stop.wait(max(0.05, args.interval - elapsed))
-    if elector is not None:
-        elector.release()
-    if obs_plane is not None:
-        # bundles still collecting their post window land with what
-        # they have — a shutdown must not lose captured evidence
-        obs_plane.flush()
-    if args.trace_out:
-        tracer.write_chrome_trace(args.trace_out)
-    if metrics_server is not None:
-        metrics_server.stop()
-    return 0
+                log.error(
+                    "not leader (lease held by %s); refusing the pass",
+                    elector.leader_identity,
+                )
+                return 1
+            try:
+                sync()
+                run_pass(engine, cluster, journal, metrics, guard,
+                         wave_size=args.wave_size,
+                         backfill=args.backfill)
+                if obs_plane is not None:
+                    obs_plane.tick(engine.clock())
+                    obs_plane.flush()
+                if planner is not None:
+                    planner.run_once()
+            finally:
+                # a raised pass must still vacate the lease, or the
+                # next --once run is locked out for the full lease
+                # duration
+                if elector is not None:
+                    elector.release()
+            return 0
+
+        # Topology hot-reload: the reference watches its cell file and
+        # exits the process on change (config.go:122-136); we rebuild
+        # the tree in place and keep the old one on a bad edit.
+        watcher = TopologyWatcher(args.topology, engine, log)
+
+        stop = setup_signal_handler()
+        log.info("scheduler loop started (interval %.1fs)", args.interval)
+        trace_written_at = 0
+        planner_ran_at = -1e18  # first planner round on the first pass
+        # reservations dropped by a hot-reload, carried until a pass
+        # actually runs with them: poll() consumes the file's mtime,
+        # so a sync()/run_pass() failure in the same iteration must
+        # not lose the head-of-queue promotion (it would never come
+        # back)
+        requeue: list = []
+        while not stop.is_set():
+            started = time.monotonic()
+            try:
+                if elector is not None and not elector.tick():
+                    # standby replica: no sync, no pass — the engine's
+                    # view is rebuilt fresh (informer resync of bound
+                    # pods) once leadership arrives
+                    stop.wait(max(0.05, args.interval))
+                    continue
+                requeue.extend(watcher.poll() or ())
+                sync()
+                run_pass(engine, cluster, journal, metrics, guard,
+                         requeue=requeue, wave_size=args.wave_size,
+                         backfill=args.backfill)
+                requeue = []
+                if obs_plane is not None:
+                    # evaluated on the scheduler tick — the alert
+                    # plane reads the in-process surface, no scrape
+                    # round-trip
+                    obs_plane.tick(engine.clock())
+                if planner is not None and (
+                    time.monotonic() - planner_ran_at
+                    >= max(args.autoscale_interval, args.interval)
+                ):
+                    planner.run_once()
+                    planner_ran_at = time.monotonic()
+            except Exception as e:  # apiserver blips must not kill the loop
+                # degraded mode: the loop keeps serving /metrics and
+                # /explain while the apiserver is away; pods queue,
+                # and the adapter forces a relist resync on recovery
+                log.error(
+                    "scheduling pass failed%s: %s",
+                    " (API degraded; decisions queued until recovery)"
+                    if getattr(cluster, "degraded", False) else "",
+                    e,
+                )
+                if obs_plane is not None:
+                    # failed passes are exactly when the degraded
+                    # latch and api-error-rate rules must still be
+                    # evaluated
+                    obs_plane.tick(engine.clock())
+            if args.trace_out and metrics.passes - trace_written_at >= 100:
+                dump_trace()
+                trace_written_at = metrics.passes
+            elapsed = time.monotonic() - started
+            stop.wait(max(0.05, args.interval - elapsed))
+        if elector is not None:
+            elector.release()
+        if obs_plane is not None:
+            # bundles still collecting their post window land with
+            # what they have — a shutdown must not lose captured
+            # evidence
+            obs_plane.flush()
+        if metrics_server is not None:
+            metrics_server.stop()
+        return 0
+    finally:
+        dump_trace()
 
 
 if __name__ == "__main__":

@@ -18,9 +18,13 @@ from typing import Callable, List, Optional
 
 from .alerts import (  # noqa: F401
     AlertConfig, AlertEvaluator, AlertRule, WindowSeries,
-    RULE_API_ERRORS, RULE_CAPACITY_DROP, RULE_DEGRADED,
-    RULE_LEDGER_DRIFT, RULE_QUEUE_SPIKE, RULE_RESTART, RULE_SHED_RATE,
+    RULE_API_ERRORS, RULE_CAPACITY_DROP, RULE_COST_REGRESSION,
+    RULE_DEGRADED, RULE_LEDGER_DRIFT, RULE_PHASE_DRIFT,
+    RULE_QUEUE_SPIKE, RULE_RESTART, RULE_SHED_RATE,
     RULE_SLO_BURN, RULE_WATCH_STORM, standard_rules,
+)
+from .profile import (  # noqa: F401
+    ProfilerBusy, ProfilerHub, SamplingProfiler, register_profile,
 )
 from .recorder import FlightRecorder, IncidentStore  # noqa: F401
 
@@ -104,6 +108,14 @@ def default_snapshot(engine_ref: Callable, cluster=None, router=None):
                 phase: round(seconds, 4)
                 for phase, seconds in engine.wave_phase_seconds.items()
             },
+            # sub-phase cost attribution: cheap (6 floats + an int),
+            # and exactly the history a cost-regression bundle's
+            # pre-window needs to show the burn developing
+            "cost_seconds": {
+                phase: round(seconds, 4)
+                for phase, seconds in engine.cost_seconds.items()
+            },
+            "cost_attempts": engine.cost_attempts,
         }
         if cluster is not None:
             doc["api"] = {
@@ -154,6 +166,7 @@ def build_plane(
         max_bundles=max_bundles,
         tracer=tracer,
         journal_ref=lambda: engine_ref().explain,
+        attribution_ref=lambda: engine_ref().cost_attribution(),
         log=log,
     )
     return IncidentPlane(evaluator, recorder)

@@ -31,6 +31,12 @@ of scheduler time:
 - **node-capacity-drop** — the healthy-node count fell since the last
   evaluation (flap/drain/partition; capacity loss is always worth an
   incident bundle even before queues feel it).
+- **cost-regression** / **cost-phase-drift** — the perf sentinel over
+  the engine's cost-attribution counters: windowed wall
+  seconds-per-attempt vs a frozen-while-hot EWMA baseline (both
+  windows must burn), and per-phase cost-share drift over the slow
+  window — a hot-path regression pages the day it lands instead of
+  waiting for the next offline bench run.
 
 Alert states export as ``tpu_scheduler_alert_active{rule}`` gauges
 plus ``tpu_scheduler_alerts_fired_total{rule}`` counters. Firing is
@@ -64,6 +70,8 @@ RULE_SHED_RATE = "shed-rate"
 RULE_LEDGER_DRIFT = "ledger-drift"
 RULE_RESTART = "scheduler-restart"
 RULE_CAPACITY_DROP = "node-capacity-drop"
+RULE_COST_REGRESSION = "cost-regression"
+RULE_PHASE_DRIFT = "cost-phase-drift"
 
 
 @dataclass
@@ -86,6 +94,21 @@ class AlertConfig:
     queue_baseline_alpha: float = 0.1    # EWMA step per evaluation
     shed_rate_threshold: float = 0.2     # shed / submitted, fast window
     shed_min_requests: int = 20          # windowed submissions floor
+    # perf-regression sentinel (cost-attribution plane): windowed
+    # wall-seconds-per-attempt vs a frozen-while-hot EWMA baseline,
+    # BOTH windows elevated (one GC pause inflates the fast window
+    # but barely moves the slow one), and per-phase cost-share drift.
+    # OPT-IN: the sentinel models roughly stationary traffic — the
+    # daemon's steady serving load qualifies, a bursty fault gauntlet
+    # does not (saturation legitimately moves the filter share from
+    # ~0.1 to ~0.9, which is workload, not regression) — so gauntlets
+    # that grade exact alert classification leave it off.
+    cost_rules: bool = False
+    cost_regression_factor: float = 2.5  # x baseline, both windows
+    cost_min_attempts: int = 50          # windowed attempts floor
+    cost_baseline_alpha: float = 0.05    # EWMA step per evaluation
+    cost_phase_drift: float = 0.25       # absolute share move that fires
+    cost_phase_min_seconds: float = 0.05  # slow-window attributed floor
     clear_after: int = 2                 # clean evals before clearing
     clear_ratio: float = 0.5             # "clean" = level <= ratio x thr
 
@@ -461,6 +484,115 @@ def capacity_drop_rule(node_count: Callable[[], int],
                      clear_after=cfg.clear_after)
 
 
+def cost_regression_rule(cost_totals: Callable[[], Tuple[float, float]],
+                         cfg: AlertConfig) -> AlertRule:
+    """Perf-regression sentinel over the cost-attribution counters:
+    ``cost_totals`` returns cumulative ``(attributed wall seconds,
+    attempts)``; the level is windowed seconds-per-attempt against a
+    slow EWMA baseline, taken as ``min(fast, slow)`` burn — BOTH
+    windows must be elevated, so one GC pause inside the fast window
+    (which barely moves the slow one) cannot page. The baseline is
+    frozen while the level is at or past the threshold ("frozen while
+    hot"): a sustained regression keeps firing instead of being
+    EWMA-absorbed as the new normal. Counter-reset tolerant like the
+    restart rule — the engine rebuilding after a crash zeroes the
+    counters, the WindowSeries clears, and no verdict is produced
+    until fresh windows fill."""
+    series = WindowSeries(cfg.slow_window)
+    baseline: List[Optional[float]] = [None]
+
+    def level(now: float) -> Tuple[float, dict]:
+        seconds, attempts = cost_totals()
+        series.observe(now, (float(seconds), float(attempts)))
+
+        def per_attempt(window: float) -> Optional[float]:
+            d = series.delta(now, window)
+            if not d or d[1] < cfg.cost_min_attempts:
+                return None  # too few attempts: no verdict
+            return d[0] / d[1]
+
+        fast = per_attempt(cfg.fast_window)
+        slow = per_attempt(cfg.slow_window)
+        if fast is None or slow is None:
+            return 0.0, {}
+        base = baseline[0]
+        if base is None or base <= 0:
+            baseline[0] = slow  # first valid window seeds the baseline
+            return 0.0, {}
+        ratio = min(fast, slow) / base
+        if ratio < cfg.cost_regression_factor * cfg.clear_ratio:
+            # the baseline learns only while the level sits below the
+            # CLEAR point — a moderate sustained regression (between
+            # clear and fire) must not be EWMA-absorbed either, or a
+            # creeping slowdown never pages
+            baseline[0] = base + cfg.cost_baseline_alpha * (slow - base)
+        return ratio, {
+            "per_attempt_us": round(fast * 1e6, 1),
+            "baseline_us": round(base * 1e6, 1),
+            "fast_ratio": round(fast / base, 2),
+            "slow_ratio": round(slow / base, 2),
+        }
+
+    return AlertRule(RULE_COST_REGRESSION, level,
+                     threshold=cfg.cost_regression_factor,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
+def phase_drift_rule(phase_totals: Callable[[], Dict[str, float]],
+                     cfg: AlertConfig) -> AlertRule:
+    """Per-phase cost-SHARE drift: ``phase_totals`` returns the
+    cumulative ``cost_seconds`` map; shares are computed over the
+    slow window (noise from a single stall averages out there) and
+    compared to frozen-while-hot EWMA baselines. Level is the worst
+    phase's absolute share move over ``cost_phase_drift`` — a hot
+    path whose filter share doubles fires even when total
+    seconds-per-attempt has not yet crossed the regression factor.
+    Counter-reset tolerant the same way as the regression rule."""
+    series = WindowSeries(cfg.slow_window)
+    keys: List[str] = []  # pinned phase order on first observation
+    baselines: Dict[str, float] = {}
+
+    def level(now: float) -> Tuple[float, dict]:
+        current = phase_totals()
+        if not keys:
+            keys.extend(sorted(current))
+        series.observe(
+            now, tuple(float(current.get(k, 0.0)) for k in keys)
+        )
+        d = series.delta(now, cfg.slow_window)
+        total = sum(d) if d else 0.0
+        if not d or total < cfg.cost_phase_min_seconds:
+            return 0.0, {}
+        shares = {k: v / total for k, v in zip(keys, d)}
+        if not baselines:
+            baselines.update(shares)  # first valid window seeds
+            return 0.0, {}
+        worst_phase, worst = "", 0.0
+        for k, share in shares.items():
+            drift = abs(share - baselines.get(k, share))
+            if drift > worst:
+                worst, worst_phase = drift, k
+        value = worst / max(cfg.cost_phase_drift, 1e-9)
+        if value < cfg.clear_ratio:  # frozen-while-hot, as above
+            alpha = cfg.cost_baseline_alpha
+            for k, share in shares.items():
+                base = baselines.get(k, share)
+                baselines[k] = base + alpha * (share - base)
+        context = {}
+        if worst_phase:
+            context = {
+                "phase": worst_phase,
+                "share": round(shares[worst_phase], 3),
+                "baseline": round(baselines.get(worst_phase, 0.0), 3),
+            }
+        return value, context
+
+    return AlertRule(RULE_PHASE_DRIFT, level, threshold=1.0,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
 def standard_rules(engine_ref: Callable, cluster=None, router=None,
                    cfg: Optional[AlertConfig] = None) -> List[AlertRule]:
     """The full rule set against a live engine (via ``engine_ref`` —
@@ -493,6 +625,14 @@ def standard_rules(engine_ref: Callable, cluster=None, router=None,
     def node_count():
         return engine_ref().healthy_node_count
 
+    def cost_totals():
+        engine = engine_ref()
+        return (sum(engine.cost_seconds.values()),
+                float(engine.cost_attempts))
+
+    def phase_totals():
+        return dict(engine_ref().cost_seconds)
+
     rules = [
         burn_rate_rule(wait_totals, cfg),
         queue_spike_rule(queue_depths, cfg),
@@ -500,6 +640,11 @@ def standard_rules(engine_ref: Callable, cluster=None, router=None,
         counter_reset_rule(engine_counters, cfg),
         capacity_drop_rule(node_count, cfg),
     ]
+    if cfg.cost_rules:
+        rules += [
+            cost_regression_rule(cost_totals, cfg),
+            phase_drift_rule(phase_totals, cfg),
+        ]
     if cluster is not None:
         def api_errors():
             # KubeCluster counts api_errors; the sim's FaultInjector

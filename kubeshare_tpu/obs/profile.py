@@ -1,0 +1,362 @@
+"""Stdlib sampling profiler: always-on-capable, bounded, flamegraph-ready.
+
+Google-Wide Profiling's lesson (Ren et al., 2010) scaled down to one
+daemon: a profile you can afford to leave enabled answers "where does
+scheduler CPU go" continuously, not just when someone reruns the
+offline bench. :class:`SamplingProfiler` walks ``sys._current_frames``
+from a background thread at ~50-100 Hz and folds each thread's stack
+into a bounded ``{stack tuple: sample count}`` aggregate — no sys
+hooks, no per-call overhead on the profiled code, just a GIL grab per
+sweep (measured ≤ 3% on the 1024-node engine hot path by the
+paired-ratio A/B in ``tools/profile_report.py``; PROFILE.json pins the
+ceiling). Two export forms:
+
+- **collapsed** — Brendan Gregg folded-stack text (one
+  ``frame;frame;... count`` line per distinct stack, root first),
+  which ``flamegraph.pl``, speedscope, and Grafana's flame-graph panel
+  ingest directly;
+- **chrome_trace** — ``trace_event`` JSON (one complete event per
+  distinct stack, width proportional to its sample share), loadable
+  in chrome://tracing / Perfetto next to the Tracer's span rings.
+
+:class:`ProfilerHub` serves on-demand runs over ``GET /profile`` on
+the scheduler's MetricServer (``?seconds=N&hz=H&format=folded|chrome``,
+one profile at a time — a second request gets 409) and exports its own
+health counters; ``python -m kubeshare_tpu profile`` is the CLI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import expfmt
+
+DEFAULT_HZ = 67.0   # not a divisor of common 10ms timers: avoids
+                    # lockstep with periodic work, like GWP's phased
+                    # collection avoids synchronized sampling bias
+MAX_HZ = 1000.0
+MAX_DEPTH = 64      # frames kept per stack — the ROOT-most ones:
+                    # truncated stacks keep their root prefix (so
+                    # flamegraph merging still works) and drop
+                    # leaf-side detail past the bound
+
+# the bucket every distinct-stack-bound overflow folds into: bounded
+# memory is never silent — it shows up IN the profile
+OVERFLOW_STACK: Tuple[str, ...] = ("[stack table full]",)
+
+
+def _frame_label(code) -> str:
+    """``file.py:function`` — function-granular, not line-granular:
+    line numbers churn distinct stacks out of one loop body and blow
+    the bounded stack table for zero flamegraph value."""
+    return f"{os.path.basename(code.co_filename)}:{code.co_name}"
+
+
+class SamplingProfiler:
+    """Periodic ``sys._current_frames()`` walk, folded-stack aggregate.
+
+    ``start()``/``stop()`` at runtime; the sampler thread excludes
+    itself. Aggregation is bounded: at most ``max_stacks`` distinct
+    stacks are kept, further novel stacks count under
+    :data:`OVERFLOW_STACK` (and on ``stacks_overflowed``).
+    """
+
+    def __init__(
+        self,
+        hz: float = DEFAULT_HZ,
+        max_stacks: int = 4096,
+        max_depth: int = MAX_DEPTH,
+        clock=time.perf_counter,
+    ):
+        if not 0 < hz <= MAX_HZ:
+            raise ValueError(f"hz must be in (0, {MAX_HZ:g}], got {hz}")
+        self.hz = float(hz)
+        self.max_stacks = max_stacks
+        self.max_depth = max_depth
+        self.clock = clock
+        self.samples_taken = 0      # sampling sweeps completed
+        self.stacks_recorded = 0    # per-thread stacks folded in
+        self.stacks_overflowed = 0  # folded under OVERFLOW_STACK
+        self.started_at = 0.0
+        self.duration = 0.0         # wall seconds profiled (at stop)
+        self._stacks: Dict[Tuple[str, ...], int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "SamplingProfiler":
+        if self.running:
+            return self
+        self._stop.clear()
+        self.started_at = self.clock()
+        self._thread = threading.Thread(
+            target=self._run, name="kubeshare-profiler", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> "SamplingProfiler":
+        thread = self._thread
+        if thread is None:
+            return self
+        self._stop.set()
+        thread.join(timeout=5.0)
+        self._thread = None
+        self.duration = max(0.0, self.clock() - self.started_at)
+        return self
+
+    def _run(self) -> None:
+        period = 1.0 / self.hz
+        # drift-corrected cadence: wait to the next grid point instead
+        # of period-after-last-sweep, so a slow sweep doesn't lower
+        # the effective rate (the count/rate math assumes hz holds)
+        next_at = self.clock() + period
+        while not self._stop.wait(max(0.0, next_at - self.clock())):
+            self._sample()
+            next_at += period
+            now = self.clock()
+            if next_at < now:  # fell behind: skip missed grid points
+                next_at = now + period
+
+    # -- sampling -----------------------------------------------------
+
+    def _sample(self) -> None:
+        own = threading.get_ident()
+        max_depth = self.max_depth
+        folded: List[Tuple[str, ...]] = []
+        # sys._current_frames snapshots every thread's top frame under
+        # the GIL; walking f_back reads immutable-enough frame chains
+        # (CPython keeps them alive while referenced)
+        for tid, frame in sys._current_frames().items():
+            if tid == own:
+                continue
+            # walk the whole chain (bounded by the interpreter's
+            # recursion limit), then keep the ROOT-most max_depth
+            # frames: truncating from the leaf side preserves the
+            # shared root prefix flamegraph merging depends on
+            stack: List[str] = []
+            f = frame
+            while f is not None:
+                stack.append(_frame_label(f.f_code))  # leaf first
+                f = f.f_back
+            if stack:
+                if len(stack) > max_depth:
+                    del stack[:len(stack) - max_depth]  # drop leaf side
+                stack.reverse()  # root first (folded-stack convention)
+                folded.append(tuple(stack))
+        self._fold(folded)
+
+    def _fold(self, folded: List[Tuple[str, ...]]) -> None:
+        """Aggregate one sweep's stacks, bounded: a NOVEL stack past
+        ``max_stacks`` counts under :data:`OVERFLOW_STACK` instead of
+        growing the table — bounded memory, never silent."""
+        with self._lock:
+            self.samples_taken += 1
+            stacks = self._stacks
+            for key in folded:
+                count = stacks.get(key)
+                if count is None and len(stacks) >= self.max_stacks:
+                    self.stacks_overflowed += 1
+                    key = OVERFLOW_STACK
+                    count = stacks.get(key)
+                stacks[key] = (count or 0) + 1
+                self.stacks_recorded += 1
+
+    # -- export -------------------------------------------------------
+
+    def stacks(self) -> Dict[Tuple[str, ...], int]:
+        with self._lock:
+            return dict(self._stacks)
+
+    def collapsed(self) -> str:
+        """Folded-stack text: ``frame;frame;... count`` per line,
+        heaviest first — pipe straight into flamegraph.pl."""
+        rows = sorted(
+            self.stacks().items(), key=lambda kv: (-kv[1], kv[0])
+        )
+        return "\n".join(
+            f"{';'.join(stack)} {count}" for stack, count in rows
+        ) + ("\n" if rows else "")
+
+    def chrome_trace(self, process_name: str = "kubeshare-profile") -> dict:
+        """``trace_event`` JSON: one complete ("X") event per distinct
+        stack laid end to end, ``dur`` = samples x sampling period —
+        widths are proportional to CPU share, and the full folded
+        stack rides in ``args`` for inspection."""
+        period_us = 1e6 / self.hz
+        events: List[dict] = [{
+            "name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+            "args": {"name": process_name},
+        }]
+        ts = 0.0
+        for stack, count in sorted(
+            self.stacks().items(), key=lambda kv: (-kv[1], kv[0])
+        ):
+            dur = count * period_us
+            events.append({
+                "name": stack[-1], "ph": "X", "pid": 1, "tid": 0,
+                "ts": ts, "dur": dur,
+                "args": {"stack": ";".join(stack), "samples": count},
+            })
+            ts += dur
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def report(self) -> dict:
+        """Summary document (the CLI's --json form)."""
+        return {
+            "hz": self.hz,
+            "duration_s": round(self.duration, 3),
+            "samples": self.samples_taken,
+            "stacks_recorded": self.stacks_recorded,
+            "distinct_stacks": len(self._stacks),
+            "stacks_overflowed": self.stacks_overflowed,
+        }
+
+
+def profile(seconds: float, hz: float = DEFAULT_HZ,
+            **kwargs) -> SamplingProfiler:
+    """Run one bounded profile synchronously and return it stopped."""
+    prof = SamplingProfiler(hz=hz, **kwargs).start()
+    time.sleep(max(0.0, seconds))
+    return prof.stop()
+
+
+class ProfilerBusy(RuntimeError):
+    """A profile is already running (one at a time per hub)."""
+
+
+class ProfilerHub:
+    """On-demand profile runs behind ``GET /profile``, one at a time,
+    with cumulative health counters for the metrics exposition. The
+    hub outlives individual :class:`SamplingProfiler` runs so
+    ``tpu_scheduler_profiler_*`` counters are monotonic."""
+
+    def __init__(self, hz: float = DEFAULT_HZ,
+                 max_seconds: float = 60.0, max_stacks: int = 4096):
+        # clamp (the --profile-hz flag documents "capped"): a typo'd
+        # server default must not turn every parameterless
+        # GET /profile into a 400
+        self.hz = min(max(float(hz), 1.0), MAX_HZ)
+        self.max_seconds = max_seconds
+        self.max_stacks = max_stacks
+        self.runs_total = 0
+        self.samples_total = 0
+        self.busy_rejections = 0
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return self._lock.locked()
+
+    def run_profile(self, seconds: float,
+                    hz: Optional[float] = None) -> SamplingProfiler:
+        """Blocking bounded run (the /profile handler blocks its own
+        HTTP thread, never the scheduler). Raises :class:`ProfilerBusy`
+        when a run is already in flight, ValueError on bad knobs."""
+        seconds = float(seconds)
+        if not 0 < seconds <= self.max_seconds:
+            raise ValueError(
+                f"seconds must be in (0, {self.max_seconds:g}], "
+                f"got {seconds}"
+            )
+        if not self._lock.acquire(blocking=False):
+            self.busy_rejections += 1
+            raise ProfilerBusy("a profile is already running")
+        try:
+            prof = profile(seconds, hz=hz or self.hz,
+                           max_stacks=self.max_stacks)
+            self.runs_total += 1
+            self.samples_total += prof.samples_taken
+            return prof
+        finally:
+            self._lock.release()
+
+    def samples(self) -> List["expfmt.Sample"]:
+        return [
+            expfmt.Sample(
+                "tpu_scheduler_profiler_runs_total", {}, self.runs_total,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_profiler_samples_total", {},
+                self.samples_total,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_profiler_busy_rejections_total", {},
+                self.busy_rejections,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_profiler_active", {},
+                1 if self.active else 0,
+            ),
+        ]
+
+
+def render_profile(prof: SamplingProfiler, fmt: str
+                   ) -> Tuple[str, str]:
+    """One renderer for the /profile handler AND the CLI:
+    ``(content_type, body)`` for ``folded`` / ``chrome`` / ``json``.
+    Raises ValueError on an unknown format."""
+    if fmt == "chrome":
+        return "application/json", json.dumps(prof.chrome_trace()) + "\n"
+    if fmt == "json":
+        doc = prof.report()
+        doc["stacks"] = {
+            ";".join(stack): count
+            for stack, count in prof.stacks().items()
+        }
+        return "application/json", json.dumps(doc) + "\n"
+    if fmt == "folded":
+        return "text/plain; charset=utf-8", prof.collapsed()
+    raise ValueError(f"unknown format {fmt!r}")
+
+
+def profile_handler(hub: ProfilerHub):
+    """``GET /profile?seconds=N[&hz=H][&format=folded|chrome|json]``:
+    runs one bounded profile and returns it — folded-stack text by
+    default, Chrome-trace or summary JSON on request. 409 while
+    another profile runs, 400 on bad parameters."""
+
+    def handle(rest: str, params: Dict[str, List[str]]
+               ) -> Tuple[int, str, str]:
+        try:
+            seconds = float((params.get("seconds") or ["2"])[0])
+            hz_raw = (params.get("hz") or [""])[0]
+            hz = float(hz_raw) if hz_raw else None
+            fmt = (params.get("format") or ["folded"])[0]
+            if fmt not in ("folded", "chrome", "json"):
+                raise ValueError(f"unknown format {fmt!r}")
+            if hz is not None and not 0 < hz <= MAX_HZ:
+                raise ValueError(f"hz must be in (0, {MAX_HZ:g}]")
+        except ValueError as e:
+            return 400, "application/json", json.dumps(
+                {"error": str(e)}
+            ) + "\n"
+        try:
+            prof = hub.run_profile(seconds, hz=hz)
+        except ProfilerBusy as e:
+            return 409, "application/json", json.dumps(
+                {"error": str(e)}
+            ) + "\n"
+        except ValueError as e:
+            return 400, "application/json", json.dumps(
+                {"error": str(e)}
+            ) + "\n"
+        ctype, body = render_profile(prof, fmt)
+        return 200, ctype, body
+
+    return handle
+
+
+def register_profile(server, hub: ProfilerHub) -> None:
+    server.route_prefix("/profile", profile_handler(hub))

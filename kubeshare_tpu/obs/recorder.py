@@ -155,6 +155,7 @@ class FlightRecorder:
         max_trace_events: int = 2048,
         tracer=None,
         journal_ref: Optional[Callable] = None,
+        attribution_ref: Optional[Callable] = None,
         log=None,
     ):
         self.snapshot_fn = snapshot_fn
@@ -170,6 +171,10 @@ class FlightRecorder:
         self.max_trace_events = max_trace_events
         self.tracer = tracer
         self.journal_ref = journal_ref
+        # cost-attribution snapshot embedded at fire time (the perf
+        # sentinel's evidence: which phases and tenant/shape classes
+        # were burning when the rule tripped)
+        self.attribution_ref = attribution_ref
         self.log = log
         self.suppressed = 0
         self.snapshots_taken = 0
@@ -256,6 +261,11 @@ class FlightRecorder:
                 )
             except Exception:
                 bundle["pods"] = []
+        if self.attribution_ref is not None:
+            try:
+                bundle["cost_attribution"] = self.attribution_ref()
+            except Exception:
+                pass  # evidence must never fail a fire
         self._pending.append(bundle)
         return bundle["id"]
 

@@ -300,6 +300,32 @@ class TpuShareScheduler:
         self.wave_phase_seconds = {
             "sync": 0.0, "sort": 0.0, "attempts": 0.0, "flush": 0.0,
         }
+        # Sub-phase cost attribution (the layer BELOW wave_phase_
+        # seconds["attempts"]): cumulative wall seconds per segment of
+        # the scheduling walk — parse (prefilter + group), quota
+        # (admission gate), filter (candidate scan incl. the
+        # nobody-fit cold path), score, reserve_permit (reserve +
+        # permit + bind verbs), journal (attempt-record build + batch
+        # append; demand notes land in the phase that files them).
+        # Same idiom as wave_phase_seconds — plain perf_counter sums,
+        # never tracer spans: the attribution must not tax the path
+        # it measures. Exported as tpu_scheduler_cost_seconds_total
+        # {phase}; the cost-regression/phase-drift alert rules and
+        # tools/profile_report.py read it.
+        self.cost_seconds = {
+            "parse": 0.0, "quota": 0.0, "filter": 0.0, "score": 0.0,
+            "reserve_permit": 0.0, "journal": 0.0,
+        }
+        self.cost_attempts = 0  # attempts attributed (journal-independent)
+        # Per-(tenant, kind, outcome) attempt cost: [seconds, attempts]
+        # — "which tenants and shapes consume scheduler CPU" as a
+        # queryable family. Bounded: past the cap new classes collapse
+        # into the "_other" tenant, so hostile tenant churn cannot
+        # grow the exposition without bound.
+        self.cost_by_class: Dict[Tuple[str, str, str], List] = {}
+        self._cost_tail = 0.0   # perf stamp at _schedule_attempt exit
+        self._cost_mark = 0.0   # perf stamp opening the current segment
+        self._cost_phase = "parse"
         # set by _schedule_attempt for the wave driver: the parsed
         # requirements and demand-reason of the LAST attempt (cheaper
         # than threading them through every return path)
@@ -1149,9 +1175,19 @@ class TpuShareScheduler:
         per pod."""
         self._last_attempt_req = None
         self._last_demand_reason = ""
+        t0 = _time.perf_counter()
         if not journal_on:
-            with maybe_span(self.tracer, "attempt", pod=pod.key):
-                return self._schedule_attempt(pod, None)
+            try:
+                with maybe_span(self.tracer, "attempt", pod=pod.key):
+                    decision = self._schedule_attempt(pod, None, t0)
+            except BaseException:
+                # a raising verb (API outage, injected crash) still
+                # burned real time — attribute it, outcome "error",
+                # or class totals drift under phase totals forever
+                self._attribute_cost(pod, "error", t0)
+                raise
+            self._attribute_cost(pod, decision.status, t0)
+            return decision
         # exact clock, no rounding: _live_entry compares this attempt
         # start against the bind's outcome_at to tell "bound moments
         # ago in THIS attempt" from "bound by a previous incarnation",
@@ -1159,8 +1195,12 @@ class TpuShareScheduler:
         # AttemptRecord is slots-only scratch — the /explain dict is
         # rendered from it on read, never on this path
         rec = AttemptRecord(self.clock())
-        with maybe_span(self.tracer, "attempt", pod=pod.key):
-            decision = self._schedule_attempt(pod, rec)
+        try:
+            with maybe_span(self.tracer, "attempt", pod=pod.key):
+                decision = self._schedule_attempt(pod, rec, t0)
+        except BaseException:
+            self._attribute_cost(pod, "error", t0)  # as above
+            raise
         req = self._last_attempt_req
         rec.outcome = decision.status
         if decision.node:
@@ -1190,7 +1230,59 @@ class TpuShareScheduler:
                 tenant=req.tenant if req is not None else pod.namespace,
                 shape=shape,
             )
+        self._attribute_cost(pod, decision.status, t0)
         return decision
+
+    def _attribute_cost(self, pod: Pod, outcome: str,
+                        t0: float) -> None:
+        """Close the attempt's cost accounting: everything after
+        ``_schedule_attempt``'s exit stamp (record build, batch
+        append, terminal note) is the ``journal`` sub-phase, and the
+        whole attempt charges its (tenant, kind, outcome) class.
+        ``outcome`` is the decision status, or ``"error"`` when the
+        walk raised (API outage) — raising attempts burned time too,
+        and skipping them would leave the class totals permanently
+        under the phase totals."""
+        now = _time.perf_counter()
+        self.cost_seconds["journal"] += now - self._cost_tail
+        self.cost_attempts += 1
+        req = self._last_attempt_req
+        if req is not None:
+            key = (req.tenant, req.kind.value, outcome)
+        else:  # prefilter rejected before requirements existed
+            key = (pod.namespace, "", outcome)
+        by_class = self.cost_by_class
+        entry = by_class.get(key)
+        if entry is None:
+            if len(by_class) >= 512:
+                key = ("_other", key[1], key[2])
+                entry = by_class.get(key)
+            if entry is None:
+                entry = by_class[key] = [0.0, 0]
+        entry[0] += now - t0
+        entry[1] += 1
+
+    def cost_attribution(self, top: int = 16) -> dict:
+        """Snapshot of the cost-attribution surface — the flight
+        recorder embeds this into incident bundles when a perf
+        sentinel fires. Classes are the ``top`` heaviest by seconds;
+        metrics-thread safe (list() snapshots, counters are plain)."""
+        classes = sorted(
+            list(self.cost_by_class.items()),
+            key=lambda kv: kv[1][0], reverse=True,
+        )[:top]
+        return {
+            "phases": {
+                phase: round(seconds, 6)
+                for phase, seconds in self.cost_seconds.items()
+            },
+            "attempts": self.cost_attempts,
+            "classes": [
+                {"tenant": tenant, "kind": kind, "outcome": outcome,
+                 "seconds": round(seconds, 6), "attempts": count}
+                for (tenant, kind, outcome), (seconds, count) in classes
+            ],
+        }
 
     def schedule_wave(self, pods: Sequence[Pod], limit: int = 0,
                       backfill: bool = True) -> List[Decision]:
@@ -1511,8 +1603,40 @@ class TpuShareScheduler:
             )
         whole_counts[node] = whole
 
-    def _schedule_attempt(self, pod: Pod,
-                          rec: Optional[AttemptRecord]) -> Decision:
+    def _schedule_attempt(self, pod: Pod, rec: Optional[AttemptRecord],
+                          t0: Optional[float] = None) -> Decision:
+        """Timing shell around :meth:`_schedule_walk`: opens the
+        attempt's sub-phase cost accounting (phase ``parse``) and, in
+        ``finally``, flushes whatever segment was in flight when the
+        walk returned — every early return (and even a raising API
+        verb) lands its wall time in the phase it died in. The exit
+        stamp is left on ``_cost_tail`` for ``_attribute_cost``'s
+        ``journal`` segment; ``t0`` (the caller's attempt-start stamp)
+        charges the pre-walk work — AttemptRecord build, span entry —
+        to ``journal`` too, so the per-class totals and the sub-phase
+        sums cover exactly the same interval."""
+        perf = _time.perf_counter
+        mark = perf()
+        if t0 is not None:
+            self.cost_seconds["journal"] += mark - t0
+        self._cost_mark = mark
+        self._cost_phase = "parse"
+        try:
+            return self._schedule_walk(pod, rec)
+        finally:
+            now = perf()
+            self.cost_seconds[self._cost_phase] += now - self._cost_mark
+            self._cost_tail = now
+
+    def _cost_boundary(self, phase: str) -> None:
+        """Close the in-flight cost segment and open ``phase``."""
+        now = _time.perf_counter()
+        self.cost_seconds[self._cost_phase] += now - self._cost_mark
+        self._cost_mark = now
+        self._cost_phase = phase
+
+    def _schedule_walk(self, pod: Pod,
+                       rec: Optional[AttemptRecord]) -> Decision:
         """The scheduling walk. ``rec`` accumulates phase outcomes for
         the journal — None when the journal is disabled, in which case
         no record fields (nor the journal-only runner-up scoring) are
@@ -1532,6 +1656,7 @@ class TpuShareScheduler:
                             retryable=e.retryable)
         self._last_attempt_req = req
         group = self.groups.get_or_create(pod, req.gang)
+        self._cost_boundary("quota")
 
         # Quota admission gate — BEFORE any filtering and before
         # defrag: an over-quota guarantee pod waits (retryable; quota
@@ -1566,6 +1691,7 @@ class TpuShareScheduler:
                               created_at=pod.created_at)
             return Decision("unschedulable", pod.key, message=why,
                             retryable=True)
+        self._cost_boundary("filter")
 
         # gang anchors are needed twice: anchor NODES must be examined
         # first (sampling must never hide the node the rest of the gang
@@ -1626,6 +1752,7 @@ class TpuShareScheduler:
                 "unschedulable", pod.key,
                 message=rejections.summary() or "no nodes",
             )
+        self._cost_boundary("score")
 
         with maybe_span(self.tracer, "score", pod=pod.key):
             seed_frees = (
@@ -1711,6 +1838,7 @@ class TpuShareScheduler:
                 if runner is not None:
                     rec.runner_node = runner
                     rec.runner_score = runner_raw
+        self._cost_boundary("reserve_permit")
 
         if req.kind == PodKind.REGULAR:
             try:
@@ -2625,6 +2753,30 @@ class TpuShareScheduler:
             samples.append(expfmt.Sample(
                 "tpu_scheduler_wave_phase_seconds_total",
                 {"phase": phase}, self.wave_phase_seconds[phase],
+            ))
+        # sub-phase cost attribution: where the attempts budget goes
+        # BELOW the phase level, plus the per-(tenant, kind, outcome)
+        # split — "which tenants and shapes consume scheduler CPU"
+        for phase in sorted(self.cost_seconds):
+            samples.append(expfmt.Sample(
+                "tpu_scheduler_cost_seconds_total",
+                {"phase": phase}, self.cost_seconds[phase],
+            ))
+        samples.append(expfmt.Sample(
+            "tpu_scheduler_cost_attempts_total", {}, self.cost_attempts,
+        ))
+        # metrics-thread read against scheduling-thread inserts: same
+        # list()-snapshot idiom as the defrag-holds gauge above
+        for (tenant, kind, outcome), (secs, count) in sorted(
+            list(self.cost_by_class.items())
+        ):
+            labels = {"tenant": tenant, "kind": kind,
+                      "outcome": outcome}
+            samples.append(expfmt.Sample(
+                "tpu_scheduler_cost_class_seconds_total", labels, secs,
+            ))
+            samples.append(expfmt.Sample(
+                "tpu_scheduler_cost_class_attempts_total", labels, count,
             ))
         samples += self._wave_size_hist.samples("tpu_scheduler_wave_size")
         # per-tenant quota plane gauges: dominant share, weighted

@@ -57,16 +57,31 @@ class FaultEvent:
     every cluster API verb fail for ``duration`` virtual seconds
     (requires fault injection): scheduling passes fail whole and the
     control plane must degrade and recover, never wedge or leak.
+
+    Perf fault (PR-10): ``hot_path_delay`` injects a WALL-clock
+    slowdown into the engine's scheduling walk — every ``pre_filter``
+    call busy-waits ``duration`` real seconds (default 0.5 ms) from
+    this virtual tick onward. Decisions are untouched (the walk just
+    gets slower), which is precisely the failure mode the
+    cost-attribution sentinel exists to catch: the
+    ``cost-regression`` / ``cost-phase-drift`` alert rules must fire
+    while every outcome-graded invariant stays green
+    (tools/profile_report.py's sentinel gauntlet). A later
+    ``scheduler_crash`` rebuild sheds the wrapper with the rest of
+    the process state.
     """
 
     time: float
     kind: str         # node_down | node_up | pod_kill | node_add |
-                      # node_remove | scheduler_crash | api_flake
+                      # node_remove | scheduler_crash | api_flake |
+                      # hot_path_delay
     target: str = ""
     chips: int = 0    # node_add: chips the new node brings (0 = default)
                       # scheduler_crash: crash after N more binds (0 =
                       # crash between passes, at this tick)
     duration: float = 0.0  # api_flake: seconds the API stays down
+                           # hot_path_delay: WALL seconds burned per
+                           # pre_filter call (0 = 0.0005)
 
 
 @dataclass
@@ -545,6 +560,25 @@ class Simulator:
             if self.injector is None:
                 raise ValueError("api_flake needs inject_faults=True")
             self.injector.start_flake(fault.duration or 30.0)
+            return
+        if fault.kind == "hot_path_delay":
+            # wall-clock perturbation for the cost sentinel: wrap the
+            # live engine's pre_filter in a busy-wait (sleep() has
+            # ~1 ms granularity; a spin burns exactly the injected
+            # cost). Shadows the bound method via the instance attr;
+            # a scheduler_crash rebuild sheds it like any process
+            # state.
+            delay = fault.duration or 0.0005
+            inner = self.engine.pre_filter
+
+            def slow_pre_filter(pod, _inner=inner, _delay=delay,
+                                _perf=_time.perf_counter):
+                t_end = _perf() + _delay
+                while _perf() < t_end:
+                    pass
+                return _inner(pod)
+
+            self.engine.pre_filter = slow_pre_filter
             return
         raise ValueError(f"unknown fault kind {fault.kind!r}")
 

@@ -34,6 +34,19 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     1e-5, 3e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1, 1.0, 3.0, 10.0,
 )
 
+# Pass/wave-level spans cover a whole queue drain: a saturated pass at
+# 1024+ nodes runs past DEFAULT_BUCKETS' 10 s ceiling, piling every
+# observation into +Inf and making pass-latency quantiles unreadable.
+# Same log spacing, shifted up: 1 ms .. 600 s.
+WIDE_BUCKETS: Tuple[float, ...] = (
+    1e-3, 3e-3, 1e-2, 3e-2, 1e-1, 3e-1, 1.0, 3.0, 10.0, 30.0, 100.0,
+    300.0, 600.0,
+)
+
+# span names that get WIDE_BUCKETS by default (phase spans — filter,
+# score, reserve... — keep DEFAULT_BUCKETS)
+PASS_SPANS: Tuple[str, ...] = ("pass", "wave")
+
 
 class Histogram:
     """Fixed-bucket cumulative histogram (Prometheus semantics)."""
@@ -134,11 +147,19 @@ class Tracer:
         clock: Callable[[], float] = time.perf_counter,
         keep_events: bool = True,
         buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+        span_buckets: Optional[Mapping[str, Tuple[float, ...]]] = None,
     ):
         self.clock = clock
         self.keep_events = keep_events
         self.max_events = max_events
         self.buckets = buckets
+        # per-span bucket override: pass-level spans default to
+        # WIDE_BUCKETS (a drain can run minutes), phase spans keep
+        # ``buckets``. Applied when a span's histogram is first
+        # created, so the override must be wired before recording.
+        if span_buckets is None:
+            span_buckets = {name: WIDE_BUCKETS for name in PASS_SPANS}
+        self.span_buckets = dict(span_buckets)
         self._lock = threading.Lock()
         self._events: List[SpanEvent] = []
         self._dropped = 0
@@ -164,7 +185,9 @@ class Tracer:
         with self._lock:
             hist = self.histograms.get(name)
             if hist is None:
-                hist = self.histograms[name] = Histogram(self.buckets)
+                hist = self.histograms[name] = Histogram(
+                    self.span_buckets.get(name, self.buckets)
+                )
             hist.observe(duration)
             if not self.keep_events:
                 return

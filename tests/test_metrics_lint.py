@@ -131,18 +131,27 @@ def scraped(tmp_path_factory):
 
     # the incident plane rides the same exposition: alert-state
     # gauges + fired counters + flight-recorder health, with one rule
-    # actually fired so the counters are nonzero
+    # actually fired so the counters are nonzero. cost_rules on: the
+    # perf sentinel's families must scrape end to end too.
     from kubeshare_tpu.obs import AlertConfig, build_plane
 
     plane = build_plane(lambda: engine, cluster=kube, router=router,
                         tracer=tracer,
-                        config=AlertConfig(eval_interval=0.0))
+                        config=AlertConfig(eval_interval=0.0,
+                                           cost_rules=True))
     plane.tick(clock[0])
     plane.tick(clock[0] + 1.0)
 
+    # the sampling profiler's hub rides the same exposition; one real
+    # (tiny) run so its counters carry values
+    from kubeshare_tpu.obs.profile import ProfilerHub
+
+    hub = ProfilerHub()
+    hub.run_profile(0.1, hz=200)
+
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
                                router=router, cluster=kube,
-                               obs=plane)
+                               obs=plane, profiler=hub)
     metrics.record_pass(0.01, 4)
 
     server = MetricServer(host="127.0.0.1", port=0)
@@ -249,6 +258,15 @@ class TestExpositionHygiene:
             ("tpu_scheduler_incidents_pending", "gauge"),
             ("tpu_scheduler_phase_events", "gauge"),
             ("tpu_scheduler_phase_events_dropped_total", "gauge"),
+            # PR-10: cost-attribution + sampling-profiler families
+            ("tpu_scheduler_cost_seconds_total", "gauge"),
+            ("tpu_scheduler_cost_attempts_total", "gauge"),
+            ("tpu_scheduler_cost_class_seconds_total", "gauge"),
+            ("tpu_scheduler_cost_class_attempts_total", "gauge"),
+            ("tpu_scheduler_profiler_runs_total", "gauge"),
+            ("tpu_scheduler_profiler_samples_total", "gauge"),
+            ("tpu_scheduler_profiler_busy_rejections_total", "gauge"),
+            ("tpu_scheduler_profiler_active", "gauge"),
         ]:
             assert kinds.get(fam) == kind, (fam, kinds.get(fam))
 
@@ -270,7 +288,7 @@ class TestExpositionHygiene:
             "slo-burn-rate", "queue-depth-spike", "ledger-drift",
             "scheduler-restart", "node-capacity-drop",
             "api-error-rate", "watch-reconnect-storm", "degraded",
-            "shed-rate",
+            "shed-rate", "cost-regression", "cost-phase-drift",
         }
         assert set(active) == expected
         assert fired == expected
@@ -373,3 +391,51 @@ class TestExpositionHygiene:
         assert value("tpu_scheduler_watch_reconnects_total") == 2
         assert value("tpu_scheduler_poison_events_total") == 1
         assert value("tpu_scheduler_explain_spool_appends_total") >= 1
+
+    def test_cost_and_profiler_families_have_values(self, scraped):
+        """PR-10: the cost-attribution plane scrapes end to end — the
+        4 attempts the fixture scheduled land attributed seconds per
+        sub-phase and per (tenant, kind, outcome) class, INCLUDING
+        the hostile tenant name on the per-class family (the
+        escaping round-trip the exposition layer must survive), and
+        the profiler hub's one run carries real sample counts."""
+        parsed = expfmt.parse(scraped)
+
+        def select(name, **labels):
+            return [
+                s for s in parsed
+                if s.name == name
+                and all(s.labels.get(k) == v for k, v in labels.items())
+            ]
+
+        phases = {
+            s.labels["phase"]: s.value
+            for s in select("tpu_scheduler_cost_seconds_total")
+        }
+        assert set(phases) == {
+            "parse", "quota", "filter", "score", "reserve_permit",
+            "journal",
+        }
+        assert sum(phases.values()) > 0
+        [attempts] = select("tpu_scheduler_cost_attempts_total")
+        assert attempts.value == 4  # ok, big, bad, weird
+        # per-class attribution sums match the flat counters exactly
+        class_secs = select("tpu_scheduler_cost_class_seconds_total")
+        class_counts = select("tpu_scheduler_cost_class_attempts_total")
+        assert sum(s.value for s in class_counts) == attempts.value
+        assert abs(
+            sum(s.value for s in class_secs) - sum(phases.values())
+        ) <= 1e-6
+        # hostile tenant label round-trips on the per-class family
+        weird = select("tpu_scheduler_cost_class_seconds_total",
+                       tenant=WEIRD_TENANT)
+        assert weird and weird[0].value > 0
+        assert weird[0].labels["outcome"] == "bound"
+        assert weird[0].labels["kind"] == "shared"
+        # profiler hub counters carry the fixture's one real run
+        [runs] = select("tpu_scheduler_profiler_runs_total")
+        assert runs.value == 1
+        [taken] = select("tpu_scheduler_profiler_samples_total")
+        assert taken.value > 0
+        [active] = select("tpu_scheduler_profiler_active")
+        assert active.value == 0

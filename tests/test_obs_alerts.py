@@ -21,9 +21,11 @@ from kubeshare_tpu.obs import (
     IncidentPlane, IncidentStore, WindowSeries,
 )
 from kubeshare_tpu.obs.alerts import (
-    RULE_API_ERRORS, burn_rate_rule, capacity_drop_rule,
+    RULE_API_ERRORS, RULE_COST_REGRESSION, RULE_PHASE_DRIFT,
+    burn_rate_rule, capacity_drop_rule, cost_regression_rule,
     counter_reset_rule, counter_window_rule, degraded_rule,
-    queue_spike_rule, shed_rate_rule,
+    phase_drift_rule, queue_spike_rule, shed_rate_rule,
+    standard_rules,
 )
 from kubeshare_tpu.obs.http import register_obs
 from kubeshare_tpu.utils.httpserv import MetricServer
@@ -340,6 +342,178 @@ class TestSimpleRules:
         for t in range(10):
             ev.evaluate(float(t))
         assert calls["n"] == 1  # only the first tick evaluated
+
+
+# ===================== perf-regression sentinel ======================
+
+
+class _CostFeed:
+    """Synthetic cumulative (seconds, attempts) source."""
+
+    def __init__(self):
+        self.seconds = 0.0
+        self.attempts = 0.0
+
+    def add(self, n, per_attempt_s):
+        self.attempts += n
+        self.seconds += n * per_attempt_s
+
+    def totals(self):
+        return (self.seconds, self.attempts)
+
+
+COST_CFG = AlertConfig(
+    fast_window=60.0, slow_window=300.0,
+    cost_regression_factor=2.5, cost_min_attempts=50,
+)
+
+
+class TestCostSentinel:
+    def _drive(self, rule, feed_steps, dt=10.0):
+        """Evaluate ``rule`` after each feed step, dt apart; returns
+        the evaluator (time continues from 0)."""
+        ev = AlertEvaluator([rule], eval_interval=0.0)
+        t = 0.0
+        for step in feed_steps:
+            step()
+            ev.evaluate(t, force=True)
+            t += dt
+        return ev
+
+    def test_regression_fires_on_sustained_jump_only(self):
+        feed = _CostFeed()
+        rule = cost_regression_rule(feed.totals, COST_CFG)
+        steady = lambda: feed.add(20, 100e-6)  # noqa: E731
+        slowed = lambda: feed.add(20, 500e-6)  # noqa: E731
+        ev = self._drive(rule, [steady] * 60 + [slowed] * 30)
+        st = ev.state(RULE_COST_REGRESSION)
+        assert st.active and st.fired_total == 1
+        assert st.last_context["per_attempt_us"] > 400
+
+    def test_regression_quiet_on_steady_and_single_stall(self):
+        """One 50ms stall (a GC pause) blows up the fast window but
+        barely moves the slow one — min(fast, slow) stays under the
+        factor and nothing pages."""
+        feed = _CostFeed()
+        rule = cost_regression_rule(feed.totals, COST_CFG)
+        steps = [lambda: feed.add(20, 100e-6)] * 60
+        steps.append(lambda: (feed.add(20, 100e-6), feed.add(1, 0.05)))
+        steps += [lambda: feed.add(20, 100e-6)] * 30
+        ev = self._drive(rule, steps)
+        st = ev.state(RULE_COST_REGRESSION)
+        assert not st.active and st.fired_total == 0
+
+    def test_regression_baseline_frozen_while_hot(self):
+        """A sustained regression must not be EWMA-absorbed as the
+        new normal: 300 further seconds at 5x, the level still holds
+        at or past the factor."""
+        feed = _CostFeed()
+        rule = cost_regression_rule(feed.totals, COST_CFG)
+        ev = self._drive(
+            rule,
+            [lambda: feed.add(20, 100e-6)] * 60
+            + [lambda: feed.add(20, 500e-6)] * 60,
+        )
+        st = ev.state(RULE_COST_REGRESSION)
+        assert st.active
+        assert st.last_level >= COST_CFG.cost_regression_factor
+
+    def test_regression_counter_reset_tolerated(self):
+        """An engine rebuild zeroes the counters: the history clears,
+        no verdict (and certainly no fire) until fresh windows fill."""
+        feed = _CostFeed()
+        rule = cost_regression_rule(feed.totals, COST_CFG)
+        steps = [lambda: feed.add(20, 100e-6)] * 60
+
+        def crash():
+            feed.seconds = 0.0
+            feed.attempts = 0.0
+
+        steps.append(crash)
+        steps += [lambda: feed.add(20, 100e-6)] * 30
+        ev = self._drive(rule, steps)
+        st = ev.state(RULE_COST_REGRESSION)
+        assert not st.active and st.fired_total == 0
+
+    def test_regression_min_attempts_gate(self):
+        feed = _CostFeed()
+        rule = cost_regression_rule(feed.totals, COST_CFG)
+        # 2 attempts per step: fast window holds 12 << 50 -> never a
+        # verdict, even at 100x cost
+        ev = self._drive(
+            rule,
+            [lambda: feed.add(2, 100e-6)] * 40
+            + [lambda: feed.add(2, 10e-3)] * 40,
+        )
+        st = ev.state(RULE_COST_REGRESSION)
+        assert not st.active and st.last_level == 0.0
+
+    def test_phase_drift_fires_on_share_flip(self):
+        phases = {"filter": 0.0, "score": 0.0}
+
+        def grow(f, s):
+            phases["filter"] += f
+            phases["score"] += s
+
+        rule = phase_drift_rule(lambda: dict(phases), COST_CFG)
+        ev = self._drive(
+            rule,
+            [lambda: grow(0.008, 0.002)] * 60   # shares 0.8 / 0.2
+            + [lambda: grow(0.002, 0.008)] * 30,  # flip
+        )
+        st = ev.state(RULE_PHASE_DRIFT)
+        assert st.active and st.fired_total == 1
+        assert st.last_context["phase"] in ("filter", "score")
+
+    def test_phase_drift_quiet_on_steady_mix(self):
+        phases = {"filter": 0.0, "score": 0.0}
+
+        def grow():
+            phases["filter"] += 0.008
+            phases["score"] += 0.002
+
+        rule = phase_drift_rule(lambda: dict(phases), COST_CFG)
+        ev = self._drive(rule, [grow] * 90)
+        st = ev.state(RULE_PHASE_DRIFT)
+        assert not st.active and st.fired_total == 0
+
+    def test_phase_drift_min_seconds_gate_and_reset(self):
+        phases = {"filter": 0.0}
+        rule = phase_drift_rule(lambda: dict(phases), COST_CFG)
+
+        def tiny():
+            phases["filter"] += 1e-5  # slow window << min seconds
+
+        ev = self._drive(rule, [tiny] * 60)
+        assert ev.state(RULE_PHASE_DRIFT).last_level == 0.0
+        # counters moving backward clear the series, no crash
+        phases["filter"] = 0.0
+        ev.evaluate(1e6, force=True)
+        assert not ev.state(RULE_PHASE_DRIFT).active
+
+    def test_standard_rules_cost_opt_in(self):
+        class _Journal:
+            def wait_slo_totals(self, s):
+                return (0, 0)
+
+            def queue_depths(self):
+                return {}
+
+        class _Engine:
+            explain = _Journal()
+
+            def ledger_drift(self):
+                return {}
+
+        names_off = {r.name for r in standard_rules(lambda: _Engine())}
+        names_on = {
+            r.name for r in standard_rules(
+                lambda: _Engine(), cfg=AlertConfig(cost_rules=True)
+            )
+        }
+        assert RULE_COST_REGRESSION not in names_off
+        assert RULE_PHASE_DRIFT not in names_off
+        assert {RULE_COST_REGRESSION, RULE_PHASE_DRIFT} <= names_on
 
 
 # ===================== flight recorder ===============================

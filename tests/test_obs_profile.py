@@ -1,0 +1,236 @@
+"""Sampling-profiler units: folded-stack capture of a known-busy
+thread, bounded stack-table overflow accounting, the collapsed /
+Chrome-trace export contracts, the ProfilerHub's one-at-a-time gate,
+and the /profile HTTP endpoint (folded, chrome, json, 400/409) plus
+the `python -m kubeshare_tpu profile --local` CLI."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeshare_tpu.obs.profile import (
+    OVERFLOW_STACK, ProfilerBusy, ProfilerHub, SamplingProfiler,
+    profile, profile_handler, register_profile,
+)
+from kubeshare_tpu.utils.httpserv import MetricServer
+
+
+def _burn(stop):
+    """A worker with a recognizable frame to find in profiles."""
+    while not stop.is_set():
+        sum(i * i for i in range(200))
+
+
+class TestSamplingProfiler:
+    def test_captures_known_busy_thread(self):
+        stop = threading.Event()
+        worker = threading.Thread(target=_burn, args=(stop,))
+        worker.start()
+        try:
+            prof = profile(0.4, hz=200)
+        finally:
+            stop.set()
+            worker.join()
+        assert prof.samples_taken > 10
+        assert not prof.running
+        text = prof.collapsed()
+        assert "_burn" in text
+        # folded format: every line is "frame;frame... count"
+        for line in text.splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and int(count) > 0
+
+    def test_sampler_excludes_itself(self):
+        prof = profile(0.2, hz=200)
+        assert "kubeshare-profiler" not in prof.collapsed()
+        assert all(
+            "profile.py:_run" not in ";".join(stack)
+            for stack in prof.stacks()
+        )
+
+    def test_bounded_stack_table_overflows_visibly(self):
+        prof = SamplingProfiler(hz=100, max_stacks=2)
+        # drive the real fold path with synthetic sweeps
+        # (deterministic, no timing): 5 novel stacks into a 2-slot
+        # table -> 2 kept, 3 folded into the overflow bucket
+        prof._fold([(f"f{i}",) for i in range(5)])
+        assert len(prof.stacks()) == 3  # 2 distinct + overflow bucket
+        assert prof.stacks_overflowed == 3
+        assert prof.stacks()[OVERFLOW_STACK] == 3
+        assert prof.stacks_recorded == 5
+        assert "[stack table full]" in prof.collapsed()
+        # known stacks keep folding into their own slots afterwards
+        prof._fold([("f0",), ("f9",)])
+        assert prof.stacks()[("f0",)] == 2
+        assert prof.stacks()[OVERFLOW_STACK] == 4
+
+    def test_max_depth_bounds_stacks(self):
+        def recurse(n, stop):
+            if n > 0:
+                return recurse(n - 1, stop)
+            stop.wait(0.5)
+            return 0
+
+        stop = threading.Event()
+        worker = threading.Thread(target=recurse, args=(200, stop))
+        worker.start()
+        try:
+            prof = profile(0.2, hz=200, max_depth=16)
+        finally:
+            stop.set()
+            worker.join()
+        assert prof.stacks()
+        assert all(len(s) <= 16 for s in prof.stacks())
+
+    def test_chrome_trace_widths_proportional(self):
+        prof = SamplingProfiler(hz=100)
+        with prof._lock:
+            prof._stacks[("a", "b")] = 30
+            prof._stacks[("a", "c")] = 10
+        doc = prof.chrome_trace()
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(spans) == 2
+        by_stack = {e["args"]["stack"]: e for e in spans}
+        # dur = samples x period (10ms at 100 Hz), heaviest first
+        assert by_stack["a;b"]["dur"] == pytest.approx(30 * 1e4)
+        assert by_stack["a;c"]["dur"] == pytest.approx(10 * 1e4)
+        assert spans[0]["args"]["samples"] == 30
+        assert json.dumps(doc)  # serializable whole
+
+    def test_hz_validation(self):
+        with pytest.raises(ValueError):
+            SamplingProfiler(hz=0)
+        with pytest.raises(ValueError):
+            SamplingProfiler(hz=99999)
+
+
+class TestProfilerHub:
+    def test_run_counts_and_limits(self):
+        hub = ProfilerHub(max_seconds=1.0)
+        prof = hub.run_profile(0.1, hz=200)
+        assert hub.runs_total == 1
+        assert hub.samples_total == prof.samples_taken > 0
+        with pytest.raises(ValueError):
+            hub.run_profile(5.0)  # past max_seconds
+        names = {s.name for s in hub.samples()}
+        assert names == {
+            "tpu_scheduler_profiler_runs_total",
+            "tpu_scheduler_profiler_samples_total",
+            "tpu_scheduler_profiler_busy_rejections_total",
+            "tpu_scheduler_profiler_active",
+        }
+
+    def test_one_at_a_time(self):
+        hub = ProfilerHub()
+        results = {}
+
+        def long_run():
+            results["prof"] = hub.run_profile(0.5, hz=100)
+
+        t = threading.Thread(target=long_run)
+        t.start()
+        time.sleep(0.1)
+        assert hub.active
+        with pytest.raises(ProfilerBusy):
+            hub.run_profile(0.1)
+        t.join()
+        assert hub.busy_rejections == 1
+        assert not hub.active
+
+
+class TestProfileEndpoint:
+    @pytest.fixture()
+    def server(self):
+        hub = ProfilerHub()
+        server = MetricServer(host="127.0.0.1", port=0)
+        register_profile(server, hub)
+        server.start()
+        yield server, hub
+        server.stop()
+
+    def _get(self, server, query):
+        url = f"http://127.0.0.1:{server.port}/profile?{query}"
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, resp.headers["Content-Type"], \
+                resp.read().decode()
+
+    def test_folded_chrome_and_json_forms(self, server):
+        server, hub = server
+        status, ctype, body = self._get(server, "seconds=0.1&hz=200")
+        assert status == 200 and ctype.startswith("text/plain")
+        status, ctype, body = self._get(
+            server, "seconds=0.1&hz=200&format=chrome"
+        )
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert "traceEvents" in doc
+        status, _, body = self._get(
+            server, "seconds=0.1&hz=200&format=json"
+        )
+        doc = json.loads(body)
+        assert doc["samples"] > 0 and "stacks" in doc
+        assert hub.runs_total == 3
+
+    def test_bad_params_400(self, server):
+        server, _ = server
+        for query in ("seconds=999", "seconds=nan_is_not_a_number",
+                      "format=flame", "hz=0", "seconds=-1"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._get(server, query)
+            assert e.value.code == 400
+
+    def test_busy_409(self, server):
+        server, hub = server
+
+        def long_req():
+            self._get(server, "seconds=0.6&hz=100")
+
+        t = threading.Thread(target=long_req)
+        t.start()
+        time.sleep(0.15)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._get(server, "seconds=0.1")
+        assert e.value.code == 409
+        t.join()
+        assert hub.busy_rejections == 1
+
+
+class TestProfileCli:
+    def test_local_folded(self, capsys):
+        from kubeshare_tpu.cmd.profile import main
+
+        assert main(["--local", "--seconds", "0.2", "--hz", "200"]) == 0
+        out = capsys.readouterr().out
+        assert out.strip()
+        stack, _, count = out.splitlines()[0].rpartition(" ")
+        assert stack and int(count) > 0
+
+    def test_local_json_and_out(self, tmp_path, capsys):
+        from kubeshare_tpu.cmd.profile import main
+
+        out_path = tmp_path / "prof.json"
+        assert main([
+            "--local", "--seconds", "0.2", "--hz", "200",
+            "--format", "json", "--out", str(out_path),
+        ]) == 0
+        doc = json.loads(out_path.read_text())
+        assert doc["samples"] > 0
+
+    def test_local_top_summary(self, capsys):
+        from kubeshare_tpu.cmd.profile import main
+
+        assert main(["--local", "--seconds", "0.2", "--hz", "200",
+                     "--top", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "total samples" in out
+
+    def test_unreachable_server_exit_code(self, capsys):
+        from kubeshare_tpu.cmd.profile import main
+
+        assert main(["--url", "http://127.0.0.1:9",
+                     "--seconds", "0.05"]) == 1
+        assert "cannot reach" in capsys.readouterr().err

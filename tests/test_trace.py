@@ -11,7 +11,8 @@ from kubeshare_tpu.scheduler import constants as C
 from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
 from kubeshare_tpu.utils import expfmt
 from kubeshare_tpu.utils.trace import (
-    DEFAULT_BUCKETS, Histogram, Tracer, maybe_span,
+    DEFAULT_BUCKETS, Histogram, PASS_SPANS, Tracer, WIDE_BUCKETS,
+    maybe_span,
 )
 
 GIB = 1 << 30
@@ -69,6 +70,40 @@ class TestHistogram:
         h = Histogram(buckets=(1.0,))
         h.observe(100.0)
         assert h.quantile(0.5) == float("inf")
+
+    def test_quantile_empty_all_q(self):
+        h = Histogram()
+        for q in (0.0, 0.5, 1.0):
+            assert h.quantile(q) == 0.0
+
+    def test_quantile_all_overflow(self):
+        """Every observation past the last bound: any q >= the first
+        sample's mass resolves to +Inf, and the +Inf bucket carries
+        the whole count."""
+        h = Histogram(buckets=(0.001, 0.01))
+        for _ in range(10):
+            h.observe(5.0)
+        assert h.quantile(0.5) == float("inf")
+        assert h.quantile(1.0) == float("inf")
+        by_le = {
+            s.labels["le"]: s.value
+            for s in h.samples("x") if s.name == "x_bucket"
+        }
+        assert by_le["+Inf"] == 10
+        assert by_le[repr(0.001)] == 0
+
+    def test_quantile_q_zero_and_one(self):
+        """q=0 is the smallest bucket bound (target mass 0 is met by
+        the first bucket); q=1 is the bound covering EVERY sample —
+        finite when nothing overflowed, +Inf once anything did."""
+        h = Histogram(buckets=(0.001, 0.01, 0.1))
+        for _ in range(5):
+            h.observe(0.005)
+        assert h.quantile(0.0) == 0.001
+        assert h.quantile(1.0) == 0.01
+        h.observe(99.0)  # one overflow sample moves q=1 to +Inf
+        assert h.quantile(1.0) == float("inf")
+        assert h.quantile(0.5) == 0.01
 
 
 class TestTracer:
@@ -161,6 +196,101 @@ class TestTracer:
 
     def test_default_buckets_sorted(self):
         assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+        assert list(WIDE_BUCKETS) == sorted(WIDE_BUCKETS)
+
+    def test_pass_spans_get_wide_buckets(self):
+        """A 25s pass at 1024 nodes used to fall into DEFAULT_BUCKETS'
+        +Inf (quantiles unreadable); pass-level spans now carry
+        WIDE_BUCKETS while phase spans keep the 10us..10s set."""
+        t = Tracer(keep_events=False)
+        t.record("pass", 0.0, 25.0)    # past the old 10s ceiling
+        t.record("filter", 0.0, 0.001)
+        assert t.histograms["pass"].buckets == WIDE_BUCKETS
+        assert t.histograms["filter"].buckets == DEFAULT_BUCKETS
+        assert t.histograms["pass"].quantile(0.5) == 30.0  # finite!
+        for name in PASS_SPANS:
+            assert name in t.span_buckets
+
+    def test_span_buckets_override(self):
+        t = Tracer(keep_events=False,
+                   span_buckets={"custom": (1.0, 2.0)})
+        t.record("custom", 0.0, 1.5)
+        t.record("pass", 0.0, 25.0)  # explicit map replaces defaults
+        assert t.histograms["custom"].buckets == (1.0, 2.0)
+        assert t.histograms["pass"].buckets == DEFAULT_BUCKETS
+
+    def test_concurrent_record_vs_metric_samples_consistent(self):
+        """metric_samples renders under the tracer lock: every scrape
+        must be internally consistent — per family, the +Inf bucket
+        equals _count and the cumulative buckets never decrease —
+        even while writer threads hammer observe()."""
+        t = Tracer(keep_events=False)
+        stop = threading.Event()
+
+        def work():
+            while not stop.is_set():
+                for name in ("a", "b"):
+                    t.record(name, 0.0, 0.005)
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for th in threads:
+            th.start()
+        try:
+            for _ in range(200):
+                samples = t.metric_samples()
+                counts = {}
+                infs = {}
+                buckets = {}
+                for s in samples:
+                    if s.name.endswith("_seconds_count"):
+                        counts[s.name[:-len("_count")]] = s.value
+                    elif s.name.endswith("_seconds_bucket"):
+                        buckets.setdefault(s.name, []).append(s.value)
+                        if s.labels["le"] == "+Inf":
+                            infs[s.name[:-len("_bucket")]] = s.value
+                for fam, count in counts.items():
+                    assert infs.get(fam) == count, fam
+                for fam, values in buckets.items():
+                    assert values == sorted(values), fam
+        finally:
+            stop.set()
+            for th in threads:
+                th.join()
+
+    def test_chrome_trace_max_events_drop_arithmetic(self):
+        """max_events keeps the NEWEST spans; the dropped marker
+        counts ring evictions + export trims exactly."""
+        t = Tracer(max_events=100)
+        for i in range(30):
+            t.record("e", 0.0, 0.001, {"i": i})
+        doc = t.chrome_trace(max_events=10)
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        markers = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert len(spans) == 10
+        # the newest 10 survive, oldest first
+        assert [s["args"]["i"] for s in spans] == [
+            str(i) for i in range(20, 30)
+        ]
+        assert len(markers) == 1 and "20 earlier spans" in markers[0]["name"]
+        # export trimming is read-only: a full export still sees all 30
+        full = t.chrome_trace()
+        assert len([e for e in full["traceEvents"] if e["ph"] == "X"]) == 30
+        assert not [e for e in full["traceEvents"] if e["ph"] == "i"]
+
+    def test_chrome_trace_max_events_with_ring_drops(self):
+        """Ring evictions and export trims add up in the marker: a
+        10-slot ring fed 25 spans evicts 15 (drop-half at each
+        overflow); exporting the newest 4 trims 6 more."""
+        t = Tracer(max_events=10)
+        for i in range(25):
+            t.record("e", 0.0, 0.001, {"i": i})
+        assert len(t.events()) == 10
+        doc = t.chrome_trace(max_events=4)
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        [marker] = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+        assert len(spans) == 4
+        assert spans[-1]["args"]["i"] == "24"
+        assert "21 earlier spans dropped" in marker["name"]
 
 
 class TestSchedulerIntegration:
@@ -205,3 +335,29 @@ class TestSchedulerIntegration:
         assert sched.schedule_one(
             cluster.create_pod(tpu_pod("p1"))
         ).status == "bound"
+
+    def test_cost_attribution_covers_bound_and_raising_attempts(self):
+        """PR-10 coverage invariant: class totals == phase totals even
+        when a verb RAISES mid-attempt (outcome "error") — a skipped
+        attribution would leave the class family permanently under
+        the phase family after an API outage."""
+        import pytest
+
+        cluster, sched = self._env(None)
+        sched.schedule_one(cluster.create_pod(tpu_pod("p1")))
+        pod = cluster.create_pod(tpu_pod("p2"))
+
+        def boom(*args, **kwargs):
+            raise RuntimeError("api away")
+
+        cluster.bind = boom
+        with pytest.raises(RuntimeError):
+            sched.schedule_one(pod)
+        assert sched.cost_attempts == 2
+        outcomes = {key[2] for key in sched.cost_by_class}
+        assert outcomes == {"bound", "error"}
+        class_total = sum(v[0] for v in sched.cost_by_class.values())
+        class_attempts = sum(v[1] for v in sched.cost_by_class.values())
+        phase_total = sum(sched.cost_seconds.values())
+        assert class_attempts == 2
+        assert abs(class_total - phase_total) < 1e-6

@@ -1,0 +1,396 @@
+#!/usr/bin/env python
+"""Cost-attribution & continuous-profiling evidence -> PROFILE.json.
+
+Three sections, each a hard invariant the committed artifact must hold
+(tests/test_profile_report.py pins them and re-runs scaled-down live):
+
+- **attribution** — the idle trace at 32/256/1024 nodes with the
+  engine's sub-phase cost accumulators on: per-phase seconds
+  (parse / quota / filter / score / reserve_permit / journal), the
+  per-(tenant, kind, outcome) class split, and the coverage ratios —
+  sub-phase sums and class sums must each land within 5% of the
+  wave driver's ``attempts`` wall total, or the attribution is
+  missing (or double-counting) real work. This turns ROADMAP's
+  "~80% of wall is the attempts phase, bound by Python per-candidate
+  probes" from a one-off observation into a tracked artifact the
+  vectorized-hot-path work will be graded against.
+- **sampler_ab** — the stdlib sampling profiler's overhead at 1024
+  nodes, measured with PR-9's paired-ratio protocol (each rep runs
+  profiler-off and profiler-on back to back; the headline is the
+  MEDIAN of per-rep ratios, so minutes-scale CI drift cancels).
+  Floor: median overhead <= 3%.
+- **sentinel** — the perf-regression gauntlet: one multi-tenant trace
+  replayed fault-free (the cost rules must stay silent — zero false
+  positives) and with an injected ``hot_path_delay`` (every
+  pre_filter call busy-waits 0.4ms of wall time; decisions are
+  untouched). The ``cost-regression`` and ``cost-phase-drift`` rules
+  must fire on the slowdown — and nothing else may — with the
+  flight-recorder bundle embedding the cost-attribution snapshot.
+
+Regenerate: ``make profile-report``.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from kubeshare_tpu.explain.spool import JournalSpool  # noqa: E402
+from kubeshare_tpu.obs import (  # noqa: E402
+    AlertConfig, RULE_COST_REGRESSION, RULE_PHASE_DRIFT, build_plane,
+)
+from kubeshare_tpu.obs.profile import SamplingProfiler  # noqa: E402
+from kubeshare_tpu.sim.simulator import FaultEvent, Simulator  # noqa: E402
+from kubeshare_tpu.sim.trace import generate_trace  # noqa: E402
+
+CHIPS_PER_NODE = 4
+EVENTS = 2000
+ATTRIB_NODES = (32, 256, 1024)
+AB_NODES = 1024
+OUT = os.path.join(REPO, "PROFILE.json")
+
+EXPECTED_SENTINEL_RULES = frozenset({RULE_COST_REGRESSION,
+                                     RULE_PHASE_DRIFT})
+
+
+def idle_topology(n_nodes: int) -> dict:
+    return {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": CHIPS_PER_NODE,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": f"node-{i:03d}"}
+            for i in range(n_nodes)
+        ],
+    }
+
+
+def _run_idle(n_nodes: int, trace, profiler_hz: float = 0.0):
+    """One idle replay; optionally with the sampling profiler running
+    for the whole replay (the continuous-profiling configuration).
+    Returns (sim, report, wall_seconds, profiler_or_None)."""
+    sim = Simulator(
+        idle_topology(n_nodes),
+        {f"node-{i:03d}": CHIPS_PER_NODE for i in range(n_nodes)},
+        seed=0,
+    )
+    prof = None
+    if profiler_hz > 0:
+        prof = SamplingProfiler(hz=profiler_hz).start()
+    wall0 = time.perf_counter()
+    report = sim.run(list(trace))
+    wall = time.perf_counter() - wall0
+    if prof is not None:
+        prof.stop()
+    return sim, report, wall, prof
+
+
+def attribution_row(n_nodes: int, events: int = EVENTS,
+                    reps: int = 2) -> dict:
+    """Sub-phase + per-class attribution at one scale; best-of-reps
+    by wall (noisy-neighbor defense), coverage from that rep."""
+    trace = generate_trace(count=events, seed=0)
+    best = None
+    for _ in range(max(1, reps)):
+        sim, report, wall, _ = _run_idle(n_nodes, trace)
+        if best is None or wall < best[2]:
+            best = (sim, report, wall)
+    sim, report, wall = best
+    engine = sim.engine
+    attempts_wall = engine.wave_phase_seconds["attempts"]
+    phase_sum = sum(engine.cost_seconds.values())
+    class_sum = sum(v[0] for v in engine.cost_by_class.values())
+    class_attempts = sum(v[1] for v in engine.cost_by_class.values())
+    return {
+        "nodes": n_nodes,
+        "events": events,
+        "bound": report.bound,
+        "wall_seconds": round(wall, 3),
+        "attempts_phase_seconds": round(attempts_wall, 4),
+        "cost_seconds": {
+            phase: round(seconds, 4)
+            for phase, seconds in sorted(engine.cost_seconds.items())
+        },
+        "cost_shares": {
+            phase: round(seconds / phase_sum, 4) if phase_sum else 0.0
+            for phase, seconds in sorted(engine.cost_seconds.items())
+        },
+        "cost_attempts": engine.cost_attempts,
+        "phase_sum_seconds": round(phase_sum, 4),
+        "class_sum_seconds": round(class_sum, 4),
+        # the 5%-band invariants: attributed time vs the wave
+        # driver's independent attempts stopwatch
+        "phase_coverage": round(phase_sum / attempts_wall, 4)
+        if attempts_wall else 0.0,
+        "class_coverage": round(class_sum / attempts_wall, 4)
+        if attempts_wall else 0.0,
+        "class_attempts_match": class_attempts == engine.cost_attempts,
+        "top_classes": engine.cost_attribution(top=6)["classes"],
+    }
+
+
+def sampler_ab(reps: int = 7, hz: float = 67.0) -> dict:
+    """Profiler-on vs profiler-off at 1024 nodes, PAIRED per rep (the
+    journal_ab protocol): overhead is the median of per-rep ratios.
+    Two refinements over journal_ab, both noise defenses for an
+    effect this small: arms run 2x the idle event count (short arms
+    make one GC pause worth more than the sampler), and the within-
+    rep arm ORDER alternates so linear box drift biases half the
+    reps each way and the median cancels it."""
+    trace = generate_trace(count=2 * EVENTS, seed=0)
+    pairs = []
+    best = {}
+    for i in range(max(1, reps)):
+        rep = {}
+        arms = (("off", 0.0), ("on", hz))
+        for key, prof_hz in (arms if i % 2 == 0 else arms[::-1]):
+            sim, report, wall, prof = _run_idle(
+                AB_NODES, trace, profiler_hz=prof_hz
+            )
+            rate = report.bound / wall
+            rep[key] = rate
+            row = {"placements_per_sec": round(rate, 1),
+                   "wall_seconds": round(wall, 3)}
+            if prof is not None:
+                row["profiler_samples"] = prof.samples_taken
+                row["distinct_stacks"] = len(prof.stacks())
+            if key not in best or wall < best[key]["wall_seconds"]:
+                best[key] = row
+        pairs.append(100.0 * (rep["off"] - rep["on"]) / rep["off"])
+    pairs.sort()
+    median = pairs[len(pairs) // 2] if len(pairs) % 2 else (
+        (pairs[len(pairs) // 2 - 1] + pairs[len(pairs) // 2]) / 2
+    )
+    return {
+        "nodes": AB_NODES,
+        "hz": hz,
+        "profiler_off": best["off"],
+        "profiler_on": best["on"],
+        "overhead_pct": round(median, 1),
+        "overhead_pct_per_rep": [round(p, 1) for p in pairs],
+    }
+
+
+def run_sentinel(slowdown: bool, n_nodes: int = 48,
+                 trace_count: int = 1500, horizon: float = 900.0,
+                 seed: int = 3, delay_s: float = 0.001,
+                 spool_dir: str = "") -> dict:
+    """One sentinel-gauntlet replay: a STATIONARY Poisson trace (the
+    traffic shape the sentinel models — the cost rules are opt-in
+    precisely because a saturating burst legitimately rewrites the
+    cost mix) with the alert plane's cost rules armed, fault-free or
+    with a hot_path_delay injected at 40% of the horizon. Light load
+    (~40% occupancy): every pod binds promptly, so the only thing
+    that can move the cost surface is the injected slowdown."""
+    onset = horizon * 0.4 if slowdown else None
+    faults = (
+        [FaultEvent(onset, "hot_path_delay", duration=delay_s)]
+        if slowdown else []
+    )
+    # arrivals span ~85% of the horizon: the slow window needs a full
+    # post-onset ramp of slowed attempts to cross the burn factor —
+    # a trace that dries up right after onset starves the verdict
+    events = generate_trace(
+        count=trace_count, seed=seed,
+        mean_interarrival=horizon * 0.85 / trace_count,
+        mean_runtime=30.0,
+    )
+    own_tmp = None
+    if not spool_dir:
+        own_tmp = tempfile.TemporaryDirectory(prefix="profile-spool-")
+        spool_dir = own_tmp.name
+    name = "slowdown" if slowdown else "baseline"
+    spool = JournalSpool(
+        os.path.join(spool_dir, f"incidents-{name}.jsonl"),
+        max_bytes=4 << 20, max_files=2,
+        kind="incident", key_field="id",
+    )
+    sim = Simulator(
+        idle_topology(n_nodes),
+        {f"node-{i:03d}": CHIPS_PER_NODE for i in range(n_nodes)},
+        seed=seed,
+    )
+    cfg = AlertConfig(
+        eval_interval=2.0,
+        fast_window=horizon * 0.08,
+        slow_window=horizon * 0.3,
+        cost_rules=True,
+    )
+    plane = build_plane(
+        lambda: sim.engine, config=cfg, spool=spool,
+        ring=120, post_snapshots=3, min_interval=60.0, max_bundles=32,
+    )
+    sim.obs_plane = plane
+    report = sim.run(list(events), horizon=horizon, faults=list(faults))
+    plane.flush(sim.clock_now)
+
+    evaluator = plane.evaluator
+    fired = {
+        rule.name: evaluator.state(rule.name).fired_total
+        for rule in evaluator.rules
+        if evaluator.state(rule.name).fired_total
+    }
+    bundles = [plane.incident(s["id"]) for s in plane.incidents()]
+    bundles = [b for b in bundles if b is not None]
+    spool.close()
+    if own_tmp is not None:
+        own_tmp.cleanup()
+
+    expected = EXPECTED_SENTINEL_RULES if slowdown else frozenset()
+    matching = [b for b in bundles if b["rule"] in expected]
+    pre_ok = bool(matching) and all(
+        b["pre"] and b["pre"][0]["t"] <= onset <= b["at"]
+        for b in matching
+    ) if onset is not None else None
+    return {
+        "scenario": name,
+        "nodes": n_nodes,
+        "horizon_s": horizon,
+        "trace_events": len(events),
+        "fault_onset_s": onset,
+        "delay_per_call_s": delay_s if slowdown else 0.0,
+        "expected_rules": sorted(expected),
+        "alerts_fired": fired,
+        "alert_evaluations": evaluator.evaluations,
+        "rule_errors": evaluator.rule_errors,
+        "incidents": [
+            {
+                "id": b["id"], "rule": b["rule"], "at": b["at"],
+                "level": b["level"], "context": b.get("context") or {},
+                "has_cost_attribution":
+                    bool(b.get("cost_attribution")),
+            }
+            for b in bundles
+        ],
+        "report": {
+            "submitted": report.submitted,
+            "bound": report.bound,
+            "completed": report.completed,
+        },
+        "verdict": {
+            "fired_exactly_expected": set(fired) == set(expected),
+            "expected_bundle_written": (
+                bool(matching) if expected else not bundles
+            ),
+            "pre_window_contains_onset": pre_ok,
+            "bundles_embed_attribution": all(
+                b.get("cost_attribution") for b in matching
+            ) if matching else (None if expected else True),
+        },
+    }
+
+
+def failed_invariants(doc: dict):
+    bad = []
+    for row in doc["attribution"]:
+        for key in ("phase_coverage", "class_coverage"):
+            if not 0.95 <= row[key] <= 1.05:
+                bad.append(
+                    f"{row['nodes']} nodes: {key}={row[key]} outside "
+                    f"[0.95, 1.05]"
+                )
+        if not row["class_attempts_match"]:
+            bad.append(f"{row['nodes']} nodes: class attempts != total")
+    if doc["sampler_ab"]["overhead_pct"] > 3.0:
+        bad.append(
+            f"sampler overhead {doc['sampler_ab']['overhead_pct']}% > 3%"
+        )
+    for row in doc["sentinel"].values():
+        for key, ok in row["verdict"].items():
+            if ok is False:
+                bad.append(f"sentinel {row['scenario']}: {key}")
+        if row["rule_errors"]:
+            bad.append(
+                f"sentinel {row['scenario']}: {row['rule_errors']} "
+                f"rule errors"
+            )
+    return bad
+
+
+def main() -> int:
+    attribution = [attribution_row(n) for n in ATTRIB_NODES]
+    for row in attribution:
+        print(
+            f"attribution {row['nodes']:4d} nodes: "
+            f"attempts={row['attempts_phase_seconds']:.3f}s "
+            f"phase-cov={row['phase_coverage']:.3f} "
+            f"class-cov={row['class_coverage']:.3f} "
+            f"shares={row['cost_shares']}",
+            file=sys.stderr,
+        )
+    ab = sampler_ab()
+    print(
+        f"sampler A/B @{ab['nodes']}: off "
+        f"{ab['profiler_off']['placements_per_sec']:,.0f}/s, on "
+        f"{ab['profiler_on']['placements_per_sec']:,.0f}/s "
+        f"({ab['overhead_pct']}% median paired overhead)",
+        file=sys.stderr,
+    )
+    sentinel = {
+        name: run_sentinel(slowdown)
+        for name, slowdown in (("baseline", False), ("slowdown", True))
+    }
+    for name, row in sentinel.items():
+        print(
+            f"sentinel {name:9} fired={row['alerts_fired'] or '{}'} "
+            f"verdict="
+            f"{'OK' if all(v is not False for v in row['verdict'].values()) else 'FAIL'}",
+            file=sys.stderr,
+        )
+
+    doc = {
+        "generated_by": "tools/profile_report.py",
+        "note": "cost-attribution & profiling evidence: idle-trace "
+                "sub-phase/per-class attribution vs the wave "
+                "driver's independent attempts stopwatch (coverage "
+                "pinned to the 5% band), sampling-profiler overhead "
+                "via the paired-ratio A/B protocol (median of "
+                "per-rep on/off ratios, <= 3%), and the "
+                "perf-regression sentinel gauntlet (cost rules "
+                "silent fault-free, firing exactly on an injected "
+                "hot-path slowdown with the attribution snapshot "
+                "embedded in the bundle). Pinned by "
+                "tests/test_profile_report.py, which also replays "
+                "scaled-down attribution + sentinel runs live.",
+        "attribution": attribution,
+        "sampler_ab": ab,
+        "sentinel": sentinel,
+    }
+    bad = failed_invariants(doc)
+    doc["invariants"] = {
+        "attribution_within_5pct": not any("coverage" in b for b in bad),
+        "sampler_overhead_within_3pct": ab["overhead_pct"] <= 3.0,
+        "sentinel_baseline_quiet":
+            not sentinel["baseline"]["alerts_fired"],
+        "sentinel_slowdown_classified":
+            sentinel["slowdown"]["verdict"]["fired_exactly_expected"]
+            and sentinel["slowdown"]["verdict"]["expected_bundle_written"],
+        "all_green": not bad,
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(f"wrote {OUT}", file=sys.stderr)
+    if bad:
+        print("INVARIANTS FAILED: " + "; ".join(bad), file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "artifact": os.path.relpath(OUT, REPO),
+        "sampler_overhead_pct": ab["overhead_pct"],
+        "all_invariants_green": True,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
