@@ -61,6 +61,13 @@ chaos-sim:
 incident-report:
 	$(PYTHON) tools/incident_report.py
 
+# sharded multi-scheduler A/B -> MULTISCHED.json (modeled N-way
+# makespan at 1024 nodes, paired-ratio speedups, per-row conflict
+# rate + commit-latency percentiles + zero-double-bind/ledger-drift
+# invariants, and the serializability differential witness)
+multisched-bench:
+	$(PYTHON) tools/multisched_bench.py
+
 # cost-attribution & profiling evidence -> PROFILE.json (sub-phase +
 # per-class attribution at 32/256/1024 nodes within the 5% coverage
 # band, sampling-profiler overhead <= 3% via the paired-ratio A/B,

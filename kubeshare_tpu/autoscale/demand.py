@@ -41,6 +41,7 @@ an immutable copy.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, replace
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
@@ -121,8 +122,21 @@ class DemandLedger:
         its reason CODE changes — the decision journal's reason
         timeline rides this hook, so time-in-each-blocked-reason is
         derived from the exact classifications the autoscale plane
-        acts on, not a parallel reimplementation."""
+        acts on, not a parallel reimplementation.
+
+        Thread-safety (PR-11 audit): the scheduling/arbiter thread is
+        the only LOGICAL writer, but note/resolve are read-modify-
+        write pairs over the entry map, so they take ``_lock`` —
+        cheap, and the multi-shard hammer test proves exact filing/
+        resolution conservation under deliberately concurrent
+        writers. The transition hook fires INSIDE the lock: delivered
+        outside it, two concurrent same-key notes could invert their
+        hook order and leave the journal's reason timeline ending on
+        a different reason than the ledger entry. The nesting is
+        one-way (demand lock -> journal lock; the journal never calls
+        back into this ledger), so it cannot deadlock."""
         self._entries: Dict[str, DemandEntry] = {}
+        self._lock = threading.Lock()
         self.on_transition = on_transition
 
     def note(self, pod_key: str, req, reason: str, now: float,
@@ -140,33 +154,34 @@ class DemandLedger:
         without the hint every pre-crash pod's wait clock would reset
         to the restart. An existing entry's ``since`` always wins (it
         is at least as old as any hint the same process can offer)."""
-        prior = self._entries.get(pod_key)
-        if self.on_transition is not None and (
-            prior is None or prior.reason != reason
-        ):
-            self.on_transition(
-                pod_key, prior.reason if prior is not None else None,
-                reason, now,
+        with self._lock:
+            prior = self._entries.get(pod_key)
+            if prior is not None:
+                since = prior.since
+            elif since_hint is not None:
+                since = min(now, since_hint)
+            else:
+                since = now
+            entry = DemandEntry(
+                pod_key=pod_key,
+                tenant=req.tenant,
+                model=req.model or "*",
+                shape=shape_of(req),
+                guarantee=req.is_guarantee,
+                chips=chips,
+                mem=mem,
+                reason=reason,
+                since=since,
+                updated=now,
             )
-        if prior is not None:
-            since = prior.since
-        elif since_hint is not None:
-            since = min(now, since_hint)
-        else:
-            since = now
-        entry = DemandEntry(
-            pod_key=pod_key,
-            tenant=req.tenant,
-            model=req.model or "*",
-            shape=shape_of(req),
-            guarantee=req.is_guarantee,
-            chips=chips,
-            mem=mem,
-            reason=reason,
-            since=since,
-            updated=now,
-        )
-        self._entries[pod_key] = entry
+            self._entries[pod_key] = entry
+            if self.on_transition is not None and (
+                prior is None or prior.reason != reason
+            ):
+                self.on_transition(
+                    pod_key, prior.reason if prior is not None else None,
+                    reason, now,
+                )
         return entry
 
     def note_batch(self, items, resolver) -> List[DemandEntry]:
@@ -188,7 +203,8 @@ class DemandLedger:
     def resolve(self, pod_key: str) -> None:
         """The pod bound or left the cluster — either way it no longer
         wants anything."""
-        self._entries.pop(pod_key, None)
+        with self._lock:
+            self._entries.pop(pod_key, None)
 
     # -- reads --------------------------------------------------------
 
@@ -201,7 +217,8 @@ class DemandLedger:
     def snapshot(self) -> Tuple[DemandEntry, ...]:
         """Immutable copy for the planner (entries are frozen; the
         tuple pins membership)."""
-        return tuple(self._entries.values())
+        with self._lock:
+            return tuple(self._entries.values())
 
     def guarantee_demand_tenants(self) -> Set[str]:
         """Tenants with pending GUARANTEE-class demand — crossed with

@@ -224,38 +224,24 @@ class NodeModelAgg:
     survives in filtering.py as the defrag-hold slow path and the
     differential oracle (``CellTree.check_aggregates``)."""
 
-    __slots__ = ("gen", "frontier", "node_cells")
+    __slots__ = ("gen", "frontier", "node_cells", "_live", "_cells")
 
     def __init__(self, gen: int, leaves: Sequence[Cell]):
         self.gen = gen
-        self._recompute(leaves)
-
-    def refresh(self, gen: int, leaves: Sequence[Cell]) -> None:
-        """Re-derive from the (already mutated) live leaves — the
-        delta-application path. Called by the tree immediately after a
-        reserve/reclaim touched one of these leaves, so readers never
-        see a stale aggregate and never pay a rebuild."""
-        self.gen = gen
-        self._recompute(leaves)
-
-    def _recompute(self, leaves: Sequence[Cell]) -> None:
-        # Pareto-max (available, free_memory) points over healthy bound
-        # leaves, available descending / free_memory strictly ascending.
-        pts = sorted(
-            ((l.available, l.free_memory) for l in leaves if l.healthy),
-            key=lambda p: (-p[0], -p[1]),
-        )
-        frontier: List[Tuple[float, int]] = []
-        best_mem = -1
-        for avail, mem in pts:
-            if mem > best_mem:
-                frontier.append((avail, mem))
-                best_mem = mem
-        self.frontier = frontier
-        # (node-level cell, whole-free count of THIS model's leaves
-        # under it). The count is model-scoped on purpose: a multi-chip
-        # pod of model M can only consume M leaves, so counting other
-        # models' whole-free chips (what reading the node cell's
+        # STRUCTURE is fixed for this aggregate's lifetime: leaf
+        # membership and health can only change through structural
+        # events (bind/unbind/HBM correction/health flip), and every
+        # one of those bumps the node generation and EVICTS the
+        # aggregate — a fresh one rebuilds here. Caching the healthy
+        # subset and the per-node-cell leaf groups once makes
+        # ``refresh`` — the per-reserve/reclaim delta path, which sits
+        # inside the shard plane's commit critical section — a pure
+        # stats pass: no sort key, no parent walks, no dict builds.
+        self._live = [l for l in leaves if l.healthy]
+        # (node-level cell, this model's leaves under it). The whole
+        # count is model-scoped on purpose: a multi-chip pod of model
+        # M can only consume M leaves, so counting other models'
+        # whole-free chips (what reading the node cell's
         # available_whole_cell alone would do) admits nodes that then
         # fail at Reserve on mixed-model nodes.
         groups: Dict[int, List] = {}
@@ -264,11 +250,41 @@ class NodeModelAgg:
             while cell is not None and not cell.is_node:
                 cell = cell.parent
             if cell is not None:
-                groups.setdefault(id(cell), [cell, 0])
-                if leaf.is_whole_free:
-                    groups[id(cell)][1] += 1
+                entry = groups.get(id(cell))
+                if entry is None:
+                    entry = groups[id(cell)] = [cell, []]
+                entry[1].append(leaf)
+        self._cells: List[Tuple[Cell, List[Cell]]] = [
+            (cell, members) for cell, members in groups.values()
+        ]
+        self._recompute()
+
+    def refresh(self, gen: int) -> None:
+        """Re-derive the stats from the (already mutated) live leaves
+        — the delta-application path. Called by the tree immediately
+        after a reserve/reclaim touched one of these leaves, so
+        readers never see a stale aggregate and never pay a
+        rebuild."""
+        self.gen = gen
+        self._recompute()
+
+    def _recompute(self) -> None:
+        # Pareto-max (available, free_memory) points over healthy bound
+        # leaves, available descending / free_memory strictly ascending.
+        # reverse=True on the raw tuples IS the old (-avail, -mem) key
+        # order, minus the per-element key lambda.
+        pts = [(l.available, l.free_memory) for l in self._live]
+        pts.sort(reverse=True)
+        frontier: List[Tuple[float, int]] = []
+        best_mem = -1
+        for avail, mem in pts:
+            if mem > best_mem:
+                frontier.append((avail, mem))
+                best_mem = mem
+        self.frontier = frontier
         self.node_cells: List[Tuple[Cell, int]] = [
-            (cell, whole) for cell, whole in groups.values()
+            (cell, sum(1 for l in members if l.is_whole_free))
+            for cell, members in self._cells
         ]
 
     def shared_fits(self, request: float, memory: int) -> bool:
@@ -328,6 +344,20 @@ class CellTree:
         # through the scheduler's /metrics so the delta/rebuild split
         # is observable.
         self._node_gen: Dict[str, int] = {}
+        # Per-node DELTA VERSION: a monotonic counter bumped on EVERY
+        # leaf-state change — accounting deltas AND structural events,
+        # i.e. exactly the occasions ``on_delta`` fires — plus any
+        # external mutation a caller folds in via
+        # ``touch_delta_version`` (the scheduler versions its per-node
+        # port pools through it). This is the optimistic-concurrency
+        # read-set substrate (shard/): a proposal captures
+        # ``node_delta_version`` BEFORE reading a node's state, and
+        # the commit arbiter validates the version is unchanged — any
+        # mutation in between moved the counter, so a stale read can
+        # never commit. Distinct from ``_node_gen``, which moves only
+        # on structural events (it is a cache-invalidation epoch, not
+        # a read-validation clock).
+        self._delta_seq: Dict[str, int] = {}
         # model -> {node -> aggregate}: the fast Filter loop hoists the
         # per-model inner dict, so the steady-state probe is one
         # string-keyed get (no per-probe key-tuple allocation)
@@ -501,6 +531,11 @@ class CellTree:
             for by_node in self._agg_cache.values():
                 if by_node.pop(node, None) is not None:
                     self.agg_rebuilds += 1  # rebuild debt: next read pays
+            # version bump AFTER the mutation, BEFORE subscribers: an
+            # optimistic reader capturing the version post-bump is
+            # guaranteed to read post-mutation state (one mutator
+            # thread), and one capturing pre-bump conflicts at commit
+            self._delta_seq[node] = self._delta_seq.get(node, 0) + 1
             if self.on_delta is not None:
                 self.on_delta(node)
 
@@ -520,13 +555,39 @@ class CellTree:
         if by_node is not None:
             agg = by_node.get(node)
             if agg is not None:
-                agg.refresh(
-                    self._node_gen.get(node, 0),
-                    self.leaves_view(node, leaf.leaf_cell_type),
-                )
+                agg.refresh(self._node_gen.get(node, 0))
                 self.agg_delta_updates += 1
+        self._delta_seq[node] = self._delta_seq.get(node, 0) + 1
         if self.on_delta is not None:
             self.on_delta(node)
+
+    def node_delta_version(self, node: str) -> int:
+        """Monotonic per-node read-validation version: moves on every
+        leaf-state change (accounting delta or structural event) and
+        on external ``touch_delta_version`` folds. The shard plane's
+        bind transactions capture this before reading a node and the
+        commit arbiter rejects any transaction whose captured versions
+        moved — the Omega-style optimistic-concurrency commit point."""
+        return self._delta_seq.get(node, 0)
+
+    def delta_versions_snapshot(self) -> Dict[str, int]:
+        """One-shot copy of every node's delta version — the capture
+        step of a proposal's read-set. A single C-level ``dict.copy``
+        (atomic under the GIL) instead of O(nodes) method calls: the
+        shard propose path snapshots this before its first node read,
+        then keys the scored subset out of it. Nodes absent from the
+        copy have version 0 (never mutated)."""
+        return self._delta_seq.copy()
+
+    def touch_delta_version(self, node: str) -> None:
+        """Fold an EXTERNAL per-node mutation into the read-validation
+        clock without firing ``on_delta`` (no aggregate or score-memo
+        state changed). The scheduler calls this when a node's
+        pod-manager port pool mutates: port feasibility is part of a
+        SHARED proposal's read state, so port churn must conflict
+        transactions the same way leaf churn does."""
+        if node:
+            self._delta_seq[node] = self._delta_seq.get(node, 0) + 1
 
     def node_generation(self, node: str) -> int:
         """Monotonic per-node STRUCTURAL state counter: moves on

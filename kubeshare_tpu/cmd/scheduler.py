@@ -323,7 +323,7 @@ class SchedulerMetrics:
 
     def __init__(self, clock=time.time, tracer=None, engine=None,
                  elector=None, planner=None, router=None, cluster=None,
-                 obs=None, profiler=None):
+                 obs=None, profiler=None, shard=None):
         self.clock = clock
         self.tracer = tracer
         self.engine = engine
@@ -338,6 +338,9 @@ class SchedulerMetrics:
         # serving.RequestRouter (optional): merges the request plane's
         # tpu_serving_* gauges/histograms into the same exposition
         self.router = router
+        # shard.ShardedScheduler (optional): merges the multi-scheduler
+        # plane's transaction counters + commit-latency histogram
+        self.shard = shard
         # cluster adapter (optional): any adapter exposing samples()
         # (KubeCluster) merges its API-health families — retry /
         # exhausted-budget counters, watch reconnects, quarantined
@@ -395,6 +398,8 @@ class SchedulerMetrics:
             samples += self.planner.samples()
         if self.router is not None:
             samples += self.router.samples()
+        if self.shard is not None:
+            samples += self.shard.samples()
         if self.obs is not None:
             samples += self.obs.samples()
         if self.profiler is not None:

@@ -18,10 +18,10 @@ from typing import Callable, List, Optional
 
 from .alerts import (  # noqa: F401
     AlertConfig, AlertEvaluator, AlertRule, WindowSeries,
-    RULE_API_ERRORS, RULE_CAPACITY_DROP, RULE_COST_REGRESSION,
-    RULE_DEGRADED, RULE_LEDGER_DRIFT, RULE_PHASE_DRIFT,
-    RULE_QUEUE_SPIKE, RULE_RESTART, RULE_SHED_RATE,
-    RULE_SLO_BURN, RULE_WATCH_STORM, standard_rules,
+    RULE_API_ERRORS, RULE_CAPACITY_DROP, RULE_CONFLICT_STORM,
+    RULE_COST_REGRESSION, RULE_DEGRADED, RULE_LEDGER_DRIFT,
+    RULE_PHASE_DRIFT, RULE_QUEUE_SPIKE, RULE_RESTART,
+    RULE_SHED_RATE, RULE_SLO_BURN, RULE_WATCH_STORM, standard_rules,
 )
 from .profile import (  # noqa: F401
     ProfilerBusy, ProfilerHub, SamplingProfiler, register_profile,
@@ -137,6 +137,7 @@ def build_plane(
     engine_ref: Callable,
     cluster=None,
     router=None,
+    shard=None,
     tracer=None,
     config: Optional[AlertConfig] = None,
     spool=None,
@@ -153,7 +154,7 @@ def build_plane(
     cfg = config or AlertConfig()
     evaluator = AlertEvaluator(
         standard_rules(engine_ref, cluster=cluster, router=router,
-                       cfg=cfg),
+                       shard=shard, cfg=cfg),
         eval_interval=cfg.eval_interval, log=log,
     )
     recorder = FlightRecorder(

@@ -72,6 +72,7 @@ RULE_RESTART = "scheduler-restart"
 RULE_CAPACITY_DROP = "node-capacity-drop"
 RULE_COST_REGRESSION = "cost-regression"
 RULE_PHASE_DRIFT = "cost-phase-drift"
+RULE_CONFLICT_STORM = "conflict-storm"
 
 
 @dataclass
@@ -109,6 +110,14 @@ class AlertConfig:
     cost_baseline_alpha: float = 0.05    # EWMA step per evaluation
     cost_phase_drift: float = 0.25       # absolute share move that fires
     cost_phase_min_seconds: float = 0.05  # slow-window attributed floor
+    # conflict-storm sentinel (shard plane): windowed conflict-retry
+    # rate (conflicts / commit attempts) vs a frozen-while-hot EWMA
+    # baseline, floored so a plane running near zero conflicts needs a
+    # real storm — not one stray retry against a ~0 baseline — to page
+    conflict_storm_factor: float = 4.0   # x baseline, both windows
+    conflict_min_commits: int = 20       # windowed commit attempts floor
+    conflict_rate_floor: float = 0.05    # baseline divisor floor
+    conflict_baseline_alpha: float = 0.05  # EWMA step per evaluation
     clear_after: int = 2                 # clean evals before clearing
     clear_ratio: float = 0.5             # "clean" = level <= ratio x thr
 
@@ -593,7 +602,65 @@ def phase_drift_rule(phase_totals: Callable[[], Dict[str, float]],
                      clear_after=cfg.clear_after)
 
 
+def conflict_storm_rule(txn_totals: Callable[[], Tuple[int, int]],
+                        cfg: AlertConfig) -> AlertRule:
+    """Shard-plane conflict sentinel: ``txn_totals`` returns
+    cumulative ``(commits, conflicts)`` from the commit arbiter; the
+    level is the windowed conflict-retry rate — conflicts per commit
+    attempt — against a slow EWMA baseline, ``min(fast, slow)`` burn
+    so one contended wave inside the fast window cannot page, with
+    the ``cost_regression_rule`` idioms throughout: frozen-while-hot
+    baseline (a sustained storm keeps firing instead of becoming the
+    new normal), counter-reset tolerance (a restarted plane clears
+    the series, no verdict until fresh windows fill), a minimum
+    commit-attempts floor, and edge-triggered firing with hysteresis
+    from the evaluator. The baseline divisor is floored at
+    ``conflict_rate_floor``: a healthy plane idles near zero
+    conflicts, and without the floor the first stray retry would
+    divide by epsilon — from quiet, only a genuine storm
+    (``factor x floor`` of commit traffic conflicting) fires."""
+    series = WindowSeries(cfg.slow_window)
+    baseline: List[Optional[float]] = [None]
+
+    def level(now: float) -> Tuple[float, dict]:
+        commits, conflicts = txn_totals()
+        series.observe(now, (float(commits) + float(conflicts),
+                             float(conflicts)))
+
+        def rate(window: float) -> Optional[float]:
+            d = series.delta(now, window)
+            if not d or d[0] < cfg.conflict_min_commits:
+                return None  # too few commit attempts: no verdict
+            return d[1] / d[0]
+
+        fast = rate(cfg.fast_window)
+        slow = rate(cfg.slow_window)
+        if fast is None or slow is None:
+            return 0.0, {}
+        base = baseline[0]
+        if base is None:
+            baseline[0] = slow  # first valid window seeds the baseline
+            return 0.0, {}
+        floor = max(base, cfg.conflict_rate_floor)
+        value = min(fast, slow) / floor / cfg.conflict_storm_factor
+        if value < cfg.clear_ratio:
+            # learn only below the clear point — frozen while hot
+            baseline[0] = base + cfg.conflict_baseline_alpha * (
+                slow - base
+            )
+        return value, {
+            "fast_rate": round(fast, 3),
+            "slow_rate": round(slow, 3),
+            "baseline": round(base, 3),
+        }
+
+    return AlertRule(RULE_CONFLICT_STORM, level, threshold=1.0,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
 def standard_rules(engine_ref: Callable, cluster=None, router=None,
+                   shard=None,
                    cfg: Optional[AlertConfig] = None) -> List[AlertRule]:
     """The full rule set against a live engine (via ``engine_ref`` —
     a callable, because the sim REBUILDS the engine on an injected
@@ -668,4 +735,7 @@ def standard_rules(engine_ref: Callable, cluster=None, router=None,
         ]
     if router is not None:
         rules.append(shed_rate_rule(router.request_totals, cfg))
+    if shard is not None:
+        # shard.ShardedScheduler (or any object with txn_totals())
+        rules.append(conflict_storm_rule(shard.txn_totals, cfg))
     return rules

@@ -16,13 +16,27 @@ tenant's guaranteed entitlement is what reclaim treats as *borrowed*.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterable
 
 _EPS = 1e-9
 
 
 class UsageLedger:
+    """Thread-safety contract (PR-11 audit): the engine's scheduling
+    thread was historically the only charge/credit writer, but the
+    shard plane's hammer tests drive charge/credit from concurrent
+    threads, and a tenant's balance is a read-modify-write over FOUR
+    dicts — two racing updates under the GIL can interleave between
+    the ``get`` and the store and lose one charge, silently inflating
+    or deflating the tenant's share forever. Mutations therefore take
+    ``_lock``; ``snapshot`` takes it too so the crash-recovery oracle
+    never reads a half-applied charge. Single-value getters
+    (``chips_used`` etc.) stay lock-free on purpose: one dict read is
+    GIL-atomic, and they sit in queue-sort/admission hot paths."""
+
     def __init__(self):
+        self._lock = threading.Lock()
         self._chips: Dict[str, float] = {}      # total fractional chips
         self._mem: Dict[str, int] = {}          # total HBM bytes
         self._gchips: Dict[str, float] = {}     # guarantee-class chips
@@ -33,11 +47,14 @@ class UsageLedger:
                guarantee: bool) -> None:
         if not tenant or (chips <= 0 and mem <= 0):
             return
-        self._chips[tenant] = self._chips.get(tenant, 0.0) + chips
-        self._mem[tenant] = self._mem.get(tenant, 0) + mem
-        if guarantee:
-            self._gchips[tenant] = self._gchips.get(tenant, 0.0) + chips
-            self._gmem[tenant] = self._gmem.get(tenant, 0) + mem
+        with self._lock:
+            self._chips[tenant] = self._chips.get(tenant, 0.0) + chips
+            self._mem[tenant] = self._mem.get(tenant, 0) + mem
+            if guarantee:
+                self._gchips[tenant] = (
+                    self._gchips.get(tenant, 0.0) + chips
+                )
+                self._gmem[tenant] = self._gmem.get(tenant, 0) + mem
 
     def credit(self, tenant: str, chips: float, mem: int,
                guarantee: bool) -> None:
@@ -47,26 +64,32 @@ class UsageLedger:
         relative share."""
         if not tenant or (chips <= 0 and mem <= 0):
             return
-        self._chips[tenant] = max(0.0, self._chips.get(tenant, 0.0) - chips)
-        self._mem[tenant] = max(0, self._mem.get(tenant, 0) - mem)
-        if guarantee:
-            self._gchips[tenant] = max(
-                0.0, self._gchips.get(tenant, 0.0) - chips
+        with self._lock:
+            self._chips[tenant] = max(
+                0.0, self._chips.get(tenant, 0.0) - chips
             )
-            self._gmem[tenant] = max(0, self._gmem.get(tenant, 0) - mem)
-        if self._chips[tenant] <= _EPS and self._mem[tenant] == 0:
-            # drop idle tenants so the metrics surface and the
-            # queue-order terms only carry live ones
-            self._chips.pop(tenant, None)
-            self._mem.pop(tenant, None)
-            self._gchips.pop(tenant, None)
-            self._gmem.pop(tenant, None)
+            self._mem[tenant] = max(0, self._mem.get(tenant, 0) - mem)
+            if guarantee:
+                self._gchips[tenant] = max(
+                    0.0, self._gchips.get(tenant, 0.0) - chips
+                )
+                self._gmem[tenant] = max(
+                    0, self._gmem.get(tenant, 0) - mem
+                )
+            if self._chips[tenant] <= _EPS and self._mem[tenant] == 0:
+                # drop idle tenants so the metrics surface and the
+                # queue-order terms only carry live ones
+                self._chips.pop(tenant, None)
+                self._mem.pop(tenant, None)
+                self._gchips.pop(tenant, None)
+                self._gmem.pop(tenant, None)
 
     def note_reclaim(self, tenant: str, victims: int) -> None:
         if victims > 0:
-            self.reclaim_evictions[tenant] = (
-                self.reclaim_evictions.get(tenant, 0) + victims
-            )
+            with self._lock:
+                self.reclaim_evictions[tenant] = (
+                    self.reclaim_evictions.get(tenant, 0) + victims
+                )
 
     # -- reads --------------------------------------------------------
 
@@ -75,16 +98,18 @@ class UsageLedger:
         mem, guarantee_chips, guarantee_mem)}``, floats rounded so a
         ledger REBUILT from relist (different charge order, same
         pods) compares equal to the continued one — the crash-recovery
-        invariant."""
-        return {
-            t: (
-                round(self._chips.get(t, 0.0), 9),
-                self._mem.get(t, 0),
-                round(self._gchips.get(t, 0.0), 9),
-                self._gmem.get(t, 0),
-            )
-            for t in sorted(self._chips)
-        }
+        invariant. Locked: the drift oracle must never see a charge
+        half-applied across the four dicts."""
+        with self._lock:
+            return {
+                t: (
+                    round(self._chips.get(t, 0.0), 9),
+                    self._mem.get(t, 0),
+                    round(self._gchips.get(t, 0.0), 9),
+                    self._gmem.get(t, 0),
+                )
+                for t in sorted(self._chips)
+            }
 
     def chips_used(self, tenant: str) -> float:
         return self._chips.get(tenant, 0.0)

@@ -94,6 +94,13 @@ class QuotaPlane:
         # live (every read goes straight to the ledger, seed behavior).
         self._wave_usage: Optional[dict] = None
         self._wave_share: Optional[dict] = None
+        # Per-tenant ledger version: bumped by every charge/credit —
+        # the quota half of the shard plane's optimistic read-set. A
+        # proposal captures ``ledger_version(tenant)`` before its
+        # admission read; the commit arbiter rejects the transaction
+        # if the tenant's ledger moved in between (a concurrent
+        # commit charged or credited the same tenant).
+        self._ledger_versions: dict = {}
 
     # -- wave memoization --------------------------------------------
 
@@ -332,12 +339,26 @@ class QuotaPlane:
 
     # -- accounting (plugin call sites) ------------------------------
 
+    def _bump_ledger_version(self, tenant: str) -> None:
+        """Version only CONFIGURED tenants: an unconfigured tenant's
+        admission verdict reads no ledger, the shard propose path
+        skips its validation (txn.tenant_version == -1), and — unlike
+        the usage ledger, which drops idle tenants — a version
+        counter is forever, so one entry per hostile tenant name
+        would be an unbounded leak in a long-lived daemon."""
+        spec = self.registry.spec(tenant)
+        if spec.guaranteed is not None or spec.borrow_limit is not None:
+            self._ledger_versions[tenant] = (
+                self._ledger_versions.get(tenant, 0) + 1
+            )
+
     def charge(self, status) -> None:
         self.ledger.charge(
             status.tenant, status.charged_chips, status.charged_mem,
             status.requirements.is_guarantee,
         )
         self._wave_invalidate(status.tenant)
+        self._bump_ledger_version(status.tenant)
 
     def credit(self, status) -> None:
         self.ledger.credit(
@@ -347,6 +368,14 @@ class QuotaPlane:
         status.charged_chips = 0.0
         status.charged_mem = 0
         self._wave_invalidate(status.tenant)
+        self._bump_ledger_version(status.tenant)
+
+    def ledger_version(self, tenant: str) -> int:
+        """Monotonic per-tenant charge/credit counter — the read-
+        validation clock for the quota part of a bind transaction's
+        read-set (see shard/txn.py). Moves only for configured
+        tenants; the propose path never validates the rest."""
+        return self._ledger_versions.get(tenant, 0)
 
     def deficit_chips(self, tenant: str) -> float:
         """Unmet guaranteed entitlement in chips: how far the tenant's

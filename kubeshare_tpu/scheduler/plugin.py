@@ -68,6 +68,26 @@ class Decision:
 
 
 @dataclass
+class ReservationPlan:
+    """Output of :meth:`TpuShareScheduler.plan_reservation` — the
+    read-only half of ``reserve``: the chosen leaves, resolved memory
+    and charge, and the annotation/env template, everything the commit
+    critical section needs to APPLY the placement without re-running
+    selection. SHARED plans carry ``needs_port=True`` and get their
+    manager-port fields at apply time (ports are allocated inside the
+    critical section, never at propose time)."""
+
+    node: str
+    group_key: str
+    leaves: List[Cell]
+    memory: int
+    charged_chips: float
+    needs_port: bool
+    annotations: Dict[str, str]
+    env: Dict[str, str]
+
+
+@dataclass
 class _Waiting:
     pod_key: str
     node: str
@@ -312,9 +332,14 @@ class TpuShareScheduler:
         # it measures. Exported as tpu_scheduler_cost_seconds_total
         # {phase}; the cost-regression/phase-drift alert rules and
         # tools/profile_report.py read it.
+        # "commit" is the shard plane's arbiter critical section
+        # (validate + apply_reservation + permit + bind): 0 forever on
+        # the sequential/wave paths, charged per transaction by
+        # shard/plane.py — the serialized fraction of a multi-scheduler
+        # deployment, the number Amdahl grades the shard count against.
         self.cost_seconds = {
             "parse": 0.0, "quota": 0.0, "filter": 0.0, "score": 0.0,
-            "reserve_permit": 0.0, "journal": 0.0,
+            "reserve_permit": 0.0, "journal": 0.0, "commit": 0.0,
         }
         self.cost_attempts = 0  # attempts attributed (journal-independent)
         # Per-(tenant, kind, outcome) attempt cost: [seconds, attempts]
@@ -922,7 +947,16 @@ class TpuShareScheduler:
                           self._held_leaves(pod, req, node_name),
                           seed_frees)
 
-    def reserve(self, pod: Pod, req: PodRequirements, node_name: str) -> PodStatus:
+    def plan_reservation(self, pod: Pod, req: PodRequirements,
+                         node_name: str) -> "ReservationPlan":
+        """The READ half of :meth:`reserve`: leaf choice, memory
+        resolution, and the annotation/env template for placing
+        ``req`` on ``node_name`` — no tree, port, ledger, or cluster
+        mutation. Safe to run on shard proposal threads against live
+        state: everything it reads is covered by the node's delta
+        version, so a stale plan is rejected at the commit point
+        instead of being applied. Raises the same Unschedulable
+        ``reserve`` raised when nothing fits at reserve time."""
         group = self.groups.get_or_create(pod, req.gang)
         anchors = self.status.group_placed_leaves(group.key)
         leaves = select_leaves(self.tree, node_name, req, anchors,
@@ -931,33 +965,69 @@ class TpuShareScheduler:
             raise Unschedulable(
                 f"pod {pod.key}: no chips left on {node_name} at reserve time"
             )
+        annotations: Dict[str, str] = {}
+        env: Dict[str, str] = {}
+        if req.kind == PodKind.MULTI_CHIP:
+            total_memory = sum(l.full_memory for l in leaves)
+            annotations[C.ANNOTATION_CELL_ID] = ",".join(l.id for l in leaves)
+            annotations[C.ANNOTATION_CHIP_UUID] = ",".join(l.uuid for l in leaves)
+            annotations[C.ANNOTATION_TPU_MODEL] = leaves[0].leaf_cell_type
+            annotations[C.ANNOTATION_TPU_MEMORY] = str(total_memory)
+            env[C.ENV_VISIBLE_CHIPS] = ",".join(l.uuid for l in leaves)
+            return ReservationPlan(
+                node=node_name, group_key=group.key, leaves=leaves,
+                memory=total_memory, charged_chips=float(len(leaves)),
+                needs_port=False, annotations=annotations, env=env,
+            )
+        leaf = leaves[0]
+        memory = _resolved_memory(leaf, req)
+        annotations[C.ANNOTATION_CELL_ID] = leaf.id
+        annotations[C.ANNOTATION_CHIP_UUID] = leaf.uuid
+        annotations[C.ANNOTATION_TPU_MODEL] = leaf.leaf_cell_type
+        annotations[C.ANNOTATION_TPU_MEMORY] = str(memory)
+        env[C.ENV_VISIBLE_CHIPS] = leaf.uuid
+        env[C.ENV_POD_NAME] = pod.key
+        env[C.ENV_HBM_LIMIT] = str(memory)
+        env[C.ENV_LIBRARY_PATH] = C.LIBRARY_PATH
+        return ReservationPlan(
+            node=node_name, group_key=group.key, leaves=leaves,
+            memory=memory, charged_chips=req.request,
+            needs_port=True, annotations=annotations, env=env,
+        )
+
+    def apply_reservation(self, pod: Pod, req: PodRequirements,
+                          plan: "ReservationPlan") -> PodStatus:
+        """The WRITE half of :meth:`reserve` — the shard arbiter's
+        commit critical section: port allocation, leaf bookkeeping,
+        the annotation patch, and the ledger charge, exactly as the
+        sequential path orders them (port before leaves for SHARED, so
+        a full pool aborts before anything is taken; ledger charge
+        only after the last fallible step). Scheduling/arbiter thread
+        only. PROFILE.json is why this split exists: ~0.42-0.49 of the
+        attempts budget sat in reserve_permit, and only THIS slice of
+        it must serialize across schedulers."""
+        node_name = plan.node
+        leaves = plan.leaves
         status = PodStatus(
             key=pod.key,
             uid=pod.uid,
             requirements=req,
-            group_key=group.key,
+            group_key=plan.group_key,
             node_name=node_name,
             leaves=leaves,
             uuids=[l.uuid for l in leaves],
             state=PodState.RESERVED,
             tenant=req.tenant,
         )
-        annotations: Dict[str, str] = {}
-        env: Dict[str, str] = {}
+        annotations = plan.annotations
+        env = plan.env
         if req.kind == PodKind.MULTI_CHIP:
-            total_memory = 0
             for leaf in leaves:
                 self.tree.reserve(leaf, 1.0, leaf.full_memory)
-                total_memory += leaf.full_memory
-            status.memory = total_memory
-            annotations[C.ANNOTATION_CELL_ID] = ",".join(l.id for l in leaves)
-            annotations[C.ANNOTATION_CHIP_UUID] = ",".join(l.uuid for l in leaves)
-            annotations[C.ANNOTATION_TPU_MODEL] = leaves[0].leaf_cell_type
-            annotations[C.ANNOTATION_TPU_MEMORY] = str(total_memory)
-            env[C.ENV_VISIBLE_CHIPS] = ",".join(l.uuid for l in leaves)
+            status.memory = plan.memory
         else:
             leaf = leaves[0]
-            memory = _resolved_memory(leaf, req)
+            memory = plan.memory
             pool = self._node_ports(node_name)
             port_slot = pool.find_next_and_set()
             if port_slot == -1:
@@ -969,20 +1039,12 @@ class TpuShareScheduler:
             self.tree.reserve(leaf, req.request, memory)
             status.memory = memory
             status.port = port
-            annotations[C.ANNOTATION_CELL_ID] = leaf.id
-            annotations[C.ANNOTATION_CHIP_UUID] = leaf.uuid
-            annotations[C.ANNOTATION_TPU_MODEL] = leaf.leaf_cell_type
-            annotations[C.ANNOTATION_TPU_MEMORY] = str(memory)
+            # plans are single-use (a conflicted transaction re-plans
+            # from scratch), so the port fields land in place — no
+            # defensive copy on the commit critical section
             annotations[C.ANNOTATION_MANAGER_PORT] = str(port)
-            env[C.ENV_VISIBLE_CHIPS] = leaf.uuid
             env[C.ENV_POD_MANAGER_PORT] = str(port)
-            env[C.ENV_POD_NAME] = pod.key
-            env[C.ENV_HBM_LIMIT] = str(memory)
-            env[C.ENV_LIBRARY_PATH] = C.LIBRARY_PATH
-        status.charged_chips = (
-            float(len(leaves)) if req.kind == PodKind.MULTI_CHIP
-            else req.request
-        )
+        status.charged_chips = plan.charged_chips
         status.charged_mem = status.memory
         try:
             self.cluster.patch_pod(pod.key, annotations=annotations, env=env)
@@ -1016,6 +1078,16 @@ class TpuShareScheduler:
         self.quota.charge(status)
         self.status.put(status)
         return status
+
+    def reserve(self, pod: Pod, req: PodRequirements, node_name: str) -> PodStatus:
+        """Plan + apply in one step — the sequential path. The shard
+        plane runs :meth:`plan_reservation` on proposal threads and
+        :meth:`apply_reservation` inside the commit critical section
+        instead; composed back-to-back here the two halves are the
+        pre-split ``reserve`` behavior, message for message."""
+        return self.apply_reservation(
+            pod, req, self.plan_reservation(pod, req, node_name)
+        )
 
     def unreserve(self, pod_key: str, reject_group: bool = True) -> List[str]:
         """Release a reservation; optionally reject all waiting gang
@@ -1251,6 +1323,16 @@ class TpuShareScheduler:
             key = (req.tenant, req.kind.value, outcome)
         else:  # prefilter rejected before requirements existed
             key = (pod.namespace, "", outcome)
+        self.charge_cost_class(key, now - t0)
+
+    def charge_cost_class(self, key: Tuple[str, str, str],
+                          seconds: float) -> None:
+        """Accumulate one attempt into its (tenant, kind, outcome)
+        class total — bounded: past 512 classes new keys collapse
+        into the ``_other`` tenant, so hostile tenant churn cannot
+        grow the exposition without bound. The ONE home of that cap
+        policy: the sequential attempt accounting and the shard
+        plane's finalize both charge through here."""
         by_class = self.cost_by_class
         entry = by_class.get(key)
         if entry is None:
@@ -1259,7 +1341,7 @@ class TpuShareScheduler:
                 entry = by_class.get(key)
             if entry is None:
                 entry = by_class[key] = [0.0, 0]
-        entry[0] += now - t0
+        entry[0] += seconds
         entry[1] += 1
 
     def cost_attribution(self, top: int = 16) -> dict:
@@ -2459,6 +2541,8 @@ class TpuShareScheduler:
         """Release every hold owned by ``pod_key`` (it bound somewhere
         or was deleted — either way the space is no longer owed).
         Other beneficiaries' holds on the same nodes stay live."""
+        if not self._defrag_holds:
+            return  # steady state: one falsy check, no list build
         for key in [k for k in self._defrag_holds if k[1] == pod_key]:
             self._defrag_holds.pop(key, None)
 
@@ -2847,6 +2931,11 @@ class TpuShareScheduler:
             self._full_port_nodes.add(node_name)
         else:
             self._full_port_nodes.discard(node_name)
+        # port feasibility is part of a SHARED proposal's read state:
+        # fold every pool mutation into the node's read-validation
+        # version so a transaction proposed against the old pool
+        # conflicts at the commit point (shard/txn.py)
+        self.tree.touch_delta_version(node_name)
 
     def _bind(self, pod_key: str, node_name: str) -> None:
         self.cluster.bind(pod_key, node_name)

@@ -1,0 +1,28 @@
+"""Sharded optimistically-concurrent multi-scheduler plane (PR-11).
+
+N shard schedulers propose placements against shared cell state as
+bind transactions (read-set: scored-node delta versions + tenant
+ledger version + the capacity-release counter); one commit arbiter
+validates and applies them — serializable with bounded conflict
+retries, sequential fallback so no pod starves. See DESIGN.md
+"PR-11 additions" for the transaction contract.
+"""
+
+from .plane import ShardedScheduler
+from .propose import propose
+from .txn import (
+    COMMITTED, CONFLICT, FALLBACK, PROPOSED,
+    BindTransaction, CommitResult, Proposal,
+)
+
+__all__ = [
+    "ShardedScheduler",
+    "propose",
+    "BindTransaction",
+    "CommitResult",
+    "Proposal",
+    "PROPOSED",
+    "FALLBACK",
+    "COMMITTED",
+    "CONFLICT",
+]

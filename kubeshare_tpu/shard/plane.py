@@ -1,0 +1,676 @@
+"""The sharded multi-scheduler plane: N proposal shards, one commit
+arbiter, a serializable commit point.
+
+Omega-style shared-state scheduling (Schwarzkopf et al., EuroSys'13)
+over the PR-5 engine substrate: N shard schedulers run the read-only
+propose walk (shard/propose.py) over a hash partition of the backlog
+— gangs hash by group key so a gang never straddles shards and the
+existing Permit barrier machinery works unchanged — and a single
+commit arbiter validates each resulting bind transaction against
+current shared state before applying it:
+
+1. **validate** — the global ``capacity_releases`` counter is
+   unchanged (no release voided the monotone-capacity-loss premise
+   that lets the read-set stop at the scored nodes), every scored
+   node's delta version is unchanged, and the tenant's ledger version
+   is unchanged;
+2. **apply** — ``apply_reservation`` (port + leaf bookkeeping +
+   annotation patch + ledger charge: the minimal critical section
+   PROFILE.json's reserve_permit budget demanded), then the ordinary
+   Permit (quota re-check + gang barrier) and bind;
+3. **conflict** — the shard re-proposes against fresh state, up to
+   ``max_retries`` times, then the pod falls back to the sequential
+   ``schedule_one`` path at the end of the batch — bounded retries,
+   no pod starves.
+
+Two drivers share that machinery. ``threaded=True`` runs each shard
+on a real thread (proposals genuinely race the arbiter — what the
+hammer/invariant tests exercise). The default interleaved driver
+round-robins proposals across shards on one thread with per-segment
+wall clocks, which is how the MULTISCHED.json A/B measures the
+modeled N-way makespan ``max(shard propose walls) + serialized
+commit/fallback/prep`` — under CPython's GIL, N CPU-bound threads
+interleave rather than run in parallel, so threaded wall time
+measures the GIL, not the architecture; the per-segment model
+measures what N scheduler replicas (the PR-8 bind-conflict machinery
+already anticipates them) would do. The artifact records the
+protocol.
+
+Every second the plane spends lands in the engine's cost-attribution
+plane exactly once: propose-side phases merge at finalize, the
+arbiter critical section accrues live into
+``cost_seconds["commit"]``, and wasted work (conflicted or
+fallen-back proposals) finalizes under the ``conflict`` / ``fallback``
+outcome classes — the class totals and phase totals stay equal, the
+PR-10 invariant tests/test_metrics_lint.py pins.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+import zlib
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..autoscale import demand as D
+from ..cluster.api import Conflict
+from ..scheduler.labels import LabelError, parse_gang
+from ..scheduler.plugin import Decision, Unschedulable
+from ..utils import expfmt
+from ..utils.trace import Histogram
+from .propose import propose
+from .txn import (
+    COMMITTED, CONFLICT, CONFLICT_APPLY, CONFLICT_LEDGER,
+    CONFLICT_RELEASE, FALLBACK, BindTransaction, CommitResult,
+)
+
+# commit-latency buckets: the critical section is microseconds on the
+# fake cluster and milliseconds against a real apiserver
+COMMIT_BUCKETS = (
+    1e-6, 2e-6, 5e-6, 1e-5, 2e-5, 5e-5, 1e-4, 2e-4, 5e-4,
+    1e-3, 5e-3, 1e-2, 5e-2, 1e-1, 1.0,
+)
+
+
+class ShardedScheduler:
+    """N-shard optimistic scheduling over one engine. The engine's
+    scheduling thread IS the arbiter thread: construct the plane where
+    you would call ``schedule_wave`` and hand it the same backlog."""
+
+    def __init__(self, engine, shards: int = 4, max_retries: int = 3,
+                 log=None):
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        if max_retries < 1:
+            raise ValueError(
+                f"max_retries must be >= 1, got {max_retries}"
+            )
+        self.engine = engine
+        self.shards = int(shards)
+        self.max_retries = int(max_retries)
+        self.log = log or engine.log
+        # transaction counters (exported; mutated on the arbiter
+        # thread except proposals/shard_failures, which shard threads
+        # bump under the counter lock in threaded mode)
+        self.commits = 0            # transactions committed (any verdict)
+        self.conflicts = 0          # commit-point rejections
+        self.retries = 0            # re-proposals actually performed
+        self.proposals = 0          # propose() calls
+        self.shard_failures = 0     # propose() raised (shard died mid-propose)
+        self.fallbacks: Dict[str, int] = {}   # reason -> pods routed sequential
+        self.batches = 0
+        self.commit_hist = Histogram(COMMIT_BUCKETS)
+        # makespan model segments (wall seconds, cumulative)
+        self.propose_seconds = [0.0] * self.shards
+        self.commit_seconds = 0.0     # validate + apply + permit + bind
+        self.fallback_seconds = 0.0   # sequential tail
+        self.prep_seconds = 0.0       # sync + prewarm + sort + partition
+        self.flush_seconds = 0.0      # journal batch + wasted finalize
+        self._counter_lock = threading.Lock()
+        # per-batch scratch (arbiter-owned)
+        self._acc: Dict[str, list] = {}        # pod key -> [tenant, kind, phases]
+        self._fallback: List = []              # pods for the sequential tail
+        self.last_order: List[str] = []        # finalize order (differential)
+
+    # ---- public driver ---------------------------------------------
+
+    def schedule_backlog(self, pods, threaded: bool = False
+                         ) -> List[Decision]:
+        """Schedule ``pods`` (a pending backlog snapshot) through the
+        shard plane; returns one Decision per pod. Arbiter-thread
+        entry point — the caller must be the engine's scheduling
+        thread."""
+        engine = self.engine
+        perf = _time.perf_counter
+        t0 = perf()
+        if engine._unsynced:
+            for name in sorted(engine._unsynced):
+                engine._ensure_synced(name)
+        self._prewarm()
+        # wave-memoized sort: one ledger read per tenant serves the
+        # whole backlog's share terms, exactly as schedule_wave's sort
+        engine.quota.wave_begin()
+        try:
+            order = sorted(pods, key=engine.queue_sort_key)
+        finally:
+            engine.quota.wave_end()
+        index = {pod.key: i for i, pod in enumerate(order)}
+        ready, deferred = [], []
+        for pod in order:
+            # pods already holding state (requeue races, RESERVED
+            # survivors of a bind failure) take the sequential path —
+            # _handle_existing owns their recovery semantics
+            if engine.status.get(pod.key) is None:
+                ready.append(pod)
+            else:
+                deferred.append(pod)
+        parts = self._partition(ready)
+        journal_on = engine.explain.enabled
+        batch: Optional[list] = [] if journal_on else None
+        self._acc = {}
+        self._fallback = []
+        self.last_order = []
+        self.batches += 1
+        for pod in deferred:
+            self._defer(pod, "held-state")
+        self.prep_seconds += perf() - t0
+
+        if threaded and self.shards > 1:
+            decisions = self._run_threaded(parts, journal_on, batch)
+        else:
+            decisions = self._run_interleaved(parts, journal_on, batch)
+
+        # sequential tail, in queue order: conflict-exhausted and
+        # fallback pods run the full schedule_one walk (journal,
+        # demand classification, defrag) against post-commit state
+        t1 = perf()
+        tail = sorted(self._fallback, key=lambda p: index[p.key])
+        for pod in tail:
+            decisions.append(engine.schedule_one(pod))
+            self.last_order.append(pod.key)
+        self.fallback_seconds += perf() - t1
+
+        t2 = perf()
+        self._finalize_wasted()
+        if batch:
+            engine.explain.record_attempts(batch)
+        self.flush_seconds += perf() - t2
+        return decisions
+
+    def makespan_seconds(self) -> float:
+        """The modeled N-way wall: the slowest shard's propose time
+        plus every serialized segment (commit critical sections, the
+        sequential tail, prep, flush). With ``shards=1`` this is the
+        whole batch wall — the A/B baseline."""
+        return (
+            max(self.propose_seconds)
+            + self.commit_seconds
+            + self.fallback_seconds
+            + self.prep_seconds
+            + self.flush_seconds
+        )
+
+    def txn_totals(self):
+        """Cumulative ``(commits, conflicts)`` — the conflict-storm
+        alert rule's source (obs/alerts.py)."""
+        return self.commits, self.conflicts
+
+    # ---- drivers ----------------------------------------------------
+
+    def _run_interleaved(self, parts, journal_on, batch):
+        """Round-based concurrency model on the caller thread: each
+        round, every shard with work PROPOSES against the state as of
+        round start, then the arbiter commits the round's transactions
+        in shard order — so a later shard's transaction can genuinely
+        conflict with an earlier shard's commit, exactly as
+        free-running shard threads would. Per-segment perf_counter
+        walls are meaningful because only one segment runs at a time;
+        the round barrier is slightly conservative versus free-running
+        threads (a fast shard waits for the round), which makes the
+        modeled makespan an honest lower bound on the claimed
+        parallelism, not an optimistic one."""
+        engine = self.engine
+        perf = _time.perf_counter
+        decisions: List[Decision] = []
+        queues = [deque((pod, 0) for pod in p) for p in parts]
+        n_nodes = max(1, len(engine._node_index))
+        cursors = [
+            self._initial_cursor(s, n_nodes) for s in range(self.shards)
+        ]
+        while True:
+            round_txns: List[tuple] = []  # (shard, pod, tries, prop)
+            worked = False
+            for s in range(self.shards):
+                if not queues[s]:
+                    continue
+                pod, tries = queues[s].popleft()
+                worked = True
+                t0 = perf()
+                try:
+                    prop = propose(engine, pod, s, cursors[s], journal_on)
+                except Exception as e:
+                    # a dying shard loses only its in-flight READ:
+                    # nothing was mutated, the pod takes the
+                    # sequential path (tests pin the fingerprint)
+                    self.propose_seconds[s] += perf() - t0
+                    self.shard_failures += 1
+                    self.log.error("shard %d propose %s: %s",
+                                   s, pod.key, e)
+                    self._defer(pod, "propose-error")
+                    continue
+                self.propose_seconds[s] += perf() - t0
+                self.proposals += 1
+                cursors[s] = (cursors[s] + prop.consumed) % n_nodes
+                self._accumulate(pod, prop)
+                if prop.kind == FALLBACK:
+                    self._defer(pod, prop.reason)
+                    continue
+                prop.txn.attempt = tries + 1
+                round_txns.append((s, pod, tries, prop))
+            if not worked:
+                return decisions
+            for s, pod, tries, prop in round_txns:
+                result = self._commit(prop.txn)
+                if result.kind == COMMITTED:
+                    decisions.append(result.decision)
+                    self._finalize(prop.txn, result.decision)
+                    if batch is not None and prop.txn.rec is not None:
+                        batch.append(self._record_tuple(prop.txn))
+                elif tries + 1 >= self.max_retries:
+                    self._defer(pod, "conflict-exhausted")
+                else:
+                    self.retries += 1
+                    queues[s].appendleft((pod, tries + 1))
+
+    def _run_threaded(self, parts, journal_on, batch):
+        """Real shard threads racing the arbiter: proposals run
+        optimistically against live state while the arbiter (this
+        thread) serializes commits. Each shard submits one transaction
+        at a time and blocks on its verdict — the Omega model — so a
+        shard never proposes against its own uncommitted writes."""
+        import queue as _queue
+
+        engine = self.engine
+        perf = _time.perf_counter
+        decisions: List[Decision] = []
+        n_nodes = max(1, len(engine._node_index))
+        txq: "_queue.Queue" = _queue.Queue()
+
+        def shard_loop(s: int, part) -> None:
+            cursor = self._initial_cursor(s, n_nodes)
+            try:
+                for pod in part:
+                    tries = 0
+                    while True:
+                        t0 = perf()
+                        try:
+                            prop = propose(engine, pod, s, cursor,
+                                           journal_on)
+                        except Exception as e:
+                            with self._counter_lock:
+                                self.propose_seconds[s] += perf() - t0
+                                self.shard_failures += 1
+                            self.log.error(
+                                "shard %d propose %s: %s", s, pod.key, e
+                            )
+                            txq.put(("defer", pod, "propose-error", None))
+                            break
+                        with self._counter_lock:
+                            self.propose_seconds[s] += perf() - t0
+                            self.proposals += 1
+                        cursor = (cursor + prop.consumed) % n_nodes
+                        if prop.kind == FALLBACK:
+                            txq.put(("defer", pod, prop.reason, prop))
+                            break
+                        prop.txn.attempt = tries + 1
+                        verdict = threading.Event()
+                        slot: List[Optional[CommitResult]] = [None]
+                        txq.put(("txn", prop, verdict, slot))
+                        verdict.wait()
+                        result = slot[0]
+                        if result is None:
+                            return  # batch aborted by the arbiter
+                        if result.kind == COMMITTED:
+                            break
+                        tries += 1
+                        if tries >= self.max_retries:
+                            txq.put(("defer", pod,
+                                     "conflict-exhausted", None))
+                            break
+                        with self._counter_lock:
+                            self.retries += 1
+            finally:
+                txq.put(("done", s, None, None))
+
+        threads = [
+            threading.Thread(
+                target=shard_loop, args=(s, parts[s]),
+                name=f"shard-{s}", daemon=True,
+            )
+            for s in range(self.shards)
+        ]
+        for t in threads:
+            t.start()
+        remaining = self.shards
+        try:
+            while remaining:
+                kind, a, b, c = txq.get()
+                if kind == "done":
+                    remaining -= 1
+                    continue
+                if kind == "defer":
+                    pod, reason, prop = a, b, c
+                    if prop is not None:
+                        self._accumulate(pod, prop)
+                    self._defer(pod, reason)
+                    continue
+                prop, verdict, slot = a, b, c
+                try:
+                    self._accumulate(prop.pod, prop)
+                    result = self._commit(prop.txn)
+                    if result.kind == COMMITTED:
+                        decisions.append(result.decision)
+                        self._finalize(prop.txn, result.decision)
+                        if batch is not None and prop.txn.rec is not None:
+                            batch.append(self._record_tuple(prop.txn))
+                    slot[0] = result
+                finally:
+                    # ALWAYS answer the submitting shard: a commit
+                    # raising here leaves slot[0] None — the poison
+                    # verdict — so that shard exits instead of
+                    # blocking on this event forever
+                    verdict.set()
+        except BaseException:
+            # loud abort (e.g. a commit's API verb exhausted PR-8's
+            # retry budget and raised): every shard parked on — or
+            # about to park on — a verdict must be released with the
+            # poison result, or its thread blocks on verdict.wait()
+            # forever and the daemon leaks N threads per failed batch
+            self._abort_shards(txq, remaining)
+            for t in threads:
+                t.join(timeout=5.0)
+            raise
+        for t in threads:
+            t.join()
+        return decisions
+
+    @staticmethod
+    def _abort_shards(txq, remaining: int) -> None:
+        """Drain the transaction queue until every shard has reported
+        done, answering each in-flight transaction with the poison
+        (None) verdict so its shard stops instead of retrying into a
+        queue nobody consumes. Deferred pods are dropped — the batch
+        is aborting and the caller re-raises the original error."""
+        while remaining:
+            kind, a, b, c = txq.get()
+            if kind == "done":
+                remaining -= 1
+            elif kind == "txn":
+                c[0] = None
+                b.set()
+
+    # ---- the commit point ------------------------------------------
+
+    def _validate(self, txn: BindTransaction) -> List[str]:
+        """The serializable commit check. Returns the stale read-set
+        keys ([] = valid): a capacity release anywhere (monotone-loss
+        premise void), any scored node whose delta version moved, or
+        the tenant's ledger version moving."""
+        engine = self.engine
+        bad: List[str] = []
+        if engine.capacity_releases != txn.releases_seen:
+            bad.append(CONFLICT_RELEASE)
+        # direct dict reads, node_delta_version sans frames: the
+        # validation loop runs once per scored node per transaction —
+        # it IS the commit point's fixed cost
+        seq_get = engine.tree._delta_seq.get
+        for node, version in txn.node_versions.items():
+            if seq_get(node, 0) != version:
+                bad.append(node)
+        if txn.tenant_version >= 0 and (
+            engine.quota.ledger_version(txn.tenant) != txn.tenant_version
+        ):
+            bad.append(CONFLICT_LEDGER)
+        return bad
+
+    def _commit(self, txn: BindTransaction) -> CommitResult:
+        """Validate-then-apply, the arbiter critical section. Wall
+        time accrues into ``cost_seconds["commit"]`` (the satellite's
+        sub-phase) and the commit-latency histogram, conflicts
+        included — validation cost is commit cost."""
+        engine = self.engine
+        perf = _time.perf_counter
+        t0 = perf()
+        conflicts = self._validate(txn)
+        if not conflicts and engine.status.get(txn.pod.key) is not None:
+            conflicts = [CONFLICT_APPLY]  # defensive: state appeared
+        if conflicts:
+            self.conflicts += 1
+            return self._commit_exit(
+                txn, t0, CommitResult(CONFLICT, conflicts=conflicts)
+            )
+        pod, req, plan = txn.pod, txn.req, txn.plan
+        try:
+            status = engine.apply_reservation(pod, req, plan)
+        except Unschedulable:
+            # validation should make this unreachable; treat a refusal
+            # as one more conflict rather than trusting a stale plan
+            self.conflicts += 1
+            return self._commit_exit(
+                txn, t0, CommitResult(CONFLICT, conflicts=[CONFLICT_APPLY])
+            )
+        action, extra = engine.permit(pod, status)
+        rec = txn.rec
+        if rec is not None:
+            rec.permit_action = action
+            if plan.group_key:
+                rec.permit_group = plan.group_key
+                group = engine.groups.get(plan.group_key)
+                if group is not None:
+                    rec.permit_min_available = group.min_available
+            if action == "deny":
+                rec.permit_detail = extra
+            elif action == "wait":
+                rec.permit_detail = f"gang barrier, timeout {extra}s"
+            elif extra:
+                rec.permit_detail = (
+                    f"barrier released, co-binding {len(extra)} members"
+                )
+        replica_dt = 0.0
+        if action == "deny":
+            engine.unreserve(pod.key, reject_group=False)
+            engine._note_demand(pod.key, req, D.REASON_OVER_QUOTA,
+                                created_at=pod.created_at)
+            decision = Decision("unschedulable", pod.key, retryable=True,
+                                message=extra)
+        elif action == "allow":
+            # the bind verb + journal outcome write are REPLICA-LOCAL
+            # in the deployment this models: the winning scheduler
+            # issues its own apiserver bind after the cell-state
+            # transaction commits (bind races are PR-8's Conflict
+            # machinery), so their wall is charged to the winning
+            # shard's lane — and to the reserve_permit phase, exactly
+            # where the sequential walk charges bind verbs — not to
+            # the serialized commit section
+            tb = perf()
+            try:
+                engine._bind(pod.key, plan.node)
+            except Conflict:
+                engine.unreserve(pod.key, reject_group=False)
+                decision = Decision(
+                    "unschedulable", pod.key, retryable=True,
+                    message="bind conflict (another replica acted); "
+                            "requeued",
+                )
+            else:
+                decision = Decision("bound", pod.key, node=plan.node,
+                                    bound_with=extra)
+            replica_dt = perf() - tb
+        else:  # wait: parked at the gang barrier, capacity held
+            engine._note_demand(pod.key, req, D.REASON_GANG_WAITING,
+                                created_at=pod.created_at)
+            decision = Decision(
+                "waiting", pod.key, node=plan.node,
+                message=f"gang barrier, timeout {extra}s",
+            )
+        if rec is not None:
+            rec.outcome = decision.status
+            if decision.node:
+                rec.node = decision.node
+            if decision.message:
+                rec.message = decision.message
+        self.commits += 1
+        return self._commit_exit(
+            txn, t0, CommitResult(COMMITTED, decision=decision),
+            replica_dt=replica_dt,
+        )
+
+    def _commit_exit(self, txn, t0, result: CommitResult,
+                     replica_dt: float = 0.0) -> CommitResult:
+        dt = _time.perf_counter() - t0 - replica_dt
+        result.commit_seconds = dt
+        self.commit_seconds += dt
+        self.engine.cost_seconds["commit"] += dt
+        self.commit_hist.observe(dt)
+        entry = self._acc.get(txn.pod.key)
+        if entry is not None:
+            phases = entry[2]
+            phases["commit"] = phases.get("commit", 0.0) + dt
+            if replica_dt:
+                phases["reserve_permit"] = (
+                    phases.get("reserve_permit", 0.0) + replica_dt
+                )
+        if replica_dt:
+            with self._counter_lock:  # shard threads also add here
+                self.propose_seconds[txn.shard] += replica_dt
+        return result
+
+    # ---- cost finalization -----------------------------------------
+
+    def _accumulate(self, pod, prop) -> None:
+        """Fold a proposal attempt's phase walls into the pod's
+        accumulator (arbiter thread)."""
+        entry = self._acc.get(pod.key)
+        if entry is None:
+            entry = self._acc[pod.key] = [
+                prop.tenant, prop.kind_label, {},
+            ]
+        else:
+            if prop.tenant:
+                entry[0] = prop.tenant
+            if prop.kind_label:
+                entry[1] = prop.kind_label
+        phases = entry[2]
+        for phase, seconds in prop.phase_seconds.items():
+            phases[phase] = phases.get(phase, 0.0) + seconds
+
+    def _finalize(self, txn: BindTransaction, decision: Decision) -> None:
+        """A pod reached its terminal plane decision: merge its
+        accumulated propose phases into the engine's cost surface and
+        charge its (tenant, kind, outcome) class with the full total
+        (propose + commit) — one attempt, exactly once."""
+        entry = self._acc.pop(txn.pod.key, None)
+        if entry is None:
+            return
+        self.last_order.append(txn.pod.key)
+        self._charge_class(entry, decision.status)
+
+    def _defer(self, pod, reason: str) -> None:
+        self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+        self._fallback.append(pod)
+
+    def _finalize_wasted(self) -> None:
+        """Pods that fell back carry propose/conflict wall the
+        sequential tail did not re-spend: finalize it under the
+        ``conflict`` (read-set races) / ``fallback`` (read-only walk
+        could not decide) outcome classes so the cost plane's class
+        totals keep covering every attributed second."""
+        for key, entry in self._acc.items():
+            phases = entry[2]
+            outcome = "conflict" if phases.get("commit") else "fallback"
+            self._charge_class(entry, outcome)
+        self._acc = {}
+
+    def _charge_class(self, entry, outcome: str) -> None:
+        engine = self.engine
+        tenant, kind_label, phases = entry
+        total = 0.0
+        for phase, seconds in phases.items():
+            total += seconds
+            if phase != "commit":
+                # commit accrued live in _commit_exit; everything else
+                # merges here — each second lands in cost_seconds once
+                engine.cost_seconds[phase] = (
+                    engine.cost_seconds.get(phase, 0.0) + seconds
+                )
+        engine.cost_attempts += 1
+        engine.charge_cost_class((tenant, kind_label, outcome), total)
+
+    # ---- partitioning & prep ---------------------------------------
+
+    def _initial_cursor(self, shard: int, n_nodes: int) -> int:
+        """Spread shard filter windows around the node ring: mostly
+        disjoint sampling windows mean mostly disjoint winners, which
+        is what keeps a conflict-light backlog conflict-light."""
+        return (shard * n_nodes) // self.shards
+
+    def _partition(self, order) -> List[list]:
+        """Split the sorted backlog across shards: gangs hash by group
+        key (a gang never straddles shards, so its members propose one
+        at a time in queue order and the Permit barrier sees the same
+        sequence the sequential loop would produce); solo pods deal
+        round-robin — the modeled makespan is ``max`` over shard
+        walls, and a hash skew of a few percent lands entirely on the
+        critical path."""
+        parts: List[list] = [[] for _ in range(self.shards)]
+        solo_next = 0
+        for pod in order:
+            # derive the group key WITHOUT get_or_create: partitioning
+            # is a read, and registering a group for a pod pre_filter
+            # may yet reject would be a side effect the sequential
+            # path doesn't have (same "<namespace>/<gang>" key string
+            # the registry uses)
+            try:
+                gang = parse_gang(pod)
+            except LabelError:
+                gang = None  # malformed: solo; pre_filter will reject
+            if gang is not None and gang.min_available > 0:
+                group_key = f"{pod.namespace}/{gang.name}"
+                parts[
+                    zlib.crc32(group_key.encode()) % self.shards
+                ].append(pod)
+            else:
+                parts[solo_next].append(pod)
+                solo_next = (solo_next + 1) % self.shards
+        return parts
+
+    def _prewarm(self) -> None:
+        """Build every (node, model) aggregate on the arbiter thread
+        before proposals start: proposal threads then only READ the
+        aggregate cache (in-place refreshes stay arbiter-side), so a
+        torn cold build can never be cached by a racing reader."""
+        tree = self.engine.tree
+        for node in self.engine._node_index:
+            for model in tree.models_on_node(node):
+                tree.node_model_agg(node, model)
+
+    def _record_tuple(self, txn: BindTransaction):
+        tenant, model, shape, guarantee = txn.rec_meta
+        return (txn.pod.key, self.engine.clock(), txn.rec, tenant,
+                model, shape, guarantee)
+
+    # ---- observability ---------------------------------------------
+
+    def conflict_retry_rate(self) -> float:
+        """Conflicts per commit attempt (commits + conflicts) — the
+        headline MULTISCHED.json records per row."""
+        attempts = self.commits + self.conflicts
+        return self.conflicts / attempts if attempts else 0.0
+
+    def samples(self) -> List["expfmt.Sample"]:
+        out = [
+            expfmt.Sample("tpu_scheduler_shard_count", {}, self.shards),
+            expfmt.Sample("tpu_scheduler_txn_commits_total", {},
+                          self.commits),
+            expfmt.Sample("tpu_scheduler_txn_conflicts_total", {},
+                          self.conflicts),
+            expfmt.Sample("tpu_scheduler_txn_retries_total", {},
+                          self.retries),
+            expfmt.Sample("tpu_scheduler_txn_proposals_total", {},
+                          self.proposals),
+            expfmt.Sample("tpu_scheduler_shard_failures_total", {},
+                          self.shard_failures),
+        ]
+        for reason in sorted(self.fallbacks):
+            out.append(expfmt.Sample(
+                "tpu_scheduler_txn_fallbacks_total",
+                {"reason": reason}, self.fallbacks[reason],
+            ))
+        for s in range(self.shards):
+            out.append(expfmt.Sample(
+                "tpu_scheduler_shard_propose_seconds_total",
+                {"shard": str(s)}, self.propose_seconds[s],
+            ))
+        out += self.commit_hist.samples("tpu_scheduler_txn_commit_seconds")
+        return out

@@ -1,0 +1,193 @@
+"""The propose half of a shard scheduler: one read-only scheduling
+walk producing a :class:`~kubeshare_tpu.shard.txn.BindTransaction`.
+
+This is ``TpuShareScheduler._schedule_walk`` with every mutation
+removed: prefilter/parse, the quota admission READ, the candidate
+filter scan (per-shard rotation cursor — shards start spread around
+the node ring so their sampling windows, and therefore their chosen
+nodes, are mostly disjoint on big clusters), fresh scoring (the shared
+score memo is deliberately not written from proposal threads — a
+torn-read score cached by a proposal that later conflicts would
+poison the memo for the sequential path, and the memo's eviction hook
+runs on the arbiter thread), and ``plan_reservation`` (the read half
+of reserve). Node delta versions are captured BEFORE the first read
+of any node, so every feasibility/score read is covered: a mutation
+landing after capture moves the version and the commit point rejects
+the transaction.
+
+Anything the read-only walk cannot faithfully decide falls back to
+the sequential path on the arbiter (``Proposal(kind=FALLBACK)``):
+prefilter rejects (permanent-reject journaling), REGULAR pods (their
+bind is trivially cheap and mutates nothing shard-parallelism helps
+with), quota refusals (demand-ledger classification), empty filter
+results (defrag and fragmentation classification live there), and
+opportunistic pods while defrag holds are live (the hold view expires
+entries lazily — a mutation proposal threads must not perform).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Dict
+
+from ..autoscale import demand as D
+from ..explain.journal import AttemptRecord
+from ..scheduler.labels import PodKind
+from ..scheduler.plugin import Unschedulable
+from ..scheduler.scoring import pick_top2_seq
+from .txn import FALLBACK, PROPOSED, BindTransaction, Proposal
+
+
+def propose(engine, pod, shard: int, cursor: int,
+            journal_on: bool) -> Proposal:
+    """One proposal attempt for ``pod`` against live engine state.
+    Read-only: the engine is never mutated (beyond GIL-safe lazy
+    cache fills the sequential path performs identically). Returns a
+    PROPOSED transaction or a FALLBACK verdict; ``consumed`` is the
+    rotation-window progress for the caller's per-shard cursor."""
+    perf = _time.perf_counter
+    phases: Dict[str, float] = {}
+    mark = perf()
+
+    def boundary(phase: str) -> None:
+        nonlocal mark
+        now = perf()
+        phases[phase] = phases.get(phase, 0.0) + (now - mark)
+        mark = now
+
+    def fallback(reason: str, req=None, consumed: int = 0) -> Proposal:
+        return Proposal(
+            FALLBACK, pod, reason=reason, consumed=consumed,
+            tenant=req.tenant if req is not None else pod.namespace,
+            kind_label=req.kind.value if req is not None else "",
+            phase_seconds=phases,
+        )
+
+    rec = AttemptRecord(engine.clock()) if journal_on else None
+    releases_seen = engine.capacity_releases
+    try:
+        req = engine.pre_filter(pod)
+    except Unschedulable:
+        boundary("parse")
+        return fallback("prefilter")
+    if req.kind == PodKind.REGULAR:
+        boundary("parse")
+        return fallback("regular", req)
+    if engine._defrag_holds and not req.is_guarantee:
+        # the hold view (plugin._held_leaves) expires entries lazily —
+        # a mutation only the arbiter may perform
+        boundary("parse")
+        return fallback("defrag-holds", req)
+    group = engine.groups.get_or_create(pod, req.gang)
+    boundary("parse")
+
+    # quota admission READ — gang-granular exactly like the walk; the
+    # tenant's ledger version is captured BEFORE the read so a
+    # concurrent charge conflicts the transaction instead of
+    # committing against a stale admission verdict
+    gang_pending = 1
+    if group.key:
+        gang_pending = max(
+            1,
+            group.min_available - engine.status.held_in_group(group.key),
+        )
+    # the ledger version only guards the admission verdict, and that
+    # verdict reads the ledger only for CONFIGURED tenants (a tenant
+    # with neither guarantee nor borrow ceiling admits
+    # unconditionally) — for unconfigured tenants the sentinel skips
+    # validation, or every same-tenant commit would conflict every
+    # in-flight proposal and a single-tenant backlog would serialize
+    spec = engine.quota.registry.spec(req.tenant)
+    if spec.guaranteed is None and spec.borrow_limit is None:
+        tenant_version = -1
+    else:
+        tenant_version = engine.quota.ledger_version(req.tenant)
+    admitted, why, quota_detail = engine.quota.admit_detail(
+        req, count=gang_pending, with_detail=rec is not None
+    )
+    if rec is not None:
+        quota_detail.admitted = admitted
+        if why:
+            quota_detail.why = why
+        rec.quota = quota_detail
+    if not admitted:
+        boundary("quota")
+        return fallback("over-quota", req)
+    boundary("quota")
+
+    # capture every node's delta version BEFORE the filter reads: the
+    # scored subset of this snapshot becomes the read-set, and any
+    # mutation after this line moves a version the commit validates
+    names = list(engine._node_index)
+    n_names = len(names)
+    if not n_names:
+        boundary("filter")
+        return fallback("no-feasible", req)
+    versions = engine.tree.delta_versions_snapshot()
+    target = engine._feasible_target(n_names)
+    anchors = engine.status.group_placed_leaves(group.key)
+    anchor_nodes = {l.node for l in anchors if l.node}
+    start = cursor % n_names
+    feasible, rejections, scans, consumed = engine._filter_candidates(
+        pod, req, names, n_names, start, target, anchor_nodes
+    )
+    if rec is not None:
+        rec.filter_examined = scans
+        rec.filter_feasible = len(feasible)
+        rec.filter_target = target
+        if rejections:
+            rec.rejections = rejections
+    if not feasible:
+        # defrag and the fragmentation/no-feasible-cell demand
+        # classification belong to the sequential walk on the arbiter
+        boundary("filter")
+        return fallback("no-feasible", req, consumed=consumed)
+    boundary("filter")
+
+    seed_frees = (
+        engine._gang_seed_frees(req, feasible) if not anchors else None
+    )
+    values = [
+        engine.score(pod, req, name, anchors, seed_frees)
+        for name in feasible
+    ]
+    best, runner, best_raw, runner_raw = pick_top2_seq(feasible, values)
+    if rec is not None:
+        rec.score_candidates = len(values)
+        rec.winner_node = best
+        rec.winner_score = best_raw
+        if runner is not None:
+            rec.runner_node = runner
+            rec.runner_score = runner_raw
+    boundary("score")
+
+    try:
+        plan = engine.plan_reservation(pod, req, best)
+    except Unschedulable:
+        boundary("reserve_permit")
+        return fallback("no-chips-at-reserve", req, consumed=consumed)
+    boundary("reserve_permit")
+
+    txn = BindTransaction(
+        pod=pod,
+        req=req,
+        plan=plan,
+        shard=shard,
+        attempt=1,  # caller bumps on re-propose
+        node_versions={name: versions.get(name, 0) for name in feasible},
+        tenant=req.tenant,
+        tenant_version=tenant_version,
+        releases_seen=releases_seen,
+        rec=rec,
+        rec_meta=(req.tenant, req.model or "*",
+                  "regular" if req.kind == PodKind.REGULAR
+                  else D.shape_of(req),
+                  req.is_guarantee),
+        phase_seconds=phases,
+    )
+    boundary("journal")
+    return Proposal(
+        PROPOSED, pod, txn=txn, consumed=consumed,
+        tenant=req.tenant, kind_label=req.kind.value,
+        phase_seconds=phases,
+    )

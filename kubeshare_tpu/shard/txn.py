@@ -1,0 +1,103 @@
+"""Bind transactions: the optimistic-concurrency unit of the shard
+plane.
+
+A *bind transaction* is one shard scheduler's placement proposal,
+packaged with everything the commit arbiter needs to decide whether
+the proposal is still valid against current shared state:
+
+- the **read-set** — the per-node delta versions
+  (``CellTree.node_delta_version``) of every node the proposal SCORED
+  (captured before the first read, so a mutation landing anywhere in
+  the window moves the version and conflicts the transaction), the
+  proposing tenant's ledger version (``QuotaPlane.ledger_version``),
+  and the engine's ``capacity_releases`` counter;
+- the **write-set** — the :class:`ReservationPlan` (chosen leaves,
+  resolved memory/charge, annotation template) the commit applies.
+
+Why the read-set can stop at the scored nodes: between propose and
+commit the only mutators are other commits, and commits only CONSUME
+capacity — the same monotone-loss premise the wave scheduler's
+backfill memo rests on — so a node that was infeasible at propose
+time is still infeasible at commit time. Any capacity RELEASE
+(defrag eviction, Permit-deny unreserve, bind-conflict unreserve,
+informer delete) voids that premise, which is exactly what the
+``capacity_releases`` guard catches: the arbiter conflicts every
+in-flight transaction proposed before the release. Together the three
+checks make a committed transaction equivalent to running the full
+sequential scheduling walk at its commit point — the serializability
+claim tests/test_shard.py pins differentially.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# proposal dispositions (Proposal.kind)
+PROPOSED = "proposed"    # a transaction is ready for the arbiter
+FALLBACK = "fallback"    # route the pod to the sequential path
+
+# commit verdicts (CommitResult.kind)
+COMMITTED = "committed"  # applied; CommitResult.decision is final
+CONFLICT = "conflict"    # read-set stale; re-propose against fresh state
+
+# read-set conflict pseudo-keys (alongside plain node names)
+CONFLICT_RELEASE = "capacity-release"  # a release voided monotone loss
+CONFLICT_LEDGER = "tenant-ledger"      # the tenant's ledger moved
+CONFLICT_APPLY = "apply"               # defensive: apply itself refused
+
+
+@dataclass
+class BindTransaction:
+    """One shard's placement proposal plus its optimistic read-set.
+    Built on a proposal thread (read-only against the engine),
+    consumed exactly once by the commit arbiter."""
+
+    pod: object                      # cluster.api.Pod
+    req: object                      # scheduler.labels.PodRequirements
+    plan: object                     # scheduler.plugin.ReservationPlan
+    shard: int
+    attempt: int                     # 1-based proposal attempt
+    # read-set: scored-node delta versions + tenant ledger version +
+    # the global release counter at capture time
+    node_versions: Dict[str, int]
+    tenant: str
+    tenant_version: int
+    releases_seen: int
+    # journal scratch (None when the journal is disabled): the
+    # propose-side phase outcomes; the arbiter fills outcome/permit
+    # fields at commit and batches the record through one flush
+    rec: Optional[object] = None
+    rec_meta: tuple = ()             # (tenant, model, shape, guarantee)
+    # propose-side sub-phase wall seconds for THIS attempt
+    # (parse/quota/filter/score/reserve_permit/journal) — merged into
+    # the engine's cost attribution when the pod finalizes
+    phase_seconds: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class Proposal:
+    """What a proposal attempt produced: a transaction, or a fallback
+    verdict routing the pod to the sequential path (prefilter reject,
+    quota refusal, no feasible node, live defrag holds for an
+    opportunistic pod — every case where the sequential walk's own
+    journal/demand/defrag semantics must run)."""
+
+    kind: str                        # PROPOSED | FALLBACK
+    pod: object
+    reason: str = ""                 # fallback cause (telemetry)
+    txn: Optional[BindTransaction] = None
+    consumed: int = 0                # rotation-window progress (cursor)
+    # fallback proposals still burned read time; finalized at plane
+    # end under the "fallback" outcome class
+    tenant: str = ""
+    kind_label: str = ""
+    phase_seconds: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class CommitResult:
+    kind: str                        # COMMITTED | CONFLICT
+    decision: Optional[object] = None  # plugin.Decision when committed
+    conflicts: List[str] = field(default_factory=list)
+    commit_seconds: float = 0.0

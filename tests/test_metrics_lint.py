@@ -90,6 +90,15 @@ def scraped(tmp_path_factory):
     engine.schedule_one(pod("big", 4, prio=50))          # over-quota
     engine.schedule_one(pod("bad", 1.0, limit=0.5))      # prefilter
     engine.schedule_one(pod("weird", 0.5, ns=WEIRD_TENANT))
+    # the shard plane rides the same exposition: one pod committed
+    # through a real propose/commit cycle so the txn counters, the
+    # commit-latency histogram, and the "commit" cost phase carry
+    # values (and the cost class/phase sums stay exactly equal)
+    from kubeshare_tpu.shard import ShardedScheduler
+
+    shard_plane = ShardedScheduler(engine, shards=2)
+    [shard_decision] = shard_plane.schedule_backlog([pod("ok2", 0.5)])
+    assert shard_decision.status == "bound"
     clock[0] = 10.0
 
     # the request plane rides the same exposition: a router with a
@@ -136,7 +145,7 @@ def scraped(tmp_path_factory):
     from kubeshare_tpu.obs import AlertConfig, build_plane
 
     plane = build_plane(lambda: engine, cluster=kube, router=router,
-                        tracer=tracer,
+                        shard=shard_plane, tracer=tracer,
                         config=AlertConfig(eval_interval=0.0,
                                            cost_rules=True))
     plane.tick(clock[0])
@@ -151,7 +160,8 @@ def scraped(tmp_path_factory):
 
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
                                router=router, cluster=kube,
-                               obs=plane, profiler=hub)
+                               obs=plane, profiler=hub,
+                               shard=shard_plane)
     metrics.record_pass(0.01, 4)
 
     server = MetricServer(host="127.0.0.1", port=0)
@@ -267,6 +277,15 @@ class TestExpositionHygiene:
             ("tpu_scheduler_profiler_samples_total", "gauge"),
             ("tpu_scheduler_profiler_busy_rejections_total", "gauge"),
             ("tpu_scheduler_profiler_active", "gauge"),
+            # PR-11: shard plane transaction families
+            ("tpu_scheduler_shard_count", "gauge"),
+            ("tpu_scheduler_txn_commits_total", "gauge"),
+            ("tpu_scheduler_txn_conflicts_total", "gauge"),
+            ("tpu_scheduler_txn_retries_total", "gauge"),
+            ("tpu_scheduler_txn_proposals_total", "gauge"),
+            ("tpu_scheduler_shard_failures_total", "gauge"),
+            ("tpu_scheduler_shard_propose_seconds_total", "gauge"),
+            ("tpu_scheduler_txn_commit_seconds", "histogram"),
         ]:
             assert kinds.get(fam) == kind, (fam, kinds.get(fam))
 
@@ -289,6 +308,7 @@ class TestExpositionHygiene:
             "scheduler-restart", "node-capacity-drop",
             "api-error-rate", "watch-reconnect-storm", "degraded",
             "shed-rate", "cost-regression", "cost-phase-drift",
+            "conflict-storm",
         }
         assert set(active) == expected
         assert fired == expected
@@ -375,14 +395,19 @@ class TestExpositionHygiene:
         assert value(
             "tpu_scheduler_pod_wait_seconds_count",
             tenant="alpha", shape="shared", outcome="bound",
-        ) == 1
+        ) == 2  # "ok" via schedule_one + "ok2" via the shard plane
         assert value(
             "tpu_scheduler_pod_wait_seconds_count",
             tenant="alpha", outcome="unschedulable",
         ) == 1
-        # 4 pods + the slots::llama-7b pseudo-entry the router's
-        # no-free-slot transition filed through the ledger hook
-        assert value("tpu_scheduler_explain_journal_pods") == 5
+        # 5 pods (incl. the shard-committed one) + the slots::llama-7b
+        # pseudo-entry the router's no-free-slot transition filed
+        # through the ledger hook
+        assert value("tpu_scheduler_explain_journal_pods") == 6
+        # shard plane families carry the fixture's one committed txn
+        assert value("tpu_scheduler_txn_commits_total") == 1
+        assert value("tpu_scheduler_txn_conflicts_total") == 0
+        assert value("tpu_scheduler_txn_commit_seconds_count") == 1
         # PR-8 families carry the values staged in the fixture: the
         # degraded flag and API-health counters from the cluster
         # adapter, and the spool append for the one bound terminal
@@ -414,11 +439,14 @@ class TestExpositionHygiene:
         }
         assert set(phases) == {
             "parse", "quota", "filter", "score", "reserve_permit",
-            "journal",
+            "journal", "commit",
         }
         assert sum(phases.values()) > 0
+        # the shard plane's one commit charged the arbiter critical
+        # section into the new sub-phase
+        assert phases["commit"] > 0
         [attempts] = select("tpu_scheduler_cost_attempts_total")
-        assert attempts.value == 4  # ok, big, bad, weird
+        assert attempts.value == 5  # ok, big, bad, weird, ok2 (shard)
         # per-class attribution sums match the flat counters exactly
         class_secs = select("tpu_scheduler_cost_class_seconds_total")
         class_counts = select("tpu_scheduler_cost_class_attempts_total")

@@ -21,11 +21,11 @@ from kubeshare_tpu.obs import (
     IncidentPlane, IncidentStore, WindowSeries,
 )
 from kubeshare_tpu.obs.alerts import (
-    RULE_API_ERRORS, RULE_COST_REGRESSION, RULE_PHASE_DRIFT,
-    burn_rate_rule, capacity_drop_rule, cost_regression_rule,
-    counter_reset_rule, counter_window_rule, degraded_rule,
-    phase_drift_rule, queue_spike_rule, shed_rate_rule,
-    standard_rules,
+    RULE_API_ERRORS, RULE_CONFLICT_STORM, RULE_COST_REGRESSION,
+    RULE_PHASE_DRIFT, burn_rate_rule, capacity_drop_rule,
+    conflict_storm_rule, cost_regression_rule, counter_reset_rule,
+    counter_window_rule, degraded_rule, phase_drift_rule,
+    queue_spike_rule, shed_rate_rule, standard_rules,
 )
 from kubeshare_tpu.obs.http import register_obs
 from kubeshare_tpu.utils.httpserv import MetricServer
@@ -884,3 +884,137 @@ class TestLazyAttemptRecords:
         assert journal.queue_depths() == {"ml": 1, "batch": 1}
         worst = journal.worst_pending(10.0, tenant="ml", limit=5)
         assert [d["pod"] for d in worst] == ["default/p0"]
+
+
+# ===================== conflict-storm sentinel (PR-11) ===============
+
+
+class _TxnFeed:
+    """Synthetic cumulative (commits, conflicts) source — the shard
+    plane's ``txn_totals`` shape."""
+
+    def __init__(self):
+        self.commits = 0
+        self.conflicts = 0
+
+    def add(self, commits, conflicts=0):
+        self.commits += commits
+        self.conflicts += conflicts
+
+    def totals(self):
+        return (self.commits, self.conflicts)
+
+
+CONFLICT_CFG = AlertConfig(
+    fast_window=60.0, slow_window=300.0,
+    conflict_storm_factor=4.0, conflict_min_commits=20,
+    conflict_rate_floor=0.05,
+)
+
+
+class TestConflictStorm:
+    def _drive(self, rule, feed_steps, dt=10.0):
+        ev = AlertEvaluator([rule], eval_interval=0.0)
+        t = 0.0
+        for step in feed_steps:
+            step()
+            ev.evaluate(t, force=True)
+            t += dt
+        return ev
+
+    def test_storm_fires_quiet_baseline_does_not(self):
+        """A plane idling near zero conflicts stays quiet; a sustained
+        storm (half of commit traffic conflicting, > factor x floor)
+        fires exactly once at the edge."""
+        feed = _TxnFeed()
+        rule = conflict_storm_rule(feed.totals, CONFLICT_CFG)
+        quiet = lambda: feed.add(30, 0)          # noqa: E731
+        ev = self._drive(rule, [quiet] * 60)
+        st = ev.state(RULE_CONFLICT_STORM)
+        assert not st.active and st.fired_total == 0
+
+        feed = _TxnFeed()
+        rule = conflict_storm_rule(feed.totals, CONFLICT_CFG)
+        storm = lambda: feed.add(30, 30)         # noqa: E731
+        ev = self._drive(rule, [lambda: feed.add(30, 0)] * 60
+                         + [storm] * 40)
+        st = ev.state(RULE_CONFLICT_STORM)
+        assert st.active and st.fired_total == 1
+        assert st.last_context["fast_rate"] >= 0.4
+
+    def test_single_contended_wave_does_not_page(self):
+        """One burst of conflicts inflates the fast window but barely
+        moves the slow one — min(fast, slow) stays under the bar."""
+        feed = _TxnFeed()
+        rule = conflict_storm_rule(feed.totals, CONFLICT_CFG)
+        steps = [lambda: feed.add(30, 0)] * 60
+        steps.append(lambda: feed.add(30, 25))
+        steps += [lambda: feed.add(30, 0)] * 20
+        ev = self._drive(rule, steps)
+        st = ev.state(RULE_CONFLICT_STORM)
+        assert not st.active and st.fired_total == 0
+
+    def test_min_commits_floor_gates_verdict(self):
+        """A trickle of commit attempts below the windowed floor
+        yields no verdict even at a 100% conflict rate."""
+        feed = _TxnFeed()
+        rule = conflict_storm_rule(feed.totals, CONFLICT_CFG)
+        ev = self._drive(rule, [lambda: feed.add(1, 1)] * 40)
+        st = ev.state(RULE_CONFLICT_STORM)
+        assert not st.active and st.fired_total == 0
+
+    def test_baseline_frozen_while_hot_and_hysteresis_clears(self):
+        """The baseline must not EWMA-absorb a sustained storm; once
+        the storm ends, the rule clears only after the hysteresis
+        window of clean evaluations."""
+        feed = _TxnFeed()
+        rule = conflict_storm_rule(feed.totals, CONFLICT_CFG)
+        ev = self._drive(
+            rule,
+            [lambda: feed.add(30, 0)] * 60
+            + [lambda: feed.add(30, 30)] * 60,
+        )
+        st = ev.state(RULE_CONFLICT_STORM)
+        assert st.active
+        assert st.last_level >= 1.0  # still at/past the bar after 600s
+        # storm over: clean evals past the slow window clear it
+        t = 1200.0
+        for _ in range(40):
+            feed.add(30, 0)
+            ev.evaluate(t, force=True)
+            t += 10.0
+        assert not ev.state(RULE_CONFLICT_STORM).active
+
+    def test_counter_reset_tolerated(self):
+        """A restarted plane zeroes its counters: history clears, no
+        verdict until fresh windows fill."""
+        feed = _TxnFeed()
+        rule = conflict_storm_rule(feed.totals, CONFLICT_CFG)
+        steps = [lambda: feed.add(30, 0)] * 40
+
+        def crash():
+            feed.commits = 0
+            feed.conflicts = 0
+
+        steps.append(crash)
+        # a storm right after the reset, but below the windowed
+        # commit-attempts floor: history is void and the fresh deltas
+        # are too thin for a verdict
+        steps += [lambda: feed.add(4, 4)] * 2
+        ev = self._drive(rule, steps)
+        st = ev.state(RULE_CONFLICT_STORM)
+        assert not st.active and st.fired_total == 0
+
+    def test_standard_rules_wires_shard_source(self):
+        """standard_rules grows the conflict-storm rule exactly when a
+        shard plane (anything with txn_totals) is provided."""
+        class _Shard:
+            txn_totals = staticmethod(lambda: (0, 0))
+
+        engine_ref = lambda: None  # noqa: E731
+        base = {r.name for r in standard_rules(lambda: None)}
+        with_shard = {
+            r.name for r in standard_rules(lambda: None, shard=_Shard())
+        }
+        assert RULE_CONFLICT_STORM not in base
+        assert with_shard - base == {RULE_CONFLICT_STORM}

@@ -24,8 +24,11 @@ from profile_report import (  # noqa: E402
 
 ARTIFACT = os.path.join(REPO, "PROFILE.json")
 
+# "commit" is PR-11's arbiter critical section: 0 on the wave driver
+# (no shard plane in these replays) but always exported, so coverage
+# sums are unchanged while the phase vocabulary includes it
 PHASES = {"parse", "quota", "filter", "score", "reserve_permit",
-          "journal"}
+          "journal", "commit"}
 
 
 def _doc():

@@ -1,0 +1,620 @@
+"""Serializability + invariants for the sharded multi-scheduler plane
+(PR-11) — the generalization of PR-5's wave ≡ sequential differential
+to "serializable with conflict retries".
+
+Four claims, each pinned:
+
+1. **Serializable.** The N-shard plane's final state — per-pod binds,
+   tenant ledger, recovery fingerprint — equals a fresh engine
+   replaying the SAME pods sequentially through ``schedule_one`` in
+   the plane's finalize order (commits in commit order, fallbacks in
+   their execution order). Pinned on conflict-free traces AND on
+   contended traces where conflicts genuinely occurred: a committed
+   transaction's read-set validation makes it equivalent to running
+   the full sequential walk at its commit point. Differential runs
+   use clusters at or under the full-scan floor, where the walk is
+   rotation-cursor independent.
+2. **Invariants under contention + defrag + quota.** Zero
+   double-binds, ``ledger_drift() == {}``, live aggregate oracle
+   (``check_aggregates``) through every run, gang all-or-nothing.
+3. **Propose is read-only.** A proposal produced and DISCARDED — or a
+   shard dying mid-propose — leaves the engine state fingerprint,
+   ledger, and demand ledger byte-identical; the pod falls back.
+4. **Multi-incarnation recovery.** The arbiter dying between commits
+   loses nothing: an engine rebuilt from the cluster relist equals
+   the continued one on the PR-8 recovery fingerprint, and a new
+   plane on the rebuilt engine finishes the backlog with every
+   invariant intact.
+
+Plus the PR-11 thread-safety satellite: multi-thread hammers proving
+exact conservation on UsageLedger charge/credit and DemandLedger
+note/resolve, and the threaded plane racing real proposal threads
+against the arbiter.
+
+Seeded, no JAX, tier-1 fast.
+"""
+
+import random
+import threading
+
+import pytest
+
+from kubeshare_tpu.autoscale.demand import DemandLedger
+from kubeshare_tpu.cells.cell import ChipInfo
+from kubeshare_tpu.cluster.api import Pod
+from kubeshare_tpu.cluster.fake import FakeCluster
+from kubeshare_tpu.quota.ledger import UsageLedger
+from kubeshare_tpu.scheduler import constants as C
+from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+from kubeshare_tpu.shard import FALLBACK, PROPOSED, ShardedScheduler
+from kubeshare_tpu.shard.propose import propose
+
+GIB = 1 << 30
+
+
+def topo(n):
+    return {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": 4,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+                "torus": [2, 2],
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": f"n{i:03d}"}
+            for i in range(n)
+        ],
+    }
+
+
+def build(n_nodes, tenants=None, defrag=False, check=True):
+    cluster = FakeCluster()
+    for i in range(n_nodes):
+        name = f"n{i:03d}"
+        cluster.add_node(name, [
+            ChipInfo(f"{name}-c{j}", "tpu-v5e", 16 * GIB, j)
+            for j in range(4)
+        ])
+    engine = TpuShareScheduler(
+        topo(n_nodes), cluster, clock=lambda: 0.0,
+        tenants=tenants, defrag=defrag,
+    )
+    engine.tree.check_aggregates = check
+    return cluster, engine
+
+
+def make_pods(cluster, spec_rows):
+    """``spec_rows``: (name, labels) pairs -> created cluster pods."""
+    return [
+        cluster.create_pod(Pod(
+            name=name, namespace=ns, labels=labels,
+            scheduler_name=C.SCHEDULER_NAME,
+        ))
+        for name, ns, labels in spec_rows
+    ]
+
+
+def random_trace(rng, count, gang_every=0, tenants=("default",)):
+    """Randomized mixed-shape rows: fractional opportunistic pods,
+    whole-chip guarantee pods, and optionally whole-chip gangs."""
+    rows = []
+    gang_id = 0
+    i = 0
+    while i < count:
+        ns = rng.choice(tenants)
+        if gang_every and gang_id * gang_every < i:
+            gang_id += 1
+            size = rng.choice((2, 3))
+            for m in range(size):
+                rows.append((f"g{gang_id:02d}-m{m}", ns, {
+                    C.LABEL_TPU_REQUEST: "1",
+                    C.LABEL_TPU_LIMIT_ALIASES[1]: "1",
+                    C.LABEL_PRIORITY: "60",
+                    C.LABEL_GROUP_NAME: f"gang-{gang_id}",
+                    C.LABEL_GROUP_HEADCOUNT: str(size),
+                    C.LABEL_GROUP_THRESHOLD: "1.0",
+                }))
+            i += size
+            continue
+        roll = rng.random()
+        if roll < 0.6:
+            rows.append((f"p{i:04d}", ns, {
+                C.LABEL_TPU_REQUEST: str(round(rng.uniform(0.1, 0.9), 2)),
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+            }))
+        else:
+            chips = rng.choice(("1", "2"))
+            rows.append((f"m{i:04d}", ns, {
+                C.LABEL_TPU_REQUEST: chips,
+                C.LABEL_TPU_LIMIT_ALIASES[1]: chips,
+                C.LABEL_PRIORITY: "50",
+            }))
+        i += 1
+    return rows
+
+
+def final_state(cluster, engine, pods):
+    """The comparable end state: per-pod binds, ledger digest, and
+    the PR-8 recovery fingerprint."""
+    return {
+        "binds": {p.key: cluster.get_pod(p.key).node_name for p in pods},
+        "ledger": engine.quota.ledger.snapshot(),
+        "fingerprint": engine.recovery_fingerprint(),
+    }
+
+
+def replay_sequentially(n_nodes, spec_rows, order, **build_kw):
+    """Fresh engine, same pods, ``schedule_one`` in ``order`` —
+    'SOME sequential order', constructively."""
+    cluster, engine = build(n_nodes, **build_kw)
+    pods = {p.key: p for p in make_pods(cluster, spec_rows)}
+    for key in order:
+        engine.schedule_one(pods[key])
+    return cluster, engine, list(pods.values())
+
+
+class TestSerializableDifferential:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_underloaded_trace(self, seed):
+        """Underloaded 32-node cluster, 4 shards: the final state
+        equals the sequential replay exactly. At full-scan scale
+        every proposal's read-set covers the whole cluster, so
+        concurrent rounds DO conflict — the equality holding anyway
+        is the point: conflicts cost retries, never serializability.
+        (Genuinely conflict-free multi-shard runs need disjoint
+        read-sets — the model-partitioned test below, and the
+        spread sampling windows MULTISCHED.json measures at 1024
+        nodes.)"""
+        rng = random.Random(seed)
+        rows = random_trace(rng, 60)
+        cluster, engine = build(32)
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=4)
+        plane.schedule_backlog(pods)
+        assert cluster.double_binds == []
+        assert engine.ledger_drift() == {}
+        rc, re, rp = replay_sequentially(32, rows, plane.last_order)
+        assert final_state(cluster, engine, pods) == \
+            final_state(rc, re, rp)
+
+    def test_model_partitioned_trace_is_conflict_free(self):
+        """Disjoint read-sets really don't conflict: two chip models
+        on disjoint node pools, pods pinned alternately, two shards —
+        the round-robin partition sends each model to its own shard,
+        every proposal's scored set stays inside its own pool, and
+        the plane commits the whole backlog with ZERO conflicts while
+        still equaling the sequential replay."""
+        two_pool = {
+            "cell_types": {
+                "v5e-node": {
+                    "child_cell_type": "tpu-v5e",
+                    "child_cell_number": 4,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+                "v6e-node": {
+                    "child_cell_type": "tpu-v6e",
+                    "child_cell_number": 4,
+                    "child_cell_priority": 60,
+                    "is_node_level": True,
+                },
+            },
+            "cells": (
+                [{"cell_type": "v5e-node", "cell_id": f"a{i:02d}"}
+                 for i in range(12)]
+                + [{"cell_type": "v6e-node", "cell_id": f"b{i:02d}"}
+                   for i in range(12)]
+            ),
+        }
+
+        def build_two():
+            cluster = FakeCluster()
+            for i in range(12):
+                cluster.add_node(f"a{i:02d}", [
+                    ChipInfo(f"a{i:02d}-c{j}", "tpu-v5e", 16 * GIB, j)
+                    for j in range(4)
+                ])
+                cluster.add_node(f"b{i:02d}", [
+                    ChipInfo(f"b{i:02d}-c{j}", "tpu-v6e", 32 * GIB, j)
+                    for j in range(4)
+                ])
+            engine = TpuShareScheduler(two_pool, cluster,
+                                       clock=lambda: 0.0)
+            engine.tree.check_aggregates = True
+            return cluster, engine
+
+        rows = []
+        for i in range(40):
+            model = "tpu-v5e" if i % 2 == 0 else "tpu-v6e"
+            rows.append((f"p{i:03d}", "default", {
+                C.LABEL_TPU_REQUEST: "0.5",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+                C.LABEL_TPU_MODEL: model,
+            }))
+        cluster, engine = build_two()
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=2)
+        decisions = plane.schedule_backlog(pods)
+        assert plane.conflicts == 0
+        assert all(d.status == "bound" for d in decisions)
+        assert cluster.double_binds == []
+        assert engine.ledger_drift() == {}
+        rc2, re2 = build_two()
+        rp2 = {p.key: p for p in make_pods(rc2, rows)}
+        for key in plane.last_order:
+            re2.schedule_one(rp2[key])
+        assert final_state(cluster, engine, pods) == \
+            final_state(rc2, re2, list(rp2.values()))
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_contended_trace_with_real_conflicts(self, seed):
+        """A small contended cluster forces genuine read-set
+        conflicts (every shard scores every node); retries + the
+        sequential fallback still land a final state equal to the
+        sequential replay in finalize order."""
+        rng = random.Random(100 + seed)
+        rows = random_trace(rng, 40)
+        cluster, engine = build(8)
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=4)
+        plane.schedule_backlog(pods)
+        assert plane.conflicts > 0  # contention is real
+        assert cluster.double_binds == []
+        assert engine.ledger_drift() == {}
+        rc, re, rp = replay_sequentially(8, rows, plane.last_order)
+        assert final_state(cluster, engine, pods) == \
+            final_state(rc, re, rp)
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_gang_trace(self, seed):
+        """Gangs hash to one shard and serialize through the commit
+        barrier: binds, waits, and the final state all match the
+        sequential replay."""
+        rng = random.Random(200 + seed)
+        rows = random_trace(rng, 36, gang_every=6)
+        cluster, engine = build(24)
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=4)
+        plane.schedule_backlog(pods)
+        assert cluster.double_binds == []
+        assert engine.ledger_drift() == {}
+        rc, re, rp = replay_sequentially(24, rows, plane.last_order)
+        assert final_state(cluster, engine, pods) == \
+            final_state(rc, re, rp)
+
+    def test_quota_trace(self, ):
+        """Configured tenants: the gate refuses over-quota guarantee
+        pods (fallback files the demand note), the tenant ledger
+        version guards admissions, and the end state still equals the
+        replay."""
+        tenants = {"tenants": {
+            "alpha": {"weight": 2.0, "guaranteed": 0.25},
+            "beta": {"weight": 1.0, "borrow_limit": 0.5},
+        }}
+        rng = random.Random(7)
+        rows = random_trace(rng, 48, tenants=("alpha", "beta"))
+        cluster, engine = build(16, tenants=tenants)
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=4)
+        plane.schedule_backlog(pods)
+        assert cluster.double_binds == []
+        assert engine.ledger_drift() == {}
+        rc, re, rp = replay_sequentially(
+            16, rows, plane.last_order, tenants=tenants,
+        )
+        assert final_state(cluster, engine, pods) == \
+            final_state(rc, re, rp)
+
+
+class TestInvariants:
+    @pytest.mark.parametrize("threaded", (False, True))
+    def test_contended_defrag_quota_invariants(self, threaded):
+        """The full adversarial mix — contention, defrag on, quota
+        tenants, gangs, both drivers — holds the invariant set: zero
+        double-binds, exact ledger, live aggregate oracle, gang
+        all-or-nothing."""
+        tenants = {"tenants": {
+            "alpha": {"weight": 2.0, "guaranteed": 0.25},
+            "beta": {"weight": 1.0},
+        }}
+        rng = random.Random(11)
+        rows = random_trace(rng, 64, gang_every=8,
+                            tenants=("alpha", "beta"))
+        cluster, engine = build(12, tenants=tenants, defrag=True)
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=4)
+        plane.schedule_backlog(pods, threaded=threaded)
+        assert cluster.double_binds == []
+        assert engine.ledger_drift() == {}
+        assert engine.backfill_head_delays == 0
+        # gang all-or-nothing: no group may end partially BOUND below
+        # its barrier threshold (members parked WAITING hold capacity
+        # but bind together or not at all)
+        by_group = {}
+        for status in engine.status.values():
+            if status.group_key:
+                by_group.setdefault(status.group_key, []).append(status)
+        for group_key, members in by_group.items():
+            bound = sum(1 for s in members if s.state.value == "bound")
+            group = engine.groups.get(group_key)
+            assert bound == 0 or bound >= group.min_available, group_key
+
+    def test_repeated_batches_reuse_the_plane(self):
+        """The plane is reusable across batches (the daemon loop
+        shape): counters accumulate, invariants hold each time."""
+        cluster, engine = build(16)
+        plane = ShardedScheduler(engine, shards=3)
+        for batch in range(3):
+            rows = random_trace(random.Random(batch), 20)
+            rows = [(f"b{batch}-{name}", ns, labels)
+                    for name, ns, labels in rows]
+            pods = make_pods(cluster, rows)
+            plane.schedule_backlog(pods)
+            assert engine.ledger_drift() == {}
+        assert plane.batches == 3
+        assert cluster.double_binds == []
+
+
+class TestProposeReadOnly:
+    def test_discarded_proposal_leaves_no_trace(self):
+        """Propose then throw the transaction away: fingerprint,
+        ledger, demand ledger, and status store are untouched — a
+        shard can die mid-propose (or mid-wait) and forfeit nothing
+        but its own work."""
+        cluster, engine = build(8)
+        pods = make_pods(cluster, random_trace(random.Random(3), 10))
+        before = (
+            engine.recovery_fingerprint(),
+            engine.quota.ledger.snapshot(),
+            len(engine.demand),
+            len(list(engine.status.values())),
+        )
+        for pod in pods:
+            prop = propose(engine, pod, 0, 0, True)
+            assert prop.kind in (PROPOSED, FALLBACK)
+        after = (
+            engine.recovery_fingerprint(),
+            engine.quota.ledger.snapshot(),
+            len(engine.demand),
+            len(list(engine.status.values())),
+        )
+        assert before == after
+
+    def test_shard_dying_mid_propose_falls_back(self):
+        """An exception inside a shard's propose (injected into the
+        score hook for one pod) kills nothing: the pod takes the
+        sequential path, every other pod schedules normally, the
+        failure is counted, state stays exact."""
+        cluster, engine = build(16)
+        pods = make_pods(cluster, random_trace(random.Random(5), 24))
+        poisoned = pods[7].key
+        orig_score = engine.score
+        armed = [True]  # one-shot: the shard dies once, the
+        # sequential fallback later in the batch runs clean
+
+        def score(pod, req, node, anchors=None, seed_frees=None):
+            if pod.key == poisoned and armed[0]:
+                armed[0] = False
+                raise RuntimeError("shard died mid-propose")
+            return orig_score(pod, req, node, anchors, seed_frees)
+
+        engine.score = score
+        plane = ShardedScheduler(engine, shards=4)
+        decisions = plane.schedule_backlog(pods)
+        engine.score = orig_score
+        assert plane.shard_failures == 1
+        assert plane.fallbacks.get("propose-error", 0) == 1
+        assert len(decisions) == len(pods)
+        assert engine.ledger_drift() == {}
+        assert cluster.double_binds == []
+        # the poisoned pod still got a real decision via the
+        # sequential fallback at the end of the batch
+        poisoned_decisions = [
+            d for d in decisions if d.pod_key == poisoned
+        ]
+        assert poisoned_decisions and \
+            poisoned_decisions[0].status == "bound"
+
+
+class TestMultiIncarnationRecovery:
+    def test_arbiter_dies_between_commits(self):
+        """Kill the arbiter mid-backlog (schedule only half, then
+        abandon the plane): an engine rebuilt from the cluster relist
+        equals the continued engine on the recovery fingerprint, and
+        a NEW plane incarnation on the rebuilt engine finishes the
+        rest with clean invariants — multi-incarnation recovery."""
+        rows = random_trace(random.Random(9), 40)
+        cluster, engine = build(16)
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=4)
+        plane.schedule_backlog(pods[:20])
+        continued = engine.recovery_fingerprint()
+
+        # "crash": the cluster is the durable store; a fresh engine
+        # rebuilds from the relist (PR-8 contract)
+        cluster.reset_handlers()
+        rebuilt_engine = TpuShareScheduler(
+            topo(16), cluster, clock=lambda: 0.0,
+        )
+        rebuilt_engine.tree.check_aggregates = True
+        assert rebuilt_engine.recovery_fingerprint() == continued
+        assert rebuilt_engine.ledger_drift() == {}
+
+        plane2 = ShardedScheduler(rebuilt_engine, shards=4)
+        decisions = plane2.schedule_backlog(pods[20:])
+        assert len(decisions) == 20
+        assert cluster.double_binds == []
+        assert rebuilt_engine.ledger_drift() == {}
+
+    def test_threaded_abort_releases_every_shard(self):
+        """A commit raising out of the THREADED arbiter loop must
+        release every shard parked on its verdict (poison result)
+        instead of leaking blocked threads, and still re-raise."""
+        rows = random_trace(random.Random(17), 32)
+        cluster, engine = build(16)
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=4)
+        orig_bind = cluster.bind
+        calls = [0]
+
+        def bind(pod_key, node_name):
+            calls[0] += 1
+            if calls[0] == 5:
+                raise RuntimeError("apiserver gone")
+            orig_bind(pod_key, node_name)
+
+        cluster.bind = bind
+        before = threading.active_count()
+        with pytest.raises(RuntimeError):
+            plane.schedule_backlog(pods, threaded=True)
+        cluster.bind = orig_bind
+        # every shard thread exited — nothing parked on a verdict
+        assert threading.active_count() == before
+        assert engine.ledger_drift() == {}
+
+    def test_arbiter_crash_mid_batch_interrupt(self):
+        """An exception thrown out of a commit (injected bind error)
+        aborts the batch loudly; the engine's own state stays
+        consistent and a rebuilt incarnation matches it."""
+        rows = random_trace(random.Random(13), 24)
+        cluster, engine = build(16)
+        pods = make_pods(cluster, rows)
+        plane = ShardedScheduler(engine, shards=2)
+        orig_bind = cluster.bind
+        calls = [0]
+
+        def bind(pod_key, node_name):
+            calls[0] += 1
+            if calls[0] == 8:
+                raise RuntimeError("apiserver gone")
+            orig_bind(pod_key, node_name)
+
+        cluster.bind = bind
+        with pytest.raises(RuntimeError):
+            plane.schedule_backlog(pods)
+        cluster.bind = orig_bind
+        # the died-mid-bind pod holds a RESERVED status (PR-8's bind
+        # retry owns it); ledger still matches held charges exactly
+        assert engine.ledger_drift() == {}
+        cluster.reset_handlers()
+        rebuilt = TpuShareScheduler(topo(16), cluster,
+                                    clock=lambda: 0.0)
+        assert rebuilt.recovery_fingerprint() == \
+            engine.recovery_fingerprint()
+
+
+class TestHammer:
+    """PR-11 thread-safety satellite: exact conservation under
+    deliberately concurrent writers."""
+
+    def test_usage_ledger_concurrent_charge_credit_conserves(self):
+        ledger = UsageLedger()
+        threads = 8
+        ops = 400
+        barrier = threading.Barrier(threads)
+
+        def worker(i):
+            rng = random.Random(i)
+            tenant = f"t{i % 4}"
+            barrier.wait()
+            for _ in range(ops):
+                chips = round(rng.uniform(0.1, 2.0), 3)
+                mem = rng.randrange(1, 1 << 30)
+                guarantee = rng.random() < 0.5
+                ledger.charge(tenant, chips, mem, guarantee)
+                ledger.credit(tenant, chips, mem, guarantee)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # exact conservation: every charge met its inverse credit —
+        # the snapshot must be empty (idle tenants dropped), not
+        # merely near zero
+        assert ledger.snapshot() == {}
+
+    def test_usage_ledger_concurrent_net_balance_exact(self):
+        """Charges without credits from many threads sum exactly —
+        no read-modify-write interleave may lose one."""
+        ledger = UsageLedger()
+        threads, ops = 8, 500
+        barrier = threading.Barrier(threads)
+
+        def worker(i):
+            barrier.wait()
+            for _ in range(ops):
+                ledger.charge("shared", 1.0, 1, True)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        snap = ledger.snapshot()
+        assert snap["shared"] == (
+            float(threads * ops), threads * ops,
+            float(threads * ops), threads * ops,
+        )
+
+    def test_demand_ledger_concurrent_note_resolve(self):
+        """Concurrent note/resolve storms settle exactly: every pod
+        noted by all threads then resolved once ends absent; pods
+        never resolved end present — len() is exact."""
+        class _Req:
+            tenant = "t"
+            model = ""
+            is_guarantee = False
+            kind = None
+            serving_slots = 0
+
+            @property
+            def request(self):
+                return 0.5
+
+        ledger = DemandLedger()
+        req = _Req()
+        threads = 6
+        keys = [f"pod-{i}" for i in range(50)]
+        barrier = threading.Barrier(threads)
+
+        def worker(i):
+            barrier.wait()
+            for key in keys:
+                ledger.note(key, req, "no-feasible-cell", 1.0, 0.5, 0)
+            if i == 0:
+                for key in keys[:25]:
+                    ledger.resolve(key)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        # thread 0 resolved 25 AFTER its notes, but other threads may
+        # re-note them — settle deterministically now
+        for key in keys[:25]:
+            ledger.resolve(key)
+        assert len(ledger) == 25
+        for e in ledger.entries():
+            assert e.pod_key in keys[25:]
+
+    def test_threaded_plane_exact_conservation(self):
+        """The satellite's headline hammer: real shard threads racing
+        the arbiter on a contended cluster — ledger exact, no double
+        binds, every pod decided, repeated 3x."""
+        for round_ in range(3):
+            cluster, engine = build(8, check=False)
+            rows = random_trace(random.Random(round_), 48)
+            pods = make_pods(cluster, rows)
+            plane = ShardedScheduler(engine, shards=4)
+            decisions = plane.schedule_backlog(pods, threaded=True)
+            assert len(decisions) == len(pods)
+            assert cluster.double_binds == []
+            assert engine.ledger_drift() == {}
