@@ -21,6 +21,11 @@ engine-bench:
 sim-replay:
 	$(PYTHON) tools/sim_replay.py
 
+# migration plane A/Bs: evict-vs-move on a fragmentation-heavy trace
+# + compaction sweeps on/off on a gang torus trace -> MIGRATION.json
+migrate-sim:
+	$(PYTHON) tools/migrate_sim.py
+
 # multi-tenant skew replay through the quota plane -> FAIRNESS.json
 # (cluster Jain index + per-tenant shares + the reclaim proof)
 fairness-sim:
@@ -120,4 +125,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay fairness-sim autoscale-sim explain-report serving-sim chaos-sim incident-report profile-report dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay migrate-sim fairness-sim autoscale-sim explain-report serving-sim chaos-sim incident-report profile-report dryrun images push save kind-e2e perf-evidence clean

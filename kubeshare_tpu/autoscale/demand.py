@@ -52,6 +52,12 @@ REASON_NO_FEASIBLE_CELL = "no-feasible-cell"
 REASON_FRAGMENTATION = "fragmentation-blocked"
 REASON_GANG_WAITING = "gang-waiting"
 REASON_NO_FREE_SLOT = "no-free-slot"
+# a migration-displaced pod waiting out its checkpoint/rebind window:
+# a committed move holds a pinned destination for it, so this is
+# neither a capacity shortfall nor quota pressure — the autoscale
+# sizing terms exclude it (scaling up for capacity a committed move is
+# about to free would buy nodes the cluster does not need)
+REASON_MIGRATION_PENDING = "migration-pending"
 
 REASONS = (
     REASON_OVER_QUOTA,
@@ -59,6 +65,7 @@ REASONS = (
     REASON_FRAGMENTATION,
     REASON_GANG_WAITING,
     REASON_NO_FREE_SLOT,
+    REASON_MIGRATION_PENDING,
 )
 
 # reasons that mean "admitted but unplaceable" — capacity the cluster

@@ -46,7 +46,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Tuple
 
 from .demand import (
-    REASON_NO_FREE_SLOT, UNPLACED_REASONS, DemandEntry, DemandLedger,
+    REASON_MIGRATION_PENDING, REASON_NO_FREE_SLOT, UNPLACED_REASONS,
+    DemandEntry, DemandLedger,
 )
 
 _EPS = 1e-9
@@ -205,8 +206,13 @@ class Recommender:
                 if e.tenant == tenant and e.guarantee and e.model == model
                 # slot backlog is not chip demand: it sizes REPLICAS
                 # (the serving term); the replica pods file their own
-                # chip demand once submitted
-                and e.reason != REASON_NO_FREE_SLOT
+                # chip demand once submitted. Migration-pending pods
+                # hold a pinned destination a committed move is about
+                # to hand them — sizing quota for them would buy
+                # capacity the move already accounts for.
+                and e.reason not in (
+                    REASON_NO_FREE_SLOT, REASON_MIGRATION_PENDING,
+                )
             )
             if demand <= 0:
                 continue

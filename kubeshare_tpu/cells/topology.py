@@ -69,6 +69,24 @@ def id_path_distance(id_a: str, id_b: str) -> float:
     return dist
 
 
+def mean_pairwise_hops(leaves: Sequence) -> float:
+    """Mean pairwise :func:`ici_distance` over a set of leaf cells —
+    the gang-spread statistic: the sim report, the live
+    ``tpu_scheduler_gang_ici_spread_hops`` gauge, and the compaction
+    sweeper's objective all share this one walk. 0.0 below two
+    leaves."""
+    n = len(leaves)
+    if n < 2:
+        return 0.0
+    total = 0.0
+    pairs = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            total += ici_distance(leaves[i], leaves[j])
+            pairs += 1
+    return total / pairs
+
+
 def ici_distance(leaf_a, leaf_b) -> float:
     """Distance between two *leaf* cells (``Cell`` instances).
 

@@ -119,6 +119,27 @@ def build_parser() -> argparse.ArgumentParser:
              "--defrag-eviction-rate",
     )
     parser.add_argument(
+        "--migrate", action="store_true",
+        help="checkpoint/restore migration as the defrag verb: when "
+             "the modeled move price (checkpoint + restore + warmup, "
+             "sized off the victim's HBM request) beats the modeled "
+             "restart price, defrag victims get a pinned destination "
+             "reservation instead of a plain evict-and-resubmit; a "
+             "destination that breaks before the rebind commits "
+             "falls back to today's eviction path",
+    )
+    parser.add_argument(
+        "--compaction", action="store_true",
+        help="idle-tick compaction sweeps (requires --migrate): "
+             "drain straggler fractional pods off nearly-empty nodes "
+             "and move the worst-spread gang's member closer to its "
+             "siblings, under the --defrag-eviction-rate budget",
+    )
+    parser.add_argument(
+        "--compaction-interval", type=float, default=60.0,
+        help="seconds between compaction sweeps",
+    )
+    parser.add_argument(
         "--autoscale-interval", type=float, default=0.0,
         help="run the capacity planner every N seconds (0 = off): "
              "demand ledger + quota deficits -> per-model node-pool "
@@ -647,6 +668,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         log.info("journal spool at %s (%.0f MiB x %d files)",
                  args.journal_spool, args.journal_spool_max_mb,
                  args.journal_spool_files)
+    if args.compaction and not args.migrate:
+        raise SystemExit("--compaction requires --migrate")
     engine = TpuShareScheduler(
         topology=args.topology,
         cluster=cluster,
@@ -660,6 +683,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         defrag_hold_ttl=args.defrag_hold_ttl,
         defrag_eviction_rate=args.defrag_eviction_rate,
         defrag_reclaim_share=args.defrag_reclaim_share,
+        migrate=args.migrate,
+        compaction=args.compaction,
+        compaction_interval=args.compaction_interval,
         percentage_of_nodes_to_score=args.percentage_of_nodes_to_score,
         min_feasible_nodes=args.min_feasible_nodes,
         tenants=args.tenants or None,

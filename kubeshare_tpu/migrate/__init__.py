@@ -1,0 +1,12 @@
+"""Migration plane: checkpoint/restore pod moves, destination pins,
+and ICI-compact defrag sweeps (see plane.py for the full contract)."""
+
+from .cost import MigrationCost, MoveCost
+from .plane import MigrationPlane, PendingMove
+
+__all__ = [
+    "MigrationCost",
+    "MoveCost",
+    "MigrationPlane",
+    "PendingMove",
+]

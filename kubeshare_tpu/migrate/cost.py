@@ -1,0 +1,90 @@
+"""The migration cost model: when is a move cheaper than a restart?
+
+Eviction prices a victim with a FULL restart: every chip-second it ran
+is discarded and redone. Checkpoint/restore migration (Gandiva's cheap
+consolidation primitive) prices the same displacement as a bounded
+pause: serialize the workload's state (the pod's HBM footprint — the
+same orbax checkpoints ``models/checkpoint.py`` already writes), free
+the source, restore on the destination, and re-warm (recompilation,
+cache refill). The planner compares the two modeled prices and only
+migrates when the move wins; a pod that has barely started is cheaper
+to restart, one that has been running for an hour is not.
+
+All quantities are modeled seconds on whatever clock the engine runs
+(virtual in the simulator, monotonic in the daemon). The bandwidth
+defaults are deliberately conservative for a TPU VM writing sharded
+checkpoints to a persistent store; they are constructor knobs, not
+constants, so a deployment can calibrate them from its own
+checkpoint timings.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+_GIB = float(1 << 30)
+
+
+@dataclass(frozen=True)
+class MoveCost:
+    """One move's modeled price, split the way the simulator spends it
+    on the virtual clock: pause+checkpoint before the source frees,
+    restore+warmup after the destination binds."""
+
+    checkpoint_s: float
+    restore_s: float
+    warmup_s: float
+
+    @property
+    def total_s(self) -> float:
+        return self.checkpoint_s + self.restore_s + self.warmup_s
+
+
+@dataclass(frozen=True)
+class MigrationCost:
+    """Checkpoint/restore/warmup price as a function of the pod's HBM
+    footprint, plus the restart-side terms the move is compared
+    against."""
+
+    checkpoint_gbps: float = 4.0    # HBM -> durable store write rate
+    restore_gbps: float = 8.0       # durable store -> HBM read rate
+    checkpoint_overhead_s: float = 2.0   # barrier + serialize setup
+    restore_overhead_s: float = 2.0      # pod start + store open
+    warmup_s: float = 10.0          # recompilation / cache refill
+    requeue_s: float = 5.0          # scheduling delay a restart pays
+
+    def checkpoint_seconds(self, hbm_bytes: int) -> float:
+        return self.checkpoint_overhead_s + (
+            hbm_bytes / _GIB / self.checkpoint_gbps
+            if self.checkpoint_gbps > 0 else 0.0
+        )
+
+    def restore_seconds(self, hbm_bytes: int) -> float:
+        return self.restore_overhead_s + (
+            hbm_bytes / _GIB / self.restore_gbps
+            if self.restore_gbps > 0 else 0.0
+        )
+
+    def move_cost(self, hbm_bytes: int) -> MoveCost:
+        return MoveCost(
+            checkpoint_s=self.checkpoint_seconds(hbm_bytes),
+            restore_s=self.restore_seconds(hbm_bytes),
+            warmup_s=self.warmup_s,
+        )
+
+    def move_seconds(self, hbm_bytes: int) -> float:
+        return self.move_cost(hbm_bytes).total_s
+
+    def restart_seconds(self, run_elapsed_s: float) -> float:
+        """What a plain eviction costs the victim: every second it
+        already ran is redone, plus the requeue delay."""
+        return max(0.0, run_elapsed_s) + self.requeue_s
+
+    def move_beats_restart(self, hbm_bytes: int,
+                           run_elapsed_s: float) -> bool:
+        """The planner's decision rule: migrate only when the modeled
+        move price undercuts the modeled restart price. Fresh pods
+        (elapsed < move cost - requeue) restart; long-runners move."""
+        return self.move_seconds(hbm_bytes) < self.restart_seconds(
+            run_elapsed_s
+        )

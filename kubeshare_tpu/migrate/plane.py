@@ -1,0 +1,591 @@
+"""The migration plane: checkpoint/restore pod moves as a scheduler verb.
+
+Defrag's only consolidation verb used to be controlled EVICTION — a
+full restart priced on the victim. Real TPU workloads checkpoint
+(``models/checkpoint.py``), so the cheap primitive is a MOVE: pause,
+checkpoint the HBM footprint, free the source, restore on a
+pre-validated destination. This plane makes that move first-class:
+
+- ``consider_move`` — called per defrag victim (and by the compaction
+  sweeps): decides move-vs-evict with the :class:`MigrationCost` model
+  (modeled move price vs modeled restart price), picks a destination
+  through the engine's own Filter/score/select_leaves read path, and
+  registers a :class:`PendingMove` whose chosen leaves are PINNED —
+  invisible to every other pod, guarantee class included — until the
+  victim's replacement rebinds or the pin expires.
+- destination-reservation transactionality: the pin captures the
+  destination node's delta version (the shard plane's read-validation
+  clock). ``tick`` re-validates pins whose version moved; a
+  destination that broke before the rebind commits drops the pin and
+  the replacement falls back to today's evict-and-resubmit path — a
+  failed move NEVER loses the pod, it just reschedules normally.
+- ``rebind_target`` — the engine's scheduling walk asks it per
+  attempt: a pinned replacement skips the candidate scan and places
+  straight onto its reserved destination (the commit point); a filter
+  failure there abandons the pin and the walk continues unpinned.
+- :class:`CompactionSweeper` semantics in ``tick``: on idle ticks
+  (empty demand ledger — never while capacity is owed), proactively
+  (a) drain straggler fractional pods off nearly-empty nodes so whole
+  nodes return to the multi-chip pool, and (b) move one member of the
+  worst-ICI-spread gang closer to its siblings (the spread statistic
+  the sim report carries is the objective). Both spend the SAME
+  defrag eviction-rate budget and respect its sliding window.
+
+The plane is entirely gated: an engine built without ``migrate=True``
+holds no plane, pays no per-attempt probes, and is decision-for-
+decision identical to the pre-plane evict-and-resubmit defrag path
+(pinned differentially in tests/test_migrate.py).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from ..cells.topology import mean_pairwise_hops
+from ..scheduler.labels import PodKind
+from ..scheduler.scoring import select_leaves
+from ..scheduler.state import PodState
+from ..utils import expfmt
+from .cost import MigrationCost, MoveCost
+
+# move outcome labels (tpu_scheduler_migration_moves_total{outcome})
+OUTCOMES = ("planned", "completed", "fallback", "expired", "cancelled")
+# compaction objectives (tpu_scheduler_migration_compaction_moves_total)
+OBJECTIVES = ("straggler", "gang-spread")
+
+
+class _KeyPod:
+    """Shim carrying only ``key`` — all the engine's Filter/score hold
+    resolution reads off the pod object. Lets the plane reuse the
+    engine's feasibility walk for a pod that, mid-move, exists only as
+    a pending replacement the controller has not recreated yet."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key: str):
+        self.key = key
+
+
+@dataclass
+class PendingMove:
+    """One in-flight checkpoint/restore move: victim evicted (or about
+    to be), destination leaves pinned, replacement not yet rebound.
+    ``key`` is the CURRENT beneficiary — the victim's key until the
+    controller's resubmit is re-keyed (``rekey``), the replacement's
+    after."""
+
+    key: str
+    victim_key: str
+    source_node: str
+    dest_node: str
+    leaf_uuids: FrozenSet[str]
+    dest_version: int            # delta version captured at plan time
+    req: object                  # PodRequirements (labels survive the clone)
+    hbm_bytes: int
+    cost: MoveCost
+    reason: str                  # defrag | straggler | gang-spread
+    group_key: str
+    planned_at: float
+    deadline: float
+    replacement_key: Optional[str] = None
+
+
+class MigrationPlane:
+    def __init__(
+        self,
+        engine,
+        cost: Optional[MigrationCost] = None,
+        pin_ttl: float = 120.0,
+        compaction: bool = False,
+        compaction_interval: float = 60.0,
+        compaction_max_moves: int = 2,
+        straggler_max_chips: float = 1.0,
+        spread_min_gain: float = 0.5,
+        dest_candidates: int = 8,
+    ):
+        self.engine = engine
+        self.cost = cost or MigrationCost()
+        self.pin_ttl = pin_ttl
+        self.compaction = compaction
+        self.compaction_interval = compaction_interval
+        self.compaction_max_moves = compaction_max_moves
+        # a node is a "straggler" when its whole occupancy is at most
+        # this many chips, all of it fractional opportunistic solo pods
+        self.straggler_max_chips = straggler_max_chips
+        self.spread_min_gain = spread_min_gain
+        self.dest_candidates = dest_candidates
+        self._moves: Dict[str, PendingMove] = {}  # by current key
+        self._last_sweep = float("-inf")
+        self.moves_planned = 0
+        self.moves_completed = 0
+        self.moves_fallbacks = 0
+        self.moves_expired = 0
+        self.moves_cancelled = 0
+        self.compaction_moves: Dict[str, int] = {o: 0 for o in OBJECTIVES}
+        self.modeled_move_seconds = 0.0  # sum of planned moves' prices
+
+    # ---- hot-path reads (near-free while no move is in flight) ------
+
+    def has_pins(self) -> bool:
+        return bool(self._moves)
+
+    def is_pinned(self, pod_key: str) -> bool:
+        return pod_key in self._moves
+
+    def rebind_target(self, pod_key: str) -> Optional[str]:
+        move = self._moves.get(pod_key)
+        return move.dest_node if move is not None else None
+
+    def move_for(self, key: str) -> Optional[PendingMove]:
+        return self._moves.get(key)
+
+    def pinned_leaves(self, node: str,
+                      pod_key: str) -> Optional[FrozenSet[str]]:
+        """Leaf uuids pinned on ``node`` for beneficiaries OTHER than
+        ``pod_key`` — what every other pod must treat as nonexistent.
+        None when nothing is pinned there (the common case)."""
+        out: Optional[set] = None
+        for move in self._moves.values():
+            if move.dest_node != node or move.key == pod_key:
+                continue
+            if out is None:
+                out = set(move.leaf_uuids)
+            else:
+                out.update(move.leaf_uuids)
+        return frozenset(out) if out else None
+
+    # ---- move lifecycle --------------------------------------------
+
+    def consider_move(
+        self,
+        status,
+        now: float,
+        reason: str = "defrag",
+        forbid_nodes: Sequence[str] = (),
+        anchors: Sequence = (),
+        grace_required: float = 0.0,
+    ) -> Optional[PendingMove]:
+        """Decide move-vs-evict for one BOUND victim. Registers and
+        returns the pending move (destination leaves pinned from this
+        instant) or None — meaning the caller should fall back to the
+        plain eviction it was about to do anyway. ``grace_required``
+        rejects moves whose pause+restore could not finish inside a
+        gang's rejoin grace; ``anchors`` steers the destination by ICI
+        locality (gang compaction) instead of packing."""
+        if status is None or status.state != PodState.BOUND \
+                or not status.leaves:
+            return None
+        if status.key in self._moves:
+            return None  # one move in flight per pod
+        req = status.requirements
+        hbm = status.charged_mem or status.memory
+        elapsed = max(0.0, now - (status.bound_at or now))
+        if not self.cost.move_beats_restart(hbm, elapsed):
+            return None  # young pod: the restart is the cheap verb
+        cost = self.cost.move_cost(hbm)
+        if grace_required and cost.checkpoint_s >= grace_required:
+            # the member must REJOIN (hold its destination again)
+            # before the half-gang reconcile deadline; only the
+            # checkpoint pause delays the rebind — restore/warmup run
+            # after it already holds capacity
+            return None
+        dest, leaves = self._find_destination(
+            status, req, set(forbid_nodes), anchors
+        )
+        if dest is None:
+            return None
+        move = PendingMove(
+            key=status.key,
+            victim_key=status.key,
+            source_node=status.node_name,
+            dest_node=dest,
+            leaf_uuids=frozenset(l.uuid for l in leaves),
+            dest_version=self.engine.tree.node_delta_version(dest),
+            req=req,
+            hbm_bytes=hbm,
+            cost=cost,
+            reason=reason,
+            group_key=status.group_key,
+            planned_at=now,
+            deadline=now + self.pin_ttl + cost.checkpoint_s,
+        )
+        self._moves[status.key] = move
+        self.moves_planned += 1
+        self.modeled_move_seconds += cost.total_s
+        return move
+
+    def _find_destination(
+        self, status, req, forbid: set, anchors: Sequence
+    ) -> Tuple[Optional[str], Optional[List]]:
+        """Destination through the engine's OWN read path: Filter for
+        feasibility (defrag holds and other moves' pins apply — the
+        victim is priority 0 on the defrag path), then packing
+        preference (least free capacity that fits — consolidation is
+        the point) or anchor-locality scoring when ``anchors`` is
+        given, then select_leaves for the exact chips to pin."""
+        engine = self.engine
+        shim = _KeyPod(status.key)
+        feasible: List[str] = []
+        # snapshot: filter() can sync inventory and edit _node_index
+        # mid-walk (the engine's own scan snapshots for the same
+        # hazard, plugin._schedule_walk), which would skip candidates
+        for name in list(engine._node_index):
+            if name in forbid:
+                continue
+            fit, _ = engine.filter(shim, req, name)
+            if fit:
+                feasible.append(name)
+                if not anchors and len(feasible) >= self.dest_candidates:
+                    break
+        if not feasible:
+            return None, None
+        if anchors:
+            anchor_list = list(anchors)
+            best = max(feasible, key=lambda n: (
+                engine.score(shim, req, n, anchors=anchor_list), n
+            ))
+        else:
+            tree = engine.tree
+            anchor_list = []
+            best = min(feasible, key=lambda n: (
+                sum(l.available for l in tree.leaves_view(n)), n
+            ))
+        leaves = select_leaves(
+            engine.tree, best, req, anchor_list,
+            engine._held_leaves(shim, req, best),
+        )
+        if not leaves:
+            return None, None
+        return best, leaves
+
+    def rekey(self, old_key: str, new_key: str) -> None:
+        """The victim's controller recreated it as ``new_key``: the
+        replacement inherits the pinned destination."""
+        move = self._moves.pop(old_key, None)
+        if move is None:
+            return
+        move.key = new_key
+        move.replacement_key = new_key
+        self._moves[new_key] = move
+
+    def adopt(self, pod_key: str, req) -> Optional[str]:
+        """Live-daemon rekey fallback: controllers recreate evicted
+        pods under fresh names (Job pod hashes), and nothing in the
+        kube watch stream links the clone back to its victim the way
+        the sim's explicit ``note_resubmit`` does. Match an ORPHANED
+        move — victim already gone from the status store, replacement
+        never announced — to a newly-seen pod by namespace + parsed
+        requirements (the label surface survives a controller
+        recreate verbatim, so the parsed requirements do too). Rekeys
+        and returns the pinned destination on a match, None otherwise.
+
+        A same-namespace twin with identical labels can win a pin
+        meant for its sibling; that is benign — the destination fits
+        it by construction, and the true replacement reschedules
+        through the ordinary walk (the evict-and-resubmit fallback)."""
+        ns = pod_key.split("/", 1)[0]
+        for move in self._moves.values():
+            if move.replacement_key is not None or move.key == pod_key:
+                continue
+            if move.key.split("/", 1)[0] != ns:
+                continue
+            if self.engine.status.get(move.key) is not None:
+                continue  # victim still tracked: not displaced yet
+            if move.req == req:
+                self.rekey(move.key, pod_key)
+                return move.dest_node
+        return None
+
+    def complete(self, pod_key: str) -> None:
+        """The beneficiary bound — the move committed; drop the pin."""
+        if self._moves.pop(pod_key, None) is not None:
+            self.moves_completed += 1
+
+    def abandon(self, pod_key: str, why: str = "") -> None:
+        """Destination broke before the rebind committed: drop the pin
+        and let the pod take the ordinary evict-and-resubmit path."""
+        if self._moves.pop(pod_key, None) is not None:
+            self.moves_fallbacks += 1
+            self.engine.log.info(
+                "migration fallback for %s: %s", pod_key,
+                why or "destination broke",
+            )
+
+    def cancel(self, pod_key: str) -> None:
+        """Un-register a move whose eviction never happened (PDB
+        refusal, aborted sweep plan) — nothing was displaced, so this
+        is neither a fallback nor an expiry."""
+        if self._moves.pop(pod_key, None) is not None:
+            self.moves_cancelled += 1
+
+    def reset(self) -> None:
+        """Drop every pin (topology reload: the pinned leaves may not
+        exist in the new tree). Replacements reschedule normally —
+        the evict-and-resubmit fallback, never pod loss."""
+        if self._moves:
+            self.moves_cancelled += len(self._moves)
+            self._moves.clear()
+
+    def forget(self, pod_key: str) -> None:
+        """Informer delete for ``pod_key``. The victim's OWN eviction
+        delete (replacement not yet known) keeps the pin — that delete
+        IS the move in progress; a delete after re-keying means the
+        replacement itself left the cluster, so the destination is no
+        longer owed to anyone."""
+        move = self._moves.get(pod_key)
+        if move is not None and move.replacement_key is not None:
+            self._moves.pop(pod_key, None)
+            self.moves_cancelled += 1
+
+    # ---- tick: pin hygiene + compaction sweeps ----------------------
+
+    def tick(self, now: float) -> None:
+        if not self._moves and not self.compaction:
+            return
+        t0 = _time.perf_counter()
+        worked = False
+        tree = self.engine.tree
+        for key in list(self._moves):
+            move = self._moves.get(key)
+            if move is None:
+                continue
+            if move.deadline <= now:
+                # the replacement never came back (crashed controller):
+                # the destination must not stay reserved forever
+                self._moves.pop(key, None)
+                self.moves_expired += 1
+                worked = True
+                continue
+            version = tree.node_delta_version(move.dest_node)
+            if version != move.dest_version:
+                # the destination's read-set moved since the plan was
+                # captured: re-validate the reservation (the pin's own
+                # leaves stay visible to it) before trusting it again
+                worked = True
+                fit, _ = self.engine.filter(
+                    _KeyPod(key), move.req, move.dest_node
+                )
+                if fit:
+                    move.dest_version = version
+                else:
+                    self.abandon(key, "destination broke before commit")
+        if (
+            self.compaction
+            and now - self._last_sweep >= self.compaction_interval
+        ):
+            self._last_sweep = now
+            self._sweep(now)
+            worked = True
+        if worked:
+            # the plane's tick work is scheduler CPU outside any
+            # attempt: charge the migrate phase AND a class entry so
+            # the cost plane's class-totals == phase-totals invariant
+            # survives (the shard plane's finalize idiom)
+            dt = _time.perf_counter() - t0
+            engine = self.engine
+            engine.cost_seconds["migrate"] += dt
+            engine.cost_attempts += 1
+            engine.charge_cost_class(("_system", "migrate", "tick"), dt)
+
+    def _sweep(self, now: float) -> int:
+        """One compaction sweep: straggler drains first (they return
+        whole nodes to the multi-chip pool), then at most one
+        gang-spread move. Never runs while the demand ledger is
+        non-empty — capacity owed to waiting pods outranks tidiness —
+        and spends the same eviction budget defrag does."""
+        engine = self.engine
+        if engine.demand.guarantee_demand_tenants():
+            # never spend moves while guarantee-class capacity is
+            # owed; pending opportunistic pods don't block the sweep
+            # (a straggler drain often OPENS the slot they wait for,
+            # and pins keep the destinations out of their reach)
+            return 0
+        budget = engine.eviction_budget_left(now)
+        cap = self.compaction_max_moves
+        if budget is not None:
+            cap = max(0, min(cap, budget))
+        if cap <= 0:
+            return 0
+        made = self._sweep_stragglers(now, max(0, cap - 1))
+        # the gang objective gets its own slot (at most one move per
+        # sweep) rather than queueing behind straggler drains — a
+        # fragmented cluster can otherwise starve the spread objective
+        # for the whole run
+        made += self._sweep_gang_spread(now)
+        return made
+
+    def _sweep_stragglers(self, now: float, cap: int) -> int:
+        engine = self.engine
+        by_node: Dict[str, List] = {}
+        for status in engine.status.values():
+            if status.state == PodState.BOUND and status.leaves:
+                by_node.setdefault(status.node_name, []).append(status)
+        candidates = []
+        for node, occupants in by_node.items():
+            if len(occupants) > cap:
+                continue
+            if any(
+                s.requirements.priority > 0
+                or s.group_key
+                or s.requirements.kind != PodKind.SHARED
+                or s.key in self._moves
+                for s in occupants
+            ):
+                continue
+            occupied = sum(s.requirements.request for s in occupants)
+            if 0 < occupied <= self.straggler_max_chips:
+                candidates.append((occupied, node, occupants))
+        if not candidates:
+            return 0
+        candidates.sort(key=lambda t: (t[0], t[1]))
+        made = 0
+        for idx, (_, node, occupants) in enumerate(candidates):
+            if made + len(occupants) > cap:
+                continue
+            # the emptiest straggler drains first, and only into
+            # DENSER nodes: itself and every emptier straggler are
+            # forbidden destinations, denser stragglers are fair game
+            # (two half-empty nodes must be able to consolidate into
+            # one). Density-ordered drains cannot ping-pong — the
+            # packing destination preference always moves occupancy
+            # toward the denser node.
+            forbid = {node} | {
+                name for _, name, _ in candidates[:idx]
+            }
+            moves = []
+            for status in occupants:
+                move = self.consider_move(
+                    status, now, reason="straggler",
+                    forbid_nodes=forbid,
+                )
+                if move is None:
+                    break
+                moves.append(move)
+            if len(moves) != len(occupants):
+                # partial drains leave the node just as fragmented:
+                # all-or-nothing per straggler
+                for move in moves:
+                    self.cancel(move.key)
+                continue
+            evict_failed = False
+            for move in moves:
+                if evict_failed:
+                    # all-or-nothing holds at the evict step too: a
+                    # refused eviction leaves the node fragmented, so
+                    # displacing the REST buys nothing — cancel their
+                    # moves (nothing displaced yet). Occupants already
+                    # evicted this round keep their pins and rebind.
+                    self.cancel(move.key)
+                    continue
+                engine._defrag_inflight.add(move.key)
+                try:
+                    engine.cluster.evict(move.key)
+                except Exception as e:
+                    engine._defrag_inflight.discard(move.key)
+                    self.cancel(move.key)
+                    engine.log.error(
+                        "compaction evict %s: %s", move.key, e
+                    )
+                    evict_failed = True
+                    continue
+                engine._note_eviction(now, False)
+                self.compaction_moves["straggler"] += 1
+                made += 1
+            if made >= cap:
+                break
+        return made
+
+    def _sweep_gang_spread(self, now: float) -> int:
+        """Move ONE member of the worst-spread gang closer to its
+        siblings — one per sweep bounds the disruption, and the
+        sweep's cadence (plus the eviction budget) bounds the rate."""
+        engine = self.engine
+        groups: Dict[str, List] = {}
+        for status in engine.status.values():
+            if (status.group_key and status.state == PodState.BOUND
+                    and status.leaves):
+                groups.setdefault(status.group_key, []).append(status)
+        scored = []
+        for group_key, members in groups.items():
+            if len(members) < 2:
+                continue
+            leaves = [l for s in members for l in s.leaves]
+            spread = mean_pairwise_hops(leaves)
+            if spread > self.spread_min_gain:
+                scored.append((spread, group_key, members))
+        scored.sort(key=lambda t: (-t[0], t[1]))
+        for spread, group_key, members in scored:
+            group = engine.groups.get(group_key)
+            headcount = group.headcount if group is not None \
+                else len(members)
+            grace = engine.permit_wait_base * headcount
+            for status in sorted(members, key=lambda s: s.key):
+                others = [
+                    l for other in members if other is not status
+                    for l in other.leaves
+                ]
+                if not others:
+                    continue
+                move = self.consider_move(
+                    status, now, reason="gang-spread",
+                    anchors=others, grace_required=grace,
+                )
+                if move is None:
+                    continue
+                new_leaves = [
+                    engine.tree.leaf_cells[u]
+                    for u in move.leaf_uuids
+                    if u in engine.tree.leaf_cells
+                ]
+                new_spread = mean_pairwise_hops(others + new_leaves)
+                if new_spread > spread - self.spread_min_gain:
+                    self.cancel(move.key)  # not worth the pause
+                    continue
+                engine._defrag_inflight.add(move.key)
+                try:
+                    engine.cluster.evict(move.key)
+                except Exception as e:
+                    engine._defrag_inflight.discard(move.key)
+                    self.cancel(move.key)
+                    engine.log.error(
+                        "compaction evict %s: %s", move.key, e
+                    )
+                    continue
+                engine._note_eviction(now, False)
+                self.compaction_moves["gang-spread"] += 1
+                return 1
+        return 0
+
+    # ---- observability ---------------------------------------------
+
+    def samples(self) -> List["expfmt.Sample"]:
+        by_outcome = {
+            "planned": self.moves_planned,
+            "completed": self.moves_completed,
+            "fallback": self.moves_fallbacks,
+            "expired": self.moves_expired,
+            "cancelled": self.moves_cancelled,
+        }
+        samples = [
+            expfmt.Sample(
+                "tpu_scheduler_migration_moves_total",
+                {"outcome": outcome}, by_outcome[outcome],
+            )
+            for outcome in OUTCOMES
+        ]
+        samples.append(expfmt.Sample(
+            "tpu_scheduler_migration_pins", {}, len(self._moves),
+        ))
+        for objective in OBJECTIVES:
+            samples.append(expfmt.Sample(
+                "tpu_scheduler_migration_compaction_moves_total",
+                {"objective": objective},
+                self.compaction_moves[objective],
+            ))
+        samples.append(expfmt.Sample(
+            "tpu_scheduler_migration_modeled_seconds_total", {},
+            self.modeled_move_seconds,
+        ))
+        return samples

@@ -29,7 +29,7 @@ wires them to the cluster's evict verb.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..cells.cell import Cell, CellTree
@@ -50,12 +50,14 @@ class DefragPlan:
                                 # starved tenant claws back borrowed
                                 # chips before touching anyone within
                                 # their entitlement
-    leaves: List[str] = None    # uuids of the leaves the plan frees —
-                                # the scope of the post-eviction hold
-                                # (plugin._defrag_holds); holding the
-                                # whole node would starve opportunistic
-                                # pods of capacity the beneficiary
-                                # never asked for
+    # uuids of the leaves the plan frees — the scope of the
+    # post-eviction hold (plugin._defrag_holds); holding the whole
+    # node would starve opportunistic pods of capacity the beneficiary
+    # never asked for. default_factory, not None: the declared type is
+    # List[str] and every consumer iterates it (the old `= None`
+    # default lied about the type and leaked a None to any caller
+    # constructing a plan without leaves).
+    leaves: List[str] = field(default_factory=list)
 
 
 @dataclass

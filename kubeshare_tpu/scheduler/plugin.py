@@ -116,6 +116,10 @@ class TpuShareScheduler:
         explain_capacity: int = 512,
         journal_spool=None,
         wall_clock: Optional[Callable[[], float]] = None,
+        migrate: bool = False,
+        migration_cost=None,
+        compaction: bool = False,
+        compaction_interval: float = 60.0,
     ):
         # function-scope import: quota depends on scheduler.labels /
         # scheduler.constants, so a module-level import here would be
@@ -278,6 +282,24 @@ class TpuShareScheduler:
         # sliding one-minute window: (time, quota_driven) per eviction
         self._defrag_evict_times: List[Tuple[float, bool]] = []
 
+        # Migration plane (PR-12): checkpoint/restore moves as the
+        # defrag verb when the modeled move cost beats the modeled
+        # restart cost, pinned destination reservations, and the
+        # idle-tick compaction sweeps. None when disabled — every hook
+        # below gates on it, so a migration-off engine is decision-
+        # for-decision the pre-plane evict-and-resubmit scheduler.
+        self.migration = None
+        if migrate:
+            from ..migrate.plane import MigrationPlane
+
+            self.migration = MigrationPlane(
+                self, cost=migration_cost, compaction=compaction,
+                compaction_interval=compaction_interval,
+            )
+        # metrics-thread memo for the per-gang ICI-spread gauge:
+        # group_key -> (frozenset of leaf uuids, spread)
+        self._gang_spread_cache: Dict[str, tuple] = {}
+
         # Feasible-node sampling (kube-scheduler percentageOfNodesToScore
         # analog): on big clusters, stop filtering once enough feasible
         # candidates are found and score only those — per-pod cost stays
@@ -337,9 +359,15 @@ class TpuShareScheduler:
         # the sequential/wave paths, charged per transaction by
         # shard/plane.py — the serialized fraction of a multi-scheduler
         # deployment, the number Amdahl grades the shard count against.
+        # "migrate" is the migration plane's lane: destination
+        # planning and pinned rebinds inside attempts, plus the tick's
+        # pin-revalidation/compaction work (which charges a matching
+        # "_system" class entry so class totals keep equalling phase
+        # totals). 0 forever with migration off.
         self.cost_seconds = {
             "parse": 0.0, "quota": 0.0, "filter": 0.0, "score": 0.0,
             "reserve_permit": 0.0, "journal": 0.0, "commit": 0.0,
+            "migrate": 0.0,
         }
         self.cost_attempts = 0  # attempts attributed (journal-independent)
         # Per-(tenant, kind, outcome) attempt cost: [seconds, attempts]
@@ -443,6 +471,11 @@ class TpuShareScheduler:
         self._defrag_holds = {}
         self._half_gangs = {}
         self._stale_group_census = set()
+        if self.migration is not None:
+            # pinned destinations name leaves of the OLD tree: drop
+            # them (the replacements reschedule normally — a dropped
+            # pin is the evict-and-resubmit fallback, never pod loss)
+            self.migration.reset()
         for node in self.cluster.list_nodes():
             self._on_node_update(node)
         for pod in self.cluster.list_pods():
@@ -575,6 +608,11 @@ class TpuShareScheduler:
         self._defrag_last.pop(pod.key, None)
         self._defrag_inflight.discard(pod.key)  # eviction completed
         self._drop_defrag_holds(pod.key)  # beneficiary gone -> free the space
+        if self.migration is not None:
+            # a deleted REPLACEMENT releases its pinned destination;
+            # the victim's own eviction delete keeps the pin (that
+            # delete IS the move in progress)
+            self.migration.forget(pod.key)
         self.demand.resolve(pod.key)  # a deleted pod wants nothing
         # journal: a pod deleted while pending closes its timeline as
         # "deleted" (a bound pod's entry is already terminal and is
@@ -846,6 +884,11 @@ class TpuShareScheduler:
                 )
         status.leaves = leaves
         status.uuids = [l.uuid for l in leaves]
+        # restart stamp, not the original bind time (unknowable from
+        # annotations): conservative for the migration cost model — a
+        # freshly-restored pod looks young, and young pods restart
+        # rather than migrate
+        status.bound_at = self.clock() or 1e-9
         if leaves:  # vanished chips held nothing — charge what is held
             status.charged_chips = (
                 float(len(leaves)) if req.kind == PodKind.MULTI_CHIP
@@ -1779,25 +1822,64 @@ class TpuShareScheduler:
         # first (sampling must never hide the node the rest of the gang
         # sits on), and the leaves weight locality scoring below
         anchors = self.status.group_placed_leaves(group.key)
+        # pinned rebind (migration plane): a pod holding a committed
+        # move's destination skips the candidate scan and places onto
+        # its pinned node — the move's commit point. A filter failure
+        # there means the destination broke before commit: drop the
+        # pin and fall through to the ordinary walk (the evict-and-
+        # resubmit fallback — a failed move never loses the pod).
+        pinned_dest: Optional[str] = None
+        if self.migration is not None and self.migration.has_pins():
+            dest = self.migration.rebind_target(pod.key)
+            if dest is None:
+                # live-daemon path: the controller recreated the
+                # victim under a fresh name and nothing called
+                # note_resubmit — match orphaned moves by namespace +
+                # requirements so the pin is claimable, not stranded
+                self._cost_boundary("migrate")
+                dest = self.migration.adopt(pod.key, req)
+                self._cost_boundary("filter")
+            if dest is not None:
+                self._cost_boundary("migrate")
+                fit = False
+                if dest in self._node_index_set:
+                    fit, _why = self.filter(pod, req, dest)
+                if fit:
+                    pinned_dest = dest
+                else:
+                    self.migration.abandon(
+                        pod.key, "destination broke at rebind"
+                    )
+                self._cost_boundary("filter")
         with maybe_span(self.tracer, "filter", pod=pod.key):
-            # the incrementally-maintained sorted index replaces the
-            # per-cycle list_nodes()+sorted() scan — per-pod cost is
-            # O(examined candidates), not O(cluster)
-            names = self._node_index
-            if self._unsynced:
-                # syncing inventory mid-scan can deliver a health flip
-                # that edits the index; iterate a snapshot until every
-                # known node has synced (steady state: zero-copy)
-                names = list(names)
-            n_names = len(names)
-            target = self._feasible_target(n_names)
-            anchor_nodes = {l.node for l in anchors if l.node}
-            start = self._filter_cursor % n_names if n_names else 0
-            self.filter_attempts += 1
-            feasible, rejections, scans, consumed = self._filter_candidates(
-                pod, req, names, n_names, start, target, anchor_nodes
-            )
-            self._filter_cursor = (start + consumed) % max(1, n_names)
+            if pinned_dest is not None:
+                feasible = [pinned_dest]
+                rejections = RejectionAgg()
+                target = 1
+                scans = 1
+                self.filter_attempts += 1
+            else:
+                # the incrementally-maintained sorted index replaces
+                # the per-cycle list_nodes()+sorted() scan — per-pod
+                # cost is O(examined candidates), not O(cluster)
+                names = self._node_index
+                if self._unsynced:
+                    # syncing inventory mid-scan can deliver a health
+                    # flip that edits the index; iterate a snapshot
+                    # until every known node has synced (steady
+                    # state: zero-copy)
+                    names = list(names)
+                n_names = len(names)
+                target = self._feasible_target(n_names)
+                anchor_nodes = {l.node for l in anchors if l.node}
+                start = self._filter_cursor % n_names if n_names else 0
+                self.filter_attempts += 1
+                feasible, rejections, scans, consumed = \
+                    self._filter_candidates(
+                        pod, req, names, n_names, start, target,
+                        anchor_nodes,
+                    )
+                self._filter_cursor = (start + consumed) % max(1, n_names)
             self.filter_scans += scans
         if rec is not None:
             rec.filter_examined = scans
@@ -1854,6 +1936,10 @@ class TpuShareScheduler:
                 seed_frees is None
                 and not self._backfill_hold
                 and (req.is_guarantee or not self._defrag_holds)
+                # a live migration pin varies _held_leaves per pod for
+                # EVERY class, so scores are per-pod while one exists
+                and (self.migration is None
+                     or not self.migration.has_pins())
             )
             if cacheable:
                 # two-level memo (shape -> node -> score): the shape
@@ -2043,6 +2129,12 @@ class TpuShareScheduler:
         hook_only = (
             req.kind == PodKind.REGULAR
             or not (req.is_guarantee or not self._defrag_holds)
+            # migration pins hide leaves from every class: while one
+            # is live the aggregate probe over-reports capacity, so
+            # every candidate takes the hold-aware hook chain (pins
+            # are rare and short — the fast loop returns the moment
+            # the last move commits)
+            or (self.migration is not None and self.migration.has_pins())
         )
         screen = bool(self._backfill_hold) and not hook_only
         if hook_only:
@@ -2282,6 +2374,17 @@ class TpuShareScheduler:
         an existing entry's ``since`` always wins."""
         if req.kind == PodKind.REGULAR:
             return  # consumes no TPU capacity; not capacity demand
+        if (
+            self.migration is not None
+            and reason in (D.REASON_NO_FEASIBLE_CELL,
+                           D.REASON_FRAGMENTATION)
+            and self.migration.is_pinned(pod_key)
+        ):
+            # a displaced pod still holding a committed move's pinned
+            # destination is not capacity demand: the move is about to
+            # hand it the chips, and the autoscale sizing terms must
+            # not buy nodes for it
+            reason = D.REASON_MIGRATION_PENDING
         self._last_demand_reason = reason
         hint = self._since_hint(created_at)
         if self._wave_demand is not None:
@@ -2310,6 +2413,20 @@ class TpuShareScheduler:
         if not buf:
             return
         items, buf[:] = list(buf), []
+        # drop notes for pods that BOUND later in the same wave (a
+        # gang member parked at the barrier files gang-waiting, then a
+        # sibling's Permit releases and binds it mid-wave — its
+        # resolve() ran before this flush, so filing the buffered note
+        # would re-create a phantom entry that persists until the pod
+        # completes, inflating the autoscale quota term and masking
+        # cluster idleness the whole time the gang runs)
+        items = [
+            item for item in items
+            if (status := self.status.get(item[0])) is None
+            or status.state != PodState.BOUND
+        ]
+        if not items:
+            return
         sync = self.explain.sync_reason
         for (pod_key, req, reason, now, _hint), entry in zip(
             items, self.demand.note_batch(items, self.quota.demand)
@@ -2353,10 +2470,23 @@ class TpuShareScheduler:
             self._backfill_hold.get(node_name)
             if self._backfill_hold else None
         )
+        # migration pins bind EVERYONE except the replacement they are
+        # held for (guarantee class included — a destination stolen by
+        # a guarantee pod would break the committed move); None while
+        # no move is in flight, which is the steady state
+        pins = (
+            self.migration.pinned_leaves(node_name, pod.key)
+            if self.migration is not None and self.migration.has_pins()
+            else None
+        )
         if req.is_guarantee or not self._defrag_holds:
+            if pins:
+                return frozenset(pins | set(bf)) if bf else pins
             return bf or frozenset()
         now = self.clock()
         held: set = set(bf) if bf else set()
+        if pins:
+            held.update(pins)
         for (node, beneficiary), (until, leaves) in list(
             self._defrag_holds.items()
         ):
@@ -2404,6 +2534,28 @@ class TpuShareScheduler:
             pct = max(5, 50 - n_nodes // 8)
         return max(self.min_feasible_nodes, n_nodes * pct // 100)
 
+    def eviction_budget_left(self, now: float) -> Optional[int]:
+        """Evictions left in the sliding one-minute defrag budget —
+        None when unbudgeted (rate 0). Shared by defrag and the
+        migration plane's compaction sweeps: both displace pods, so
+        both spend the same budget. Prunes the window as a side
+        effect (the single scheduling thread is the only mutator)."""
+        if self.defrag_eviction_rate <= 0:
+            return None
+        self._defrag_evict_times = [
+            e for e in self._defrag_evict_times if e[0] > now - 60.0
+        ]
+        return int(
+            self.defrag_eviction_rate - len(self._defrag_evict_times)
+        )
+
+    def _note_eviction(self, now: float, quota_driven: bool) -> None:
+        """Record one displacement against the sliding budget window.
+        Only tracked when budgeted: at rate=0 nothing prunes the list
+        and it would grow for the process lifetime."""
+        if self.defrag_eviction_rate > 0:
+            self._defrag_evict_times.append((now, quota_driven))
+
     def _maybe_defrag(self, pod: Pod, req) -> List[str]:
         """Evict-to-fit for a guarantee pod no node can place (see
         scheduler/defrag.py for the policy). Returns the evicted pod
@@ -2435,12 +2587,7 @@ class TpuShareScheduler:
             and self.quota.deficit_chips(req.tenant) > _EPS
         )
         if self.defrag_eviction_rate > 0:
-            self._defrag_evict_times = [
-                e for e in self._defrag_evict_times if e[0] > now - 60.0
-            ]
-            remaining = int(
-                self.defrag_eviction_rate - len(self._defrag_evict_times)
-            )
+            remaining = self.eviction_budget_left(now)
             if not quota_driven and self.defrag_reclaim_share > 0 and any(
                 self.quota.deficit_chips(t) > _EPS
                 for t in self.demand.guarantee_demand_tenants()
@@ -2484,6 +2631,21 @@ class TpuShareScheduler:
         self._defrag_last[pod.key] = now
         evicted = []
         for victim in plan.victims:
+            # migration plane: generate a MOVE plan for this victim
+            # when the modeled move cost beats the modeled restart
+            # cost and a destination fits — the victim still gets the
+            # evict verb (checkpoint/restore migration on Kubernetes
+            # IS delete-and-recreate), but its replacement inherits a
+            # pinned destination and its work survives the move.
+            # Destination excludes this node: freeing it is the point.
+            directive = None
+            if self.migration is not None:
+                self._cost_boundary("migrate")
+                directive = self.migration.consider_move(
+                    self.status.get(victim), now, reason="defrag",
+                    forbid_nodes=(plan.node,),
+                )
+                self._cost_boundary("filter")
             try:
                 self.cluster.evict(victim)
             except Exception as e:
@@ -2492,6 +2654,8 @@ class TpuShareScheduler:
                 # disruption — stop here ("no speculative eviction"),
                 # and block this victim so the next attempt plans
                 # AROUND it instead of retrying the same refusal
+                if directive is not None:
+                    self.migration.cancel(victim)  # nothing displaced
                 self._defrag_blocked[victim] = now + 300.0
                 self.log.error(
                     "defrag evict %s: %s; abandoning plan", victim, e
@@ -2505,10 +2669,7 @@ class TpuShareScheduler:
             self.defrag_evictions += 1
             if quota_driven:
                 self.defrag_quota_evictions += 1
-            if self.defrag_eviction_rate > 0:
-                # only track when budgeted: at rate=0 nothing prunes
-                # this list and it would grow for the process lifetime
-                self._defrag_evict_times.append((now, quota_driven))
+            self._note_eviction(now, quota_driven)
             self._defrag_inflight.add(victim)
             evicted.append(victim)
             post = getattr(self.cluster, "post_event", None)
@@ -2569,6 +2730,10 @@ class TpuShareScheduler:
         # crash recovery: gangs stranded partially bound past their
         # grace are requeued whole (bound members evicted)
         self._reconcile_half_gangs(now)
+        # migration plane: pin expiry + destination re-validation +
+        # the idle-tick compaction sweeps (one falsy check when off)
+        if self.migration is not None:
+            self.migration.tick(now)
         # deferred group-liveness verdicts (census failed at delete
         # time): retry until the API answers
         for group_key in list(self._stale_group_census):
@@ -2586,6 +2751,16 @@ class TpuShareScheduler:
                 self.groups.mark_deleted(group_key)
         self.groups.gc()
         return rejected
+
+    def note_resubmit(self, old_key: str, new_key: str) -> None:
+        """A controller recreated ``old_key`` as ``new_key`` (the
+        sim's eviction resubmit; a live deployment's controller
+        adapter would call this from its recreate hook). The migration
+        plane re-keys its pending move so the replacement inherits
+        the pinned destination; without a move in flight this is a
+        no-op."""
+        if self.migration is not None:
+            self.migration.rekey(old_key, new_key)
 
     def recovery_fingerprint(self) -> dict:
         """Deterministic digest of the state a restart must rebuild
@@ -2878,6 +3053,44 @@ class TpuShareScheduler:
         # still-pending wait gauge. The journal's lock makes this
         # metrics-thread read safe against scheduling-thread writes.
         samples += self.explain.samples(now)
+        # migration plane: move outcomes, live pins, compaction moves
+        if self.migration is not None:
+            samples += self.migration.samples()
+        # per-gang ICI spread, live: the same pair walk the sim report
+        # runs at Permit release, over each gang's currently-held
+        # leaves — the compaction sweeps' objective as a gauge.
+        # Metrics-thread read: group_keys/in_group snapshot lists.
+        # The O(leaves^2) walk is memoized per gang on its leaf-uuid
+        # set — spread only changes at (re)bind/eviction events, so a
+        # steady scrape interval pays one O(leaves) set build, not
+        # thousands of distance calls per large gang. The cache lives
+        # on the metrics thread only (single scraper; a racing second
+        # scrape at worst recomputes).
+        from ..cells.topology import mean_pairwise_hops
+
+        fresh_spread: Dict[str, tuple] = {}
+        for group_key in self.status.group_keys():
+            leaves = [
+                l
+                for s in self.status.in_group(group_key)
+                if s.state == PodState.BOUND
+                for l in s.leaves
+            ]
+            if len(leaves) < 2:
+                continue
+            uuids = frozenset(l.uuid for l in leaves)
+            cached = self._gang_spread_cache.get(group_key)
+            if cached is not None and cached[0] == uuids:
+                spread = cached[1]
+            else:
+                spread = mean_pairwise_hops(leaves)
+            fresh_spread[group_key] = (uuids, spread)
+            samples.append(expfmt.Sample(
+                "tpu_scheduler_gang_ici_spread_hops",
+                {"group": group_key}, spread,
+            ))
+        # replace wholesale so departed gangs don't accumulate
+        self._gang_spread_cache = fresh_spread
         for node in self.tree.nodes():
             # non-caching read: this runs on the metrics HTTP thread,
             # which must not write the scheduling thread's leaf cache
@@ -2940,10 +3153,16 @@ class TpuShareScheduler:
     def _bind(self, pod_key: str, node_name: str) -> None:
         self.cluster.bind(pod_key, node_name)
         self._drop_defrag_holds(pod_key)  # beneficiary placed; debt paid
+        if self.migration is not None:
+            self.migration.complete(pod_key)  # move committed (no-op
+            # for pods that never held a pin)
         self.demand.resolve(pod_key)      # placed: demand satisfied
         status = self.status.get(pod_key)
         if status is not None:
             status.state = PodState.BOUND
+            # nudged off exact 0.0 (the 'unknown stamp' sentinel — a
+            # sim's very first binds land at virtual t=0)
+            status.bound_at = self.clock() or 1e-9
             # journal terminal: time-to-bind observed into the wait-SLO
             # histogram under the pod's (tenant, shape). This is the
             # single bind choke point, so gang members released by a

@@ -41,6 +41,11 @@ class PodStatus:
     tenant: str = ""
     charged_chips: float = 0.0
     charged_mem: int = 0
+    # engine-clock stamp of the bind (0.0 = unknown): the migration
+    # cost model compares modeled move cost against the run time a
+    # restart would discard, which needs to know how long the pod has
+    # been running
+    bound_at: float = 0.0
 
 
 class PodStatusStore:
@@ -98,6 +103,12 @@ class PodStatusStore:
             ):
                 count += 1
         return count
+
+    def group_keys(self) -> List[str]:
+        """Live gang keys (groups with at least one tracked member) —
+        the per-gang ICI-spread gauge walks these on the metrics
+        thread, so return a snapshot list."""
+        return list(self._by_group)
 
     def group_placed_leaves(self, group_key: str) -> List[Cell]:
         """Leaf cells already held by members of a gang — the locality

@@ -136,9 +136,22 @@ class SimReport:
     # gang requeues whole); kept separate from defrag_evicted so the
     # chaos artifact never attributes recovery churn to defrag
     gang_requeued: int = 0
+    # migration plane (PR-12): checkpoint/restore moves executed on
+    # the virtual clock — the displaced pod pauses for the modeled
+    # checkpoint, rebinds to its pinned destination, and pays
+    # restore+warmup there; its pre-move work SURVIVES (banked into
+    # goodput when the job completes) instead of being discarded the
+    # way an eviction's partial run is
+    migrated: int = 0
+    migration_downtime_s: float = 0.0   # sum of modeled move prices
+    # group key -> last observed mean pairwise ICI hops over the
+    # gang's held leaves, refreshed at every member (re)bind — the
+    # compaction A/B's objective (gang_hops above is bind-time only
+    # and never sees a post-bind compaction move)
+    gang_spread_final: Dict[str, float] = field(default_factory=dict)
     # end-of-run population (exact pod conservation: submitted ==
     # completed + unschedulable + killed + defrag_evicted +
-    # gang_requeued + running_at_end + pending_at_end)
+    # gang_requeued + migrated + running_at_end + pending_at_end)
     running_at_end: int = 0
     pending_at_end: int = 0
 
@@ -197,6 +210,13 @@ class SimReport:
                 t: round(s, 1)
                 for t, s in sorted(self.tenant_chip_seconds.items())
             },
+            "migrated": self.migrated,
+            "migration_downtime_s": round(self.migration_downtime_s, 1),
+            "gangs_tracked": len(self.gang_spread_final),
+            "mean_final_gang_ici_hops": round(
+                sum(self.gang_spread_final.values())
+                / len(self.gang_spread_final), 3
+            ) if self.gang_spread_final else None,
             "nodes_added": self.nodes_added,
             "nodes_removed": self.nodes_removed,
             "gang_requeued": self.gang_requeued,
@@ -217,6 +237,18 @@ class _Job:
     submitted_at: float
     bound_at: Optional[float] = None
     credited: float = 0.0  # chip-seconds credited at bind (horizon-capped)
+    # migration clones: schedulable only once the modeled checkpoint
+    # finishes (pause on the virtual clock), resuming from the work
+    # already done plus the restore/warmup surcharge; the pre-move
+    # chip-seconds ride along so completion can credit them to goodput
+    ready_at: float = 0.0
+    completed_work: float = 0.0   # runtime seconds already executed
+    extra_runtime: float = 0.0    # restore + warmup surcharge at rebind
+    banked_goodput: float = 0.0   # chip-seconds from pre-move runs
+
+    def remaining_runtime(self) -> float:
+        return max(0.0, self.event.runtime - self.completed_work) \
+            + self.extra_runtime
 
 
 class Simulator:
@@ -238,6 +270,11 @@ class Simulator:
         use_waves: bool = True,
         wave_size: int = 0,
         backfill: bool = False,
+        migrate: bool = False,
+        compaction: bool = False,
+        migration_cost=None,
+        compaction_interval: float = 60.0,
+        tick_interval: float = 0.0,
         explain_capacity: int = 512,
         inject_faults: bool = False,
         fault_seed: int = 0,
@@ -279,6 +316,9 @@ class Simulator:
             defrag_eviction_rate=defrag_eviction_rate,
             tenants=tenants, explain_capacity=explain_capacity,
             journal_spool=journal_spool,
+            migrate=migrate, compaction=compaction,
+            migration_cost=migration_cost,
+            compaction_interval=compaction_interval,
         )
         # parse the topology ONCE: a rebuild must see the exact config
         # the crashed engine ran, not whatever the path resolves to at
@@ -303,6 +343,12 @@ class Simulator:
         self.use_waves = use_waves
         self.wave_size = wave_size
         self.backfill = backfill
+        # periodic scheduler ticks on the virtual clock (0 = only at
+        # workload events — the historical behavior): the daemon's
+        # run loop ticks steadily, and time-driven engine work (the
+        # compaction sweeps, hold expiry) needs the same cadence here
+        # or a quiet stretch of trace skips it entirely
+        self.tick_interval = tick_interval
         self.total_chips = sum(nodes.values())
         self.chip_model = chip_model
         self.chip_memory = chip_memory
@@ -447,19 +493,32 @@ class Simulator:
         """Mean pairwise ICI hops over every leaf the gang's members
         hold, captured at the Permit release — the per-gang locality
         number the score terms exist to minimize."""
-        import itertools
-
-        from ..cells.topology import ici_distance
+        from ..cells.topology import mean_pairwise_hops
 
         leaves = []
         for key in keys:
             status = self.engine.status.get(key)
             if status is not None and status.leaves:
                 leaves.extend(status.leaves)
-        pairs = list(itertools.combinations(leaves, 2))
-        if pairs:
-            report.gang_hops.append(
-                sum(ici_distance(a, b) for a, b in pairs) / len(pairs)
+        if len(leaves) >= 2:
+            report.gang_hops.append(mean_pairwise_hops(leaves))
+
+    def _note_gang_spread(self, group_key: str,
+                          report: SimReport) -> None:
+        """Refresh the gang's FINAL spread from its currently-held
+        leaves: the Permit-release number above never changes again,
+        but a compaction move does — this map is what the sweeps-on
+        vs sweeps-off A/B compares."""
+        from ..cells.topology import mean_pairwise_hops
+
+        leaves = [
+            l
+            for status in self.engine.status.in_group(group_key)
+            for l in status.leaves
+        ]
+        if len(leaves) >= 2:
+            report.gang_spread_final[group_key] = mean_pairwise_hops(
+                leaves
             )
 
     def _uncredit(self, job: "_Job", report: SimReport) -> None:
@@ -662,6 +721,7 @@ class Simulator:
         self._cap_integral = 0.0
         self._cap_last_t = 0.0
         next_ctrl = controller_interval
+        next_tick = self.tick_interval  # 0 disables periodic ticks
         fault_queue = sorted(faults or [], key=lambda f: f.time)
         fi = 0
 
@@ -695,11 +755,26 @@ class Simulator:
             if retry_at is not None:
                 candidates.append(retry_at)
                 retry_at = None
+            # a migration clone becomes schedulable when its modeled
+            # checkpoint finishes: wake the loop for it
+            future_ready = [
+                j.ready_at for j in pending if j.ready_at > self.clock_now
+            ]
+            if future_ready:
+                candidates.append(min(future_ready))
             if controller is not None:
                 # planner ticks run to the horizon even when the trace
                 # has drained: scale-DOWN evidence (idle nodes draining
                 # after load subsides) only exists on those idle ticks
                 candidates.append(next_ctrl)
+            if self.tick_interval > 0 and (
+                pending or finishes or i < len(arrivals)
+            ):
+                # periodic tick while work remains: quiet stretches
+                # (everything running, nothing arriving) still get
+                # scheduler ticks, which is when the compaction
+                # sweeps do their job
+                candidates.append(next_tick)
             if not candidates:
                 break
             next_t = max(self.clock_now, min(candidates))
@@ -715,7 +790,20 @@ class Simulator:
                 if job is not None:
                     self.cluster.finish_pod(key)
                     report.completed += 1
-                    report.chip_seconds_goodput += job.credited
+                    # banked_goodput: chip-seconds a migrated job ran
+                    # BEFORE its move(s) — checkpointed work that
+                    # survived, unlike an evicted job's discarded
+                    # partial run (0.0 for everything else). The
+                    # final stint's restore/warmup surcharge is NOT
+                    # goodput — the chips were busy (it stays in
+                    # chip_seconds_used) but no workload progressed —
+                    # so a migrated job's completed goodput is exactly
+                    # chips x runtime, same as an undisturbed job's
+                    report.chip_seconds_goodput += max(
+                        0.0,
+                        job.credited
+                        - job.event.chips * job.extra_runtime,
+                    ) + job.banked_goodput
 
             # injected faults at this tick
             while fi < len(fault_queue) and fault_queue[fi].time <= self.clock_now:
@@ -744,6 +832,12 @@ class Simulator:
             while controller is not None and next_ctrl <= self.clock_now:
                 controller(self, report)
                 next_ctrl += controller_interval
+
+            # advance the periodic-tick cursor past now (the pass +
+            # engine.tick() below ARE the tick)
+            if self.tick_interval > 0:
+                while next_tick <= self.clock_now:
+                    next_tick += self.tick_interval
 
             # a scheduler_crash that hit during an API outage keeps
             # crash-looping until its relist succeeds; the control
@@ -778,20 +872,32 @@ class Simulator:
                 report.tenant_waits.setdefault(
                     job.pod.namespace, []
                 ).append(wait)
+                # a migration clone resumes from its checkpoint: only
+                # the not-yet-run remainder (plus restore/warmup)
+                # executes here — identical to event.runtime for
+                # everything that never migrated
+                remaining = job.remaining_runtime()
                 heapq.heappush(
                     finishes,
-                    (self.clock_now + job.event.runtime, job.pod.key),
+                    (self.clock_now + remaining, job.pod.key),
                 )
                 # credit only work inside the horizon so utilization
                 # stays <= 1 on cut-off runs
                 job.credited = job.event.chips * min(
-                    job.event.runtime, max(0.0, end - self.clock_now)
+                    remaining, max(0.0, end - self.clock_now)
                 )
                 report.chip_seconds_used += job.credited
                 ns = job.pod.namespace
                 report.tenant_chip_seconds[ns] = (
                     report.tenant_chip_seconds.get(ns, 0.0) + job.credited
                 )
+                # gang spread refresh: covers both the initial Permit
+                # release and a migrated member rejoining elsewhere
+                group_name = job.pod.labels.get(C.LABEL_GROUP_NAME)
+                if group_name:
+                    self._note_gang_spread(
+                        f"{job.pod.namespace}/{group_name}", report
+                    )
 
             def drain_evictions(cause: str = "defrag") -> None:
                 # engine-evicted pods (defrag victims, or a half-gang
@@ -806,14 +912,29 @@ class Simulator:
                     victim = jobs.pop(victim_key, None)
                     if victim is None:
                         continue
+                    # a victim with a registered pending move is a
+                    # MIGRATION, whatever drain pass found it (defrag
+                    # moves surface here; compaction moves surface in
+                    # the post-tick drain): its work survives via the
+                    # checkpoint instead of being discarded
+                    move = (
+                        self.engine.migration.move_for(victim_key)
+                        if self.engine.migration is not None else None
+                    )
                     self._uncredit(victim, report)
-                    if cause == "gang":
+                    if move is not None:
+                        report.migrated += 1
+                    elif cause == "gang":
                         report.gang_requeued += 1
                     else:
                         report.defrag_evicted += 1
                     self._resubmits += 1
                     clone = Pod(
-                        name=f"{victim.pod.name}-d{self._resubmits}",
+                        name=(
+                            f"{victim.pod.name}-"
+                            f"{'m' if move is not None else 'd'}"
+                            f"{self._resubmits}"
+                        ),
                         namespace=victim.pod.namespace,  # tenant survives
                         labels=dict(victim.pod.labels),
                         scheduler_name=C.SCHEDULER_NAME,
@@ -825,6 +946,37 @@ class Simulator:
                     # metrics (the cost side of the defrag A/B)
                     requeued = _Job(pod=clone, event=victim.event,
                                     submitted_at=victim.submitted_at)
+                    if move is not None:
+                        # pause -> checkpoint on the virtual clock ->
+                        # rebind to the pinned destination -> pay
+                        # restore+warmup there; pre-move work banks.
+                        # The first extra_runtime seconds of this
+                        # stint were a PRIOR move's restore/warmup
+                        # surcharge, not workload progress: only the
+                        # remainder advances completed_work or banks
+                        # as goodput (the chips were still occupied,
+                        # so the surcharge stays in chip_seconds_used)
+                        elapsed = max(
+                            0.0, self.clock_now - (victim.bound_at or 0.0)
+                        )
+                        useful = max(
+                            0.0, elapsed - victim.extra_runtime
+                        )
+                        requeued.completed_work = (
+                            victim.completed_work + useful
+                        )
+                        requeued.ready_at = (
+                            self.clock_now + move.cost.checkpoint_s
+                        )
+                        requeued.extra_runtime = (
+                            move.cost.restore_s + move.cost.warmup_s
+                        )
+                        requeued.banked_goodput = (
+                            victim.banked_goodput
+                            + victim.event.chips * useful
+                        )
+                        report.migration_downtime_s += move.cost.total_s
+                        self.engine.note_resubmit(victim_key, clone.key)
                     jobs[clone.key] = requeued
                     still_pending.append(requeued)
                     self.engine.explain.carry_over(
@@ -866,8 +1018,16 @@ class Simulator:
                 # (binds landed), undelivered decisions are simply
                 # lost — the next pass re-observes everything.
                 try:
+                    # migration clones still inside their checkpoint
+                    # window are not offered (the workload is paused
+                    # serializing, not schedulable); they stay queued
+                    # via the undrained-tail loop below
                     decisions = self.engine.schedule_wave(
-                        [j.pod for j in pending], limit=self.wave_size,
+                        [
+                            j.pod for j in pending
+                            if j.ready_at <= self.clock_now
+                        ],
+                        limit=self.wave_size,
                         backfill=self.backfill,
                     )
                 except SimCrash:
@@ -900,6 +1060,9 @@ class Simulator:
                 for idx, job in enumerate(pending):
                     if job.pod.key in gang_bound:
                         continue  # bound this pass via a sibling's Permit
+                    if job.ready_at > self.clock_now:
+                        still_pending.append(job)  # checkpoint running
+                        continue
                     try:
                         decision = self.engine.schedule_one(job.pod)
                     except SimCrash:
@@ -955,10 +1118,14 @@ class Simulator:
                     retry_at = self.clock_now + 1.0
 
             if (i >= len(arrivals) and not finishes and pending
-                    and fi >= len(fault_queue) and controller is None):
+                    and fi >= len(fault_queue) and controller is None
+                    and all(j.ready_at <= self.clock_now
+                            for j in pending)):
                 # nothing will ever free capacity for these (with a
                 # controller, capacity can still ARRIVE — the horizon
-                # bounds the wait instead)
+                # bounds the wait instead; a clone still checkpointing
+                # gets its rebind chance first — its pin holds free
+                # capacity the sweep cannot see)
                 for job in pending:
                     report.unschedulable += 1
                     self.cluster.delete_pod(job.pod.key)

@@ -255,6 +255,35 @@ class TestRecommender:
         [plan] = Recommender().recommend(snap).plans
         assert plan.delta_nodes == 0 and plan.chips_needed == 0
 
+    def test_migration_pending_excluded_from_both_sizing_terms(self):
+        """PR-12 regression: a migration-displaced pod holds a pinned
+        destination a committed move is about to hand it — neither the
+        quota term nor the placement term may buy nodes for it. The
+        identical entry under a capacity reason DOES size a scale-up
+        (the control arm proving the exclusion is reason-driven)."""
+        from kubeshare_tpu.autoscale.demand import (
+            REASON_MIGRATION_PENDING,
+        )
+
+        def snap_with(reason):
+            return mk_snapshot(
+                total=8.0,
+                demand=[mk_entry(chips=6.0, reason=reason)],
+                guaranteed={"prod": 0.5}, used={"prod": 4.0},
+            )
+
+        [control] = Recommender(max_surge_nodes=8).recommend(
+            snap_with(REASON_NO_FEASIBLE_CELL)
+        ).plans
+        assert control.delta_nodes > 0  # the exclusion has teeth
+
+        [plan] = Recommender(max_surge_nodes=8).recommend(
+            snap_with(REASON_MIGRATION_PENDING)
+        ).plans
+        assert plan.quota_term_chips == 0.0
+        assert plan.placement_term_chips == 0.0
+        assert plan.delta_nodes == 0
+
     def test_max_surge_and_pool_clamps(self):
         snap = mk_snapshot(
             pool=3, bound=2,

@@ -314,6 +314,78 @@ class TestDistance:
         b = tree.leaf_cells["perf-1-chip-0"]
         assert ici_distance(a, b) >= 100
 
+    # -- PR-12 edge cases: previously only exercised indirectly
+    # through the scoring paths ------------------------------------
+
+    def test_torus_distance_rank_mismatch_raises(self):
+        with pytest.raises(ValueError, match="rank mismatch"):
+            torus_distance((0, 0), (1,), (4, 4))
+        with pytest.raises(ValueError, match="rank mismatch"):
+            torus_distance((0, 0), (1, 1), (4,))
+        with pytest.raises(ValueError, match="rank mismatch"):
+            torus_distance((0,), (1, 2, 3), (2, 2, 2))
+
+    def test_cross_domain_ici_falls_back_to_id_path(self):
+        """Two leaves in DIFFERENT torus domains never compare by
+        torus hops, even when both carry coordinates — the id-path
+        distance (DCN-scale magnitudes) answers instead."""
+        tree = CellTree(load_topology(V5E_16))
+        [root] = tree.free_list["tpu-v5e"][4]
+        leaves = list(root.iter_leaves())
+        # the wraparound pair: 1 torus hop, 100+ by id path — the two
+        # metrics genuinely disagree, so the fallback is observable
+        a, b = leaves[0], leaves[12]
+        assert a.torus_domain == b.torus_domain
+        assert ici_distance(a, b) == 1.0
+        saved = b.torus_domain
+        try:
+            b.torus_domain = "some/other/slice"
+            assert ici_distance(a, b) == id_path_distance(a.id, b.id)
+            assert ici_distance(a, b) >= 100
+        finally:
+            b.torus_domain = saved
+
+    def test_missing_coord_leaf_falls_back_to_id_path(self):
+        """A leaf without torus coordinates (topology declares no
+        torus for its subtree, or a synthetic cell) must not crash
+        the distance — id-path fallback covers it."""
+        tree = CellTree(load_topology(V5E_16))
+        [root] = tree.free_list["tpu-v5e"][4]
+        a, b = list(root.iter_leaves())[:2]
+        saved = a.coord
+        try:
+            a.coord = None
+            assert ici_distance(a, b) == id_path_distance(a.id, b.id)
+        finally:
+            a.coord = saved
+        # and a leaf with NO torus metadata at all (both None)
+        flat = CellTree(load_topology(HETERO))
+        flat.bind_node("lite-1", chips("lite-1", "tpu-v5e", 4))
+        x = flat.leaf_cells["lite-1-chip-0"]
+        y = flat.leaf_cells["lite-1-chip-1"]
+        if x.torus_domain is None:
+            assert ici_distance(x, y) == id_path_distance(x.id, y.id)
+
+    def test_mean_pairwise_hops_degenerate_and_known(self):
+        from kubeshare_tpu.cells.topology import mean_pairwise_hops
+
+        assert mean_pairwise_hops([]) == 0.0
+        tree = CellTree(load_topology(V5E_16))
+        [root] = tree.free_list["tpu-v5e"][4]
+        leaves = list(root.iter_leaves())
+        assert mean_pairwise_hops(leaves[:1]) == 0.0
+        # two leaves: exactly their pair distance
+        assert mean_pairwise_hops(leaves[:2]) == ici_distance(
+            leaves[0], leaves[1]
+        )
+        # three leaves: mean over the 3 pairs
+        expected = (
+            ici_distance(leaves[0], leaves[1])
+            + ici_distance(leaves[0], leaves[2])
+            + ici_distance(leaves[1], leaves[2])
+        ) / 3.0
+        assert mean_pairwise_hops(leaves[:3]) == pytest.approx(expected)
+
 
 class TestReviewRegressions:
     def test_cycle_in_cell_types(self):

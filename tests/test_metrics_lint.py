@@ -65,9 +65,12 @@ def scraped(tmp_path_factory):
         tenants={"tenants": {"alpha": {"weight": 2.0,
                                        "guaranteed": 0.25}}},
         journal_spool=spool,
+        # PR-12: migration plane on, so its tpu_scheduler_migration_*
+        # families ride the same end-to-end scrape
+        migrate=True,
     )
 
-    def pod(name, request, limit=None, prio=0, ns="alpha"):
+    def pod(name, request, limit=None, prio=0, ns="alpha", gang=None):
         labels = {
             C.LABEL_TPU_REQUEST: str(request),
             C.LABEL_TPU_LIMIT_ALIASES[1]: str(
@@ -77,6 +80,10 @@ def scraped(tmp_path_factory):
         }
         if prio:
             labels[C.LABEL_PRIORITY] = str(prio)
+        if gang:
+            labels[C.LABEL_GROUP_NAME] = gang
+            labels[C.LABEL_GROUP_HEADCOUNT] = "2"
+            labels[C.LABEL_GROUP_THRESHOLD] = "1.0"
         return cluster.create_pod(Pod(
             name=name, namespace=ns, labels=labels,
             scheduler_name=C.SCHEDULER_NAME,
@@ -85,11 +92,18 @@ def scraped(tmp_path_factory):
     # exercise every family source: binds (wait histograms, node
     # occupancy), a stuck guarantee pod (demand ledger, queue depth,
     # pending gauge), a permanent reject (unschedulable histogram),
-    # and a hostile tenant name (escaping)
+    # a hostile tenant name (escaping), and a bound 2-member gang
+    # (the per-gang ICI spread gauge)
     engine.schedule_one(pod("ok", 0.5))
     engine.schedule_one(pod("big", 4, prio=50))          # over-quota
     engine.schedule_one(pod("bad", 1.0, limit=0.5))      # prefilter
     engine.schedule_one(pod("weird", 0.5, ns=WEIRD_TENANT))
+    # both gang members must exist before the first schedule attempt —
+    # the group scan counts live pods against min_available
+    g0 = pod("g0", 1.0, ns="beta", gang="gg")
+    g1 = pod("g1", 1.0, ns="beta", gang="gg")
+    engine.schedule_one(g0)
+    engine.schedule_one(g1)
     # the shard plane rides the same exposition: one pod committed
     # through a real propose/commit cycle so the txn counters, the
     # commit-latency histogram, and the "commit" cost phase carry
@@ -286,6 +300,12 @@ class TestExpositionHygiene:
             ("tpu_scheduler_shard_failures_total", "gauge"),
             ("tpu_scheduler_shard_propose_seconds_total", "gauge"),
             ("tpu_scheduler_txn_commit_seconds", "histogram"),
+            # PR-12: migration plane + gang ICI spread families
+            ("tpu_scheduler_migration_moves_total", "gauge"),
+            ("tpu_scheduler_migration_pins", "gauge"),
+            ("tpu_scheduler_migration_compaction_moves_total", "gauge"),
+            ("tpu_scheduler_migration_modeled_seconds_total", "gauge"),
+            ("tpu_scheduler_gang_ici_spread_hops", "gauge"),
         ]:
             assert kinds.get(fam) == kind, (fam, kinds.get(fam))
 
@@ -400,10 +420,10 @@ class TestExpositionHygiene:
             "tpu_scheduler_pod_wait_seconds_count",
             tenant="alpha", outcome="unschedulable",
         ) == 1
-        # 5 pods (incl. the shard-committed one) + the slots::llama-7b
-        # pseudo-entry the router's no-free-slot transition filed
-        # through the ledger hook
-        assert value("tpu_scheduler_explain_journal_pods") == 6
+        # 5 pods (incl. the shard-committed one) + the 2 bound gang
+        # members + the slots::llama-7b pseudo-entry the router's
+        # no-free-slot transition filed through the ledger hook
+        assert value("tpu_scheduler_explain_journal_pods") == 8
         # shard plane families carry the fixture's one committed txn
         assert value("tpu_scheduler_txn_commits_total") == 1
         assert value("tpu_scheduler_txn_conflicts_total") == 0
@@ -439,14 +459,15 @@ class TestExpositionHygiene:
         }
         assert set(phases) == {
             "parse", "quota", "filter", "score", "reserve_permit",
-            "journal", "commit",
+            "journal", "commit", "migrate",
         }
         assert sum(phases.values()) > 0
         # the shard plane's one commit charged the arbiter critical
         # section into the new sub-phase
         assert phases["commit"] > 0
         [attempts] = select("tpu_scheduler_cost_attempts_total")
-        assert attempts.value == 5  # ok, big, bad, weird, ok2 (shard)
+        # ok, big, bad, weird, g0, g1, ok2 (shard)
+        assert attempts.value == 7
         # per-class attribution sums match the flat counters exactly
         class_secs = select("tpu_scheduler_cost_class_seconds_total")
         class_counts = select("tpu_scheduler_cost_class_attempts_total")

@@ -1,0 +1,125 @@
+"""MIGRATION.json invariants + scaled-down live replays.
+
+Two layers, the INCIDENTS/PROFILE pattern: the committed artifact
+must hold the migration plane's acceptance floors (move goodput >=
+eviction-only at equal fragmentation, compaction cuts mean final gang
+ICI spread vs sweeps-off, exact conservation with in-flight moves
+counted, zero double-binds, ledger drift {}), and small live replays
+prove the current tree still produces them."""
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from migrate_sim import (  # noqa: E402
+    compaction_ab, conservation_ok, migration_ab,
+)
+
+ARTIFACT = os.path.join(REPO, "MIGRATION.json")
+
+
+def _doc():
+    return json.load(open(ARTIFACT))
+
+
+class TestCommittedArtifact:
+    def test_exists_and_well_formed(self):
+        doc = _doc()
+        assert doc["generated_by"] == "tools/migrate_sim.py"
+        assert len(doc["migration_ab"]) == 2
+        assert len(doc["compaction_ab"]) == 2
+        evict_row, move_row = doc["migration_ab"]
+        assert evict_row["migrate"] is False
+        assert move_row["migrate"] is True
+        # equal fragmentation pressure: same trace, same scale, same
+        # horizon, and comparable displacement counts
+        assert evict_row["nodes"] == move_row["nodes"]
+        assert evict_row["horizon_s"] == move_row["horizon_s"]
+        assert move_row["displacements"] > 0
+        assert evict_row["displacements"] > 0
+
+    def test_goodput_floor_migration_ge_eviction(self):
+        evict_row, move_row = _doc()["migration_ab"]
+        assert move_row["goodput"] >= evict_row["goodput"], (
+            move_row["goodput"], evict_row["goodput"],
+        )
+        assert move_row["migrated"] > 0
+        assert move_row["moves"]["completed"] > 0
+        # every terminal outcome traces back to a planned move (moves
+        # still in flight at the horizon are the only remainder)
+        moves = move_row["moves"]
+        resolved = (
+            moves["completed"] + moves["fallback"] + moves["expired"]
+            + moves["cancelled"]
+        )
+        assert moves["planned"] >= resolved
+        assert moves["planned"] >= moves["completed"] > 0
+
+    def test_compaction_floor_spread_reduced(self):
+        off_row, on_row = _doc()["compaction_ab"]
+        assert off_row["compaction"] is False
+        assert on_row["compaction"] is True
+        assert on_row["mean_final_gang_ici_hops"] is not None
+        assert off_row["mean_final_gang_ici_hops"] is not None
+        assert (
+            on_row["mean_final_gang_ici_hops"]
+            < off_row["mean_final_gang_ici_hops"]
+        )
+        assert sum(on_row["compaction_moves"].values()) > 0
+        assert sum(off_row["compaction_moves"].values()) == 0
+
+    def test_conservation_and_safety_every_row(self):
+        doc = _doc()
+        for row in doc["migration_ab"] + doc["compaction_ab"]:
+            assert row["conservation_exact"] is True
+            assert row["double_binds"] == 0
+            assert row["ledger_drift"] == {}
+
+    def test_invariants_block_green(self):
+        inv = _doc()["invariants"]
+        for key, value in inv.items():
+            assert value is True, key
+
+
+class TestLiveScaledDown:
+    def test_migration_ab_live(self):
+        """A smaller fragmentation replay still shows the move verb
+        preserving work: goodput at least matches eviction-only (with
+        a hair of float tolerance) and every safety invariant holds
+        live."""
+        rows = migration_ab(n_nodes=6, horizon=3000.0,
+                            background=48, guarantees=16)
+        evict_row, move_row = rows
+        assert move_row["migrated"] > 0
+        assert move_row["goodput"] >= evict_row["goodput"] - 0.005
+        for row in rows:
+            assert row["conservation_exact"] is True
+            assert row["double_binds"] == 0
+            assert row["ledger_drift"] == {}
+
+    def test_compaction_ab_live(self):
+        rows = compaction_ab()
+        off_row, on_row = rows
+        assert sum(on_row["compaction_moves"].values()) > 0
+        assert (
+            on_row["mean_final_gang_ici_hops"]
+            <= off_row["mean_final_gang_ici_hops"]
+        )
+        for row in rows:
+            assert row["conservation_exact"] is True
+            assert row["double_binds"] == 0
+            assert row["ledger_drift"] == {}
+
+    def test_conservation_helper_counts_moves(self):
+        doc = {
+            "submitted": 10, "completed": 5, "unschedulable": 1,
+            "defrag_evicted": 1, "gang_requeued": 0, "migrated": 2,
+            "running_at_end": 1, "pending_at_end": 0,
+        }
+        assert conservation_ok(doc)
+        doc["migrated"] = 1  # a lost move must break the equation
+        assert not conservation_ok(doc)

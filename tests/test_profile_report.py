@@ -26,9 +26,10 @@ ARTIFACT = os.path.join(REPO, "PROFILE.json")
 
 # "commit" is PR-11's arbiter critical section: 0 on the wave driver
 # (no shard plane in these replays) but always exported, so coverage
-# sums are unchanged while the phase vocabulary includes it
+# sums are unchanged while the phase vocabulary includes it; same for
+# "migrate" (PR-12) — 0.0 with the migration plane off
 PHASES = {"parse", "quota", "filter", "score", "reserve_permit",
-          "journal", "commit"}
+          "journal", "commit", "migrate"}
 
 
 def _doc():
