@@ -210,6 +210,12 @@ class DemandLedger:
     def resolve(self, pod_key: str) -> None:
         """The pod bound or left the cluster — either way it no longer
         wants anything."""
+        if pod_key not in self._entries:
+            # GIL-atomic membership peek: most binds/deletes never had
+            # a pending entry, and a note() racing this miss leaves
+            # the same state the locked pop would (note-after-resolve
+            # keeps the entry either way)
+            return
         with self._lock:
             self._entries.pop(pod_key, None)
 

@@ -19,7 +19,9 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (
+    Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple,
+)
 
 from .spec import CellSpec, CellTypeSpec, TopologyConfig
 from .topology import unravel
@@ -364,8 +366,23 @@ class CellTree:
         self._agg_cache: Dict[str, Dict[str, NodeModelAgg]] = {}
         # fired with the node name on every leaf-state change (delta
         # application AND generation bump): the scheduler's score memo
-        # evicts its per-(node, shape) entries from this hook
+        # evicts its per-(node, shape) entries from this hook, and the
+        # column store marks the node's rows dirty
         self.on_delta: Optional[Callable[[str], None]] = None
+        # fired ONLY on structural events (bind/unbind/HBM correction/
+        # health flip — the generation-bump path), BEFORE on_delta: a
+        # subscriber that maintains positional per-model row arrays
+        # (scheduler.columns) needs to know when a node's model
+        # MEMBERSHIP may have moved, which accounting deltas never do
+        self.on_structural: Optional[Callable[[str], None]] = None
+        # (node, model) aggregates whose leaves mutated since their
+        # last read: accounting walks mark the node dirty instead of
+        # refreshing every touched aggregate inline, and the next read
+        # through node_model_agg (or the Filter loop's flush guard)
+        # pays ONE refresh per (node, model) however many deltas
+        # landed in between — a gang bind's four reserves, or a
+        # reserve followed by the same wave's release, coalesce.
+        self.agg_dirty: Set[str] = set()
         # Total HBM across bound leaves, maintained by the same
         # bind/unbind/HBM-correction walks that bump generations: the
         # quota plane's capacity denominator must be O(1) per read
@@ -531,35 +548,46 @@ class CellTree:
             for by_node in self._agg_cache.values():
                 if by_node.pop(node, None) is not None:
                     self.agg_rebuilds += 1  # rebuild debt: next read pays
+            self.agg_dirty.discard(node)  # nothing cached left to refresh
             # version bump AFTER the mutation, BEFORE subscribers: an
             # optimistic reader capturing the version post-bump is
             # guaranteed to read post-mutation state (one mutator
             # thread), and one capturing pre-bump conflicts at commit
             self._delta_seq[node] = self._delta_seq.get(node, 0) + 1
+            if self.on_structural is not None:
+                self.on_structural(node)
             if self.on_delta is not None:
                 self.on_delta(node)
 
     def _apply_leaf_delta(self, leaf: Cell) -> None:
         """Delta maintenance for an accounting change on ``leaf``
-        (reserve/reclaim): refresh the one affected (node, model)
-        aggregate in place from the already-mutated leaves —
-        O(leaves-on-node for that model) — and fire ``on_delta`` so
-        external memos (score cache) evict their entries for this
-        node. No generation bump: readers holding the aggregate see
-        the post-mutation state immediately, and untouched nodes'
-        caches are left alone."""
+        (reserve/reclaim): mark the node's cached aggregates stale for
+        a lazy refresh at their next read (O(1) here; the read pays
+        O(leaves-on-node) once per burst of deltas instead of once per
+        delta) and fire ``on_delta`` so external memos (score cache,
+        column rows) evict/dirty their entries for this node. No
+        generation bump: the aggregates refresh in place, untouched
+        nodes' caches are left alone."""
         node = leaf.node
         if not node:
             return
-        by_node = self._agg_cache.get(leaf.leaf_cell_type)
-        if by_node is not None:
-            agg = by_node.get(node)
-            if agg is not None:
-                agg.refresh(self._node_gen.get(node, 0))
-                self.agg_delta_updates += 1
+        self.agg_dirty.add(node)
         self._delta_seq[node] = self._delta_seq.get(node, 0) + 1
         if self.on_delta is not None:
             self.on_delta(node)
+
+    def flush_node_aggs(self, node: str) -> None:
+        """Refresh every cached aggregate for ``node`` after deferred
+        accounting deltas — the read-side half of the lazy delta
+        contract. Called by ``node_model_agg`` and by the engine's
+        inline Filter loop before a raw ``_agg_cache`` read."""
+        self.agg_dirty.discard(node)
+        gen = self._node_gen.get(node, 0)
+        for by_node in self._agg_cache.values():
+            agg = by_node.get(node)
+            if agg is not None:
+                agg.refresh(gen)
+                self.agg_delta_updates += 1
 
     def node_delta_version(self, node: str) -> int:
         """Monotonic per-node read-validation version: moves on every
@@ -599,9 +627,12 @@ class CellTree:
 
     def node_model_agg(self, node: str, model: str) -> NodeModelAgg:
         """The (node, model) feasibility aggregate. A cached entry is
-        always valid: accounting walks refresh it in place and
-        structural events evict it, so this is one dict probe on the
-        steady-state Filter path and a cold build otherwise."""
+        always valid: accounting walks mark it stale for the refresh
+        paid here, and structural events evict it, so this is one
+        dict probe (plus a dirty-set check) on the steady-state Filter
+        path and a cold build otherwise."""
+        if node in self.agg_dirty:
+            self.flush_node_aggs(node)
         by_node = self._agg_cache.get(model)
         if by_node is None:
             by_node = self._agg_cache[model] = {}
@@ -687,7 +718,11 @@ class CellTree:
 
     # -- accounting ----------------------------------------------------
 
-    def reserve(self, leaf: Cell, request: float, memory: int) -> None:
+    def _reserve_leaf(self, leaf: Cell, request: float,
+                      memory: int) -> None:
+        """Validate + mutate + propagate for one leaf reservation,
+        WITHOUT the delta notification — the shared body of
+        :meth:`reserve` and :meth:`reserve_batch`."""
         if leaf.level != 1:
             raise ValueError(f"reserve targets leaf cells, got {leaf!r}")
         if leaf.state != CellState.BOUND:
@@ -707,9 +742,38 @@ class CellTree:
         whole_delta = int(leaf.is_whole_free) - int(was_whole)
         leaf.available_whole_cell += whole_delta
         self._propagate(leaf, -request, whole_delta, -memory, 0)
+
+    def reserve(self, leaf: Cell, request: float, memory: int) -> None:
+        self._reserve_leaf(leaf, request, memory)
         self._apply_leaf_delta(leaf)
 
-    def reclaim(self, leaf: Cell, request: float, memory: int) -> None:
+    def reserve_batch(
+        self, ops: Sequence[Tuple[Cell, float, int]]
+    ) -> None:
+        """Apply several leaf reservations on ONE node with a single
+        delta notification — the flattened reserve lane's leaf
+        bookkeeping (a 4-chip gang member used to fan out four
+        aggregate-dirty marks, four score-memo evictions, and four
+        column dirties for the same node). State after the batch is
+        identical to serial :meth:`reserve` calls in ``ops`` order;
+        subscribers simply hear about the node once. Callers pass
+        leaves of one node (reserve-time selection never spans
+        nodes); a validation failure raises after the notification
+        for whatever already mutated, like the serial loop."""
+        if not ops:
+            return
+        try:
+            for leaf, request, memory in ops:
+                self._reserve_leaf(leaf, request, memory)
+        finally:
+            self._apply_leaf_delta(ops[0][0])
+
+    def _reclaim_leaf(self, leaf: Cell, request: float,
+                      memory: int) -> None:
+        """Validate + mutate + propagate for one leaf reclaim WITHOUT
+        the delta notification — the release path applies several of
+        these and fires :meth:`_apply_leaf_delta` once for the node
+        (plugin._release), mirroring :meth:`reserve_batch`."""
         if leaf.level != 1:
             raise ValueError(f"reclaim targets leaf cells, got {leaf!r}")
         if leaf.state != CellState.BOUND:
@@ -731,6 +795,9 @@ class CellTree:
         whole_delta = int(leaf.is_whole_free) - int(was_whole)
         leaf.available_whole_cell += whole_delta
         self._propagate(leaf, request, whole_delta, memory, 0)
+
+    def reclaim(self, leaf: Cell, request: float, memory: int) -> None:
+        self._reclaim_leaf(leaf, request, memory)
         self._apply_leaf_delta(leaf)
 
     # -- queries -------------------------------------------------------

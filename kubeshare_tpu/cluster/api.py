@@ -12,7 +12,8 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Protocol
+from functools import cached_property
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
 
 
 class PodPhase(str, enum.Enum):
@@ -52,10 +53,30 @@ class Pod:
     # resetting it at the restarted scheduler's first attempt — the
     # user has been waiting since creation, not since the restart.
     created_at: float = 0.0
+    # parsed-requirements memo: (labels dict the parse read, parsed
+    # PodRequirements). Keyed on the labels dict's IDENTITY — informer
+    # adapters deliver label changes as fresh Pod objects (or fresh
+    # label dicts), so a stale cache can only survive an in-place
+    # labels[...] mutation, which callers must follow with
+    # ``invalidate_req_cache``. Written by scheduler.labels.cached_req,
+    # never hand-rolled elsewhere.
+    req_cache: Optional[Tuple[Dict[str, str], object]] = field(
+        default=None, repr=False, compare=False
+    )
 
-    @property
+    @cached_property
     def key(self) -> str:
+        # name/namespace are construction-time identity (nothing in the
+        # codebase rewrites them), so the joined key is computed once —
+        # it is read on every queue sort, journal append, and status
+        # probe, where the f-string used to show up in profiles
         return f"{self.namespace}/{self.name}"
+
+    def invalidate_req_cache(self) -> None:
+        """Drop the parsed-requirements memo after an in-place label
+        mutation (informer adapters replace the Pod object instead and
+        never need this)."""
+        self.req_cache = None
 
     @property
     def is_bound(self) -> bool:

@@ -248,6 +248,15 @@ def build_parser() -> argparse.ArgumentParser:
              "tpu_scheduler_backfill_head_delays_total (must stay 0)",
     )
     parser.add_argument(
+        "--no-vector", action="store_true",
+        help="disable the columnar (structure-of-arrays) Filter/Score "
+             "fast path and run every attempt through the scalar "
+             "walk — decision-for-decision identical (the columns "
+             "are an execution strategy, not a policy), kept as an "
+             "operational escape hatch and the A/B baseline; "
+             "tpu_scheduler_vector_* report which path served",
+    )
+    parser.add_argument(
         "--trace-out", default="", metavar="PATH",
         help="write a Chrome/Perfetto trace of scheduling phases here "
              "on exit (and refresh it every 100 passes)",
@@ -690,6 +699,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         min_feasible_nodes=args.min_feasible_nodes,
         tenants=args.tenants or None,
         explain_capacity=args.explain_capacity,
+        vector=not args.no_vector,
     )
     elector = None
     if args.leader_elect:

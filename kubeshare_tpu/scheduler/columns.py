@@ -1,0 +1,549 @@
+"""Structure-of-arrays wave columns: columnar Filter/Score state.
+
+PROFILE.json put ~0.22 of the attempt budget in Score and a rising
+share in Filter — both per-candidate Python loops over state the
+engine already maintains incrementally. This module restructures the
+per-(node, model) feasibility/score facts into parallel COLUMNS
+(numpy arrays, with a pure-Python fallback so the dependency stays
+optional): one wave's Filter over ALL candidates becomes a handful of
+vectorized comparisons producing a candidate mask, and Score becomes a
+column read plus a normalized argmax — a true global best at
+O(columns) per bind, which retires the ~48-candidate sampling window
+and subsumes the ROADMAP's score-sorted-candidate-index item.
+
+Per model pool, row ``i`` describes one node (rows sorted by node name
+ascending, so row order IS the scalar tie-break order):
+
+- ``avail0``/``mem0`` — the (available, free HBM) Pareto frontier's
+  FIRST point over healthy bound leaves (max available; max HBM among
+  the max-available leaves). ``avail0 == -1.0`` marks a node with no
+  healthy leaf of the model.
+- ``best_mem``  — max free HBM over healthy leaves (the frontier's
+  LAST point). A fractional query is exact from these three columns
+  except the rare row with ``mem0 < memory <= best_mem`` — a genuinely
+  multi-point frontier — which resolves through the scalar aggregate.
+- ``whole``/``cell_mem``/``cell_ok``/``simple`` — the model-scoped
+  whole-free leaf count, free HBM, and health of the node-level cell
+  (``simple`` False marks the rare node with several node-level cells,
+  which resolves through the scalar aggregate).
+- ``port_full`` — the pod-manager port pool is exhausted (mirrors the
+  engine's ``_full_port_nodes`` set; SHARED queries only).
+- ``opp``/``guar`` — the EXACT outputs of
+  ``scoring.opportunistic_node_score`` / ``guarantee_node_score`` with
+  no anchors: the refresh accumulates in the same per-leaf order, so
+  the column is bit-equal to the scalar score and the vectorized
+  argmax can reuse ``pick_top2_seq``'s normalization verbatim.
+
+Maintenance contract: the engine's ``on_delta`` subscriber (fired by
+the cell tree on EVERY leaf-state change — accounting delta or
+structural event) marks the node's rows dirty; rows refresh lazily at
+the next query, so a gang bind's four leaf deltas coalesce into one
+row refresh — O(touched rows) per wave, never O(cluster). Structural
+events (bind/unbind/health flips, via the tree's ``on_structural``
+hook) additionally re-derive the node's model MEMBERSHIP; a
+membership change rebuilds that model's store (row arrays are
+positional). Queries flush before reading, so a cached column is
+always exactly the scalar walk's view of the same state — pinned by
+tests/test_scheduler_vector.py's differential suite and the
+``check_aggregates`` oracle inside the engine's vector path.
+
+Fallback semantics: with numpy unavailable (or ``KUBESHARE_NO_NUMPY``
+set), the same columns live in plain Python lists and the mask/argmax
+run as interpreted loops — identical decisions (the fallback pick IS
+``pick_top2_seq``), just without the constant-factor win.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..cells.cell import _EPS, CellTree
+from .labels import PodKind, PodRequirements
+from .scoring import pick_top2_seq
+
+try:  # optional dependency: columns fall back to Python lists
+    import numpy as _numpy
+except ImportError:  # pragma: no cover - exercised via KUBESHARE_NO_NUMPY
+    _numpy = None
+
+
+def _numpy_enabled() -> bool:
+    return _numpy is not None and not os.environ.get("KUBESHARE_NO_NUMPY")
+
+
+
+def _derive_cells(leaves) -> Tuple[Optional[object], bool]:
+    """Resolve a row's node-level cell and whether the row is SIMPLE
+    (every leaf under ONE node-level cell) — the structural facts the
+    multi-chip mask reads. One implementation for both the whole-model
+    rebuild and the in-place structural refresh: membership ancestry
+    is exactly the split the store's correctness depends on, so the
+    two paths must not drift."""
+    node_cell = None
+    simple = True
+    for leaf in leaves:
+        cell = leaf
+        while cell is not None and not cell.is_node:
+            cell = cell.parent
+        if cell is not None:
+            if node_cell is None:
+                node_cell = cell
+            elif cell is not node_cell:
+                simple = False
+    return node_cell, simple
+
+
+class ModelColumns:
+    """One model pool's parallel arrays (see module docstring)."""
+
+    __slots__ = (
+        "model", "nodes", "row_of", "leaves", "cells", "avail0", "mem0",
+        "best_mem", "whole", "cell_mem", "cell_ok", "simple", "port_full",
+        "opp", "guar", "multi_frontier", "nonsimple", "rows", "_m1",
+        "_m2", "_key",
+    )
+
+    def __init__(self, model: str, nodes: List[str], use_numpy: bool):
+        self.model = model
+        self.nodes = nodes  # sorted ascending: row order == name order
+        self.row_of = {name: i for i, name in enumerate(nodes)}
+        # per-row bound-leaf list and node-level cell: membership and
+        # ancestry are STRUCTURAL facts — any change rebuilds the
+        # store — so the refresh path reads them straight off the row
+        # instead of re-walking leaves_view and parent chains
+        self.leaves: List[tuple] = [()] * len(nodes)
+        self.cells: List[tuple] = [(None, True)] * len(nodes)
+        # rows whose frontier has depth > 1 (best_mem > mem0): only
+        # those can need the scalar shared-fit resolve — the query
+        # skips the whole ambiguity pass while this is 0 (HBM
+        # proportional to usage keeps it 0 in practice)
+        self.multi_frontier = 0
+        self.nonsimple = 0  # rows with several node-level cells
+        n = len(nodes)
+        if use_numpy:
+            self.avail0 = _numpy.full(n, -1.0, dtype=_numpy.float64)
+            self.mem0 = _numpy.full(n, -1, dtype=_numpy.int64)
+            self.best_mem = _numpy.full(n, -1, dtype=_numpy.int64)
+            self.whole = _numpy.zeros(n, dtype=_numpy.int64)
+            self.cell_mem = _numpy.full(n, -1, dtype=_numpy.int64)
+            self.cell_ok = _numpy.zeros(n, dtype=bool)
+            self.simple = _numpy.ones(n, dtype=bool)
+            self.port_full = _numpy.zeros(n, dtype=bool)
+            self.opp = _numpy.zeros(n, dtype=_numpy.float64)
+            self.guar = _numpy.zeros(n, dtype=_numpy.float64)
+            # query scratch: preallocated mask buffers, the 0..n-1
+            # row-index vector (the name tie-break IS the row index),
+            # and the composite-key buffer the argmax runs over —
+            # per-query allocations were a visible slice of the
+            # vectorized attempt's wall
+            self.rows = _numpy.arange(n, dtype=_numpy.int64)
+            self._m1 = _numpy.empty(n, dtype=bool)
+            self._m2 = _numpy.empty(n, dtype=bool)
+            self._key = _numpy.empty(n, dtype=_numpy.int64)
+        else:
+            self.avail0 = [-1.0] * n
+            self.mem0 = [-1] * n
+            self.best_mem = [-1] * n
+            self.whole = [0] * n
+            self.cell_mem = [-1] * n
+            self.cell_ok = [False] * n
+            self.simple = [True] * n
+            self.port_full = [False] * n
+            self.opp = [0.0] * n
+            self.guar = [0.0] * n
+            self.rows = self._m1 = self._m2 = self._key = None
+
+
+class ColumnStore:
+    def __init__(self, tree: CellTree, full_ports: Set[str]):
+        self.tree = tree
+        self.full_ports = full_ports  # live reference (engine-owned)
+        self.use_numpy = _numpy_enabled()
+        self._models: Dict[str, ModelColumns] = {}
+        self._dirty: Set[str] = set()         # accounting deltas
+        self._struct_dirty: Set[str] = set()  # membership may have moved
+        self.row_refreshes = 0  # single-row recomputes (delta path)
+        self.rebuilds = 0       # whole-model rebuilds (membership)
+        self.ambiguous_resolves = 0  # multi-point-frontier scalar probes
+
+    # ---- maintenance hooks (engine's on_delta / on_structural) ------
+    # note_delta/note_structural are the hook-shaped wiring surface
+    # (standalone stores — tests/test_scheduler_vector.py — plug them
+    # straight into the tree's callbacks); the engine's own
+    # subscribers poke _dirty/_struct_dirty directly instead — a
+    # measured hot-path exception (several marks per attempt), not an
+    # invitation to reach deeper.
+
+    def note_delta(self, node: str) -> None:
+        self._dirty.add(node)
+
+    def note_structural(self, node: str) -> None:
+        self._struct_dirty.add(node)
+
+    def reset(self) -> None:
+        """Drop every store (topology reload / relist storms): the
+        next query rebuilds from the live tree."""
+        self._models.clear()
+        self._dirty.clear()
+        self._struct_dirty.clear()
+
+    # ---- refresh ----------------------------------------------------
+
+    def _flush(self) -> None:
+        if self._struct_dirty:
+            struck = self._struct_dirty
+            self._struct_dirty = set()
+            self._dirty.difference_update(struck)
+            tree = self.tree
+            for model, mc in list(self._models.items()):
+                stale = False
+                for node in struck:
+                    row = mc.row_of.get(node)
+                    fresh = tuple(tree.leaves_view(node, model))
+                    if (row is None) != (not fresh):
+                        # node gained or lost its bound set for this
+                        # model: arrays are positional, rebuild
+                        stale = True
+                        break
+                    if row is None:
+                        continue
+                    if fresh != mc.leaves[row]:
+                        # same node, different bound subset (a chip
+                        # unbound/rebound in place): re-derive the
+                        # row's structural facts, then its stats
+                        mc.leaves[row] = fresh
+                        node_cell, simple = _derive_cells(fresh)
+                        mc.nonsimple += int(not simple) - int(
+                            not mc.cells[row][1]
+                        )
+                        mc.cells[row] = (node_cell, simple)
+                    self._refresh_row(mc, row, node)
+                if stale:
+                    self._models[model] = self._build_model(model)
+        if self._dirty:
+            dirty = self._dirty
+            self._dirty = set()
+            for mc in self._models.values():
+                row_of = mc.row_of
+                for node in dirty:
+                    row = row_of.get(node)
+                    if row is not None:
+                        self._refresh_row(mc, row, node)
+
+    def _columns_for(self, model: str) -> ModelColumns:
+        self._flush()
+        mc = self._models.get(model)
+        if mc is None:
+            mc = self._models[model] = self._build_model(model)
+        return mc
+
+    def _build_model(self, model: str) -> ModelColumns:
+        tree = self.tree
+        nodes = sorted(
+            n for n in tree._leaves_by_node
+            if n and tree.leaves_view(n, model)
+        )
+        mc = ModelColumns(model, nodes, self.use_numpy)
+        self.rebuilds += 1
+        for row, node in enumerate(nodes):
+            leaves = tuple(tree.leaves_view(node, model))
+            mc.leaves[row] = leaves
+            node_cell, simple = _derive_cells(leaves)
+            mc.cells[row] = (node_cell, simple)
+            if not simple:
+                mc.nonsimple += 1
+            self._refresh_row(mc, row, node)
+        return mc
+
+    def _refresh_row(self, mc: ModelColumns, row: int, node: str) -> None:
+        """Recompute one node's columns from its live leaves in ONE
+        fused pass — the accumulation order per column matches the
+        scalar scoring functions exactly (same additions in the same
+        sequence), so the stored floats are bit-equal to what
+        ``score_node`` would return for this (node, model)."""
+        self.row_refreshes += 1
+        leaves = mc.leaves[row]
+        # fractional-filter stats (healthy leaves only — mirrors the
+        # NodeModelAgg frontier, which is built over the healthy set)
+        best_a = -1.0
+        best_am = -1
+        best_m = -1
+        # score accumulators (ALL bound leaves — mirrors score_node,
+        # which passes leaves_view through unfiltered)
+        opp = 0.0
+        free = 0.0
+        guar = 0.0
+        n = 0
+        # multi-chip stats: model-scoped whole-free count under the
+        # node-level cell (NodeModelAgg.node_cells semantics: counted
+        # over ALL leaves; the CELL's health gates the fit). Ancestry
+        # is structural — read off the row, never re-walked here.
+        node_cell, simple = mc.cells[row]
+        whole = 0
+        for leaf in leaves:
+            n += 1
+            avail = leaf.available
+            prio = leaf.priority
+            mem = leaf.free_memory
+            # is_whole_free, inlined (the cached row tuple only holds
+            # BOUND leaves, so the state check is already satisfied)
+            w = mem == leaf.full_memory and -1e-6 <= avail - 1.0 <= 1e-6
+            # opportunistic_node_score, term for term
+            opp += prio
+            if w:
+                free += 1.0
+                whole += 1
+            else:
+                opp += (1.0 - avail) * 100.0
+            # guarantee_node_score with no anchors, term for term
+            guar += prio - (1.0 - avail) * 100.0
+            if leaf.healthy:
+                if avail > best_a or (avail == best_a and mem > best_am):
+                    best_a = avail
+                    best_am = mem
+                if mem > best_m:
+                    best_m = mem
+        if n:
+            fn = float(n)
+            opp = (opp - free / fn * 100.0) / fn
+            guar = guar / fn
+        mc.multi_frontier += int(best_m > best_am) - int(
+            mc.best_mem[row] > mc.mem0[row]
+        )
+        mc.avail0[row] = best_a
+        mc.mem0[row] = best_am
+        mc.best_mem[row] = best_m
+        mc.whole[row] = whole
+        mc.simple[row] = simple
+        if node_cell is not None:
+            mc.cell_mem[row] = node_cell.free_memory
+            mc.cell_ok[row] = node_cell.healthy
+        else:
+            mc.cell_mem[row] = -1
+            mc.cell_ok[row] = False
+        mc.opp[row] = opp
+        mc.guar[row] = guar
+        mc.port_full[row] = node in self.full_ports
+
+    # ---- queries ----------------------------------------------------
+
+    def feasible_names(self, req: PodRequirements, model: str) -> List[str]:
+        """The full candidate mask as node names (oracle/cold path)."""
+        mc = self._columns_for(model)
+        if self.use_numpy:
+            mask = self._mask_numpy(mc, req)
+            return [mc.nodes[i] for i in _numpy.flatnonzero(mask)]
+        return [mc.nodes[i] for i in self._mask_rows_python(mc, req)]
+
+    def query(
+        self, req: PodRequirements, model: str, guarantee: bool
+    ) -> Tuple[int, Optional[str], Optional[str], float, float]:
+        """One vectorized Filter + Score: returns (feasible count,
+        winner, runner-up, winner raw score, runner raw score).
+        count == 0 means nothing fit (winner None). The winner is
+        bit-equal to ``pick_top2_seq`` over the scalar walk's feasible
+        set and scores — the placement decision, not an
+        approximation."""
+        mc = self._columns_for(model)
+        if self.use_numpy:
+            mask = self._mask_numpy(mc, req)
+            n = len(mc.nodes)
+            count = int(_numpy.count_nonzero(mask))
+            if not count:
+                return 0, None, None, 0.0, 0.0
+            scores = mc.guar if guarantee else mc.opp
+            if count == n:
+                # everything feasible (the unloaded / lightly-loaded
+                # steady state): skip the index materialization and
+                # gather — rows ARE the candidate positions
+                rowidx = mc.rows
+                vals = scores
+            else:
+                rowidx = _numpy.flatnonzero(mask)
+                vals = scores[rowidx]
+            if count == 1:
+                i = int(rowidx[0])
+                return 1, mc.nodes[i], None, float(vals[0]), 0.0
+            lo = float(vals.min())
+            hi = float(vals.max())
+            if lo == hi:
+                # uniform scores (an unloaded or evenly-loaded pool —
+                # the common steady state): every candidate lands in
+                # one bucket and the name tie-break alone decides, so
+                # winner and runner-up are simply the last two rows
+                return (
+                    count, mc.nodes[int(rowidx[-1])],
+                    mc.nodes[int(rowidx[-2])], lo, lo,
+                )
+            best_i, runner_i, best_raw, runner_raw = self._pick_numpy(
+                mc, rowidx, vals, lo, hi
+            )
+            return (
+                count, mc.nodes[best_i], mc.nodes[runner_i],
+                best_raw, runner_raw,
+            )
+        rows = self._mask_rows_python(mc, req)
+        if not rows:
+            return 0, None, None, 0.0, 0.0
+        names = [mc.nodes[i] for i in rows]
+        scores = mc.guar if guarantee else mc.opp
+        values = [scores[i] for i in rows]
+        if len(rows) == 1:
+            return 1, names[0], None, values[0], 0.0
+        best, runner, best_raw, runner_raw = pick_top2_seq(names, values)
+        return len(rows), best, runner, best_raw, runner_raw
+
+    def _mask_numpy(self, mc: ModelColumns, req: PodRequirements):
+        """Candidate mask into the store's scratch buffer (``_m1`` —
+        valid until the next query; every caller consumes it
+        immediately). ``out=`` forms keep the steady state at zero
+        allocations; the rare ambiguous passes may allocate."""
+        m1 = mc._m1
+        m2 = mc._m2
+        memory = req.memory
+        if req.kind == PodKind.MULTI_CHIP:
+            chips = req.chip_count
+            _numpy.greater_equal(mc.whole, chips, out=m1)
+            _numpy.logical_and(m1, mc.cell_ok, out=m1)
+            if memory > 0:
+                # memory <= 0 (no HBM cap declared — the common
+                # label shape) makes the cell test vacuous: cell_mem
+                # is -1 only where cell_ok is already False
+                _numpy.greater_equal(mc.cell_mem, memory, out=m2)
+                _numpy.logical_and(m1, m2, out=m1)
+            if mc.nonsimple:
+                # several node-level cells under one node name: the
+                # two-column (whole, mem) pairing can't see which cell
+                # holds which — resolve those rows through the scalar
+                # aggregate (exact, and vanishingly rare)
+                _numpy.logical_and(m1, mc.simple, out=m1)
+                agg = self.tree.node_model_agg
+                for i in _numpy.flatnonzero(~mc.simple):
+                    self.ambiguous_resolves += 1
+                    if agg(mc.nodes[i], mc.model).multi_chip_fits(
+                        chips, memory
+                    ):
+                        m1[i] = True
+            return m1
+        request_floor = req.request - _EPS  # fge(), constant-folded
+        _numpy.greater_equal(mc.avail0, request_floor, out=m1)
+        if memory <= 0:
+            # no HBM cap: mem0 >= 0 holds for every row with a healthy
+            # leaf, and those are exactly the rows passing the avail0
+            # test (both are -1 sentinels together) — the whole memory
+            # lane, ambiguity pass included, is vacuous
+            if self.full_ports:
+                _numpy.logical_not(mc.port_full, out=m2)
+                _numpy.logical_and(m1, m2, out=m1)
+            return m1
+        _numpy.greater_equal(mc.mem0, memory, out=m2)
+        if mc.multi_frontier:
+            # multi-point frontier rows: the max-available leaf lacks
+            # the HBM but some leaf has it — only a deeper frontier
+            # point can answer whether one leaf has BOTH. Zero such
+            # rows (HBM proportional to usage) skips the whole pass.
+            ambiguous = m1 & ~m2 & (mc.best_mem >= memory)
+            _numpy.logical_and(m1, m2, out=m1)
+            if ambiguous.any():
+                agg = self.tree.node_model_agg
+                for i in _numpy.flatnonzero(ambiguous):
+                    self.ambiguous_resolves += 1
+                    if agg(mc.nodes[i], mc.model).shared_fits(
+                        req.request, memory
+                    ):
+                        m1[i] = True
+        else:
+            _numpy.logical_and(m1, m2, out=m1)
+        if self.full_ports:
+            _numpy.logical_not(mc.port_full, out=m2)
+            _numpy.logical_and(m1, m2, out=m1)
+        return m1
+
+    def _mask_rows_python(
+        self, mc: ModelColumns, req: PodRequirements
+    ) -> List[int]:
+        rows: List[int] = []
+        if req.kind == PodKind.MULTI_CHIP:
+            chips = req.chip_count
+            memory = req.memory
+            agg = self.tree.node_model_agg
+            for i in range(len(mc.nodes)):
+                if not mc.simple[i]:
+                    self.ambiguous_resolves += 1
+                    if agg(mc.nodes[i], mc.model).multi_chip_fits(
+                        chips, memory
+                    ):
+                        rows.append(i)
+                elif (
+                    mc.cell_ok[i]
+                    and mc.whole[i] >= chips
+                    and mc.cell_mem[i] >= memory
+                ):
+                    rows.append(i)
+            return rows
+        request_floor = req.request - _EPS
+        memory = req.memory
+        ports = self.full_ports
+        agg = self.tree.node_model_agg
+        for i in range(len(mc.nodes)):
+            if mc.avail0[i] < request_floor:
+                continue
+            if ports and mc.port_full[i]:
+                continue
+            if mc.mem0[i] >= memory:
+                rows.append(i)
+            elif mc.best_mem[i] >= memory:
+                self.ambiguous_resolves += 1
+                if agg(mc.nodes[i], mc.model).shared_fits(
+                    req.request, memory
+                ):
+                    rows.append(i)
+        return rows
+
+    @staticmethod
+    def _pick_numpy(mc, idx, vals, lo, hi) -> Tuple[int, int, float,
+                                                    float]:
+        """``pick_top2_seq`` vectorized: identical normalization
+        arithmetic (same float64 expressions in the same order,
+        truncation via int cast on non-negative values) and identical
+        tie-break (max bucket, then max NAME). Rows are name-sorted,
+        so folding the row index into a composite key —
+        ``bucket * n + row`` — makes the key UNIQUE per row and ONE
+        ``argmax`` returns the max-bucket max-name candidate; a
+        second argmax with the winner masked yields the runner-up
+        under the same ordering. Callers guarantee len(vals) >= 2 and
+        non-uniform vals."""
+        shift = -lo if lo < 0 else 0.0
+        hi += shift
+        lo = 0.0 if shift else lo
+        count = len(vals)
+        kb = mc._key[:count]
+        if hi > 100:
+            span = (hi - lo) or 100.0
+            # same expression tree as the scalar normalization:
+            # 100.0 * ((vals + shift) - lo) / span, truncated
+            t = vals + shift if shift else vals
+            if lo:
+                t = t - lo
+            t = 100.0 * t
+            kb[:] = t / span  # int64 assignment truncates like int()
+        elif shift:
+            kb[:] = vals + shift
+        else:
+            kb[:] = vals
+        n = len(mc.nodes)
+        kb *= n
+        kb += idx if idx is not None else mc.rows
+        win_pos = int(kb.argmax())
+        kb[win_pos] = -1
+        runner_pos = int(kb.argmax())
+        if idx is not None:
+            return (
+                int(idx[win_pos]), int(idx[runner_pos]),
+                float(vals[win_pos]), float(vals[runner_pos]),
+            )
+        return (
+            win_pos, runner_pos,
+            float(vals[win_pos]), float(vals[runner_pos]),
+        )

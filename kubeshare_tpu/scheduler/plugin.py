@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import bisect
 import time as _time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple, Union
 
@@ -35,7 +36,8 @@ from ..utils.trace import Histogram, Tracer, maybe_span
 from . import constants as C
 from .filtering import node_fits
 from .labels import (
-    LabelError, PodKind, PodRequirements, parse_pod, parse_tenant,
+    LabelError, PodKind, PodRequirements, cached_req, parse_pod,
+    parse_tenant,
 )
 from .podgroup import PodGroupRegistry
 from .scoring import (
@@ -55,7 +57,7 @@ class Unschedulable(Exception):
         self.retryable = retryable
 
 
-@dataclass
+@dataclass(slots=True)
 class Decision:
     status: str            # "bound" | "waiting" | "unschedulable"
     pod_key: str
@@ -67,7 +69,7 @@ class Decision:
     retryable: bool = True
 
 
-@dataclass
+@dataclass(slots=True)
 class ReservationPlan:
     """Output of :meth:`TpuShareScheduler.plan_reservation` — the
     read-only half of ``reserve``: the chosen leaves, resolved memory
@@ -87,7 +89,7 @@ class ReservationPlan:
     env: Dict[str, str]
 
 
-@dataclass
+@dataclass(slots=True)
 class _Waiting:
     pod_key: str
     node: str
@@ -120,6 +122,7 @@ class TpuShareScheduler:
         migration_cost=None,
         compaction: bool = False,
         compaction_interval: float = 60.0,
+        vector: bool = True,
     ):
         # function-scope import: quota depends on scheduler.labels /
         # scheduler.constants, so a module-level import here would be
@@ -185,6 +188,11 @@ class TpuShareScheduler:
         # port check is one (usually falsy) set probe instead of a
         # dict get + method call per SHARED candidate
         self._full_port_nodes: Set[str] = set()
+        # NotReady nodes still holding bound leaves: in the column
+        # store's membership but out of the node index — while any
+        # exist, the rejection-count shortcut's set arithmetic is
+        # invalid and the exact walk classifies instead
+        self._unhealthy_bound: Set[str] = set()
         self._waiting: Dict[str, Dict[str, _Waiting]] = {}  # group_key -> pods
         self._synced_nodes: Set[str] = set()
         self._bound_queue: Dict[str, List[Pod]] = {}  # node -> pods to resync
@@ -218,6 +226,22 @@ class TpuShareScheduler:
         self.score_cache_misses = 0
         self.score_cache_evictions = 0
         self.tree.on_delta = self._on_tree_delta
+        # Structure-of-arrays wave columns (scheduler/columns.py): the
+        # vectorized Filter/Score fast path. Rows ride the same
+        # on_delta deltas as the score memo; structural events arrive
+        # through the tree's on_structural hook. None with vector=False
+        # — the engine is then decision-for-decision the scalar walk
+        # (the A/B arm of ENGINE_BENCH's vector column and the
+        # differential suite's oracle engine).
+        self.vector = vector
+        self._columns = None
+        if vector:
+            from .columns import ColumnStore
+
+            self._columns = ColumnStore(self.tree, self._full_port_nodes)
+            self.tree.on_structural = self._on_tree_structural
+        self.vector_attempts = 0   # attempts the columnar path served
+        self.vector_fallbacks = 0  # columns on, but walked scalar
 
         # every _release (delete, unreserve on Permit-deny or bind
         # conflict, gang-barrier expiry) returns capacity to the
@@ -370,6 +394,11 @@ class TpuShareScheduler:
             "migrate": 0.0,
         }
         self.cost_attempts = 0  # attempts attributed (journal-independent)
+        # raw per-attempt wall samples (seconds), bounded ring: the
+        # bench computes EXACT percentiles from these instead of
+        # quantizing to span-histogram bucket edges (which hid sub-2x
+        # regressions behind p50=300us/p99=1000us plateaus)
+        self.attempt_seconds = deque(maxlen=1 << 16)
         # Per-(tenant, kind, outcome) attempt cost: [seconds, attempts]
         # — "which tenants and shapes consume scheduler CPU" as a
         # queryable family. Bounded: past the cap new classes collapse
@@ -455,6 +484,7 @@ class TpuShareScheduler:
         self.demand = DemandLedger(on_transition=self.explain.note_reason)
         self.ports = {}
         self._full_port_nodes = set()
+        self._unhealthy_bound = set()
         self._waiting = {}
         self._synced_nodes = set()
         self._bound_queue = {}
@@ -464,6 +494,13 @@ class TpuShareScheduler:
         self._score_cache = {}
         self._score_node_shapes = {}
         self.tree.on_delta = self._on_tree_delta
+        if self._columns is not None:
+            from .columns import ColumnStore
+
+            # fresh store on the fresh tree AND the fresh port set
+            # (the old store holds references to both)
+            self._columns = ColumnStore(tree, self._full_port_nodes)
+            tree.on_structural = self._on_tree_structural
         self._backfill_hold = {}
         self._defrag_last = {}
         self._defrag_inflight = set()
@@ -510,6 +547,9 @@ class TpuShareScheduler:
         O(all cached shapes). Replaces the old generation-compare
         (reserve no longer bumps generations) and the fingerprint-
         wholesale clears; counted so churn is observable."""
+        cols = self._columns
+        if cols is not None:
+            cols._dirty.add(node)
         shapes = self._score_node_shapes.pop(node, None)
         if not shapes:
             return
@@ -521,6 +561,13 @@ class TpuShareScheduler:
                 evicted += 1
         if evicted:
             self.score_cache_evictions += evicted
+
+    def _on_tree_structural(self, node: str) -> None:
+        """Cell-tree ``on_structural`` subscriber (bind/unbind/HBM
+        correction/health flip): the node's model MEMBERSHIP may have
+        moved, which the column store's positional row arrays must
+        re-derive (an accounting delta only dirties row VALUES)."""
+        self._columns._struct_dirty.add(node)
 
     def _index_add(self, name: str) -> None:
         if name not in self._node_index_set:
@@ -552,10 +599,23 @@ class TpuShareScheduler:
                 self.tree.bind_node(node.name, [])
                 self._synced_nodes.discard(node.name)
                 self._bound_queue.pop(node.name, None)
+                # the port pool leaves with the node: a full pool's
+                # membership in _full_port_nodes would otherwise ghost
+                # through every later rejection count and port mask
+                self.ports.pop(node.name, None)
+                self._full_port_nodes.discard(node.name)
+                self._unhealthy_bound.discard(node.name)
             else:
                 self.tree.set_node_health(node.name, False)
+                # NotReady keeps its bound leaves (and so its column
+                # row) while leaving the node index — tracked so the
+                # O(reasons) rejection-count shortcut knows its
+                # index-vs-membership arithmetic is off and takes the
+                # exact walk instead
+                self._unhealthy_bound.add(node.name)
             return
         self._index_add(node.name)
+        self._unhealthy_bound.discard(node.name)
         try:
             chips = self.inventory(node.name)
         except (OSError, ValueError) as e:
@@ -761,6 +821,8 @@ class TpuShareScheduler:
                 self._note_half_gang(status.group_key)
 
     def _reconcile_half_gangs(self, now: float) -> None:
+        if not self._half_gangs:
+            return
         evict = getattr(self.cluster, "evict", None)
         post = getattr(self.cluster, "post_event", None)
         for group_key in list(self._half_gangs):
@@ -913,24 +975,36 @@ class TpuShareScheduler:
         pods sort last (PreFilter will reject them with a real
         message)."""
         try:
-            group = self.groups.get_or_create(pod)
-            tenant = parse_tenant(pod)
+            req = cached_req(pod)
         except LabelError:
             return (101, 0.0, 0.0, pod.key)
-        ts = group.timestamp if group.key else self.groups.pod_timestamp(pod.key, self.clock)
+        gang = req.gang
+        if gang is None or gang.min_available <= 0:
+            # solo fast path: no group registration, no re-parse — the
+            # cached requirements carry priority and tenant, and the
+            # stable tiebreak timestamp lives in the solo-timestamp map
+            return (
+                -req.priority,
+                self.quota.share_key(req.tenant),
+                self.groups.pod_timestamp(pod.key, self.clock),
+                pod.key,
+            )
+        group = self.groups.get_or_create(pod, gang)
         return (
             -group.priority,
-            self.quota.share_key(tenant),
-            ts,
-            group.key or pod.key,
+            self.quota.share_key(req.tenant),
+            group.timestamp,
+            group.key,
         )
 
     def pre_filter(self, pod: Pod) -> PodRequirements:
         """Label validation + gang sanity. Raises Unschedulable."""
         try:
-            req = parse_pod(pod)
+            req = cached_req(pod)
         except LabelError as e:
             raise Unschedulable(str(e), retryable=False) from e
+        if req.gang is None:
+            return req  # solo: no group to reconcile against
         group = self.groups.get_or_create(pod, req.gang)
         if group.key:
             if req.gang and req.gang.min_available != group.min_available:
@@ -1000,8 +1074,12 @@ class TpuShareScheduler:
         version, so a stale plan is rejected at the commit point
         instead of being applied. Raises the same Unschedulable
         ``reserve`` raised when nothing fits at reserve time."""
-        group = self.groups.get_or_create(pod, req.gang)
-        anchors = self.status.group_placed_leaves(group.key)
+        if req.gang is not None:
+            group_key = self.groups.get_or_create(pod, req.gang).key
+            anchors = self.status.group_placed_leaves(group_key)
+        else:
+            group_key = ""
+            anchors = ()
         leaves = select_leaves(self.tree, node_name, req, anchors,
                                self._held_leaves(pod, req, node_name))
         if not leaves:
@@ -1018,7 +1096,7 @@ class TpuShareScheduler:
             annotations[C.ANNOTATION_TPU_MEMORY] = str(total_memory)
             env[C.ENV_VISIBLE_CHIPS] = ",".join(l.uuid for l in leaves)
             return ReservationPlan(
-                node=node_name, group_key=group.key, leaves=leaves,
+                node=node_name, group_key=group_key, leaves=leaves,
                 memory=total_memory, charged_chips=float(len(leaves)),
                 needs_port=False, annotations=annotations, env=env,
             )
@@ -1033,7 +1111,7 @@ class TpuShareScheduler:
         env[C.ENV_HBM_LIMIT] = str(memory)
         env[C.ENV_LIBRARY_PATH] = C.LIBRARY_PATH
         return ReservationPlan(
-            node=node_name, group_key=group.key, leaves=leaves,
+            node=node_name, group_key=group_key, leaves=leaves,
             memory=memory, charged_chips=req.request,
             needs_port=True, annotations=annotations, env=env,
         )
@@ -1065,8 +1143,11 @@ class TpuShareScheduler:
         annotations = plan.annotations
         env = plan.env
         if req.kind == PodKind.MULTI_CHIP:
-            for leaf in leaves:
-                self.tree.reserve(leaf, 1.0, leaf.full_memory)
+            # one delta notification for the whole gang of leaves —
+            # they are all on plan.node (the flattened reserve lane)
+            self.tree.reserve_batch(
+                [(leaf, 1.0, leaf.full_memory) for leaf in leaves]
+            )
             status.memory = plan.memory
         else:
             leaf = leaves[0]
@@ -1361,6 +1442,7 @@ class TpuShareScheduler:
         now = _time.perf_counter()
         self.cost_seconds["journal"] += now - self._cost_tail
         self.cost_attempts += 1
+        self.attempt_seconds.append(now - t0)
         req = self._last_attempt_req
         if req is not None:
             key = (req.tenant, req.kind.value, outcome)
@@ -1484,7 +1566,16 @@ class TpuShareScheduler:
         failed_shapes: Dict[tuple, List[Tuple[float, int]]] = {}
         releases_at_start = self.capacity_releases
         try:
-            order = sorted(pods, key=self.queue_sort_key)
+            if len(pods) > 1:
+                order = sorted(pods, key=self.queue_sort_key)
+            else:
+                # a 1-pod wave needs no sort, but the key is still
+                # computed: it MINTS the solo FIFO timestamp as a side
+                # effect, and skipping it would stamp a lone pending
+                # pod at its first multi-pod wave instead — tying with
+                # (and possibly sorting behind) later arrivals
+                self.queue_sort_key(pods[0])
+                order = pods
             t2 = perf()
             phase["sort"] += t2 - t1
             for pod in order:
@@ -1500,7 +1591,7 @@ class TpuShareScheduler:
                     # attempt, and only behind the head's hold set;
                     # everyone else waits without paying a filter scan
                     try:
-                        req0 = parse_pod(pod)
+                        req0 = cached_req(pod)
                     except LabelError:
                         # malformed: attempt anyway so the permanent
                         # reject still happens
@@ -1780,7 +1871,14 @@ class TpuShareScheduler:
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
         self._last_attempt_req = req
-        group = self.groups.get_or_create(pod, req.gang)
+        # solo pods (the overwhelming majority on every profile) skip
+        # the group registry: nothing below reads more than key="" and
+        # an empty anchor list from the throwaway record it minted
+        group = (
+            self.groups.get_or_create(pod, req.gang)
+            if req.gang is not None else None
+        )
+        group_key = group.key if group is not None else ""
         self._cost_boundary("quota")
 
         # Quota admission gate — BEFORE any filtering and before
@@ -1797,11 +1895,11 @@ class TpuShareScheduler:
         # straddle the quota boundary, binding early members only to
         # die at the barrier (ROADMAP "gang-granular admission").
         gang_pending = 1
-        if group.key:
+        if group_key:
             gang_pending = max(
                 1,
                 group.min_available
-                - self.status.held_in_group(group.key),
+                - self.status.held_in_group(group_key),
             )
         admitted, why, quota_detail = self.quota.admit_detail(
             req, count=gang_pending, with_detail=rec is not None
@@ -1821,7 +1919,7 @@ class TpuShareScheduler:
         # gang anchors are needed twice: anchor NODES must be examined
         # first (sampling must never hide the node the rest of the gang
         # sits on), and the leaves weight locality scoring below
-        anchors = self.status.group_placed_leaves(group.key)
+        anchors = self.status.group_placed_leaves(group_key)
         # pinned rebind (migration plane): a pod holding a committed
         # move's destination skips the candidate scan and places onto
         # its pinned node — the move's commit point. A filter failure
@@ -1851,42 +1949,101 @@ class TpuShareScheduler:
                         pod.key, "destination broke at rebind"
                     )
                 self._cost_boundary("filter")
-        with maybe_span(self.tracer, "filter", pod=pod.key):
-            if pinned_dest is not None:
-                feasible = [pinned_dest]
-                rejections = RejectionAgg()
-                target = 1
-                scans = 1
+        # Columnar Filter + Score (scheduler/columns.py): when nothing
+        # couples this pod's verdicts to per-pod state — no gang
+        # anchors or seeding, no live hold/pin of any kind, no pinned
+        # rebind, inventory fully synced, and a resolvable model pool
+        # — one wave's Filter over ALL candidates is a handful of
+        # vectorized comparisons and Score a column argmax: a true
+        # GLOBAL best at O(columns), retiring the sampled candidate
+        # window for these attempts. Decision-identity with the scalar
+        # walk at full scan is pinned by the check_aggregates oracle
+        # below and tests/test_scheduler_vector.py.
+        vectorized = False
+        if (
+            self._columns is not None
+            and pinned_dest is None
+            and req.kind is not PodKind.REGULAR
+            and not anchors
+            and not self._unsynced
+            and not self._backfill_hold
+            and (req.is_guarantee or not self._defrag_holds)
+            and (self.migration is None or not self.migration.has_pins())
+            and not (req.is_guarantee and req.gang is not None
+                     and req.gang.headcount > 1)
+        ):
+            m0 = req.model or self.tree.single_model
+            # only DECLARED chip models build columns: the label is
+            # unvalidated tenant input, and keying a permanent
+            # per-model store (plus an O(cluster) build) on arbitrary
+            # strings would let typo'd/adversarial models grow
+            # engine state without bound — unknown models take the
+            # scalar walk, which rejects per node with no retained
+            # state
+            if m0 and m0 in self.tree.chip_priority:
+                vectorized = True
+                self.vector_attempts += 1
                 self.filter_attempts += 1
-            else:
-                # the incrementally-maintained sorted index replaces
-                # the per-cycle list_nodes()+sorted() scan — per-pod
-                # cost is O(examined candidates), not O(cluster)
-                names = self._node_index
-                if self._unsynced:
-                    # syncing inventory mid-scan can deliver a health
-                    # flip that edits the index; iterate a snapshot
-                    # until every known node has synced (steady
-                    # state: zero-copy)
-                    names = list(names)
-                n_names = len(names)
-                target = self._feasible_target(n_names)
-                anchor_nodes = {l.node for l in anchors if l.node}
-                start = self._filter_cursor % n_names if n_names else 0
-                self.filter_attempts += 1
-                feasible, rejections, scans, consumed = \
-                    self._filter_candidates(
-                        pod, req, names, n_names, start, target,
-                        anchor_nodes,
+                n_names = len(self._node_index)
+                self.filter_scans += n_names
+                n_feasible, best, runner, best_raw, runner_raw = (
+                    self._columns.query(req, m0, req.is_guarantee)
+                )
+                if self.tree.check_aggregates:
+                    self._vector_oracle(
+                        pod, req, m0, n_feasible, best, runner,
+                        best_raw, runner_raw,
                     )
-                self._filter_cursor = (start + consumed) % max(1, n_names)
-            self.filter_scans += scans
-        if rec is not None:
-            rec.filter_examined = scans
-            rec.filter_feasible = len(feasible)
-            rec.filter_target = target
-            if rejections:
-                rec.rejections = rejections
+                feasible = n_feasible  # count stands in for the list
+                rejections = (
+                    RejectionAgg() if n_feasible
+                    else self._vector_rejections(req, m0)
+                )
+                if rec is not None:
+                    rec.filter_examined = n_names
+                    rec.filter_feasible = n_feasible
+                    rec.filter_target = n_names
+                    if rejections:
+                        rec.rejections = rejections
+        if not vectorized:
+            if self._columns is not None:
+                self.vector_fallbacks += 1
+            with maybe_span(self.tracer, "filter", pod=pod.key):
+                if pinned_dest is not None:
+                    feasible = [pinned_dest]
+                    rejections = RejectionAgg()
+                    target = 1
+                    scans = 1
+                    self.filter_attempts += 1
+                else:
+                    # the incrementally-maintained sorted index replaces
+                    # the per-cycle list_nodes()+sorted() scan — per-pod
+                    # cost is O(examined candidates), not O(cluster)
+                    names = self._node_index
+                    if self._unsynced:
+                        # syncing inventory mid-scan can deliver a health
+                        # flip that edits the index; iterate a snapshot
+                        # until every known node has synced (steady
+                        # state: zero-copy)
+                        names = list(names)
+                    n_names = len(names)
+                    target = self._feasible_target(n_names)
+                    anchor_nodes = {l.node for l in anchors if l.node}
+                    start = self._filter_cursor % n_names if n_names else 0
+                    self.filter_attempts += 1
+                    feasible, rejections, scans, consumed = \
+                        self._filter_candidates(
+                            pod, req, names, n_names, start, target,
+                            anchor_nodes,
+                        )
+                    self._filter_cursor = (start + consumed) % max(1, n_names)
+                self.filter_scans += scans
+            if rec is not None:
+                rec.filter_examined = scans
+                rec.filter_feasible = len(feasible)
+                rec.filter_target = target
+                if rejections:
+                    rec.rejections = rejections
         if not feasible:
             evicted = self._maybe_defrag(pod, req)
             # demand-ledger classification: an eviction in flight, or
@@ -1918,6 +2075,20 @@ class TpuShareScheduler:
             )
         self._cost_boundary("score")
 
+        if vectorized:
+            # Score already collapsed into the columnar argmax (the
+            # filter lane's cost segment); only the journal fields of
+            # the winner remain for this phase
+            if rec is not None:
+                rec.score_candidates = n_feasible
+                rec.winner_node = best
+                rec.winner_score = best_raw
+                if runner is not None:
+                    rec.runner_node = runner
+                    rec.runner_score = runner_raw
+            self._cost_boundary("reserve_permit")
+            return self._finish_walk(pod, req, rec, group, group_key,
+                                     best)
         with maybe_span(self.tracer, "score", pod=pod.key):
             seed_frees = (
                 self._gang_seed_frees(req, feasible) if not anchors else None
@@ -2007,7 +2178,14 @@ class TpuShareScheduler:
                     rec.runner_node = runner
                     rec.runner_score = runner_raw
         self._cost_boundary("reserve_permit")
+        return self._finish_walk(pod, req, rec, group, group_key, best)
 
+    def _finish_walk(self, pod: Pod, req: PodRequirements,
+                     rec: Optional[AttemptRecord], group, group_key: str,
+                     best: str) -> Decision:
+        """Reserve -> Permit -> Bind on the chosen node — the tail the
+        vectorized and scalar walks share, already inside the
+        ``reserve_permit`` cost segment."""
         if req.kind == PodKind.REGULAR:
             try:
                 self._bind_regular(pod, best, req)
@@ -2029,8 +2207,8 @@ class TpuShareScheduler:
             action, extra = self.permit(pod, status)
         if rec is not None:
             rec.permit_action = action
-            if group.key:
-                rec.permit_group = group.key
+            if group_key:
+                rec.permit_group = group_key
                 rec.permit_min_available = group.min_available
             if action == "deny":
                 rec.permit_detail = extra
@@ -2173,6 +2351,8 @@ class TpuShareScheduler:
         check = tree.check_aggregates
         append = feasible.append
         unsynced = self._unsynced  # mutated in place by lazy syncs
+        agg_dirty = tree.agg_dirty  # lazy delta flush guard (raw reads)
+        flush_aggs = tree.flush_node_aggs
         rejected: List[str] = []
         probes = 0
         # Pinned model — the pod's, or a homogeneous cluster's only
@@ -2220,6 +2400,12 @@ class TpuShareScheduler:
             if full_ports and name in full_ports:
                 rejected.append(name)
                 continue
+            if agg_dirty and name in agg_dirty:
+                # deferred accounting deltas: refresh this node's
+                # cached aggregates before the raw _agg_cache read
+                # below (node_model_agg would do it, but the fast
+                # probes bypass it by design)
+                flush_aggs(name)
             if aggs0_get is not None:
                 probes += 1
                 agg = aggs0_get(name)
@@ -2338,6 +2524,126 @@ class TpuShareScheduler:
                 else:
                     rejections.add(fit_reason, name)
         return feasible, rejections, scans, consumed
+
+    def _vector_rejections(self, req: PodRequirements,
+                           m0: str) -> RejectionAgg:
+        """Rejection reasons for a vectorized attempt whose mask came
+        back empty — same per-cause classification and strings as the
+        scalar loop's nobody-fit reconstruction (port beats model
+        beats fit, per node), but derived from the column store's
+        membership in O(reasons + exemplars) instead of a per-node
+        walk: a saturated backlog files one of these per failed
+        attempt, and an O(cluster) Python reconstruction there would
+        hand back exactly the per-candidate cost the columns retired.
+        Exemplars are first-in-sorted-order (a scan-order walk's
+        would differ; counts and causes cannot)."""
+        rejections = RejectionAgg()
+        by_reason = rejections.by_reason
+        cap = RejectionAgg.MAX_EXEMPLARS
+        mc = self._columns._columns_for(m0)
+        row_of = mc.row_of
+        names = self._node_index
+        n = len(names)
+        ports = (
+            self._full_port_nodes if req.kind == PodKind.SHARED else None
+        )
+        if self._unhealthy_bound:
+            # a NotReady node still holds its bound leaves: it is in
+            # ``row_of`` but out of the index, so the set arithmetic
+            # below would mis-count — classify the (rare) window with
+            # the exact per-node walk the scalar loop uses
+            rmodel = req.model
+            model_reason = f"node has no {rmodel} chips" if rmodel else ""
+            fit_reason = (
+                f"node cannot fit request={req.request} mem={req.memory}"
+            )
+            models_on_node = self.tree.models_on_node
+            for name in names:
+                if ports and name in ports:
+                    rejections.add("pod-manager port pool full", name)
+                elif rmodel and rmodel not in models_on_node(name):
+                    rejections.add(model_reason, name)
+                else:
+                    rejections.add(fit_reason, name)
+            return rejections
+        n_port = missing_port = 0
+        if ports:
+            n_port = len(ports)
+            port_names = sorted(ports)
+            missing_port = sum(1 for p in port_names if p not in row_of)
+            by_reason["pod-manager port pool full"] = [
+                n_port, port_names[:cap],
+            ]
+        rmodel = req.model
+        n_missing = 0
+        if rmodel:
+            n_missing = n - len(row_of) - missing_port
+            if n_missing > 0:
+                exemplars: List[str] = []
+                for name in names:
+                    if name in row_of or (ports and name in ports):
+                        continue
+                    exemplars.append(name)
+                    if len(exemplars) >= cap or len(exemplars) >= n_missing:
+                        break
+                by_reason[f"node has no {rmodel} chips"] = [
+                    n_missing, exemplars,
+                ]
+        n_fit = n - n_port - n_missing
+        if not rmodel:
+            # model-less requests classify everything that is not
+            # port-full as a fit failure, membership or not (mirrors
+            # the scalar loop, which never asks models_on_node then)
+            n_fit = n - n_port
+        if n_fit > 0:
+            exemplars = []
+            for name in (mc.nodes if rmodel else names):
+                if ports and name in ports:
+                    continue
+                exemplars.append(name)
+                if len(exemplars) >= cap:
+                    break
+            by_reason[
+                f"node cannot fit request={req.request} mem={req.memory}"
+            ] = [n_fit, exemplars]
+        rejections.total = n
+        return rejections
+
+    def _vector_oracle(self, pod: Pod, req: PodRequirements, m0: str,
+                       count: int, best: Optional[str],
+                       runner: Optional[str], best_raw: float,
+                       runner_raw: float) -> None:
+        """Differential oracle for the columnar path (tests only, via
+        ``tree.check_aggregates``): the vectorized Filter mask must
+        equal the scalar ``_filter_candidates`` feasible set at full
+        scan, and the vectorized argmax must equal ``pick_top2_seq``
+        over the scalar scores — winner, runner-up, and raw scores."""
+        names = self._node_index
+        n = len(names)
+        mask_nodes = self._columns.feasible_names(req, m0)
+        feasible, _, _, _ = self._filter_candidates(
+            pod, req, names, n, 0, n, set()
+        )
+        assert sorted(feasible) == mask_nodes, (
+            f"vector mask diverged from scalar full-scan Filter for "
+            f"{pod.key}: mask={mask_nodes} scalar={sorted(feasible)}"
+        )
+        assert len(mask_nodes) == count
+        if not count:
+            return
+        values = [
+            self.score(pod, req, name, anchors=[], seed_frees=None)
+            for name in mask_nodes
+        ]
+        b2, r2, braw2, rraw2 = pick_top2_seq(mask_nodes, values)
+        assert best == b2 and best_raw == braw2, (
+            f"vector argmax diverged from pick_top2_seq for {pod.key}: "
+            f"vector=({best}, {best_raw}) scalar=({b2}, {braw2})"
+        )
+        assert runner == r2 and (runner is None or runner_raw == rraw2), (
+            f"vector runner-up diverged for {pod.key}: "
+            f"vector=({runner}, {runner_raw}) scalar=({r2}, {rraw2})"
+        )
 
     @staticmethod
     def _generic_reason(reason: str, node: str) -> str:
@@ -2715,18 +3021,23 @@ class TpuShareScheduler:
         # dict's only mutator): expiry is otherwise lazy per-node on
         # the filter path, and a hold on a node nothing filters against
         # would linger in the dict forever
-        for key in [
-            k for k, hold in self._defrag_holds.items() if hold[0] <= now
-        ]:
-            self._defrag_holds.pop(key, None)
+        if self._defrag_holds:
+            for key in [
+                k for k, hold in self._defrag_holds.items()
+                if hold[0] <= now
+            ]:
+                self._defrag_holds.pop(key, None)
         rejected: List[str] = []
-        for group_key, waiters in list(self._waiting.items()):
-            if not waiters:
-                self._waiting.pop(group_key, None)
-                continue
-            if any(w.deadline <= now for w in waiters.values()):
-                first = next(iter(waiters.values()))
-                rejected.extend(self.unreserve(first.pod_key, reject_group=True))
+        if self._waiting:
+            for group_key, waiters in list(self._waiting.items()):
+                if not waiters:
+                    self._waiting.pop(group_key, None)
+                    continue
+                if any(w.deadline <= now for w in waiters.values()):
+                    first = next(iter(waiters.values()))
+                    rejected.extend(
+                        self.unreserve(first.pod_key, reject_group=True)
+                    )
         # crash recovery: gangs stranded partially bound past their
         # grace are requeued whole (bound members evicted)
         self._reconcile_half_gangs(now)
@@ -2980,6 +3291,36 @@ class TpuShareScheduler:
                 "tpu_scheduler_index_builds_total", {},
                 self.tree.agg_builds,
             ),
+            # vectorized wave engine (PR-13): attempts served by the
+            # columnar Filter/Score path vs scalar fallbacks, and the
+            # column-maintenance economics (row refreshes ride deltas,
+            # rebuilds follow membership changes, ambiguous resolves
+            # are the rare multi-point-frontier scalar probes)
+            expfmt.Sample(
+                "tpu_scheduler_vector_attempts_total", {},
+                self.vector_attempts,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_vector_fallbacks_total", {},
+                self.vector_fallbacks,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_column_row_refreshes_total", {},
+                self._columns.row_refreshes if self._columns else 0,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_column_rebuilds_total", {},
+                self._columns.rebuilds if self._columns else 0,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_column_ambiguous_resolves_total", {},
+                self._columns.ambiguous_resolves if self._columns else 0,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_vector_numpy", {},
+                1 if (self._columns is not None
+                      and self._columns.use_numpy) else 0,
+            ),
             # wave scheduling: waves driven, pods offered per wave
             # (histogram), backfill activity, and the safety counter
             # that must stay 0
@@ -3140,10 +3481,21 @@ class TpuShareScheduler:
         mutation (the inline Filter loop's cheap port check; must
         always agree with ``ports.full()`` — the check_aggregates
         oracle asserts it does)."""
+        full_nodes = self._full_port_nodes
+        was_full = node_name in full_nodes
         if ports.full():
-            self._full_port_nodes.add(node_name)
+            full_nodes.add(node_name)
         else:
-            self._full_port_nodes.discard(node_name)
+            full_nodes.discard(node_name)
+        if self._columns is not None and (
+            was_full != (node_name in full_nodes)
+        ):
+            # port feasibility is a column (SHARED masks read it):
+            # most pool mutations ride a leaf delta on the same node,
+            # but not all — dirty the row when FULLNESS flips (the
+            # only port fact a column holds; same-state mutations
+            # leave the row untouched)
+            self._columns._dirty.add(node_name)
         # port feasibility is part of a SHARED proposal's read state:
         # fold every pool mutation into the node's read-validation
         # version so a transaction proposed against the old pool
@@ -3208,8 +3560,13 @@ class TpuShareScheduler:
         # charge), so even a reclaim that errors below cannot leave
         # the tenant's share inflated after the pod is gone
         self.quota.credit(status)
+        touched: Optional[Cell] = None
+        multi = req.kind == PodKind.MULTI_CHIP
+        reclaim = self.tree._reclaim_leaf
+        uuids = status.uuids
+        n_uuids = len(uuids)
         for i, leaf in enumerate(status.leaves):
-            expected_uuid = status.uuids[i] if i < len(status.uuids) else leaf.uuid
+            expected_uuid = uuids[i] if i < n_uuids else leaf.uuid
             if leaf.uuid != expected_uuid:
                 # the chip vanished (unbound) or was swapped since we
                 # reserved — its reservation left the tree with it
@@ -3219,15 +3576,20 @@ class TpuShareScheduler:
                 )
                 continue
             try:
-                if req.kind == PodKind.MULTI_CHIP:
-                    self.tree.reclaim(leaf, 1.0, leaf.full_memory)
+                # per-leaf mutation, ONE delta notification below (the
+                # flattened release lane — all leaves share the node)
+                if multi:
+                    reclaim(leaf, 1.0, leaf.full_memory)
                 else:
-                    self.tree.reclaim(leaf, req.request, status.memory)
+                    reclaim(leaf, req.request, status.memory)
+                touched = leaf
             except ValueError as e:
                 # inventory churn between reserve and release (e.g. chip
                 # rebound fresh): never let accounting noise crash the
                 # delete path
                 self.log.error("release %s: %s", status.key, e)
+        if touched is not None:
+            self.tree._apply_leaf_delta(touched)
         if status.port >= C.POD_MANAGER_PORT_START and status.node_name in self.ports:
             pool = self.ports[status.node_name]
             pool.clear(status.port - C.POD_MANAGER_PORT_START)

@@ -103,6 +103,8 @@ class PodGroupRegistry:
         Called from the scheduling tick AND the informer pod-delete
         path (plugin._on_pod_delete), so deleted-group entries cannot
         linger across quiet periods with no ticks."""
+        if not self._groups:
+            return 0  # gang-free workloads pay nothing here
         now = self._clock()
         expired = [
             key

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple, Union
 
-from ..cells.cell import Cell, CellTree, feq, fge
+from ..cells.cell import _EPS, Cell, CellTree, feq, fge
 from ..cells.topology import ici_distance, id_path_distance
 from .labels import PodKind, PodRequirements
 
@@ -302,8 +302,43 @@ def select_leaves(
     gang's chips land torus-adjacent, not just priority-sorted
     (divergence: the reference scores picks independently and can
     scatter a multi-chip pod across the fabric)."""
+    view = tree.leaves_view(node, req.model or None)
+    if not anchors and req.kind != PodKind.MULTI_CHIP:
+        # anchor-free fast path (every solo fractional pod): the
+        # ranked-then-first-fitting scan reduces to "the FITTING leaf
+        # with the highest score, earliest in tree order on ties" —
+        # sorted() is stable, so a strict > comparison reproduces the
+        # tie-break exactly without building or sorting the rank
+        # list. Health/exclude/fit checks fused into one pass with
+        # fge and _resolved_memory inlined (this runs on every bind).
+        best = None
+        best_score = 0.0
+        guarantee = req.is_guarantee
+        floor = req.request - _EPS  # fge(), constant-folded
+        mem = req.memory
+        for leaf in view:
+            if not leaf.healthy:
+                continue
+            if exclude and leaf.uuid in exclude:
+                continue
+            avail = leaf.available
+            if avail < floor:
+                continue
+            if leaf.free_memory < (
+                mem if mem > 0 else int(req.request * leaf.full_memory)
+            ):
+                continue
+            usage = (1.0 - avail) * 100.0
+            score = (
+                leaf.priority - usage if guarantee
+                else leaf.priority + usage
+            )
+            if best is None or score > best_score:
+                best = leaf
+                best_score = score
+        return [best] if best is not None else []
     leaves = [
-        l for l in tree.leaves_view(node, req.model or None)
+        l for l in view
         if l.healthy and (not exclude or l.uuid not in exclude)
     ]
     if req.kind == PodKind.MULTI_CHIP:
@@ -336,6 +371,13 @@ def _select_whole_leaves(
     candidates = [l for l in leaves if l.is_whole_free]
     if len(candidates) < count:
         return []
+    if not req.is_guarantee or (count == 1 and not anchors):
+        # pick-independent key (no locality term, or nothing to
+        # anchor to): the per-pick re-sort is the SAME stable order
+        # every round, so the picks are simply the first ``count`` of
+        # one sort — identical leaves, one sort instead of ``count``
+        candidates.sort(key=lambda l: -float(l.priority))
+        return candidates[:count]
     picked: List[Cell] = []
     pool = list(candidates)
     for _ in range(count):

@@ -23,7 +23,7 @@ class PodState(enum.Enum):
     BOUND = "bound"
 
 
-@dataclass
+@dataclass(slots=True)
 class PodStatus:
     key: str                       # namespace/name
     uid: str

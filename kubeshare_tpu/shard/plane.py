@@ -440,6 +440,12 @@ class ShardedScheduler:
             return self._commit_exit(
                 txn, t0, CommitResult(CONFLICT, conflicts=[CONFLICT_APPLY])
             )
+        # pay the deferred aggregate refresh HERE, on the arbiter
+        # thread: the apply marked the node agg-dirty (PR-13's lazy
+        # delta contract), and leaving the flush to the next reader
+        # would hand it to a racing proposal thread — see _prewarm's
+        # invariant
+        engine.tree.flush_node_aggs(plan.node)
         action, extra = engine.permit(pod, status)
         rec = txn.rec
         if rec is not None:
@@ -626,10 +632,17 @@ class ShardedScheduler:
         return parts
 
     def _prewarm(self) -> None:
-        """Build every (node, model) aggregate on the arbiter thread
-        before proposals start: proposal threads then only READ the
-        aggregate cache (in-place refreshes stay arbiter-side), so a
-        torn cold build can never be cached by a racing reader."""
+        """Build every (node, model) aggregate — and flush any
+        deferred agg-dirty refreshes (node_model_agg pays both) — on
+        the arbiter thread before proposals start, so a torn cold
+        build or torn refresh can never be cached by a racing reader.
+        Mid-round, the invariant is kept two ways: COMMITS flush their
+        node's dirty mark inside the arbiter critical section (see
+        _commit), and RELEASES — the only other accounting path that
+        marks nodes dirty — bump ``capacity_releases``, which is in
+        every transaction's read-set: a proposal that read a
+        refresh-in-progress aggregate after a release can only
+        CONFLICT at commit, never land."""
         tree = self.engine.tree
         for node in self.engine._node_index:
             for model in tree.models_on_node(node):

@@ -23,6 +23,7 @@ from ..cluster.api import Pod
 from ..cluster.fake import FakeCluster
 from ..cluster.faultinject import ApiFault, FaultInjector, SimCrash
 from ..scheduler import constants as C
+from ..scheduler.labels import cached_req
 from ..scheduler.plugin import TpuShareScheduler
 from .trace import TraceEvent
 
@@ -230,7 +231,7 @@ class SimReport:
         }
 
 
-@dataclass
+@dataclass(slots=True)
 class _Job:
     pod: Pod
     event: TraceEvent
@@ -282,6 +283,7 @@ class Simulator:
         api_conflict_rate: float = 0.0,
         journal_spool=None,
         obs_plane=None,
+        vector: bool = True,
     ):
         import random
 
@@ -319,6 +321,7 @@ class Simulator:
             migrate=migrate, compaction=compaction,
             migration_cost=migration_cost,
             compaction_interval=compaction_interval,
+            vector=vector,
         )
         # parse the topology ONCE: a rebuild must see the exact config
         # the crashed engine ran, not whatever the path resolves to at
@@ -742,42 +745,45 @@ class Simulator:
         # beneficiary (plugin defrag hold) — waiting minutes for an
         # unrelated completion would mismodel that
         retry_at: Optional[float] = None
+        inf = float("inf")
         while (i < len(arrivals) or pending or finishes
                or fi < len(fault_queue) or controller is not None):
             # next event time: arrival, finish, fault, or prompt retry
-            candidates = []
-            if i < len(arrivals):
-                candidates.append(arrivals[i].start)
-            if finishes:
-                candidates.append(finishes[0][0])
-            if fi < len(fault_queue):
-                candidates.append(fault_queue[fi].time)
+            # (explicit min tracking — this runs per virtual tick and
+            # the old per-iteration candidate-list build was a visible
+            # slice of ENGINE_BENCH's non-engine wall)
+            next_t = arrivals[i].start if i < len(arrivals) else inf
+            if finishes and finishes[0][0] < next_t:
+                next_t = finishes[0][0]
+            if fi < len(fault_queue) and fault_queue[fi].time < next_t:
+                next_t = fault_queue[fi].time
             if retry_at is not None:
-                candidates.append(retry_at)
+                if retry_at < next_t:
+                    next_t = retry_at
                 retry_at = None
-            # a migration clone becomes schedulable when its modeled
-            # checkpoint finishes: wake the loop for it
-            future_ready = [
-                j.ready_at for j in pending if j.ready_at > self.clock_now
-            ]
-            if future_ready:
-                candidates.append(min(future_ready))
-            if controller is not None:
+            if self.engine.migration is not None and pending:
+                # a migration clone becomes schedulable when its
+                # modeled checkpoint finishes: wake the loop for it
+                # (ready_at is only ever set by the migration plane)
+                for j in pending:
+                    if self.clock_now < j.ready_at < next_t:
+                        next_t = j.ready_at
+            if controller is not None and next_ctrl < next_t:
                 # planner ticks run to the horizon even when the trace
                 # has drained: scale-DOWN evidence (idle nodes draining
                 # after load subsides) only exists on those idle ticks
-                candidates.append(next_ctrl)
-            if self.tick_interval > 0 and (
-                pending or finishes or i < len(arrivals)
-            ):
+                next_t = next_ctrl
+            if (self.tick_interval > 0 and next_tick < next_t
+                    and (pending or finishes or i < len(arrivals))):
                 # periodic tick while work remains: quiet stretches
                 # (everything running, nothing arriving) still get
                 # scheduler ticks, which is when the compaction
                 # sweeps do their job
-                candidates.append(next_tick)
-            if not candidates:
+                next_t = next_tick
+            if next_t == inf:
                 break
-            next_t = max(self.clock_now, min(candidates))
+            if next_t < self.clock_now:
+                next_t = self.clock_now
             if next_t > end:
                 break  # horizon reached: stop before processing past it
             self._advance_capacity_to(next_t)
@@ -863,11 +869,10 @@ class Simulator:
                 report.wait_times.append(wait)
                 # the engine's own rule decides the class — an inline
                 # reimplementation would silently diverge from what
-                # was actually scheduled
-                from ..scheduler.labels import parse_priority
-
+                # was actually scheduled (cached_req IS the engine's
+                # parse, memoized on the pod)
                 (report.guarantee_waits
-                 if parse_priority(job.pod) > 0
+                 if cached_req(job.pod).is_guarantee
                  else report.opportunistic_waits).append(wait)
                 report.tenant_waits.setdefault(
                     job.pod.namespace, []

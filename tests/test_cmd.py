@@ -253,10 +253,14 @@ class TestSchedulerCli:
         assert "pass" in spans and "reserve" in spans
 
         # metrics render includes phase histograms + node utilization
+        # (vector=False pins the scalar walk, which opens the
+        # per-phase tracer spans; the columnar path's phase story is
+        # the cost-attribution counters — see tests/test_trace.py)
         cluster = SnapshotCluster(str(state))
         tracer = Tracer()
         engine = TpuShareScheduler(
-            _yaml.safe_load(TOPO_YAML), cluster, tracer=tracer
+            _yaml.safe_load(TOPO_YAML), cluster, tracer=tracer,
+            vector=False,
         )
         metrics = SchedulerMetrics(tracer=tracer, engine=engine)
         run_pass(engine, cluster, None, metrics)

@@ -44,6 +44,12 @@ COUNTERS = (
     "waves",
     "backfill_binds",
     "backfill_head_delays",
+    # PR-13: columnar Filter/Score path + column maintenance
+    "vector_attempts",
+    "vector_fallbacks",
+    "column_row_refreshes",
+    "column_rebuilds",
+    "column_ambiguous_resolves",
 )
 
 
@@ -65,36 +71,66 @@ class TestCommittedArtifact:
             for key in COUNTERS:
                 assert key in r["counters"], (r["nodes"], key)
         assert doc["scaling_ratio_1024_over_32"] > 0
-        for section in ("backlog", "gang", "journal_ab"):
+        for section in ("backlog", "gang", "journal_ab", "vector_ab"):
             assert section in doc, section
 
     def test_recorded_counters_prove_fast_path_engaged(self):
-        """The index must actually answer Filter: a silently-disabled
-        fast path (every query routed to the leaves_view walk) would
-        still produce plausible wall times on a small box, so the
-        counters are the artifact's proof of mechanism."""
+        """The columnar path must actually serve the idle rows: a
+        silently-disabled vector store (every attempt falling back to
+        the scalar walk) would still produce plausible wall times on
+        a small box, so the counters are the artifact's proof of
+        mechanism. PR-13 moved the idle rows' Filter/Score onto the
+        column store, so the OLD mechanism counters (aggregate probes,
+        score memo) are proven on the ``vector_ab`` OFF arm instead —
+        the scalar engine is still the fallback and the differential
+        oracle, and its machinery must not rot."""
         doc = _doc()
         for r in doc["results"]:
             c = r["counters"]
-            assert c["filter_fast_hits"] > 0, r["nodes"]
-            assert c["score_cache_hits"] > 0, r["nodes"]
+            assert c["vector_attempts"] > 0, r["nodes"]
+            # idle solo trace: nothing gates an attempt off the
+            # columnar path (no gangs, holds, pins, or model
+            # ambiguity)
+            assert c["vector_fallbacks"] == 0, r["nodes"]
+            assert c["column_row_refreshes"] > 0, r["nodes"]
             # idle trace: no defrag holds, no backfill — the slow
-            # walk counter stays PINNED at zero (PR-5 satellite)
+            # walk counter stays PINNED at zero (PR-5 satellite,
+            # carried: ambiguous resolves go through the aggregate,
+            # never the leaf walk)
             assert c["filter_slow_walks"] == 0, r["nodes"]
+            # score-memo churn fix (PR-13 satellite): the vectorized
+            # Score path never touches the memo, so the
+            # evictions≈misses churn ENGINE_BENCH showed at 32 nodes
+            # is structurally gone on these rows
+            assert c["score_cache_misses"] == 0, r["nodes"]
+            assert c["score_cache_evictions"] == 0, r["nodes"]
+        off = doc["vector_ab"]["off"]["counters"]
+        assert off["vector_attempts"] == 0
+        assert off["filter_fast_hits"] > 0
+        assert off["score_cache_hits"] > 0
+        assert off["index_delta_updates"] > 0
+        on = doc["vector_ab"]["on"]["counters"]
+        assert on["vector_attempts"] > 0
+        assert on["vector_fallbacks"] == 0
 
     def test_delta_maintenance_replaced_rebuilds(self):
-        """PR-5 satellite: reserve/reclaim delta-refresh aggregates in
-        place, so generation-forced rebuilds on the idle trace are
-        (near) gone — <= 0.1 per bind, where the invalidate-then-
-        rebuild design measured ~2 per bind."""
+        """PR-5 satellite (carried through PR-13's lazy agg_dirty
+        deferral): accounting walks never force generation rebuilds —
+        <= 0.1 per bind, where the invalidate-then-rebuild design
+        measured ~2 per bind. Column rebuilds are membership events
+        only: a handful per run, never tracking binds."""
         doc = _doc()
         for r in doc["results"]:
             c = r["counters"]
-            assert c["index_delta_updates"] > 0, r["nodes"]
             assert c["index_rebuilds"] <= 0.1 * r["bound"], (
                 r["nodes"],
                 "generation rebuilds are tracking binds again — "
                 "delta maintenance is being bypassed",
+            )
+            assert c["column_rebuilds"] <= 0.1 * r["bound"], (
+                r["nodes"],
+                "column rebuilds are tracking binds — membership "
+                "derivation is being invalidated by accounting deltas",
             )
 
     def test_no_backfill_head_delays_any_mode(self):
@@ -165,7 +201,14 @@ class TestCommittedArtifact:
         doc = _doc()
         b = doc["backlog"]
         assert b["nodes"] == 1024
-        assert b["speedup_wave_over_sequential"] >= 1.5
+        # re-baselined for PR-13: the vectorized path serves the
+        # SEQUENTIAL loop's saturated nobody-fits attempts at
+        # O(columns) too (empty mask + O(reasons) rejection build),
+        # so the wave's remaining saturated-drain edge is batching +
+        # backfill earlier-starts, not per-attempt cost — measured
+        # 1.09x where the PR-5 scalar pair measured 1.85x. The floor
+        # asserts the wave never LOSES to the sequential loop.
+        assert b["speedup_wave_over_sequential"] >= 1.0
         assert b["wave"]["counters"]["backfill_binds"] > 0
         assert b["wave"]["bound"] == b["sequential"]["bound"], (
             "wave and sequential drains bound different pod counts — "
@@ -199,6 +242,25 @@ class TestCommittedArtifact:
         assert j["journal_overhead_pct"] <= 8.0
         assert len(j["journal_overhead_pct_per_rep"]) >= 3
 
+    def test_vector_ab_recorded(self):
+        """PR-13 tentpole A/B: the columnar Filter/Score + flattened
+        reserve lane vs the scalar walk, same trace, same box, median
+        of PAIRED per-rep ratios (the journal_ab drift-cancelling
+        protocol). Decision-identity between the arms is pinned by
+        tests/test_scheduler_vector.py; here the committed figure
+        must show the columns actually BUY speed — >= 1.1x paired
+        median (measured 1.31x; the ISSUE's 5x-at-1024 aspiration
+        is recorded in CHANGES.md as NOT reached — the per-attempt
+        floor is journal/quota/status bookkeeping, see ROADMAP's
+        native-hot-path direction)."""
+        doc = _doc()
+        v = doc["vector_ab"]
+        assert v["nodes"] == 1024
+        assert v["vector_speedup"] >= 1.1
+        assert len(v["vector_speedup_per_rep"]) >= 3
+        assert v["vector_on_placements_per_sec"] > \
+            v["vector_off_placements_per_sec"]
+
 
 class TestFreshRunFloor:
     def test_live_floor_32_nodes(self):
@@ -221,7 +283,10 @@ class TestFreshRunFloor:
             f"{r['placements_per_sec']:.0f} placements/s @ 512 nodes"
         )
         c = r["counters"]
-        assert c["filter_fast_hits"] > 0
-        assert c["score_cache_hits"] > 0
-        assert c["index_delta_updates"] > 0
+        # PR-13: the columnar path serves the whole idle run (no
+        # aggregate probes, no score memo); the scalar machinery's
+        # live proof moved to the vector_ab OFF arm
+        assert c["vector_attempts"] > 0
+        assert c["vector_fallbacks"] == 0
+        assert c["column_row_refreshes"] > 0
         assert c["filter_slow_walks"] == 0

@@ -306,8 +306,31 @@ class TestExpositionHygiene:
             ("tpu_scheduler_migration_compaction_moves_total", "gauge"),
             ("tpu_scheduler_migration_modeled_seconds_total", "gauge"),
             ("tpu_scheduler_gang_ici_spread_hops", "gauge"),
+            # PR-13: columnar Filter/Score path + column maintenance
+            ("tpu_scheduler_vector_attempts_total", "gauge"),
+            ("tpu_scheduler_vector_fallbacks_total", "gauge"),
+            ("tpu_scheduler_vector_numpy", "gauge"),
+            ("tpu_scheduler_column_row_refreshes_total", "gauge"),
+            ("tpu_scheduler_column_rebuilds_total", "gauge"),
+            ("tpu_scheduler_column_ambiguous_resolves_total", "gauge"),
         ]:
             assert kinds.get(fam) == kind, (fam, kinds.get(fam))
+
+    def test_vector_families_live(self, scraped):
+        """PR-13 end-to-end: the fixture's solo binds rode the
+        columnar path (attempts > 0, not just a declared-but-dead
+        family), column maintenance actually refreshed rows, and the
+        numpy flag is a clean boolean."""
+        parsed = expfmt.parse(scraped)
+        vals = {
+            s.name: s.value for s in parsed
+            if s.name.startswith(("tpu_scheduler_vector",
+                                  "tpu_scheduler_column"))
+        }
+        assert vals["tpu_scheduler_vector_attempts_total"] > 0
+        assert vals["tpu_scheduler_column_row_refreshes_total"] > 0
+        assert vals["tpu_scheduler_column_rebuilds_total"] > 0
+        assert vals["tpu_scheduler_vector_numpy"] in (0.0, 1.0)
 
     def test_alert_rules_all_exported(self, scraped):
         """Every standard rule exports an active gauge AND a fired

@@ -199,9 +199,12 @@ class TestAggregateDifferential:
         assert (tree.filter_fast_hits, tree.filter_slow_walks) == (1, 1)
 
     def test_delta_maintenance_contract(self):
-        """PR-5: accounting walks refresh the touched aggregate IN
-        PLACE (delta update, no rebuild debt); only structural events
-        (health flips, relist binds) evict for a lazy rebuild."""
+        """PR-5 refreshed the touched aggregate inline at every
+        reserve/reclaim; PR-13 defers it — the accounting walk marks
+        the node dirty (O(1)) and the NEXT read pays one refresh for
+        however many deltas landed in between. No rebuild debt either
+        way; only structural events (health flips, relist binds) evict
+        for a lazy rebuild."""
         tree = build_tree()
         agg = tree.node_model_agg("lite-1", "tpu-v5e")
         builds = tree.agg_builds
@@ -211,11 +214,17 @@ class TestAggregateDifferential:
         deltas = tree.agg_delta_updates
         assert agg.multi_chip_fits(4, 0)  # all four leaves whole-free
         tree.reserve(leaf, 0.5, GIB)
-        # refreshed in place: same object, already post-reserve, no
-        # rebuild happened and none is owed
-        assert tree.agg_delta_updates == deltas + 1
-        assert tree.agg_rebuilds == 0
+        # deferred: the walk marked the node dirty, nothing refreshed
+        assert "lite-1" in tree.agg_dirty
+        assert tree.agg_delta_updates == deltas
+        # a second delta on the same node coalesces into the same debt
+        tree.reserve(leaf, 0.25, 0)
+        assert tree.agg_delta_updates == deltas
+        # the read refreshes ONCE, in place: same object, post-reserve
         assert tree.node_model_agg("lite-1", "tpu-v5e") is agg
+        assert tree.agg_delta_updates == deltas + 1
+        assert "lite-1" not in tree.agg_dirty
+        assert tree.agg_rebuilds == 0
         assert not agg.multi_chip_fits(4, 0)  # saw the reserve
         # the untouched node's aggregate is a fresh cold build once
         before = tree.agg_builds
@@ -330,6 +339,9 @@ class TestInlineFilterOracle:
                 "cells": [{"cell_type": "v5e-node", "cell_id": "n00"}],
             },
             cluster, clock=lambda: 0.0,
+            # the scalar walk owns the memo: the vectorized path never
+            # populates anchorless shapes (its columns ARE the scores)
+            vector=False,
         )
         for i in range(1024):
             sched._score_cache[("fake", str(i), True, ())] = {}

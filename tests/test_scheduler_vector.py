@@ -1,0 +1,541 @@
+"""Differential property suite for the columnar SoA Filter/Score
+path (PR-13, scheduler/columns.py).
+
+Claims pinned here, in the oracle style of test_scheduler_wave.py /
+test_shard.py:
+
+1. **Mask ≡ scalar Filter.** ``ColumnStore.feasible_names`` equals
+   the exhaustive walk oracles (``shared_fit_walk`` /
+   ``multi_chip_fit_walk`` + the port-pool check) on every probe of a
+   grid straddling the fit boundaries, after EVERY mutation of a
+   randomized reserve/reclaim/health/rebind/port sequence — with
+   ``check_aggregates`` live, so the rare ambiguous-row resolves
+   through ``node_model_agg`` are themselves walk-asserted.
+2. **Argmax ≡ pick_top2_seq.** ``ColumnStore.query`` returns the
+   winner, runner-up, and raw scores ``pick_top2_seq`` produces over
+   ``score_node`` values — same normalization arithmetic, same
+   truncation, same name tie-break — including the uniform-score
+   shortcut and the vectorized ``_pick_numpy`` on hostile score
+   vectors (negatives, >100 spans, dense ties).
+3. **Engine decisions are identical.** A ``vector=True`` sim is
+   bind-for-bind identical (pod, node, virtual time) to the
+   ``vector=False`` scalar engine on underloaded, saturated, defrag
+   (live holds force scalar fallbacks mid-trace), and migration-pin
+   traces — and the vectorized path genuinely served attempts, it
+   didn't just fall back its way to agreement. The in-engine
+   ``_vector_oracle`` (tree.check_aggregates) doubles every
+   vectorized attempt against the full-scan scalar walk inside the
+   run itself.
+4. **The no-numpy fallback is the same engine.** The whole store
+   suite runs again with Python-list columns, and a fallback engine's
+   binds match the numpy engine's.
+
+Seeded, no JAX, tier-1 fast.
+"""
+
+import random
+
+import pytest
+
+from kubeshare_tpu.cells import CellTree, ChipInfo, load_topology
+from kubeshare_tpu.scheduler.columns import ColumnStore, _numpy
+from kubeshare_tpu.scheduler.filtering import (
+    multi_chip_fit_walk,
+    shared_fit_walk,
+)
+from kubeshare_tpu.scheduler.labels import PodKind, PodRequirements
+from kubeshare_tpu.scheduler.scoring import pick_top2_seq, score_node
+from kubeshare_tpu.sim.simulator import Simulator
+from kubeshare_tpu.sim.trace import (
+    TraceEvent, generate_backlog_trace, generate_trace,
+)
+
+GIB = 1 << 30
+
+HETERO = {
+    "cell_types": {
+        "v5e-node": {
+            "child_cell_type": "tpu-v5e",
+            "child_cell_number": 4,
+            "child_cell_priority": 50,
+            "is_node_level": True,
+        },
+        "v5p-node": {
+            "child_cell_type": "tpu-v5p",
+            "child_cell_number": 4,
+            "child_cell_priority": 100,
+            "is_node_level": True,
+        },
+    },
+    "cells": [
+        {"cell_type": "v5e-node", "cell_id": "lite-1"},
+        {"cell_type": "v5e-node", "cell_id": "lite-2"},
+        {"cell_type": "v5e-node", "cell_id": "lite-3"},
+        {"cell_type": "v5p-node", "cell_id": "perf-1"},
+    ],
+}
+
+NODES = {
+    "lite-1": "tpu-v5e", "lite-2": "tpu-v5e", "lite-3": "tpu-v5e",
+    "perf-1": "tpu-v5p",
+}
+MODELS = ("tpu-v5e", "tpu-v5p")
+
+# probe grid straddling the fit boundaries: fractions around typical
+# availabilities, memories around the 8/16 GiB chip sizes, chip
+# counts around the 4-per-node
+PROBES = [
+    PodRequirements(kind=PodKind.SHARED, request=r, memory=m,
+                    model=model, priority=p)
+    for r in (0.25, 0.5, 1.0)
+    for m in (0, 1 * GIB, 6 * GIB, 12 * GIB)
+    for model in MODELS
+    for p in (0, 100)
+] + [
+    PodRequirements(kind=PodKind.MULTI_CHIP, request=float(c), memory=m,
+                    model=model, priority=p)
+    for c in (1, 2, 4)
+    for m in (0, 1 * GIB, 20 * GIB)
+    for model in MODELS
+    for p in (0, 100)
+]
+
+
+def chips_for(node, model, n=4, mem=16 * GIB):
+    return [
+        ChipInfo(uuid=f"{node}-chip-{i}", model=model, memory=mem, index=i)
+        for i in range(n)
+    ]
+
+
+def build_store(use_numpy):
+    """Heterogeneous-HBM tree + a standalone ColumnStore wired to the
+    tree's hooks exactly as the engine wires it."""
+    tree = CellTree(load_topology(HETERO))
+    for node, model in NODES.items():
+        tree.bind_node(
+            node,
+            chips_for(node, model, mem=8 * GIB)[:2]
+            + chips_for(node, model)[2:],
+        )
+    tree.check_aggregates = True
+    full_ports = set()
+    store = ColumnStore(tree, full_ports)
+    store.use_numpy = use_numpy and _numpy is not None
+    tree.on_delta = store.note_delta
+    tree.on_structural = store.note_structural
+    return tree, store, full_ports
+
+
+def oracle_feasible(tree, full_ports, req):
+    """The exhaustive scalar Filter over every node, in sorted-name
+    (== row) order."""
+    names = []
+    for node in sorted(NODES):
+        if req.kind == PodKind.MULTI_CHIP:
+            if multi_chip_fit_walk(
+                tree, node, req.model, req.chip_count, req.memory
+            ):
+                names.append(node)
+        else:
+            if node in full_ports:
+                continue
+            if shared_fit_walk(
+                tree, node, req.model, req.request, req.memory
+            ):
+                names.append(node)
+    return names
+
+
+def assert_store_agrees(tree, store, full_ports):
+    for req in PROBES:
+        expected = oracle_feasible(tree, full_ports, req)
+        got = store.feasible_names(req, req.model)
+        assert got == expected, (req, got, expected)
+        count, best, runner, best_raw, runner_raw = store.query(
+            req, req.model, req.is_guarantee
+        )
+        assert count == len(expected)
+        if not expected:
+            assert best is None and runner is None
+            continue
+        values = [score_node(tree, n, req) for n in expected]
+        if len(expected) == 1:
+            assert (best, runner) == (expected[0], None)
+            assert best_raw == values[0] and runner_raw == 0.0
+            continue
+        b2, r2, braw2, rraw2 = pick_top2_seq(expected, values)
+        assert (best, best_raw) == (b2, braw2), (req, best, b2)
+        assert (runner, runner_raw) == (r2, rraw2), (req, runner, r2)
+
+
+@pytest.mark.parametrize("use_numpy", [True, False],
+                         ids=["numpy", "python-fallback"])
+class TestColumnStoreDifferential:
+    def test_fresh_tree_agrees(self, use_numpy):
+        tree, store, ports = build_store(use_numpy)
+        assert store.use_numpy == (use_numpy and _numpy is not None)
+        assert_store_agrees(tree, store, ports)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_randomized_mutation_sequence(self, seed, use_numpy):
+        """150 random reserve / reclaim / health-flip / rebind /
+        port-toggle ops; after each, every probe's mask and argmax
+        must match the walk+pick_top2_seq oracle. check_aggregates is
+        live throughout, so ambiguous-row resolves are themselves
+        asserted in-tree."""
+        rng = random.Random(seed)
+        tree, store, ports = build_store(use_numpy)
+        reservations = []
+        down = set()
+        for _ in range(150):
+            op = rng.random()
+            if op < 0.40:
+                node = rng.choice(list(NODES))
+                free = [
+                    l for l in tree.leaves_on_node(node)
+                    if l.healthy and l.available > 0
+                ]
+                if free:
+                    leaf = rng.choice(free)
+                    request = rng.choice([
+                        f for f in (0.25, 0.5, 0.75, 1.0)
+                        if f <= leaf.available + 1e-9
+                    ])
+                    memory = min(
+                        leaf.free_memory,
+                        rng.choice((1 * GIB, 4 * GIB, 8 * GIB)),
+                    )
+                    tree.reserve(leaf, request, memory)
+                    reservations.append((leaf, request, memory))
+            elif op < 0.62 and reservations:
+                leaf, request, memory = reservations.pop(
+                    rng.randrange(len(reservations))
+                )
+                tree.reclaim(leaf, request, memory)
+            elif op < 0.74:
+                node = rng.choice(list(NODES))
+                if node in down:
+                    tree.set_node_health(node, True)
+                    down.discard(node)
+                else:
+                    tree.set_node_health(node, False)
+                    down.add(node)
+            elif op < 0.86:
+                # rebind with an HBM correction: the structural path —
+                # column membership may move, rows must re-derive
+                node = rng.choice(list(NODES))
+                if node in down or any(
+                    l.node == node for l, _, _ in reservations
+                ):
+                    continue
+                batch = chips_for(node, NODES[node])
+                batch[0] = ChipInfo(
+                    uuid=batch[0].uuid,
+                    model=batch[0].model,
+                    memory=rng.choice((8 * GIB, 16 * GIB)),
+                    index=batch[0].index,
+                )
+                tree.bind_node(node, batch)
+            else:
+                # port-pool exhaustion toggles ride an explicit dirty
+                # mark, mirroring the engine's _note_port_full
+                node = rng.choice(list(NODES))
+                if node in ports:
+                    ports.discard(node)
+                else:
+                    ports.add(node)
+                store.note_delta(node)
+            assert_store_agrees(tree, store, ports)
+        # maintenance economics: deltas refreshed rows in place —
+        # whole-model rebuilds only follow membership changes, and a
+        # 4-node store can never have amassed hundreds of them
+        assert store.row_refreshes > 0
+        assert store.rebuilds < 100
+
+    def test_unbind_drops_rows(self, use_numpy):
+        """A node losing its bound set for a model must leave the
+        candidate mask, not linger as a stale row."""
+        tree, store, ports = build_store(use_numpy)
+        req = PodRequirements(kind=PodKind.SHARED, request=0.5,
+                              memory=GIB, model="tpu-v5e")
+        assert "lite-1" in store.feasible_names(req, "tpu-v5e")
+        tree.bind_node("lite-1", [])
+        assert_store_agrees(tree, store, ports)
+        assert "lite-1" not in store.feasible_names(req, "tpu-v5e")
+
+
+class TestPickNumpyProperty:
+    @pytest.mark.skipif(_numpy is None, reason="numpy unavailable")
+    @pytest.mark.parametrize("seed", range(6))
+    def test_pick_equals_pick_top2_seq(self, seed):
+        """_pick_numpy ≡ pick_top2_seq on hostile score vectors:
+        negatives (shift path), spans > 100 (rescale path), small
+        spans (truncation path), and dense ties (bucket collapse +
+        name tie-break)."""
+        rng = random.Random(seed)
+        for trial in range(40):
+            n = rng.randint(2, 30)
+            style = trial % 4
+            if style == 0:
+                vals = [rng.uniform(-500, 500) for _ in range(n)]
+            elif style == 1:
+                vals = [rng.uniform(0, 50) for _ in range(n)]
+            elif style == 2:
+                vals = [float(rng.randint(-3, 3)) for _ in range(n)]
+            else:
+                vals = [rng.choice((7.25, 7.75, 8.0)) for _ in range(n)]
+            names = [f"node-{i:03d}" for i in range(n)]
+            from kubeshare_tpu.scheduler.columns import ModelColumns
+
+            mc = ModelColumns("m", names, True)
+            arr = _numpy.asarray(vals, dtype=_numpy.float64)
+            idx = _numpy.arange(n)
+            lo = float(arr.min())
+            hi = float(arr.max())
+            if lo == hi:
+                continue  # the uniform shortcut bypasses _pick_numpy
+            bi, ri, braw, rraw = ColumnStore._pick_numpy(
+                mc, idx, arr, lo, hi
+            )
+            b2, r2, braw2, rraw2 = pick_top2_seq(names, vals)
+            assert (names[bi], braw) == (b2, braw2), (vals, names[bi], b2)
+            assert (names[ri], rraw) == (r2, rraw2), (vals, names[ri], r2)
+
+    def test_uniform_scores_pick_last_two_rows(self):
+        """The uniform-score shortcut (query, not _pick_numpy) must
+        still be pick_top2_seq: max name wins a full-grid tie."""
+        tree, store, ports = build_store(True)
+        req = PodRequirements(kind=PodKind.SHARED, request=0.25,
+                              memory=GIB, model="tpu-v5e")
+        count, best, runner, braw, rraw = store.query(
+            req, "tpu-v5e", False
+        )
+        assert count == 3
+        names = store.feasible_names(req, "tpu-v5e")
+        values = [score_node(tree, n, req) for n in names]
+        assert len(set(values)) == 1  # fresh identical nodes
+        b2, r2, braw2, rraw2 = pick_top2_seq(names, values)
+        assert (best, runner, braw, rraw) == (b2, r2, braw2, rraw2)
+
+
+def sim_topo(n):
+    return {
+        "cell_types": {
+            "v5e-node": {
+                "child_cell_type": "tpu-v5e",
+                "child_cell_number": 4,
+                "child_cell_priority": 50,
+                "is_node_level": True,
+                "torus": [2, 2],
+            },
+        },
+        "cells": [
+            {"cell_type": "v5e-node", "cell_id": f"n{i:03d}"}
+            for i in range(n)
+        ],
+    }
+
+
+def make_sim(n_nodes, vector, check=False, **kw):
+    sim = Simulator(
+        sim_topo(n_nodes), {f"n{i:03d}": 4 for i in range(n_nodes)},
+        seed=7, use_waves=True, vector=vector, **kw,
+    )
+    # the in-engine differential oracle re-runs the scalar full-scan
+    # Filter + Score for every vectorized attempt — expensive, so the
+    # saturated traces enable it only on the vector arm
+    sim.engine.tree.check_aggregates = check
+    return sim
+
+
+def record_binds(sim):
+    log = []
+    orig = sim.cluster.bind
+
+    def bind(key, node):
+        orig(key, node)
+        log.append((key, node, sim.clock_now))
+
+    sim.cluster.bind = bind
+    return log
+
+
+def run_pair(trace, n_nodes, check=True, **kw):
+    """vector=True vs vector=False on the same trace: the scalar
+    engine is the oracle the columnar one must not diverge from.
+    Node counts stay at/under the full-scan floor
+    (min_feasible_nodes) so the scalar arm scans every candidate —
+    above it the scalar walk SAMPLES and the global argmax is
+    legitimately better, not different."""
+    vec = make_sim(n_nodes, vector=True, check=check, **kw)
+    vec_binds = record_binds(vec)
+    vec_report = vec.run(list(trace))
+    scal = make_sim(n_nodes, vector=False, **kw)
+    scal_binds = record_binds(scal)
+    scal_report = scal.run(list(trace))
+    return vec, vec_binds, vec_report, scal_binds, scal_report
+
+
+class TestEngineVectorDifferential:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_underloaded_identical(self, seed):
+        trace = generate_trace(count=120, seed=seed,
+                               mean_interarrival=4.0)
+        vec, vb, vr, sb, sr = run_pair(trace, 8)
+        assert vb == sb
+        assert vr.bound == sr.bound
+        assert vec.engine.vector_attempts > 0
+
+    def test_saturated_identical(self):
+        """Backlog at ~112% capacity: nobody-fits verdicts, retry
+        waves, and head-of-line holds (which force scalar fallbacks
+        mid-trace) all agree."""
+        trace = generate_backlog_trace(count=48)
+        vec, vb, vr, sb, sr = run_pair(trace, 16, check=False)
+        assert vb == sb
+        assert (vr.bound, vr.unschedulable) == (sr.bound, sr.unschedulable)
+        assert vec.engine.vector_attempts > 0
+
+    def test_defrag_holds_identical(self):
+        """Defrag on a saturated trace: live holds route attempts to
+        the scalar path (counted as fallbacks) and the engines still
+        agree bind-for-bind — the gate is conservative, never wrong."""
+        trace = generate_backlog_trace(count=48)
+        vec, vb, vr, sb, sr = run_pair(trace, 16, check=False,
+                                       defrag=True)
+        assert vb == sb
+        assert vr.defrag_evicted == sr.defrag_evicted
+        assert vec.engine.vector_attempts > 0
+
+    def test_quota_tenants_identical(self):
+        """Quota gate engaged (guarantees + borrow ceilings, two
+        tenants straddling their entitlements): admission verdicts
+        and placements agree."""
+        tenants = {
+            "anna": {"weight": 2.0, "guaranteed": 0.5},
+            "bob": {"weight": 1.0, "borrow_limit": 0.25},
+        }
+        rng = random.Random(5)
+        events = []
+        t = 0.0
+        for i in range(80):
+            t += rng.expovariate(0.8)
+            events.append(TraceEvent(
+                round(t, 3), round(rng.uniform(0.2, 0.9), 2),
+                150.0, 50 if i % 2 else 0, 1,
+                "anna" if i % 3 else "bob",
+            ))
+        vec, vb, vr, sb, sr = run_pair(events, 6, tenants=tenants)
+        assert vb == sb
+        assert vr.to_dict() == sr.to_dict()
+        assert vec.engine.vector_attempts > 0
+
+    def test_migration_pins_identical(self):
+        """With the migration plane live, a committed move's pin
+        gates every attempt off the vector path while it exists —
+        and the engines still make identical decisions."""
+        trace = generate_trace(count=100, seed=5,
+                               fractional_ratio=0.8)
+        vec, vb, vr, sb, sr = run_pair(
+            trace, 8, defrag=True, migrate=True,
+        )
+        assert vb == sb
+        assert vr.bound == sr.bound
+
+
+class TestRejectionCountsUnderNotReady:
+    def test_notready_node_takes_exact_walk(self):
+        """A NotReady node keeps its bound leaves (and so its column
+        row) while leaving the node index — the O(reasons) rejection
+        shortcut's set arithmetic is invalid in that window, so the
+        empty-mask message must come from the exact walk: counts sum
+        to the scanned index, never negative, no ghost nodes."""
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.scheduler import constants as C
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        cluster = FakeCluster()
+        for name in ("n000", "n001"):
+            cluster.add_node(name, [
+                ChipInfo(f"{name}-c{j}", "tpu-v5e", 16 * GIB, j)
+                for j in range(4)
+            ])
+        eng = TpuShareScheduler(sim_topo(2), cluster,
+                                clock=lambda: 0.0)
+
+        def pod(name, request):
+            return cluster.create_pod(Pod(
+                name=name, namespace="t",
+                labels={
+                    C.LABEL_TPU_REQUEST: str(request),
+                    C.LABEL_TPU_LIMIT_ALIASES[1]: str(
+                        max(float(request), 1.0)
+                    ),
+                },
+                scheduler_name=C.SCHEDULER_NAME,
+            ))
+
+        # fill n01 so a 4-chip pod fits nowhere, then NotReady n00
+        assert eng.schedule_one(pod("filler", 2)).status == "bound"
+        cluster.set_node_ready("n000", False)
+        assert eng._unhealthy_bound == {"n000"}
+        assert "n000" not in eng._node_index
+        d = eng.schedule_one(pod("big", 4))
+        assert d.status == "unschedulable"
+        req = PodRequirements(kind=PodKind.MULTI_CHIP, request=4.0,
+                              model="tpu-v5e")
+        rej = eng._vector_rejections(req, "tpu-v5e")
+        total = sum(count for count, _ in rej.by_reason.values())
+        assert total == len(eng._node_index) == 1
+        assert all(count > 0 for count, _ in rej.by_reason.values())
+        for _, exemplars in rej.by_reason.values():
+            assert "n000" not in exemplars
+        # recovery: back to ready, the fast-count path resumes
+        cluster.set_node_ready("n000", True)
+        assert eng._unhealthy_bound == set()
+
+    def test_unknown_model_never_mints_columns(self):
+        """The model label is unvalidated tenant input: a bogus value
+        must take the scalar walk (counted as a fallback), never key
+        a permanent per-model column store + O(cluster) build."""
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.scheduler import constants as C
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        cluster = FakeCluster()
+        cluster.add_node("n000", [
+            ChipInfo(f"n000-c{j}", "tpu-v5e", 16 * GIB, j)
+            for j in range(4)
+        ])
+        eng = TpuShareScheduler(sim_topo(1), cluster,
+                                clock=lambda: 0.0)
+        d = eng.schedule_one(cluster.create_pod(Pod(
+            name="bogus", namespace="t",
+            labels={
+                C.LABEL_TPU_REQUEST: "0.5",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+                C.LABEL_TPU_MODEL: "tpu-vTYPO",
+            },
+            scheduler_name=C.SCHEDULER_NAME,
+        )))
+        assert d.status == "unschedulable"
+        assert eng.vector_fallbacks == 1 and eng.vector_attempts == 0
+        assert "tpu-vTYPO" not in eng._columns._models
+
+
+class TestNoNumpyEngineFallback:
+    def test_fallback_binds_match_numpy(self, monkeypatch):
+        """KUBESHARE_NO_NUMPY: same columns in Python lists, same
+        decisions — and genuinely not numpy-backed."""
+        trace = generate_trace(count=120, seed=1)
+        vec, vb, vr, sb, sr = run_pair(trace, 8)
+        monkeypatch.setenv("KUBESHARE_NO_NUMPY", "1")
+        fb = make_sim(8, vector=True, check=True)
+        fb_binds = record_binds(fb)
+        fb.run(list(trace))
+        assert fb.engine._columns.use_numpy is False
+        assert fb.engine.vector_attempts > 0
+        assert fb_binds == vb == sb

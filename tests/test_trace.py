@@ -294,23 +294,39 @@ class TestTracer:
 
 
 class TestSchedulerIntegration:
-    def _env(self, tracer):
+    def _env(self, tracer, **kwargs):
         cluster = FakeCluster()
         cluster.add_node(
             "node-a",
             [ChipInfo(f"node-a-chip-{i}", "tpu-v5e", 16 * GIB, i)
              for i in range(4)],
         )
-        sched = TpuShareScheduler(TOPO, cluster, tracer=tracer)
+        sched = TpuShareScheduler(TOPO, cluster, tracer=tracer, **kwargs)
         return cluster, sched
 
     def test_phases_traced(self):
+        # the scalar walk opens a span per phase (vector=False pins
+        # that path; the columnar walk's phase story is the cost-
+        # attribution counters, asserted below)
         tracer = Tracer()
-        cluster, sched = self._env(tracer)
+        cluster, sched = self._env(tracer, vector=False)
         d = sched.schedule_one(cluster.create_pod(tpu_pod("p1")))
         assert d.status == "bound"
         names = {e.name for e in tracer.events()}
         assert {"prefilter", "filter", "score", "reserve", "permit"} <= names
+
+    def test_vector_walk_attributes_cost(self):
+        # the vectorized walk skips the scalar filter/score spans —
+        # its phase wall lands in the cost-attribution surface instead
+        tracer = Tracer()
+        cluster, sched = self._env(tracer)
+        d = sched.schedule_one(cluster.create_pod(tpu_pod("p1")))
+        assert d.status == "bound"
+        assert sched.vector_attempts == 1
+        assert sched.cost_seconds["filter"] > 0.0
+        assert sched.cost_seconds["reserve_permit"] > 0.0
+        names = {e.name for e in tracer.events()}
+        assert {"prefilter", "reserve", "permit"} <= names
 
     def test_utilization_samples(self):
         cluster, sched = self._env(None)

@@ -52,7 +52,7 @@ from kubeshare_tpu.sim.simulator import Simulator  # noqa: E402
 from kubeshare_tpu.sim.trace import (  # noqa: E402
     generate_backlog_trace, generate_gang_trace, generate_trace,
 )
-from kubeshare_tpu.utils.trace import Tracer  # noqa: E402
+from kubeshare_tpu.utils.stats import percentile  # noqa: E402
 
 CHIPS_PER_NODE = 4
 EVENTS = 2000
@@ -78,31 +78,37 @@ def topology(n_nodes: int) -> dict:
     }
 
 
-def _simulate(n_nodes, trace, use_waves, backfill, explain_capacity=512):
-    tracer = Tracer(keep_events=False)
+def _simulate(n_nodes, trace, use_waves, backfill, explain_capacity=512,
+              vector=True):
+    # no tracer: span overhead is not part of the engine hot path
+    # being measured, and the per-attempt percentiles now come from
+    # the engine's own raw-duration ring (exact, not bucket edges)
     sim = Simulator(
         topology(n_nodes),
         {f"node-{i:03d}": CHIPS_PER_NODE for i in range(n_nodes)},
         seed=0,
-        tracer=tracer,
         use_waves=use_waves,
         backfill=backfill,
         explain_capacity=explain_capacity,
+        vector=vector,
     )
     wall0 = time.perf_counter()
     report = sim.run(trace)
     wall = time.perf_counter() - wall0
-    return sim, report, tracer, wall
+    return sim, report, wall
 
 
 def _row(n_nodes, trace, use_waves=True, backfill=False,
-         explain_capacity=512, events=None):
-    sim, report, tracer, wall = _simulate(
-        n_nodes, trace, use_waves, backfill, explain_capacity
+         explain_capacity=512, events=None, vector=True):
+    sim, report, wall = _simulate(
+        n_nodes, trace, use_waves, backfill, explain_capacity, vector
     )
-    attempts = tracer.histograms.get("attempt")
     engine = sim.engine
     tree = engine.tree
+    # EXACT attempt percentiles from sampled raw durations: the old
+    # span-histogram rows quantized to bucket edges (p50 300.0us, p99
+    # 1000.0/3000.0us), which hid sub-2x regressions entirely
+    samples = list(engine.attempt_seconds)
     return {
         "nodes": n_nodes,
         "chips": n_nodes * CHIPS_PER_NODE,
@@ -111,16 +117,10 @@ def _row(n_nodes, trace, use_waves=True, backfill=False,
         "wall_seconds": round(wall, 3),
         "placements_per_sec": round(report.bound / wall, 1),
         "schedule_attempts_per_sec": round(
-            (attempts.count if attempts else 0) / wall, 1
+            engine.cost_attempts / wall, 1
         ),
-        # per-attempt latency from the engine's own span histogram
-        # (bucket upper bounds — log-spaced 10us..10s)
-        "attempt_p50_us": round(
-            (attempts.quantile(0.5) if attempts else 0.0) * 1e6, 1
-        ),
-        "attempt_p99_us": round(
-            (attempts.quantile(0.99) if attempts else 0.0) * 1e6, 1
-        ),
+        "attempt_p50_us": round(percentile(samples, 0.5, 9) * 1e6, 1),
+        "attempt_p99_us": round(percentile(samples, 0.99, 9) * 1e6, 1),
         "counters": {
             "filter_fast_hits": tree.filter_fast_hits,
             "filter_slow_walks": tree.filter_slow_walks,
@@ -134,6 +134,18 @@ def _row(n_nodes, trace, use_waves=True, backfill=False,
             "waves": engine.wave_count,
             "backfill_binds": engine.backfill_binds,
             "backfill_head_delays": engine.backfill_head_delays,
+            "vector_attempts": engine.vector_attempts,
+            "vector_fallbacks": engine.vector_fallbacks,
+            "column_row_refreshes": (
+                engine._columns.row_refreshes if engine._columns else 0
+            ),
+            "column_rebuilds": (
+                engine._columns.rebuilds if engine._columns else 0
+            ),
+            "column_ambiguous_resolves": (
+                engine._columns.ambiguous_resolves
+                if engine._columns else 0
+            ),
         },
         "wave_phase_seconds": {
             k: round(v, 3)
@@ -285,10 +297,56 @@ def journal_ab(reps: int) -> dict:
     }
 
 
+def vector_ab(reps: int) -> dict:
+    """Tentpole A/B: the columnar Filter/Score + flattened reserve
+    lane (vector=True, the default) vs the scalar per-candidate walk
+    (vector=False), idle trace at 1024 nodes — the same engine, same
+    trace, same box, only the hot path differs. Decision-identity
+    between the arms is pinned by tests/test_scheduler_vector.py; this
+    measures only the speed.
+
+    Same paired-ratio protocol as ``journal_ab``: the speedup is the
+    MEDIAN of per-rep paired ratios (each rep runs both arms
+    back-to-back), not best-of-on over best-of-off — independent
+    best-of arms land in different throttle windows on drifting CI
+    boxes. Headline rates still report the best rep of each arm."""
+    trace = generate_trace(count=EVENTS, seed=0)
+    pairs = []
+    best = {}
+    for _ in range(max(1, reps)):
+        rep_pair = {}
+        for key, vec in (("on", True), ("off", False)):
+            row = _row(1024, trace, vector=vec)
+            rep_pair[key] = row["placements_per_sec"]
+            if key not in best or \
+                    row["wall_seconds"] < best[key]["wall_seconds"]:
+                best[key] = row
+        pairs.append(rep_pair["on"] / rep_pair["off"])
+    pairs.sort()
+    median = pairs[len(pairs) // 2] if len(pairs) % 2 else (
+        (pairs[len(pairs) // 2 - 1] + pairs[len(pairs) // 2]) / 2
+    )
+    return {
+        "nodes": 1024,
+        "vector_on_placements_per_sec":
+            best["on"]["placements_per_sec"],
+        "vector_off_placements_per_sec":
+            best["off"]["placements_per_sec"],
+        "vector_speedup": round(median, 2),
+        "vector_speedup_per_rep": [round(p, 2) for p in pairs],
+        # full rows: the off arm's counters prove the scalar walk
+        # genuinely ran (score memo + aggregate probes engaged), the
+        # on arm's that the columnar path served every attempt
+        "on": best["on"],
+        "off": best["off"],
+    }
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
-        "--mode", choices=("idle", "backlog", "gang", "journal", "all"),
+        "--mode",
+        choices=("idle", "backlog", "gang", "journal", "vector", "all"),
         default="all",
     )
     parser.add_argument(
@@ -373,6 +431,16 @@ def main(argv=None) -> None:
             f"{j['journal_on_placements_per_sec']:,.0f}/s, off "
             f"{j['journal_off_placements_per_sec']:,.0f}/s "
             f"({j['journal_overhead_pct']}% overhead)"
+        )
+
+    if args.mode in ("vector", "all"):
+        doc["vector_ab"] = vector_ab(args.reps)
+        v = doc["vector_ab"]
+        print(
+            f"vector A/B @1024: on "
+            f"{v['vector_on_placements_per_sec']:,.0f}/s, off "
+            f"{v['vector_off_placements_per_sec']:,.0f}/s "
+            f"({v['vector_speedup']}x paired-median speedup)"
         )
 
     with open(args.out, "w") as f:
