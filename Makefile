@@ -14,7 +14,7 @@ test: native
 bench: native
 	$(PYTHON) bench.py
 
-engine-bench:
+engine-bench: native
 	$(PYTHON) tools/engine_bench.py
 
 # defrag A/B over the 989-row reference-format trace -> SIM_REPLAY.json

@@ -248,6 +248,18 @@ def build_parser() -> argparse.ArgumentParser:
              "tpu_scheduler_backfill_head_delays_total (must stay 0)",
     )
     parser.add_argument(
+        "--native", action="store_true",
+        help="serve vector-eligible attempts from the native attempt "
+             "core (runtime_native/libplace_core.so via ctypes): "
+             "Filter mask + score argmax + leaf selection + the "
+             "reserve-side mirror bookkeeping in one C call per "
+             "attempt, decisions bind-for-bind identical to the "
+             "Python engine; per-attempt fallbacks to the Python "
+             "walk are counted on tpu_scheduler_native_fallbacks_"
+             "total. A missing or mismatched library logs a warning "
+             "and demotes to the vector/scalar engine",
+    )
+    parser.add_argument(
         "--no-vector", action="store_true",
         help="disable the columnar (structure-of-arrays) Filter/Score "
              "fast path and run every attempt through the scalar "
@@ -700,6 +712,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         tenants=args.tenants or None,
         explain_capacity=args.explain_capacity,
         vector=not args.no_vector,
+        native=args.native,
     )
     elector = None
     if args.leader_elect:

@@ -552,12 +552,21 @@ def phase_drift_rule(phase_totals: Callable[[], Dict[str, float]],
                      cfg: AlertConfig) -> AlertRule:
     """Per-phase cost-SHARE drift: ``phase_totals`` returns the
     cumulative ``cost_seconds`` map; shares are computed over the
-    slow window (noise from a single stall averages out there) and
-    compared to frozen-while-hot EWMA baselines. Level is the worst
-    phase's absolute share move over ``cost_phase_drift`` — a hot
-    path whose filter share doubles fires even when total
-    seconds-per-attempt has not yet crossed the regression factor.
-    Counter-reset tolerant the same way as the regression rule."""
+    slow window and compared to frozen-while-hot EWMA baselines.
+    Level is the worst phase's absolute share move over
+    ``cost_phase_drift`` — a hot path whose filter share doubles
+    fires even when total seconds-per-attempt has not yet crossed
+    the regression factor. Counter-reset tolerant the same way as
+    the regression rule.
+
+    The graded share per phase is the MEDIAN over the slow window's
+    three equal sub-windows (PR-14): a single GC stall lands in ONE
+    sub-window and the median ignores it — a 25ms collection inside
+    the reserve segment used to read as a 0.35->0.67 share move over
+    a lightly-loaded window and page a fault-free run — while a real
+    cost-mix shift moves all three. Each sub-window must clear a
+    third of ``cost_phase_min_seconds`` or the rule does not grade
+    (nor seed) at all."""
     series = WindowSeries(cfg.slow_window)
     keys: List[str] = []  # pinned phase order on first observation
     baselines: Dict[str, float] = {}
@@ -569,11 +578,34 @@ def phase_drift_rule(phase_totals: Callable[[], Dict[str, float]],
         series.observe(
             now, tuple(float(current.get(k, 0.0)) for k in keys)
         )
-        d = series.delta(now, cfg.slow_window)
-        total = sum(d) if d else 0.0
-        if not d or total < cfg.cost_phase_min_seconds:
+        w = cfg.slow_window
+        # full-coverage guard: delta() falls back to the oldest held
+        # sample for a partially-covered span, which would make the
+        # "oldest third" a truncated window with skewed shares — do
+        # not grade (or seed baselines) until history spans the whole
+        # slow window
+        oldest = series._samples[0][0] if series._samples else now
+        if oldest > now - w:
             return 0.0, {}
-        shares = {k: v / total for k, v in zip(keys, d)}
+        cum = [series.delta(now, w * f) for f in (1.0, 2.0 / 3.0,
+                                                  1.0 / 3.0)]
+        # three consecutive sub-window deltas, oldest first
+        subs = [
+            tuple(a - b for a, b in zip(cum[0], cum[1])),
+            tuple(a - b for a, b in zip(cum[1], cum[2])),
+            cum[2],
+        ]
+        floor = cfg.cost_phase_min_seconds / 3.0
+        sub_shares = []
+        for d in subs:
+            total = sum(d)
+            if total < floor:
+                return 0.0, {}
+            sub_shares.append([v / total for v in d])
+        shares = {
+            k: sorted(sub_shares[j][i] for j in range(3))[1]
+            for i, k in enumerate(keys)
+        }
         if not baselines:
             baselines.update(shares)  # first valid window seeds
             return 0.0, {}

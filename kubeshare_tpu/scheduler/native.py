@@ -1,0 +1,691 @@
+"""Native attempt core: the ctypes bridge to runtime_native's
+``libplace_core.so`` (PR-14).
+
+PR-13's columnar store took Filter/Score to a numpy argmax and left
+the per-attempt wall dominated by bookkeeping spread across ~40 Python
+calls (PROFILE.json: ``reserve_permit`` 0.43-0.47 plus interpreter
+constants around the numpy query). This module moves the hot half of
+the scheduling walk for vector-eligible attempts into one C call per
+attempt: feasibility mask + score argmax + reserve-time leaf selection
++ the reserve-side leaf/row/cell bookkeeping applied to a flat native
+MIRROR of the column state as one batched transaction. The kernel
+returns a compact decision record; the engine converts it into the
+existing ReservationPlan/PodStatus/journal writes, which remain
+authoritative.
+
+Ownership and sync contract:
+
+- The C store is allocated and freed by the kernel
+  (``pc_store_new``/``pc_store_free``); Python holds only the opaque
+  handle. Arrays crossing the ABI are Python-owned and fully consumed
+  before the call returns.
+- The Python cell tree stays the single source of truth. The mirror
+  is maintained exactly like the column store: the tree's ``on_delta``
+  / ``on_structural`` hooks mark nodes dirty, and dirty rows re-export
+  their leaf lanes from the live tree at the next query. The ONE
+  exception is a native-served reserve: the kernel already applied it
+  to the mirror inside the attempt call, so the authoritative apply's
+  own delta is consumed (``arm_skip``) instead of forcing a redundant
+  re-export — any other delta on the node (release, rollback, health,
+  port flip) resyncs from the tree as usual.
+- Pairwise leaf distances (the locality-anchored multi-chip pick) are
+  a pure function of cell position, fixed at tree build: they are
+  exported once per row build and reused until membership changes.
+
+Fallback semantics: a missing/mismatched library, an unknown model, a
+non-simple multi-chip row, or a chip count beyond the kernel's
+selection cap all fall back to the Python walk per attempt, counted on
+``tpu_scheduler_native_fallbacks_total`` — conservative, never wrong.
+The library is verified at load (ABI version, struct sizes, a
+field-for-field probe round trip) and refused on any mismatch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..cells.cell import CellTree
+from ..cells.topology import torus_distance
+from .columns import _derive_cells
+from .labels import PodKind, PodRequirements
+
+
+def _pair_matrix(leaves) -> ctypes.Array:
+    """Pairwise ``ici_distance`` over a row's leaves as a flat C
+    array. Same semantics, amortized parsing: ``id_path_distance``
+    splits both id strings per PAIR, which made the per-model store
+    build O(rows * leaves^2) string work — here each id is split and
+    numeric-parsed once per leaf. Equality of numeric segments is
+    value equality in both (int("01") == int("1") and |a-b| == 0), so
+    the pre-parsed compare cannot diverge from the string walk."""
+    n = len(leaves)
+    flat = (ctypes.c_double * (n * n))()
+    domains = []
+    segs = []
+    for leaf in leaves:
+        domains.append(
+            (leaf.torus_domain, leaf.coord, leaf.torus_dims)
+        )
+        segs.append([
+            (int(part), True) if part.isdigit() else (part, False)
+            for part in leaf.id.split("/")
+        ])
+    for i in range(n):
+        dom_i, coord_i, dims_i = domains[i]
+        seg_i = segs[i]
+        len_i = len(seg_i)
+        for j in range(i + 1, n):
+            dom_j, coord_j, _ = domains[j]
+            if (
+                dom_i is not None
+                and dom_i == dom_j
+                and coord_i is not None
+                and coord_j is not None
+            ):
+                d = float(torus_distance(coord_i, coord_j, dims_i))
+            else:
+                seg_j = segs[j]
+                len_j = len(seg_j)
+                d = 0.0
+                for k in range(max(len_i, len_j)):
+                    if k >= len_i or k >= len_j:
+                        d += 100
+                        continue
+                    va, num_a = seg_i[k]
+                    vb, num_b = seg_j[k]
+                    if num_a and num_b:
+                        d += abs(va - vb)
+                    elif va != vb or num_a != num_b:
+                        d += 100
+            flat[i * n + j] = d
+            flat[j * n + i] = d
+    return flat
+
+
+PC_ABI_VERSION = 1
+PC_MAX_SELECT = 64
+
+PC_OK = 0
+PC_NO_FIT = 1
+PC_NO_CHIPS = 2
+
+_KIND_SHARED = 0
+_KIND_MULTI = 1
+
+
+class PCRequest(ctypes.Structure):
+    _fields_ = [
+        ("kind", ctypes.c_int32),
+        ("guarantee", ctypes.c_int32),
+        ("chip_count", ctypes.c_int32),
+        ("_pad", ctypes.c_int32),
+        ("request", ctypes.c_double),
+        ("memory", ctypes.c_int64),
+    ]
+
+
+class PCDecision(ctypes.Structure):
+    _fields_ = [
+        ("status", ctypes.c_int32),
+        ("feasible", ctypes.c_int32),
+        ("winner", ctypes.c_int32),
+        ("runner", ctypes.c_int32),
+        ("winner_score", ctypes.c_double),
+        ("runner_score", ctypes.c_double),
+        ("n_leaves", ctypes.c_int32),
+        ("reserved", ctypes.c_int32),
+        ("leaf_slot", ctypes.c_int32 * PC_MAX_SELECT),
+        ("leaf_mem", ctypes.c_int64 * PC_MAX_SELECT),
+        ("total_mem", ctypes.c_int64),
+    ]
+
+
+def default_library_path() -> str:
+    """Repo-relative build output; override with KUBESHARE_PLACE_CORE
+    (the daemon container installs it elsewhere)."""
+    env = os.environ.get("KUBESHARE_PLACE_CORE")
+    if env:
+        return env
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(
+        os.path.dirname(os.path.dirname(here)),
+        "runtime_native", "build", "libplace_core.so",
+    )
+
+
+def _bind_signatures(lib: ctypes.CDLL) -> None:
+    p = ctypes.POINTER
+    lib.pc_abi_version.restype = ctypes.c_uint32
+    lib.pc_abi_version.argtypes = []
+    lib.pc_max_select.restype = ctypes.c_int32
+    lib.pc_max_select.argtypes = []
+    lib.pc_sizeof_request.restype = ctypes.c_int64
+    lib.pc_sizeof_request.argtypes = []
+    lib.pc_sizeof_decision.restype = ctypes.c_int64
+    lib.pc_sizeof_decision.argtypes = []
+    lib.pc_store_new.restype = ctypes.c_void_p
+    lib.pc_store_new.argtypes = [ctypes.c_int32]
+    lib.pc_store_free.restype = None
+    lib.pc_store_free.argtypes = [ctypes.c_void_p]
+    lib.pc_store_rows.restype = ctypes.c_int32
+    lib.pc_store_rows.argtypes = [ctypes.c_void_p]
+    lib.pc_set_row.restype = ctypes.c_int32
+    lib.pc_set_row.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        p(ctypes.c_double), p(ctypes.c_int64), p(ctypes.c_int64),
+        p(ctypes.c_double), p(ctypes.c_uint8), ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int32,
+        p(ctypes.c_double),
+    ]
+    lib.pc_set_port_full.restype = ctypes.c_int32
+    lib.pc_set_port_full.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.pc_nonsimple.restype = ctypes.c_int32
+    lib.pc_nonsimple.argtypes = [ctypes.c_void_p]
+    lib.pc_apply.restype = ctypes.c_int32
+    lib.pc_apply.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        p(ctypes.c_int32), p(ctypes.c_double), p(ctypes.c_int64),
+    ]
+    lib.pc_feasible.restype = ctypes.c_int32
+    lib.pc_feasible.argtypes = [
+        ctypes.c_void_p, p(PCRequest), p(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    lib.pc_attempt.restype = ctypes.c_int32
+    lib.pc_attempt.argtypes = [
+        ctypes.c_void_p, p(PCRequest), ctypes.c_int32, p(PCDecision),
+    ]
+    lib.pc_attempt_args.restype = ctypes.c_int32
+    lib.pc_attempt_args.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_double, ctypes.c_int64,
+        ctypes.c_int32, p(PCDecision),
+    ]
+    lib.pc_row_stat.restype = ctypes.c_double
+    lib.pc_row_stat.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.pc_probe_fill.restype = None
+    lib.pc_probe_fill.argtypes = [p(PCRequest), p(PCDecision)]
+    lib.pc_probe_check.restype = ctypes.c_int32
+    lib.pc_probe_check.argtypes = [p(PCRequest), p(PCDecision)]
+
+
+def probe_expectations() -> Tuple[dict, dict]:
+    """The field values ``pc_probe_fill`` writes (C -> Python leg) and
+    the ones ``pc_probe_check`` expects (Python -> C leg). One table
+    shared by the loader's self-check and the round-trip test so the
+    two can never drift apart."""
+    filled = {
+        "request": {
+            "kind": _KIND_MULTI, "guarantee": -2,
+            "chip_count": 0x01020304, "_pad": 0x7FFFFFFF,
+            "request": -0.5, "memory": 0x0102030405060708,
+        },
+        "decision": {
+            "status": PC_NO_CHIPS, "feasible": -7,
+            "winner": 0x0A0B0C0D, "runner": -(2 ** 31),
+            "winner_score": 1.5e300, "runner_score": -3.25,
+            "n_leaves": 3, "reserved": 1,
+            ("leaf_slot", 0): 11, ("leaf_slot", 1): -12,
+            ("leaf_slot", PC_MAX_SELECT - 1): 0x0504,
+            ("leaf_mem", 0): -(2 ** 63),
+            ("leaf_mem", 1): 0x0807060504030201,
+            ("leaf_mem", PC_MAX_SELECT - 1): -1,
+            "total_mem": 2 ** 63 - 1,
+        },
+    }
+    expected = {
+        "request": {
+            "kind": _KIND_SHARED, "guarantee": 7,
+            "chip_count": -0x01020304, "_pad": 0x1234,
+            "request": 0.125, "memory": -0x0102030405060708,
+        },
+        "decision": {
+            "status": -5, "feasible": 1024, "winner": -1,
+            "runner": 0x00010203, "winner_score": -2.5,
+            "runner_score": 6.0e-300, "n_leaves": PC_MAX_SELECT,
+            "reserved": -9,
+            ("leaf_slot", 0): 2 ** 31 - 1,
+            ("leaf_slot", PC_MAX_SELECT - 1): -0x0504,
+            ("leaf_mem", 0): 0x1112131415161718,
+            ("leaf_mem", PC_MAX_SELECT - 1): -(2 ** 63),
+            "total_mem": -42,
+        },
+    }
+    return filled, expected
+
+
+def _struct_get(obj, key):
+    if isinstance(key, tuple):
+        return getattr(obj, key[0])[key[1]]
+    return getattr(obj, key)
+
+
+def _struct_set(obj, key, value):
+    if isinstance(key, tuple):
+        getattr(obj, key[0])[key[1]] = value
+    else:
+        setattr(obj, key, value)
+
+
+def verify_layout(lib: ctypes.CDLL) -> Optional[str]:
+    """ABI handshake: version, struct sizes, and a field-for-field
+    probe round trip in both directions. Returns None when the
+    library is safe to use, else the refusal reason."""
+    version = lib.pc_abi_version()
+    if version != PC_ABI_VERSION:
+        return f"ABI version {version} != expected {PC_ABI_VERSION}"
+    if lib.pc_max_select() != PC_MAX_SELECT:
+        return "PC_MAX_SELECT mismatch"
+    if lib.pc_sizeof_request() != ctypes.sizeof(PCRequest):
+        return (
+            f"PCRequest size {lib.pc_sizeof_request()} != ctypes "
+            f"{ctypes.sizeof(PCRequest)}"
+        )
+    if lib.pc_sizeof_decision() != ctypes.sizeof(PCDecision):
+        return (
+            f"PCDecision size {lib.pc_sizeof_decision()} != ctypes "
+            f"{ctypes.sizeof(PCDecision)}"
+        )
+    filled, expected = probe_expectations()
+    rq = PCRequest()
+    dec = PCDecision()
+    lib.pc_probe_fill(ctypes.byref(rq), ctypes.byref(dec))
+    for section, obj in (("request", rq), ("decision", dec)):
+        for key, want in filled[section].items():
+            got = _struct_get(obj, key)
+            if got != want:
+                return f"probe fill {section}.{key}: {got!r} != {want!r}"
+    for section, obj in (("request", rq), ("decision", dec)):
+        for key, want in expected[section].items():
+            _struct_set(obj, key, want)
+    rc = lib.pc_probe_check(ctypes.byref(rq), ctypes.byref(dec))
+    if rc != 0:
+        return f"probe check failed at field index {rc}"
+    return None
+
+
+_LIB_CACHE: Dict[str, Tuple[Optional[ctypes.CDLL], str]] = {}
+
+
+def load_place_core(
+    path: Optional[str] = None,
+) -> Tuple[Optional[ctypes.CDLL], str]:
+    """Load + verify the kernel. Returns (lib, "") or (None, reason).
+    Cached per path: the daemon, the sim, and every test share one
+    dlopen."""
+    path = path or default_library_path()
+    cached = _LIB_CACHE.get(path)
+    if cached is not None:
+        return cached
+    if not os.path.exists(path):
+        result = (None, f"{path} not built (run `make native`)")
+        _LIB_CACHE[path] = result
+        return result
+    try:
+        lib = ctypes.CDLL(path)
+        _bind_signatures(lib)
+    except OSError as e:
+        result = (None, f"dlopen {path}: {e}")
+        _LIB_CACHE[path] = result
+        return result
+    reason = verify_layout(lib)
+    result = (None, reason) if reason else (lib, "")
+    _LIB_CACHE[path] = result
+    return result
+
+
+def native_available(path: Optional[str] = None) -> bool:
+    return load_place_core(path)[0] is not None
+
+
+class _NativeModel:
+    """One model pool's mirror: row membership (name-sorted, row index
+    IS the scalar name tie-break) plus the Python-side leaf tuples the
+    decision record indexes into."""
+
+    __slots__ = (
+        "model", "handle", "nodes", "row_of", "leaves", "cells",
+        "slot_of", "dist", "dirty", "nonsimple", "templates",
+    )
+
+    def __init__(self, model: str, handle, nodes: List[str]):
+        self.model = model
+        self.handle = handle
+        self.nodes = nodes
+        self.row_of = {name: i for i, name in enumerate(nodes)}
+        self.leaves: List[tuple] = [()] * len(nodes)
+        self.cells: List[tuple] = [(None, True)] * len(nodes)
+        # per-row {leaf uuid -> slot}: the release lane's reverse map
+        # (one dict PER row — a shared instance would alias every
+        # row's map if a partial rebuild ever populated incrementally)
+        self.slot_of: List[dict] = [{} for _ in nodes]
+        # per-row cached pairwise-distance ctypes array (None for
+        # rows with < 2 leaves — the anchored pick never reads it)
+        self.dist: List[Optional[ctypes.Array]] = [None] * len(nodes)
+        # per-row lazily-built SHARED annotation/env templates (leaf
+        # id/uuid/model are fixed until a structural rebind): the plan
+        # builder copies a 3-entry dict instead of re-minting it per
+        # bind. Invalidated by _derive_row, never by lane re-exports.
+        self.templates: List[Optional[list]] = [None] * len(nodes)
+        self.dirty: Set[str] = set()
+        self.nonsimple = 0
+
+
+class NativeStore:
+    """Engine-side owner of the native mirror — the ColumnStore
+    analog the scheduler swaps in under ``native=True``."""
+
+    def __init__(self, lib: ctypes.CDLL, tree: CellTree,
+                 full_ports: Set[str]):
+        self.lib = lib
+        self.tree = tree
+        self.full_ports = full_ports  # live reference (engine-owned)
+        self._models: Dict[str, _NativeModel] = {}
+        self._struct_dirty: Set[str] = set()
+        self._skip: Optional[Tuple[str, str]] = None
+        self.row_refreshes = 0   # row re-exports (delta resyncs)
+        self.rebuilds = 0        # whole-model rebuilds (membership)
+        self.skip_consumed = 0   # native-applied reserves not re-exported
+        # reused per-attempt ABI scratch: one request/decision pair +
+        # persistent byref wrappers + the bound entry point — the
+        # attempt path must not rebuild ctypes plumbing per pod
+        self._rq = PCRequest()
+        self._dec = PCDecision()
+        self._rq_ref = ctypes.byref(self._rq)
+        self._dec_ref = ctypes.byref(self._dec)
+        self._pc_attempt = lib.pc_attempt_args
+
+    def __del__(self):  # best-effort: free C stores with the engine
+        try:
+            self.reset()
+        except Exception:
+            pass
+
+    # ---- maintenance hooks ------------------------------------------
+
+    def note_delta(self, node: str) -> None:
+        """Tree ``on_delta`` subscriber. A delta the kernel itself
+        already applied (the armed native reserve) is consumed instead
+        of dirtying its model's row; every other model sharing the
+        node still resyncs (the node-cell HBM moved under them)."""
+        skip = self._skip
+        if skip is not None and skip[0] == node:
+            self._skip = None
+            self.skip_consumed += 1
+            for model, ms in self._models.items():
+                if model != skip[1]:
+                    ms.dirty.add(node)
+            return
+        for ms in self._models.values():
+            ms.dirty.add(node)
+
+    def note_structural(self, node: str) -> None:
+        self._struct_dirty.add(node)
+
+    def note_port_flip(self, node: str) -> None:
+        """Port-pool fullness flipped: a row fact the kernel cannot
+        see — dirty the node unconditionally (never consumes an armed
+        skip; the flip can arrive mid-apply, before the leaf delta)."""
+        for ms in self._models.values():
+            ms.dirty.add(node)
+
+    def arm_skip(self, node: str, model: str) -> None:
+        """The next ``on_delta`` for ``node`` is the authoritative
+        apply of a reserve the kernel already mirrored — consume it."""
+        self._skip = (node, model)
+
+    def disarm(self) -> None:
+        """Clear an unconsumed skip. The apply never reached the tree
+        (an exception before the leaf mutation): the mirror is AHEAD
+        of the tree, so force a resync of every model's row."""
+        skip = self._skip
+        if skip is not None:
+            self._skip = None
+            for ms in self._models.values():
+                ms.dirty.add(skip[0])
+
+    def reset(self) -> None:
+        """Drop every model store (topology reload): the next query
+        rebuilds from the live tree."""
+        for ms in self._models.values():
+            self.lib.pc_store_free(ms.handle)
+        self._models.clear()
+        self._struct_dirty.clear()
+        self._skip = None
+
+    # ---- export ------------------------------------------------------
+
+    def _export_row(self, ms: _NativeModel, row: int, node: str) -> None:
+        """Re-export one row's leaf lanes + structural facts from the
+        live tree (the resync path for any non-native mutation)."""
+        self.row_refreshes += 1
+        leaves = ms.leaves[row]
+        n = len(leaves)
+        avail = (ctypes.c_double * n)(*[l.available for l in leaves])
+        fmem = (ctypes.c_int64 * n)(*[l.free_memory for l in leaves])
+        full = (ctypes.c_int64 * n)(*[l.full_memory for l in leaves])
+        prio = (ctypes.c_double * n)(
+            *[float(l.priority) for l in leaves]
+        )
+        healthy = (ctypes.c_uint8 * n)(
+            *[1 if l.healthy else 0 for l in leaves]
+        )
+        node_cell, simple = ms.cells[row]
+        if node_cell is not None:
+            cell_ok = 1 if node_cell.healthy else 0
+            cell_mem = node_cell.free_memory
+        else:
+            cell_ok = 0
+            cell_mem = -1
+        delta = self.lib.pc_set_row(
+            ms.handle, row, n, avail, fmem, full, prio, healthy,
+            1 if simple else 0, cell_ok, cell_mem,
+            1 if node in self.full_ports else 0, ms.dist[row],
+        )
+        if delta != 0:  # pragma: no cover - programming error
+            raise RuntimeError(f"pc_set_row({node}) failed: {delta}")
+
+    def _derive_row(self, ms: _NativeModel, row: int, node: str,
+                    leaves: tuple) -> None:
+        """Row (re)build: structural facts, the uuid->slot reverse
+        map, and the pairwise distance matrix (fixed at tree build —
+        recomputed only here)."""
+        ms.leaves[row] = leaves
+        node_cell, simple = _derive_cells(leaves)
+        old_simple = ms.cells[row][1]
+        ms.nonsimple += int(not simple) - int(not old_simple)
+        ms.cells[row] = (node_cell, simple)
+        ms.slot_of[row] = {l.uuid: j for j, l in enumerate(leaves)}
+        ms.templates[row] = None
+        ms.dist[row] = _pair_matrix(leaves) if len(leaves) >= 2 else None
+        self._export_row(ms, row, node)
+
+    def _build_model(self, model: str) -> _NativeModel:
+        tree = self.tree
+        nodes = sorted(
+            n for n in tree._leaves_by_node
+            if n and tree.leaves_view(n, model)
+        )
+        old = self._models.get(model)
+        if old is not None:
+            self.lib.pc_store_free(old.handle)
+        raw = self.lib.pc_store_new(len(nodes))
+        if not raw:  # pragma: no cover - allocation failure
+            raise MemoryError("pc_store_new failed")
+        ms = _NativeModel(model, ctypes.c_void_p(raw), nodes)
+        self.rebuilds += 1
+        for row, node in enumerate(nodes):
+            ms.cells[row] = (None, True)
+            self._derive_row(
+                ms, row, node, tuple(tree.leaves_view(node, model))
+            )
+        self._models[model] = ms
+        return ms
+
+    def _flush(self) -> None:
+        if self._struct_dirty:
+            struck = self._struct_dirty
+            self._struct_dirty = set()
+            tree = self.tree
+            for model, ms in list(self._models.items()):
+                stale = False
+                for node in struck:
+                    row = ms.row_of.get(node)
+                    fresh = tuple(tree.leaves_view(node, model))
+                    if (row is None) != (not fresh):
+                        stale = True  # membership moved: positional
+                        break         # arrays rebuild wholesale
+                    if row is None:
+                        continue
+                    ms.dirty.discard(node)
+                    if fresh != ms.leaves[row]:
+                        self._derive_row(ms, row, node, fresh)
+                    else:
+                        self._export_row(ms, row, node)
+                if stale:
+                    self._build_model(model)
+
+    def _flush_model(self, ms: _NativeModel) -> None:
+        if ms.dirty:
+            dirty = ms.dirty
+            ms.dirty = set()
+            row_of = ms.row_of
+            for node in dirty:
+                row = row_of.get(node)
+                if row is not None:
+                    self._export_row(ms, row, node)
+
+    def membership(self, model: str) -> _NativeModel:
+        """The (flushed) model store — the rejection classifier and
+        the oracle read row membership off it."""
+        self._flush()
+        ms = self._models.get(model)
+        if ms is None:
+            ms = self._build_model(model)
+        self._flush_model(ms)
+        return ms
+
+    # _columns_for: ColumnStore-compatible spelling (the engine's
+    # rejection classifier serves both stores through it)
+    _columns_for = membership
+
+    def prewarm(self, models) -> None:
+        """Build the per-model stores up front (engine init / reload):
+        the first attempt should pay a dict probe, not an O(cluster)
+        export — store construction is configuration-time work, like
+        the topology parse. Models with no bound leaves build empty
+        stores that rebuild via the structural path when inventory
+        lands."""
+        self._flush()
+        for model in models:
+            if model not in self._models:
+                self._build_model(model)
+
+    # ---- queries -----------------------------------------------------
+
+    def attempt(self, req: PodRequirements, model: str,
+                do_reserve: bool = True) -> Optional[PCDecision]:
+        """One native attempt. Returns the (engine-owned, reused)
+        decision record, or None when this attempt must fall back to
+        the Python walk (selection cap, non-simple multi-chip rows).
+        With ``do_reserve`` the winner's leaves are already taken in
+        the MIRROR when the record says ``reserved`` — the caller owes
+        the authoritative apply under ``arm_skip``."""
+        if self._struct_dirty:
+            self._flush()
+        ms = self._models.get(model)
+        if ms is None:
+            ms = self._build_model(model)
+        if ms.dirty:
+            self._flush_model(ms)
+        if req.kind is PodKind.MULTI_CHIP:
+            if ms.nonsimple or req.chip_count > PC_MAX_SELECT:
+                return None
+            kind = _KIND_MULTI
+            chips = req.chip_count
+        else:
+            kind = _KIND_SHARED
+            chips = 0
+        self._pc_attempt(
+            ms.handle, kind, 1 if req.is_guarantee else 0, chips,
+            req.request, req.memory, 1 if do_reserve else 0,
+            self._dec_ref,
+        )
+        return self._dec
+
+    def feasible_names(self, req: PodRequirements,
+                       model: str) -> List[str]:
+        """Full candidate mask as node names (oracle/tests)."""
+        ms = self.membership(model)
+        rq = PCRequest()
+        if req.kind is PodKind.MULTI_CHIP:
+            rq.kind = _KIND_MULTI
+            rq.chip_count = req.chip_count
+        else:
+            rq.kind = _KIND_SHARED
+        rq.guarantee = 1 if req.is_guarantee else 0
+        rq.request = req.request
+        rq.memory = req.memory
+        n = len(ms.nodes)
+        out = (ctypes.c_int32 * max(1, n))()
+        count = self.lib.pc_feasible(
+            ms.handle, ctypes.byref(rq), out, n
+        )
+        return [ms.nodes[out[i]] for i in range(min(count, n))]
+
+    # ---- the release lane -------------------------------------------
+
+    def release(self, node: str, model: str, ops) -> bool:
+        """Mirror a release's reclaims (``ops``: (leaf, request,
+        memory) actually reclaimed from the tree) ahead of the
+        notification, then arm the skip so the coming delta does not
+        force a redundant re-export. False = could not map (row or
+        slot unknown) — the caller's delta then resyncs normally."""
+        ms = self._models.get(model)
+        if ms is None:
+            return False
+        if node in ms.dirty or node in self._struct_dirty:
+            # the row is already stale (an earlier un-mirrored delta):
+            # applying on top and swallowing the notification would
+            # leave it stale-but-clean — let the re-export cover both
+            return False
+        row = ms.row_of.get(node)
+        if row is None:
+            return False
+        slot_of = ms.slot_of[row]
+        n = len(ops)
+        slots = (ctypes.c_int32 * n)()
+        dreq = (ctypes.c_double * n)()
+        dmem = (ctypes.c_int64 * n)()
+        for k, (leaf, request, memory) in enumerate(ops):
+            slot = slot_of.get(leaf.uuid)
+            if slot is None:
+                return False
+            slots[k] = slot
+            dreq[k] = request
+            dmem[k] = memory
+        rc = self.lib.pc_apply(ms.handle, row, n, slots, dreq, dmem)
+        if rc != 0:
+            return False
+        self.arm_skip(node, model)
+        return True
+
+    # ---- debug/test helpers -----------------------------------------
+
+    def row_stats(self, model: str, node: str) -> Optional[dict]:
+        ms = self.membership(model)
+        row = ms.row_of.get(node)
+        if row is None:
+            return None
+        stat = self.lib.pc_row_stat
+        handle = ms.handle
+        names = ("avail0", "mem0", "best_mem", "whole", "cell_mem",
+                 "cell_ok", "simple", "port_full", "opp", "guar")
+        return {name: stat(handle, row, i) for i, name in
+                enumerate(names)}

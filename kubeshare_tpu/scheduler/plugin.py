@@ -96,6 +96,15 @@ class _Waiting:
     deadline: float
 
 
+# Native-lane sentinel: the kernel picked a winner but found no leaves
+# at selection time — _finish_walk must raise exactly the
+# "no chips left at reserve time" Unschedulable the Python plan raises.
+_NO_CHIPS_PLAN = ReservationPlan(
+    node="", group_key="", leaves=[], memory=0, charged_chips=0.0,
+    needs_port=False, annotations={}, env={},
+)
+
+
 class TpuShareScheduler:
     def __init__(
         self,
@@ -123,6 +132,7 @@ class TpuShareScheduler:
         compaction: bool = False,
         compaction_interval: float = 60.0,
         vector: bool = True,
+        native: bool = False,
     ):
         # function-scope import: quota depends on scheduler.labels /
         # scheduler.constants, so a module-level import here would be
@@ -235,13 +245,39 @@ class TpuShareScheduler:
         # differential suite's oracle engine).
         self.vector = vector
         self._columns = None
-        if vector:
+        # Native attempt core (scheduler/native.py + runtime_native/
+        # place_core.cc, PR-14): the hot half of the walk — mask,
+        # argmax, leaf selection, reserve-side mirror bookkeeping — in
+        # ONE C call per eligible attempt. When loaded it REPLACES the
+        # column store (one mirror, not two); every gate miss falls
+        # back to the scalar walk. A missing/mismatched library demotes
+        # to the vector/scalar engine with a warning — tier-1 must stay
+        # green on a compiler-less box.
+        self._native = None
+        if native:
+            from .native import NativeStore, load_place_core
+
+            lib, why = load_place_core()
+            if lib is None:
+                self.log.warning(
+                    "native attempt core unavailable (%s); running "
+                    "the %s engine instead",
+                    why, "vector" if vector else "scalar",
+                )
+            else:
+                self._native = NativeStore(
+                    lib, self.tree, self._full_port_nodes
+                )
+                self.tree.on_structural = self._on_tree_structural
+        if vector and self._native is None:
             from .columns import ColumnStore
 
             self._columns = ColumnStore(self.tree, self._full_port_nodes)
             self.tree.on_structural = self._on_tree_structural
         self.vector_attempts = 0   # attempts the columnar path served
         self.vector_fallbacks = 0  # columns on, but walked scalar
+        self.native_attempts = 0   # attempts the C kernel served
+        self.native_fallbacks = 0  # kernel on, but walked Python
 
         # every _release (delete, unreserve on Permit-deny or bind
         # conflict, gang-barrier expiry) returns capacity to the
@@ -370,9 +406,15 @@ class TpuShareScheduler:
         # seconds["attempts"]): cumulative wall seconds per segment of
         # the scheduling walk — parse (prefilter + group), quota
         # (admission gate), filter (candidate scan incl. the
-        # nobody-fit cold path), score, reserve_permit (reserve +
-        # permit + bind verbs), journal (attempt-record build + batch
-        # append; demand notes land in the phase that files them).
+        # nobody-fit cold path), score, reserve (leaf selection +
+        # the reservation apply: port, tree bookkeeping, annotation
+        # patch, ledger charge), permit_bind (Permit + the bind
+        # verbs), journal (attempt-record build + batch append;
+        # demand notes land in the phase that files them). The
+        # reserve/permit_bind pair split PR-10's reserve_permit
+        # bucket in PR-14, so the native kernel's reserve-side win is
+        # attributable instead of hiding inside one 0.46-share
+        # phase.
         # Same idiom as wave_phase_seconds — plain perf_counter sums,
         # never tracer spans: the attribution must not tax the path
         # it measures. Exported as tpu_scheduler_cost_seconds_total
@@ -390,8 +432,8 @@ class TpuShareScheduler:
         # totals). 0 forever with migration off.
         self.cost_seconds = {
             "parse": 0.0, "quota": 0.0, "filter": 0.0, "score": 0.0,
-            "reserve_permit": 0.0, "journal": 0.0, "commit": 0.0,
-            "migrate": 0.0,
+            "reserve": 0.0, "permit_bind": 0.0, "journal": 0.0,
+            "commit": 0.0, "migrate": 0.0,
         }
         self.cost_attempts = 0  # attempts attributed (journal-independent)
         # raw per-attempt wall samples (seconds), bounded ring: the
@@ -439,6 +481,10 @@ class TpuShareScheduler:
             self._on_pod_add(pod)
         # restart reconciliation: gangs the crash left partially bound
         self._sweep_half_gangs()
+        if self._native is not None:
+            # store construction is configuration-time work: build the
+            # per-model mirrors now, not on the first pod's attempt
+            self._native.prewarm(self.tree.chip_priority)
 
     def reload_topology(
         self, topology: Union[str, dict, TopologyConfig]
@@ -494,7 +540,17 @@ class TpuShareScheduler:
         self._score_cache = {}
         self._score_node_shapes = {}
         self.tree.on_delta = self._on_tree_delta
-        if self._columns is not None:
+        if self._native is not None:
+            from .native import NativeStore
+
+            # fresh mirror on the fresh tree AND the fresh port set
+            # (free the old C stores first — they index the old tree)
+            self._native.reset()
+            self._native = NativeStore(
+                self._native.lib, tree, self._full_port_nodes
+            )
+            tree.on_structural = self._on_tree_structural
+        elif self._columns is not None:
             from .columns import ColumnStore
 
             # fresh store on the fresh tree AND the fresh port set
@@ -518,6 +574,8 @@ class TpuShareScheduler:
         for pod in self.cluster.list_pods():
             self._on_pod_add(pod)
         self._sweep_half_gangs()
+        if self._native is not None:
+            self._native.prewarm(tree.chip_priority)
         post = getattr(self.cluster, "post_event", None)
         for key in dropped:
             self.log.info(
@@ -550,6 +608,10 @@ class TpuShareScheduler:
         cols = self._columns
         if cols is not None:
             cols._dirty.add(node)
+        elif self._native is not None:
+            # the native mirror consumes the delta it applied itself
+            # (an armed native reserve) and resyncs on any other
+            self._native.note_delta(node)
         shapes = self._score_node_shapes.pop(node, None)
         if not shapes:
             return
@@ -567,7 +629,10 @@ class TpuShareScheduler:
         correction/health flip): the node's model MEMBERSHIP may have
         moved, which the column store's positional row arrays must
         re-derive (an accounting delta only dirties row VALUES)."""
-        self._columns._struct_dirty.add(node)
+        if self._columns is not None:
+            self._columns._struct_dirty.add(node)
+        else:
+            self._native.note_structural(node)
 
     def _index_add(self, name: str) -> None:
         if name not in self._node_index_set:
@@ -1125,7 +1190,7 @@ class TpuShareScheduler:
         a full pool aborts before anything is taken; ledger charge
         only after the last fallible step). Scheduling/arbiter thread
         only. PROFILE.json is why this split exists: ~0.42-0.49 of the
-        attempts budget sat in reserve_permit, and only THIS slice of
+        attempts budget sat in reserve + permit_bind, and only THIS slice of
         it must serialize across schedulers."""
         node_name = plan.node
         leaves = plan.leaves
@@ -1960,8 +2025,10 @@ class TpuShareScheduler:
         # walk at full scan is pinned by the check_aggregates oracle
         # below and tests/test_scheduler_vector.py.
         vectorized = False
+        native_dec = None
+        native_ms = None
         if (
-            self._columns is not None
+            (self._columns is not None or self._native is not None)
             and pinned_dest is None
             and req.kind is not PodKind.REGULAR
             and not anchors
@@ -1981,22 +2048,56 @@ class TpuShareScheduler:
             # scalar walk, which rejects per node with no retained
             # state
             if m0 and m0 in self.tree.chip_priority:
-                vectorized = True
-                self.vector_attempts += 1
+                if self._native is not None:
+                    # ONE C call: mask + argmax + leaf selection + the
+                    # reserve-side mirror transaction. None = the
+                    # kernel declined (selection cap, non-simple
+                    # multi-chip rows) — fall through to the scalar
+                    # walk, counted as a native fallback below.
+                    if self.tree.check_aggregates:
+                        # oracle mode: decide WITHOUT reserving first,
+                        # grade against the scalar walk on pre-reserve
+                        # state, then re-run reserving and pin the two
+                        # decisions identical
+                        native_dec = self._native_oracle_attempt(
+                            pod, req, m0
+                        )
+                    else:
+                        native_dec = self._native.attempt(req, m0)
+                    if native_dec is not None:
+                        vectorized = True
+                        self.native_attempts += 1
+                        native_ms = self._native._models[m0]
+                        n_feasible = native_dec.feasible
+                        if native_dec.winner >= 0:
+                            best = native_ms.nodes[native_dec.winner]
+                            runner = (
+                                native_ms.nodes[native_dec.runner]
+                                if native_dec.runner >= 0 else None
+                            )
+                            best_raw = native_dec.winner_score
+                            runner_raw = native_dec.runner_score
+                        else:
+                            best = runner = None
+                            best_raw = runner_raw = 0.0
+                else:
+                    vectorized = True
+                    self.vector_attempts += 1
+                    n_feasible, best, runner, best_raw, runner_raw = (
+                        self._columns.query(req, m0, req.is_guarantee)
+                    )
+                    if self.tree.check_aggregates:
+                        self._vector_oracle(
+                            pod, req, m0, n_feasible, best, runner,
+                            best_raw, runner_raw,
+                        )
+            if vectorized:
                 self.filter_attempts += 1
                 n_names = len(self._node_index)
                 self.filter_scans += n_names
-                n_feasible, best, runner, best_raw, runner_raw = (
-                    self._columns.query(req, m0, req.is_guarantee)
-                )
-                if self.tree.check_aggregates:
-                    self._vector_oracle(
-                        pod, req, m0, n_feasible, best, runner,
-                        best_raw, runner_raw,
-                    )
                 feasible = n_feasible  # count stands in for the list
                 rejections = (
-                    RejectionAgg() if n_feasible
+                    None if n_feasible
                     else self._vector_rejections(req, m0)
                 )
                 if rec is not None:
@@ -2008,6 +2109,8 @@ class TpuShareScheduler:
         if not vectorized:
             if self._columns is not None:
                 self.vector_fallbacks += 1
+            elif self._native is not None:
+                self.native_fallbacks += 1
             with maybe_span(self.tracer, "filter", pod=pod.key):
                 if pinned_dest is not None:
                     feasible = [pinned_dest]
@@ -2076,9 +2179,9 @@ class TpuShareScheduler:
         self._cost_boundary("score")
 
         if vectorized:
-            # Score already collapsed into the columnar argmax (the
-            # filter lane's cost segment); only the journal fields of
-            # the winner remain for this phase
+            # Score already collapsed into the columnar/native argmax
+            # (the filter lane's cost segment); only the journal
+            # fields of the winner remain for this phase
             if rec is not None:
                 rec.score_candidates = n_feasible
                 rec.winner_node = best
@@ -2086,7 +2189,18 @@ class TpuShareScheduler:
                 if runner is not None:
                     rec.runner_node = runner
                     rec.runner_score = runner_raw
-            self._cost_boundary("reserve_permit")
+            self._cost_boundary("reserve")
+            if native_dec is not None:
+                # the kernel already selected the leaves (and applied
+                # the mirror transaction): convert the decision record
+                # into the ReservationPlan the shared tail applies
+                return self._finish_walk(
+                    pod, req, rec, group, group_key, best,
+                    plan=self._native_plan(
+                        pod, req, native_ms, native_dec, group_key
+                    ),
+                    plan_model=m0,
+                )
             return self._finish_walk(pod, req, rec, group, group_key,
                                      best)
         with maybe_span(self.tracer, "score", pod=pod.key):
@@ -2177,16 +2291,24 @@ class TpuShareScheduler:
                 if runner is not None:
                     rec.runner_node = runner
                     rec.runner_score = runner_raw
-        self._cost_boundary("reserve_permit")
+        self._cost_boundary("reserve")
         return self._finish_walk(pod, req, rec, group, group_key, best)
 
     def _finish_walk(self, pod: Pod, req: PodRequirements,
                      rec: Optional[AttemptRecord], group, group_key: str,
-                     best: str) -> Decision:
+                     best: str, plan: Optional["ReservationPlan"] = None,
+                     plan_model: str = "") -> Decision:
         """Reserve -> Permit -> Bind on the chosen node — the tail the
-        vectorized and scalar walks share, already inside the
-        ``reserve_permit`` cost segment."""
+        native, vectorized, and scalar walks share, entered inside the
+        ``reserve`` cost segment (Permit and the bind verbs charge
+        ``permit_bind``). ``plan`` is the native lane's pre-selected
+        reservation (the kernel already applied it to its mirror —
+        the authoritative apply runs under ``arm_skip`` so the delta
+        is consumed, not re-exported); ``_NO_CHIPS_PLAN`` marks a
+        native selection that found no leaves, which must raise
+        exactly what ``plan_reservation`` raises."""
         if req.kind == PodKind.REGULAR:
+            self._cost_boundary("permit_bind")
             try:
                 self._bind_regular(pod, best, req)
             except Conflict:
@@ -2198,10 +2320,23 @@ class TpuShareScheduler:
 
         try:
             with maybe_span(self.tracer, "reserve", pod=pod.key, node=best):
-                status = self.reserve(pod, req, best)
+                if plan is None:
+                    status = self.reserve(pod, req, best)
+                elif plan is _NO_CHIPS_PLAN:
+                    raise Unschedulable(
+                        f"pod {pod.key}: no chips left on {best} at "
+                        "reserve time"
+                    )
+                else:
+                    self._native.arm_skip(best, plan_model)
+                    try:
+                        status = self.apply_reservation(pod, req, plan)
+                    finally:
+                        self._native.disarm()
         except Unschedulable as e:
             return Decision("unschedulable", pod.key, message=str(e),
                             retryable=e.retryable)
+        self._cost_boundary("permit_bind")
 
         with maybe_span(self.tracer, "permit", pod=pod.key):
             action, extra = self.permit(pod, status)
@@ -2540,7 +2675,9 @@ class TpuShareScheduler:
         rejections = RejectionAgg()
         by_reason = rejections.by_reason
         cap = RejectionAgg.MAX_EXEMPLARS
-        mc = self._columns._columns_for(m0)
+        # one classifier for both mirrors: the column store and the
+        # native store expose the same (nodes, row_of) membership
+        mc = (self._columns or self._native)._columns_for(m0)
         row_of = mc.row_of
         names = self._node_index
         n = len(names)
@@ -2643,6 +2780,158 @@ class TpuShareScheduler:
         assert runner == r2 and (runner is None or runner_raw == rraw2), (
             f"vector runner-up diverged for {pod.key}: "
             f"vector=({runner}, {runner_raw}) scalar=({r2}, {rraw2})"
+        )
+
+    def _native_plan(self, pod: Pod, req: PodRequirements, ms,
+                     dec, group_key: str) -> "ReservationPlan":
+        """Convert the kernel's decision record into the
+        ReservationPlan the shared tail applies — the same
+        annotations/env template ``plan_reservation`` builds, with
+        selection already done (and mirrored) by the kernel."""
+        if dec.n_leaves == 0:
+            return _NO_CHIPS_PLAN
+        row_leaves = ms.leaves[dec.winner]
+        slots = dec.leaf_slot
+        leaves = [row_leaves[slots[k]] for k in range(dec.n_leaves)]
+        node_name = ms.nodes[dec.winner]
+        annotations: Dict[str, str] = {}
+        env: Dict[str, str] = {}
+        if req.kind == PodKind.MULTI_CHIP:
+            total_memory = dec.total_mem
+            annotations[C.ANNOTATION_CELL_ID] = ",".join(
+                l.id for l in leaves
+            )
+            annotations[C.ANNOTATION_CHIP_UUID] = ",".join(
+                l.uuid for l in leaves
+            )
+            annotations[C.ANNOTATION_TPU_MODEL] = leaves[0].leaf_cell_type
+            annotations[C.ANNOTATION_TPU_MEMORY] = str(total_memory)
+            env[C.ENV_VISIBLE_CHIPS] = ",".join(l.uuid for l in leaves)
+            return ReservationPlan(
+                node=node_name, group_key=group_key, leaves=leaves,
+                memory=total_memory, charged_chips=float(len(leaves)),
+                needs_port=False, annotations=annotations, env=env,
+            )
+        slot = slots[0]
+        memory = dec.leaf_mem[0]
+        templates = ms.templates[dec.winner]
+        if templates is None:
+            # leaf id/uuid/model are fixed until a structural rebind
+            # (which re-derives the row and clears this): build the
+            # per-slot base dicts once, copy per bind
+            templates = ms.templates[dec.winner] = [
+                (
+                    {
+                        C.ANNOTATION_CELL_ID: l.id,
+                        C.ANNOTATION_CHIP_UUID: l.uuid,
+                        C.ANNOTATION_TPU_MODEL: l.leaf_cell_type,
+                    },
+                    {
+                        C.ENV_VISIBLE_CHIPS: l.uuid,
+                        C.ENV_LIBRARY_PATH: C.LIBRARY_PATH,
+                    },
+                )
+                for l in row_leaves
+            ]
+        ann_base, env_base = templates[slot]
+        annotations = dict(ann_base)
+        annotations[C.ANNOTATION_TPU_MEMORY] = str(memory)
+        env = dict(env_base)
+        env[C.ENV_POD_NAME] = pod.key
+        env[C.ENV_HBM_LIMIT] = annotations[C.ANNOTATION_TPU_MEMORY]
+        return ReservationPlan(
+            node=node_name, group_key=group_key, leaves=leaves,
+            memory=memory, charged_chips=req.request,
+            needs_port=True, annotations=annotations, env=env,
+        )
+
+    def _native_oracle_attempt(self, pod: Pod, req: PodRequirements,
+                               m0: str):
+        """Oracle-mode native attempt (tests only, via
+        ``tree.check_aggregates``): decide WITHOUT reserving, grade
+        the decision — mask, argmax, selection, resolved memory —
+        against the scalar walk on pre-reserve state, then re-run
+        WITH the mirror reserve and pin the two decisions identical
+        (the reserving pass must not change the answer)."""
+        ns = self._native
+        dec = ns.attempt(req, m0, do_reserve=False)
+        if dec is None:
+            return None
+
+        def snap(d):
+            return (
+                d.status, d.feasible, d.winner, d.runner,
+                d.winner_score, d.runner_score, d.n_leaves,
+                tuple(d.leaf_slot[k] for k in range(d.n_leaves)),
+                tuple(d.leaf_mem[k] for k in range(d.n_leaves)),
+                d.total_mem,
+            )
+
+        first = snap(dec)
+        self._native_oracle(pod, req, m0, dec)
+        dec = ns.attempt(req, m0, do_reserve=True)
+        assert dec is not None and snap(dec) == first, (
+            f"native reserving attempt diverged from its dry run for "
+            f"{pod.key}: {snap(dec)} vs {first}"
+        )
+        return dec
+
+    def _native_oracle(self, pod: Pod, req: PodRequirements, m0: str,
+                       dec) -> None:
+        """Differential oracle for the native path: mask ≡ the scalar
+        full-scan Filter, argmax ≡ pick_top2_seq over scalar scores,
+        selection ≡ select_leaves (leaves AND resolved memory). Runs
+        on PRE-reserve state — callers grade the dry-run decision."""
+        ms = self._native._models[m0]
+        names = self._node_index
+        n = len(names)
+        mask_nodes = self._native.feasible_names(req, m0)
+        feasible, _, _, _ = self._filter_candidates(
+            pod, req, names, n, 0, n, set()
+        )
+        assert sorted(feasible) == mask_nodes, (
+            f"native mask diverged from scalar full-scan Filter for "
+            f"{pod.key}: mask={mask_nodes} scalar={sorted(feasible)}"
+        )
+        assert len(mask_nodes) == dec.feasible
+        if not dec.feasible:
+            assert dec.winner < 0
+            return
+        values = [
+            self.score(pod, req, name, anchors=[], seed_frees=None)
+            for name in mask_nodes
+        ]
+        b2, r2, braw2, rraw2 = pick_top2_seq(mask_nodes, values)
+        best = ms.nodes[dec.winner]
+        runner = ms.nodes[dec.runner] if dec.runner >= 0 else None
+        assert best == b2 and dec.winner_score == braw2, (
+            f"native argmax diverged from pick_top2_seq for {pod.key}: "
+            f"native=({best}, {dec.winner_score}) scalar=({b2}, {braw2})"
+        )
+        assert runner == r2 and (
+            runner is None or dec.runner_score == rraw2
+        ), (
+            f"native runner-up diverged for {pod.key}: "
+            f"native=({runner}, {dec.runner_score}) scalar=({r2}, {rraw2})"
+        )
+        sel = select_leaves(self.tree, best, req)
+        row_leaves = ms.leaves[dec.winner]
+        native_sel = [
+            row_leaves[dec.leaf_slot[k]] for k in range(dec.n_leaves)
+        ]
+        assert [l.uuid for l in sel] == [l.uuid for l in native_sel], (
+            f"native selection diverged from select_leaves for "
+            f"{pod.key} on {best}: "
+            f"{[l.id for l in native_sel]} vs {[l.id for l in sel]}"
+        )
+        if req.kind == PodKind.MULTI_CHIP:
+            want = [l.full_memory for l in sel]
+        else:
+            want = [_resolved_memory(l, req) for l in sel]
+        got = [dec.leaf_mem[k] for k in range(dec.n_leaves)]
+        assert got == want, (
+            f"native resolved memory diverged for {pod.key}: "
+            f"{got} vs {want}"
         )
 
     @staticmethod
@@ -3321,6 +3610,36 @@ class TpuShareScheduler:
                 1 if (self._columns is not None
                       and self._columns.use_numpy) else 0,
             ),
+            # native attempt core (PR-14): attempts the C kernel
+            # served vs gate misses that walked Python, whether the
+            # kernel is loaded at all, and the mirror's maintenance
+            # economics (row re-exports ride deltas, rebuilds follow
+            # membership changes, consumed skips are native-applied
+            # reserves that needed NO re-export)
+            expfmt.Sample(
+                "tpu_scheduler_native_attempts_total", {},
+                self.native_attempts,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_native_fallbacks_total", {},
+                self.native_fallbacks,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_native_loaded", {},
+                1 if self._native is not None else 0,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_native_row_refreshes_total", {},
+                self._native.row_refreshes if self._native else 0,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_native_rebuilds_total", {},
+                self._native.rebuilds if self._native else 0,
+            ),
+            expfmt.Sample(
+                "tpu_scheduler_native_skips_consumed_total", {},
+                self._native.skip_consumed if self._native else 0,
+            ),
             # wave scheduling: waves driven, pods offered per wave
             # (histogram), backfill activity, and the safety counter
             # that must stay 0
@@ -3487,15 +3806,18 @@ class TpuShareScheduler:
             full_nodes.add(node_name)
         else:
             full_nodes.discard(node_name)
-        if self._columns is not None and (
-            was_full != (node_name in full_nodes)
-        ):
+        if was_full != (node_name in full_nodes):
             # port feasibility is a column (SHARED masks read it):
             # most pool mutations ride a leaf delta on the same node,
             # but not all — dirty the row when FULLNESS flips (the
             # only port fact a column holds; same-state mutations
             # leave the row untouched)
-            self._columns._dirty.add(node_name)
+            if self._columns is not None:
+                self._columns._dirty.add(node_name)
+            elif self._native is not None:
+                # never consumes an armed skip: the flip can land
+                # mid-apply, before the reserve's own leaf delta
+                self._native.note_port_flip(node_name)
         # port feasibility is part of a SHARED proposal's read state:
         # fold every pool mutation into the node's read-validation
         # version so a transaction proposed against the old pool
@@ -3565,6 +3887,11 @@ class TpuShareScheduler:
         reclaim = self.tree._reclaim_leaf
         uuids = status.uuids
         n_uuids = len(uuids)
+        # native release lane: the reclaims actually applied, mirrored
+        # into the kernel ahead of the delta notification so the row
+        # moves by the same batched transaction instead of a Python
+        # re-export at the next query (None with the kernel off)
+        native_ops = [] if self._native is not None else None
         for i, leaf in enumerate(status.leaves):
             expected_uuid = uuids[i] if i < n_uuids else leaf.uuid
             if leaf.uuid != expected_uuid:
@@ -3580,8 +3907,14 @@ class TpuShareScheduler:
                 # flattened release lane — all leaves share the node)
                 if multi:
                     reclaim(leaf, 1.0, leaf.full_memory)
+                    if native_ops is not None:
+                        native_ops.append((leaf, 1.0, leaf.full_memory))
                 else:
                     reclaim(leaf, req.request, status.memory)
+                    if native_ops is not None:
+                        native_ops.append(
+                            (leaf, req.request, status.memory)
+                        )
                 touched = leaf
             except ValueError as e:
                 # inventory churn between reserve and release (e.g. chip
@@ -3589,6 +3922,13 @@ class TpuShareScheduler:
                 # delete path
                 self.log.error("release %s: %s", status.key, e)
         if touched is not None:
+            if native_ops:
+                # arms the skip on success, so the notification below
+                # is consumed instead of dirtying the row; an unmapped
+                # row/slot returns False and the delta resyncs as usual
+                self._native.release(
+                    touched.node, touched.leaf_cell_type, native_ops
+                )
             self.tree._apply_leaf_delta(touched)
         if status.port >= C.POD_MANAGER_PORT_START and status.node_name in self.ports:
             pool = self.ports[status.node_name]

@@ -16,7 +16,7 @@ current shared state before applying it:
    is unchanged;
 2. **apply** — ``apply_reservation`` (port + leaf bookkeeping +
    annotation patch + ledger charge: the minimal critical section
-   PROFILE.json's reserve_permit budget demanded), then the ordinary
+   PROFILE.json's reserve+permit_bind budget demanded), then the ordinary
    Permit (quota re-check + gang barrier) and bind;
 3. **conflict** — the shard re-proposes against fresh state, up to
    ``max_retries`` times, then the pod falls back to the sequential
@@ -476,7 +476,7 @@ class ShardedScheduler:
             # issues its own apiserver bind after the cell-state
             # transaction commits (bind races are PR-8's Conflict
             # machinery), so their wall is charged to the winning
-            # shard's lane — and to the reserve_permit phase, exactly
+            # shard's lane — and to the permit_bind phase, exactly
             # where the sequential walk charges bind verbs — not to
             # the serialized commit section
             tb = perf()
@@ -524,8 +524,8 @@ class ShardedScheduler:
             phases = entry[2]
             phases["commit"] = phases.get("commit", 0.0) + dt
             if replica_dt:
-                phases["reserve_permit"] = (
-                    phases.get("reserve_permit", 0.0) + replica_dt
+                phases["permit_bind"] = (
+                    phases.get("permit_bind", 0.0) + replica_dt
                 )
         if replica_dt:
             with self._counter_lock:  # shard threads also add here

@@ -164,9 +164,9 @@ def propose(engine, pod, shard: int, cursor: int,
     try:
         plan = engine.plan_reservation(pod, req, best)
     except Unschedulable:
-        boundary("reserve_permit")
+        boundary("reserve")
         return fallback("no-chips-at-reserve", req, consumed=consumed)
-    boundary("reserve_permit")
+    boundary("reserve")
 
     txn = BindTransaction(
         pod=pod,

@@ -70,7 +70,7 @@ class BindTransaction:
     rec: Optional[object] = None
     rec_meta: tuple = ()             # (tenant, model, shape, guarantee)
     # propose-side sub-phase wall seconds for THIS attempt
-    # (parse/quota/filter/score/reserve_permit/journal) — merged into
+    # (parse/quota/filter/score/reserve/permit_bind/journal) — merged into
     # the engine's cost attribution when the pod finalizes
     phase_seconds: Dict[str, float] = field(default_factory=dict)
 

@@ -284,6 +284,7 @@ class Simulator:
         journal_spool=None,
         obs_plane=None,
         vector: bool = True,
+        native: bool = False,
     ):
         import random
 
@@ -322,6 +323,7 @@ class Simulator:
             migration_cost=migration_cost,
             compaction_interval=compaction_interval,
             vector=vector,
+            native=native,
         )
         # parse the topology ONCE: a rebuild must see the exact config
         # the crashed engine ran, not whatever the path resolves to at

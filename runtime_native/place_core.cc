@@ -1,0 +1,740 @@
+// place_core.cc — the native attempt core behind the columnar store.
+//
+// PROFILE.json's verdict after PR-13 vectorized Filter/Score: the
+// per-attempt wall at 1024 nodes is dominated by the ~40 Python calls
+// of reserve/permit/journal/status bookkeeping (reserve_permit share
+// 0.43-0.47) plus the interpreter constants around the numpy query —
+// work vectorization cannot touch. This kernel ports the HOT HALF of
+// the scheduling walk for vector-eligible attempts to C++ behind a
+// C ABI (loaded via ctypes, no new Python deps):
+//
+//   - feasibility mask over a flat mirror of the per-(node, model)
+//     columns (avail0/mem0/best_mem frontier head, model-scoped
+//     whole-free count, node-cell HBM/health, port-pool fullness);
+//   - composite-key score argmax reproducing pick_top2_seq's
+//     normalize-truncate-then-max-name contract bit for bit (same
+//     float64 expression trees in the same order, truncation via
+//     toward-zero casts, name tie-break == row index over name-sorted
+//     rows);
+//   - reserve-time leaf selection (select_leaves' anchor-free
+//     fractional fast path, the pick-independent whole-chip sort, and
+//     the locality-anchored multi-chip pick loop over a Python-
+//     exported pairwise ici-distance matrix — distances are fixed at
+//     tree build, so the matrix is exported once per row build);
+//   - the reserve-side leaf/row/cell bookkeeping applied to the
+//     mirror as ONE batched transaction, so the next native attempt
+//     reads post-reserve state without a Python round trip.
+//
+// The decision comes back as a compact PCDecision record; Python
+// (kubeshare_tpu/scheduler/native.py) converts it into the existing
+// ReservationPlan / PodStatus / journal writes, which stay
+// authoritative. MEMORY OWNERSHIP: the store and everything in it is
+// allocated and freed HERE (pc_store_new/pc_store_free); Python never
+// holds a pointer into it beyond the opaque handle, and every array
+// crossing the ABI is caller-owned and fully consumed before the call
+// returns. Python owns the cell tree; the mirror resyncs from it via
+// row re-export whenever a non-native mutation dirties a node.
+//
+// Decision identity with the Python engine is the contract: every
+// expression here mirrors scheduler/columns.py::_refresh_row,
+// scoring.py::pick_top2_seq / select_leaves / _select_whole_leaves /
+// _resolved_memory term for term. Compile with -ffp-contract=off and
+// never -ffast-math: FMA contraction or reassociation would break the
+// bit-equality the in-engine oracle and the differential suite pin.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr double kEps = 1e-6;            // cells/cell.py _EPS
+constexpr double kLocalityWeight = 10.0; // scoring.LOCALITY_WEIGHT
+
+}  // namespace
+
+extern "C" {
+
+// Bump on ANY layout or semantic change: Python refuses a mismatched
+// library instead of reading garbage through stale struct offsets.
+enum { PC_ABI_VERSION = 1 };
+
+enum { PC_MAX_SELECT = 64 };
+
+enum {
+  PC_OK = 0,         // winner picked, leaves selected (and reserved)
+  PC_NO_FIT = 1,     // empty candidate mask
+  PC_NO_CHIPS = 2,   // winner picked but selection found no leaves
+                     // ("no chips left at reserve time")
+  PC_ERR_ARGS = -1,  // bad row index / oversized request
+};
+
+enum { PC_KIND_SHARED = 0, PC_KIND_MULTI = 1 };
+
+typedef struct PCRequest {
+  int32_t kind;        // PC_KIND_*
+  int32_t guarantee;   // 1 = guarantee class (priority > 0)
+  int32_t chip_count;  // whole chips (MULTI); <= PC_MAX_SELECT
+  int32_t _pad;        // explicit: layout must match ctypes exactly
+  double request;      // fractional request (SHARED)
+  int64_t memory;      // requested HBM bytes (<= 0: proportional default)
+} PCRequest;
+
+typedef struct PCDecision {
+  int32_t status;    // PC_*
+  int32_t feasible;  // candidate count (mask population)
+  int32_t winner;    // row index, -1 when none
+  int32_t runner;    // row index, -1 when none
+  double winner_score;  // RAW scores (pick_top2_seq contract)
+  double runner_score;
+  int32_t n_leaves;  // selected leaf slots on the winner row
+  int32_t reserved;  // 1 = the mirror transaction was applied
+  int32_t leaf_slot[PC_MAX_SELECT];
+  int64_t leaf_mem[PC_MAX_SELECT];  // resolved HBM charged per leaf
+  int64_t total_mem;
+} PCDecision;
+
+}  // extern "C" (structs); functions follow below
+
+namespace {
+
+struct Row {
+  int32_t n = 0;
+  // leaf lanes, in leaves_view (tree) order — the order every scalar
+  // accumulation walks, which the score recompute must reproduce
+  std::vector<double> avail;
+  std::vector<double> prio;
+  std::vector<int64_t> fmem;
+  std::vector<int64_t> full;
+  std::vector<uint8_t> healthy;
+  // pairwise ici_distance matrix (n*n, row-major), exported from
+  // Python at row build: distances are a pure function of cell
+  // position (fixed at tree build), so accounting deltas never
+  // invalidate it. Empty only for n == 0.
+  std::vector<double> dist;
+};
+
+// Derived columns live as STRUCTURE-OF-ARRAYS on the store, not on
+// the rows: the mask pass touches every row per attempt, and pulling
+// one cache line of avail0 values beats chasing 200-byte Row structs
+// (measured ~3x on the 1024-row attempt call).
+struct Store {
+  std::vector<Row> rows;
+  std::vector<double> avail0;
+  std::vector<int64_t> mem0;
+  std::vector<int64_t> best_mem;
+  std::vector<int32_t> whole;
+  std::vector<int64_t> cell_mem;
+  std::vector<uint8_t> cell_ok;
+  std::vector<uint8_t> simple;
+  std::vector<uint8_t> port_full;
+  std::vector<double> opp;
+  std::vector<double> guar;
+  int32_t nonsimple = 0;
+  // query scratch, reused across attempts (zero steady-state allocs)
+  std::vector<uint8_t> mask;
+  std::vector<int32_t> cand;
+  std::vector<int32_t> pool;
+  std::vector<int32_t> picked;
+  std::vector<double> keys;
+};
+
+inline bool whole_free(const Row& r, int32_t j) {
+  // columns._refresh_row's inlined is_whole_free: full fractional
+  // capacity AND full HBM free (the row holds only BOUND leaves)
+  const double d = r.avail[j] - 1.0;
+  return r.fmem[j] == r.full[j] && -1e-6 <= d && d <= 1e-6;
+}
+
+// Mirror of columns._refresh_row: one fused pass, the accumulation
+// order per column matching the scalar scoring functions exactly.
+void recompute_row(Store& s, int32_t row) {
+  Row& r = s.rows[static_cast<size_t>(row)];
+  double best_a = -1.0;
+  int64_t best_am = -1;
+  int64_t best_m = -1;
+  double opp = 0.0;
+  double free_leaves = 0.0;
+  double guar = 0.0;
+  int32_t whole = 0;
+  const int32_t n = r.n;
+  for (int32_t j = 0; j < n; ++j) {
+    const double avail = r.avail[j];
+    const double prio = r.prio[j];
+    const int64_t mem = r.fmem[j];
+    const bool w = whole_free(r, j);
+    // opportunistic_node_score, term for term
+    opp += prio;
+    if (w) {
+      free_leaves += 1.0;
+      whole += 1;
+    } else {
+      opp += (1.0 - avail) * 100.0;
+    }
+    // guarantee_node_score with no anchors, term for term
+    guar += prio - (1.0 - avail) * 100.0;
+    if (r.healthy[j]) {
+      if (avail > best_a || (avail == best_a && mem > best_am)) {
+        best_a = avail;
+        best_am = mem;
+      }
+      if (mem > best_m) best_m = mem;
+    }
+  }
+  if (n) {
+    const double fn = static_cast<double>(n);
+    opp = (opp - free_leaves / fn * 100.0) / fn;
+    guar = guar / fn;
+  }
+  s.avail0[row] = best_a;
+  s.mem0[row] = best_am;
+  s.best_mem[row] = best_m;
+  s.whole[row] = whole;
+  s.opp[row] = opp;
+  s.guar[row] = guar;
+}
+
+inline bool row_feasible(const Store& s, int32_t i,
+                         const PCRequest* rq) {
+  if (rq->kind == PC_KIND_MULTI) {
+    // simple rows only: Python gates MULTI attempts off the native
+    // path while any non-simple row exists (columns resolves those
+    // through the scalar aggregate; here they are a fallback)
+    if (!s.cell_ok[i]) return false;
+    if (s.whole[i] < rq->chip_count) return false;
+    if (rq->memory > 0 && s.cell_mem[i] < rq->memory) return false;
+    return true;
+  }
+  if (s.port_full[i]) return false;
+  if (s.avail0[i] < rq->request - kEps) return false;
+  if (rq->memory <= 0) return true;
+  if (s.mem0[i] >= rq->memory) return true;
+  if (s.best_mem[i] >= rq->memory) {
+    // multi-point frontier: the max-available leaf lacks the HBM but
+    // some leaf has it — the lanes answer exactly what the scalar
+    // shared_fits resolve answers (exists a healthy leaf dominating
+    // (request, memory)), no Python round trip needed
+    const Row& r = s.rows[static_cast<size_t>(i)];
+    for (int32_t j = 0; j < r.n; ++j) {
+      if (r.healthy[j] && r.avail[j] >= rq->request - kEps &&
+          r.fmem[j] >= rq->memory) {
+        return true;
+      }
+    }
+  }
+  return false;
+}
+
+// Python int(): truncation toward zero (operands are non-negative on
+// every path here, so this is a plain cast).
+inline int64_t py_int(double v) { return static_cast<int64_t>(v); }
+
+inline int64_t resolved_memory(const Row& r, int32_t j,
+                               const PCRequest* rq) {
+  // scoring._resolved_memory: unset HBM defaults to a proportional
+  // slice of the chosen chip
+  if (rq->memory > 0) return rq->memory;
+  return py_int(rq->request * static_cast<double>(r.full[j]));
+}
+
+// select_leaves' anchor-free fractional fast path, slot-for-slot.
+int32_t select_shared(const Row& r, const PCRequest* rq) {
+  int32_t best = -1;
+  double best_score = 0.0;
+  const bool guarantee = rq->guarantee != 0;
+  const double floor = rq->request - kEps;
+  for (int32_t j = 0; j < r.n; ++j) {
+    if (!r.healthy[j]) continue;
+    const double avail = r.avail[j];
+    if (avail < floor) continue;
+    const int64_t need = rq->memory > 0
+        ? rq->memory
+        : py_int(rq->request * static_cast<double>(r.full[j]));
+    if (r.fmem[j] < need) continue;
+    const double usage = (1.0 - avail) * 100.0;
+    const double score =
+        guarantee ? r.prio[j] - usage : r.prio[j] + usage;
+    if (best < 0 || score > best_score) {
+      best = j;
+      best_score = score;
+    }
+  }
+  return best;
+}
+
+// scoring._locality_penalty over the picked set: accumulate in picked
+// order, divide by count, scale — same float ops as Python.
+inline double locality_penalty(const Row& r, int32_t j,
+                               const std::vector<int32_t>& picked) {
+  if (picked.empty()) return 0.0;
+  double total = 0.0;
+  const double* drow = r.dist.data() + static_cast<size_t>(j) * r.n;
+  for (const int32_t p : picked) total += drow[p];
+  return total / static_cast<double>(picked.size()) * kLocalityWeight;
+}
+
+// scoring._select_whole_leaves: candidates are healthy whole-free
+// leaves in slot (tree) order; either one stable priority sort or the
+// per-pick anchored re-sort loop.
+int32_t select_multi(Store& s, const Row& r, const PCRequest* rq,
+                     PCDecision* out) {
+  auto& cand = s.cand;
+  cand.clear();
+  for (int32_t j = 0; j < r.n; ++j) {
+    if (r.healthy[j] && whole_free(r, j)) cand.push_back(j);
+  }
+  const int32_t count = rq->chip_count;
+  if (static_cast<int32_t>(cand.size()) < count) return 0;
+  if (!rq->guarantee || count == 1) {
+    // pick-independent: one stable sort by priority descending
+    // (Python sorts on -float(priority); equal keys keep slot order)
+    std::stable_sort(cand.begin(), cand.end(),
+                     [&r](int32_t a, int32_t b) {
+                       return r.prio[a] > r.prio[b];
+                     });
+    for (int32_t k = 0; k < count; ++k) out->leaf_slot[k] = cand[k];
+    return count;
+  }
+  // guarantee multi-pick: each pick anchored to the picks before it.
+  // Python stable-sorts the pool by -(prio - penalty) each round and
+  // pops the front; the penalty reads the exported distance matrix.
+  auto& pool = s.pool;
+  auto& picked = s.picked;
+  auto& keys = s.keys;
+  pool = cand;
+  picked.clear();
+  if (keys.size() < r.avail.size()) keys.resize(r.avail.size());
+  for (int32_t k = 0; k < count; ++k) {
+    for (const int32_t j : pool) {
+      keys[j] = r.prio[j] - locality_penalty(r, j, picked);
+    }
+    std::stable_sort(pool.begin(), pool.end(),
+                     [&keys](int32_t a, int32_t b) {
+                       return keys[a] > keys[b];
+                     });
+    picked.push_back(pool.front());
+    pool.erase(pool.begin());
+  }
+  for (int32_t k = 0; k < count; ++k) out->leaf_slot[k] = picked[k];
+  return count;
+}
+
+// Selection + the batched mirror reserve on the already-picked
+// winner — the shared tail of the uniform-score shortcut and the
+// general pick pass.
+int32_t finish_selection(Store* s, const PCRequest* rq,
+                         int32_t do_reserve, PCDecision* out) {
+  const int32_t best = out->winner;
+  Row& w = s->rows[static_cast<size_t>(best)];
+  int32_t n_sel = 0;
+  if (rq->kind == PC_KIND_MULTI) {
+    n_sel = select_multi(*s, w, rq, out);
+    for (int32_t k = 0; k < n_sel; ++k) {
+      out->leaf_mem[k] = w.full[out->leaf_slot[k]];
+      out->total_mem += out->leaf_mem[k];
+    }
+  } else {
+    const int32_t j = select_shared(w, rq);
+    if (j >= 0) {
+      n_sel = 1;
+      out->leaf_slot[0] = j;
+      out->leaf_mem[0] = resolved_memory(w, j, rq);
+      out->total_mem = out->leaf_mem[0];
+    }
+  }
+  out->n_leaves = n_sel;
+  if (n_sel == 0) {
+    out->status = PC_NO_CHIPS;
+    return out->status;
+  }
+  if (do_reserve) {
+    // the batched mirror transaction: leaf lanes, node-cell HBM, and
+    // the row's derived columns move together — the next native
+    // attempt reads post-reserve state with no Python round trip
+    for (int32_t k = 0; k < n_sel; ++k) {
+      const int32_t j = out->leaf_slot[k];
+      const double take =
+          rq->kind == PC_KIND_MULTI ? 1.0 : rq->request;
+      double v = w.avail[j] - take;
+      if (v <= 0.0) v = 0.0;  // Python: max(0.0, available - request)
+      w.avail[j] = v;
+      w.fmem[j] -= out->leaf_mem[k];
+    }
+    if (s->cell_mem[best] >= 0) s->cell_mem[best] -= out->total_mem;
+    recompute_row(*s, best);
+    out->reserved = 1;
+  }
+  out->status = PC_OK;
+  return out->status;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t pc_abi_version(void) { return PC_ABI_VERSION; }
+int32_t pc_max_select(void) { return PC_MAX_SELECT; }
+int64_t pc_sizeof_request(void) { return sizeof(PCRequest); }
+int64_t pc_sizeof_decision(void) { return sizeof(PCDecision); }
+
+void* pc_store_new(int32_t n_rows) {
+  if (n_rows < 0) return nullptr;
+  Store* s = new Store();
+  const size_t n = static_cast<size_t>(n_rows);
+  s->rows.resize(n);
+  s->avail0.assign(n, -1.0);
+  s->mem0.assign(n, -1);
+  s->best_mem.assign(n, -1);
+  s->whole.assign(n, 0);
+  s->cell_mem.assign(n, -1);
+  s->cell_ok.assign(n, 0);
+  s->simple.assign(n, 1);
+  s->port_full.assign(n, 0);
+  s->opp.assign(n, 0.0);
+  s->guar.assign(n, 0.0);
+  s->mask.assign(n, 0);
+  return s;
+}
+
+void pc_store_free(void* store) { delete static_cast<Store*>(store); }
+
+int32_t pc_store_rows(void* store) {
+  return static_cast<int32_t>(static_cast<Store*>(store)->rows.size());
+}
+
+// Full (re)export of one row: leaf lanes in tree order, structural
+// facts, and the pairwise distance matrix (may be NULL for n <= 1 —
+// the anchored pick never reads it then). Recomputes the row's
+// derived columns before returning.
+int32_t pc_set_row(void* store, int32_t row, int32_t n_leaves,
+                   const double* avail, const int64_t* free_mem,
+                   const int64_t* full_mem, const double* prio,
+                   const uint8_t* healthy, int32_t simple,
+                   int32_t cell_ok, int64_t cell_mem, int32_t port_full,
+                   const double* pair_dist) {
+  Store* s = static_cast<Store*>(store);
+  if (row < 0 || static_cast<size_t>(row) >= s->rows.size() ||
+      n_leaves < 0) {
+    return PC_ERR_ARGS;
+  }
+  Row& r = s->rows[static_cast<size_t>(row)];
+  if (s->simple[row] == 0) s->nonsimple -= 1;
+  const size_t n = static_cast<size_t>(n_leaves);
+  r.n = n_leaves;
+  r.avail.assign(avail, avail + n);
+  r.fmem.assign(free_mem, free_mem + n);
+  r.full.assign(full_mem, full_mem + n);
+  r.prio.assign(prio, prio + n);
+  r.healthy.assign(healthy, healthy + n);
+  if (pair_dist != nullptr) {
+    r.dist.assign(pair_dist, pair_dist + n * n);
+  } else {
+    r.dist.clear();
+  }
+  s->simple[row] = simple ? 1 : 0;
+  if (s->simple[row] == 0) s->nonsimple += 1;
+  s->cell_ok[row] = cell_ok ? 1 : 0;
+  s->cell_mem[row] = cell_mem;
+  s->port_full[row] = port_full ? 1 : 0;
+  recompute_row(*s, row);
+  return PC_OK;
+}
+
+int32_t pc_set_port_full(void* store, int32_t row, int32_t full) {
+  Store* s = static_cast<Store*>(store);
+  if (row < 0 || static_cast<size_t>(row) >= s->rows.size()) {
+    return PC_ERR_ARGS;
+  }
+  s->port_full[row] = full ? 1 : 0;
+  return PC_OK;
+}
+
+int32_t pc_nonsimple(void* store) {
+  return static_cast<Store*>(store)->nonsimple;
+}
+
+// Apply external accounting deltas (the release/reclaim lane): per
+// slot, avail += d_request and free HBM += d_mem (negative = take).
+// Adjusts the node-cell HBM by the summed delta — exactly what the
+// Python tree's ancestor propagation does — then recomputes the row.
+int32_t pc_apply(void* store, int32_t row, int32_t n,
+                 const int32_t* slots, const double* d_request,
+                 const int64_t* d_mem) {
+  Store* s = static_cast<Store*>(store);
+  if (row < 0 || static_cast<size_t>(row) >= s->rows.size() || n < 0) {
+    return PC_ERR_ARGS;
+  }
+  Row& r = s->rows[static_cast<size_t>(row)];
+  int64_t total = 0;
+  for (int32_t k = 0; k < n; ++k) {
+    const int32_t j = slots[k];
+    if (j < 0 || j >= r.n) return PC_ERR_ARGS;
+    double v = r.avail[j] + d_request[k];
+    if (v <= 0.0) v = 0.0;  // Python: max(0.0, available - request)
+    r.avail[j] = v;
+    r.fmem[j] += d_mem[k];
+    total += d_mem[k];
+  }
+  if (s->cell_mem[row] >= 0) s->cell_mem[row] += total;
+  recompute_row(*s, row);
+  return PC_OK;
+}
+
+// Candidate mask as row indices (oracle / cold path — the rejection
+// classifier and the differential tests read it; pc_attempt itself
+// never materializes the list).
+int32_t pc_feasible(void* store, const PCRequest* rq, int32_t* out_rows,
+                    int32_t cap) {
+  Store* s = static_cast<Store*>(store);
+  int32_t count = 0;
+  const int32_t n = static_cast<int32_t>(s->rows.size());
+  for (int32_t i = 0; i < n; ++i) {
+    if (row_feasible(*s, i, rq)) {
+      if (out_rows != nullptr && count < cap) out_rows[count] = i;
+      ++count;
+    }
+  }
+  return count;
+}
+
+// One native attempt: mask + pick_top2 + leaf selection (+ the mirror
+// reserve transaction when do_reserve). Returns PC_OK/PC_NO_FIT/
+// PC_NO_CHIPS (also left in out->status).
+int32_t pc_attempt(void* store, const PCRequest* rq, int32_t do_reserve,
+                   PCDecision* out) {
+  Store* s = static_cast<Store*>(store);
+  out->feasible = 0;
+  out->winner = -1;
+  out->runner = -1;
+  out->winner_score = 0.0;
+  out->runner_score = 0.0;
+  out->n_leaves = 0;
+  out->reserved = 0;
+  out->total_mem = 0;
+  if (rq->kind == PC_KIND_MULTI &&
+      (rq->chip_count <= 0 || rq->chip_count > PC_MAX_SELECT)) {
+    out->status = PC_ERR_ARGS;
+    return out->status;
+  }
+  const int32_t n = static_cast<int32_t>(s->rows.size());
+  // ONE mask pass over the SoA columns, caching the verdicts and the
+  // raw-score min/max (pick_top2_seq computes lo/hi before its
+  // bucket loop); the pick pass reads the cached mask instead of
+  // re-evaluating feasibility
+  int32_t count = 0;
+  double lo = 0.0, hi = 0.0;
+  const bool guarantee = rq->guarantee != 0;
+  uint8_t* mask = s->mask.data();
+  const double* scores =
+      guarantee ? s->guar.data() : s->opp.data();
+  // Specialized branchless mask loops for the two dominant request
+  // shapes — the compiler vectorizes these, and the general
+  // row_feasible walk survives for everything else (HBM-capped
+  // fractional requests with their exact-scan ambiguity resolve).
+  if (rq->kind != PC_KIND_MULTI && rq->memory <= 0) {
+    const double floor_req = rq->request - kEps;
+    const double* avail0 = s->avail0.data();
+    const uint8_t* port_full = s->port_full.data();
+    for (int32_t i = 0; i < n; ++i) {
+      mask[i] = (avail0[i] >= floor_req) & (port_full[i] == 0);
+    }
+  } else if (rq->kind == PC_KIND_MULTI) {
+    const int32_t chips = rq->chip_count;
+    const int64_t memory = rq->memory;
+    const int32_t* whole = s->whole.data();
+    const uint8_t* cell_ok = s->cell_ok.data();
+    const int64_t* cell_mem = s->cell_mem.data();
+    if (memory > 0) {
+      for (int32_t i = 0; i < n; ++i) {
+        mask[i] = (cell_ok[i] != 0) & (whole[i] >= chips) &
+                  (cell_mem[i] >= memory);
+      }
+    } else {
+      for (int32_t i = 0; i < n; ++i) {
+        mask[i] = (cell_ok[i] != 0) & (whole[i] >= chips);
+      }
+    }
+  } else {
+    for (int32_t i = 0; i < n; ++i) {
+      mask[i] = row_feasible(*s, i, rq);
+    }
+  }
+  for (int32_t i = 0; i < n; ++i) {
+    if (!mask[i]) continue;
+    const double v = scores[i];
+    if (count == 0) {
+      lo = hi = v;
+    } else {
+      if (v < lo) lo = v;
+      if (v > hi) hi = v;
+    }
+    ++count;
+  }
+  out->feasible = count;
+  if (count == 0) {
+    out->status = PC_NO_FIT;
+    return out->status;
+  }
+  int32_t best = -1, runner = -1;
+  double best_raw = 0.0, runner_raw = 0.0;
+  if (lo == hi) {
+    // uniform scores (unloaded / evenly-loaded pool): every candidate
+    // lands in one bucket and the name tie-break alone decides —
+    // winner and runner-up are the last two masked rows (the same
+    // shortcut columns.query takes; ≡ pick_top2_seq, proven there)
+    for (int32_t i = n - 1; i >= 0; --i) {
+      if (!mask[i]) continue;
+      if (best < 0) {
+        best = i;
+        best_raw = lo;
+      } else {
+        runner = i;
+        runner_raw = lo;
+        break;
+      }
+    }
+    out->winner = best;
+    out->runner = count > 1 ? runner : -1;
+    out->winner_score = best_raw;
+    out->runner_score = count > 1 ? runner_raw : 0.0;
+    return finish_selection(s, rq, do_reserve, out);
+  }
+  // pass 2: pick_top2_seq, term for term — same shift/span/truncation
+  // arithmetic, tie-break on name == row index (rows are name-sorted)
+  const double shift = lo < 0.0 ? -lo : 0.0;
+  double hi2 = hi + shift;
+  double lo2 = shift != 0.0 ? 0.0 : lo;
+  double span = 0.0;
+  bool use_span = false;
+  if (hi2 > 100.0) {
+    span = hi2 - lo2;
+    if (span == 0.0) span = 100.0;
+    use_span = true;
+  }
+  int64_t best_b = 0, runner_b = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    if (!mask[i]) continue;
+    const double raw = scores[i];
+    const int64_t b = use_span
+        ? py_int(100.0 * (raw + shift - lo2) / span)
+        : py_int(raw + shift);
+    if (best < 0 || b > best_b || (b == best_b && i > best)) {
+      runner = best;
+      runner_b = best_b;
+      runner_raw = best_raw;
+      best = i;
+      best_b = b;
+      best_raw = raw;
+    } else if (runner < 0 || b > runner_b ||
+               (b == runner_b && i > runner)) {
+      runner = i;
+      runner_b = b;
+      runner_raw = raw;
+    }
+  }
+  out->winner = best;
+  out->runner = count > 1 ? runner : -1;
+  out->winner_score = best_raw;
+  out->runner_score = count > 1 ? runner_raw : 0.0;
+  return finish_selection(s, rq, do_reserve, out);
+}
+
+// Scalar-args spelling of pc_attempt: the per-attempt hot entry.
+// ctypes converts plain scalars faster than it writes Structure
+// fields, and the attempt path runs once per pod — the struct form
+// stays for tests/tools and as the documented ABI record.
+int32_t pc_attempt_args(void* store, int32_t kind, int32_t guarantee,
+                        int32_t chip_count, double request,
+                        int64_t memory, int32_t do_reserve,
+                        PCDecision* out) {
+  PCRequest rq;
+  rq.kind = kind;
+  rq.guarantee = guarantee;
+  rq.chip_count = chip_count;
+  rq._pad = 0;
+  rq.request = request;
+  rq.memory = memory;
+  return pc_attempt(store, &rq, do_reserve, out);
+}
+
+// Row-column peek for tests/debugging: field 0..9 = avail0, mem0,
+// best_mem, whole, cell_mem, cell_ok, simple, port_full, opp, guar.
+double pc_row_stat(void* store, int32_t row, int32_t field) {
+  Store* s = static_cast<Store*>(store);
+  if (row < 0 || static_cast<size_t>(row) >= s->rows.size()) return -1e18;
+  switch (field) {
+    case 0: return s->avail0[row];
+    case 1: return static_cast<double>(s->mem0[row]);
+    case 2: return static_cast<double>(s->best_mem[row]);
+    case 3: return static_cast<double>(s->whole[row]);
+    case 4: return static_cast<double>(s->cell_mem[row]);
+    case 5: return static_cast<double>(s->cell_ok[row]);
+    case 6: return static_cast<double>(s->simple[row]);
+    case 7: return static_cast<double>(s->port_full[row]);
+    case 8: return s->opp[row];
+    case 9: return s->guar[row];
+    default: return -1e18;
+  }
+}
+
+// ---- struct-layout round-trip probes --------------------------------
+//
+// The ctypes Structures on the Python side must agree with these
+// structs field for field — offsets, widths, signedness, endianness,
+// and the padding the compiler inserts. pc_probe_fill writes a
+// deterministic pattern (including negative values, both extremes,
+// and bytes that differ under byte-swapping) for Python to read back;
+// pc_probe_check verifies the mirrored pattern Python wrote. A
+// mismatch returns the 1-based index of the first bad field.
+
+void pc_probe_fill(PCRequest* rq, PCDecision* d) {
+  std::memset(rq, 0, sizeof(*rq));
+  std::memset(d, 0, sizeof(*d));
+  rq->kind = PC_KIND_MULTI;
+  rq->guarantee = -2;                    // sign survives the trip
+  rq->chip_count = 0x01020304;           // endianness-sensitive
+  rq->_pad = 0x7fffffff;                 // padding-adjacent extreme
+  rq->request = -0.5;
+  rq->memory = 0x0102030405060708LL;
+  d->status = PC_NO_CHIPS;
+  d->feasible = -7;
+  d->winner = 0x0a0b0c0d;
+  d->runner = INT32_MIN;
+  d->winner_score = 1.5e300;
+  d->runner_score = -3.25;
+  d->n_leaves = 3;
+  d->reserved = 1;
+  d->leaf_slot[0] = 11;
+  d->leaf_slot[1] = -12;
+  d->leaf_slot[PC_MAX_SELECT - 1] = 0x0504;  // last-element offset
+  d->leaf_mem[0] = INT64_MIN;
+  d->leaf_mem[1] = 0x0807060504030201LL;
+  d->leaf_mem[PC_MAX_SELECT - 1] = -1;
+  d->total_mem = INT64_MAX;
+}
+
+int32_t pc_probe_check(const PCRequest* rq, const PCDecision* d) {
+  if (rq->kind != PC_KIND_SHARED) return 1;
+  if (rq->guarantee != 7) return 2;
+  if (rq->chip_count != -0x01020304) return 3;
+  if (rq->_pad != 0x1234) return 4;
+  if (rq->request != 0.125) return 5;
+  if (rq->memory != -0x0102030405060708LL) return 6;
+  if (d->status != -5) return 7;
+  if (d->feasible != 1024) return 8;
+  if (d->winner != -1) return 9;
+  if (d->runner != 0x00010203) return 10;
+  if (d->winner_score != -2.5) return 11;
+  if (d->runner_score != 6.0e-300) return 12;
+  if (d->n_leaves != PC_MAX_SELECT) return 13;
+  if (d->reserved != -9) return 14;
+  if (d->leaf_slot[0] != INT32_MAX) return 15;
+  if (d->leaf_slot[PC_MAX_SELECT - 1] != -0x0504) return 16;
+  if (d->leaf_mem[0] != 0x1112131415161718LL) return 17;
+  if (d->leaf_mem[PC_MAX_SELECT - 1] != INT64_MIN) return 18;
+  if (d->total_mem != -42) return 19;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,506 @@
+// place_core_stress.cc — hermetic differential stress for the native
+// attempt core (no Python involved; the Python-side identity suite is
+// tests/test_scheduler_native.py).
+//
+// A deliberately naive reference implementation of the same contract
+// (mask / pick_top2 / select / reserve bookkeeping) is re-derived
+// here from scratch — fresh arrays every query, insertion-stable
+// sorts, no incremental state — and pc_attempt must agree with it
+// decision-for-decision across thousands of randomized store states
+// and reserve transactions. What this catches that unit tests don't:
+// scratch-buffer reuse bleeding between attempts, derived-column
+// staleness after the batched mirror transaction, and accumulation-
+// order drift in the score recompute.
+//
+// Usage: place_core_stress [iterations] [seed]
+
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <vector>
+
+// The ABI under test, redeclared as a consumer would see it.
+extern "C" {
+typedef struct PCRequest {
+  int32_t kind;
+  int32_t guarantee;
+  int32_t chip_count;
+  int32_t _pad;
+  double request;
+  int64_t memory;
+} PCRequest;
+
+enum { PC_MAX_SELECT = 64 };
+
+typedef struct PCDecision {
+  int32_t status;
+  int32_t feasible;
+  int32_t winner;
+  int32_t runner;
+  double winner_score;
+  double runner_score;
+  int32_t n_leaves;
+  int32_t reserved;
+  int32_t leaf_slot[PC_MAX_SELECT];
+  int64_t leaf_mem[PC_MAX_SELECT];
+  int64_t total_mem;
+} PCDecision;
+
+uint32_t pc_abi_version(void);
+int64_t pc_sizeof_request(void);
+int64_t pc_sizeof_decision(void);
+void* pc_store_new(int32_t n_rows);
+void pc_store_free(void* store);
+int32_t pc_set_row(void* store, int32_t row, int32_t n_leaves,
+                   const double* avail, const int64_t* free_mem,
+                   const int64_t* full_mem, const double* prio,
+                   const uint8_t* healthy, int32_t simple,
+                   int32_t cell_ok, int64_t cell_mem, int32_t port_full,
+                   const double* pair_dist);
+int32_t pc_apply(void* store, int32_t row, int32_t n,
+                 const int32_t* slots, const double* d_request,
+                 const int64_t* d_mem);
+int32_t pc_feasible(void* store, const PCRequest* rq, int32_t* out_rows,
+                    int32_t cap);
+int32_t pc_attempt(void* store, const PCRequest* rq, int32_t do_reserve,
+                   PCDecision* out);
+void pc_probe_fill(PCRequest* rq, PCDecision* d);
+int32_t pc_probe_check(const PCRequest* rq, const PCDecision* d);
+}
+
+namespace {
+
+constexpr double kEps = 1e-6;
+
+struct RefLeaf {
+  double avail;
+  double prio;
+  int64_t fmem;
+  int64_t full;
+  bool healthy;
+};
+
+struct RefRow {
+  std::vector<RefLeaf> leaves;
+  std::vector<double> dist;  // n*n
+  int64_t cell_mem = -1;
+  bool cell_ok = false;
+  bool port_full = false;
+};
+
+bool ref_whole(const RefLeaf& l) {
+  const double d = l.avail - 1.0;
+  return l.fmem == l.full && -1e-6 <= d && d <= 1e-6;
+}
+
+// Reference scores, re-derived per query (no caching on purpose).
+void ref_scores(const RefRow& r, double* opp_out, double* guar_out) {
+  double opp = 0.0, free_leaves = 0.0, guar = 0.0;
+  for (const RefLeaf& l : r.leaves) {
+    opp += l.prio;
+    if (ref_whole(l)) {
+      free_leaves += 1.0;
+    } else {
+      opp += (1.0 - l.avail) * 100.0;
+    }
+    guar += l.prio - (1.0 - l.avail) * 100.0;
+  }
+  const double fn = static_cast<double>(r.leaves.size());
+  if (fn > 0) {
+    opp = (opp - free_leaves / fn * 100.0) / fn;
+    guar = guar / fn;
+  }
+  *opp_out = opp;
+  *guar_out = guar;
+}
+
+bool ref_feasible(const RefRow& r, const PCRequest& rq) {
+  if (rq.kind == 1) {
+    if (!r.cell_ok) return false;
+    int32_t whole = 0;
+    for (const RefLeaf& l : r.leaves) {
+      if (ref_whole(l)) ++whole;
+    }
+    if (whole < rq.chip_count) return false;
+    if (rq.memory > 0 && r.cell_mem < rq.memory) return false;
+    return true;
+  }
+  if (r.port_full) return false;
+  for (const RefLeaf& l : r.leaves) {
+    if (!l.healthy) continue;
+    if (l.avail < rq.request - kEps) continue;
+    if (rq.memory > 0 && l.fmem < rq.memory) continue;
+    return true;
+  }
+  return false;
+}
+
+// pick_top2_seq, re-derived: names are row indices (already sorted).
+void ref_pick(const std::vector<int32_t>& rows,
+              const std::vector<double>& vals, int32_t* best_out,
+              int32_t* runner_out, double* braw, double* rraw) {
+  double lo = vals[0], hi = vals[0];
+  for (double v : vals) {
+    if (v < lo) lo = v;
+    if (v > hi) hi = v;
+  }
+  const double shift = lo < 0.0 ? -lo : 0.0;
+  hi += shift;
+  if (shift != 0.0) lo = 0.0;
+  bool use_span = hi > 100.0;
+  double span = hi - lo;
+  if (use_span && span == 0.0) span = 100.0;
+  int32_t best = -1, runner = -1;
+  int64_t best_b = 0, runner_b = 0;
+  double best_raw = 0.0, runner_raw = 0.0;
+  for (size_t i = 0; i < rows.size(); ++i) {
+    const double raw = vals[i];
+    const int64_t b = use_span
+        ? static_cast<int64_t>(100.0 * (raw + shift - lo) / span)
+        : static_cast<int64_t>(raw + shift);
+    const int32_t name = rows[i];
+    if (best < 0 || b > best_b || (b == best_b && name > best)) {
+      runner = best;
+      runner_b = best_b;
+      runner_raw = best_raw;
+      best = name;
+      best_b = b;
+      best_raw = raw;
+    } else if (runner < 0 || b > runner_b ||
+               (b == runner_b && name > runner)) {
+      runner = name;
+      runner_b = b;
+      runner_raw = raw;
+    }
+  }
+  *best_out = best;
+  *runner_out = runner;
+  *braw = best_raw;
+  *rraw = runner_raw;
+}
+
+// Insertion-stable sort by key descending (what Python's stable sort
+// on a negated key does).
+void stable_desc(std::vector<int32_t>& idx,
+                 const std::vector<double>& key) {
+  for (size_t i = 1; i < idx.size(); ++i) {
+    const int32_t v = idx[i];
+    size_t j = i;
+    while (j > 0 && key[idx[j - 1]] < key[v]) {
+      idx[j] = idx[j - 1];
+      --j;
+    }
+    idx[j] = v;
+  }
+}
+
+std::vector<int32_t> ref_select(const RefRow& r, const PCRequest& rq) {
+  std::vector<int32_t> out;
+  const int32_t n = static_cast<int32_t>(r.leaves.size());
+  if (rq.kind == 1) {
+    std::vector<int32_t> cand;
+    for (int32_t j = 0; j < n; ++j) {
+      if (r.leaves[j].healthy && ref_whole(r.leaves[j])) {
+        cand.push_back(j);
+      }
+    }
+    if (static_cast<int32_t>(cand.size()) < rq.chip_count) return out;
+    if (!rq.guarantee || rq.chip_count == 1) {
+      std::vector<double> key(n);
+      for (int32_t j : cand) key[j] = r.leaves[j].prio;
+      stable_desc(cand, key);
+      out.assign(cand.begin(), cand.begin() + rq.chip_count);
+      return out;
+    }
+    std::vector<int32_t> pool = cand;
+    for (int32_t k = 0; k < rq.chip_count; ++k) {
+      std::vector<double> key(n);
+      for (int32_t j : pool) {
+        double pen = 0.0;
+        if (!out.empty()) {
+          double total = 0.0;
+          for (int32_t p : out) total += r.dist[j * n + p];
+          pen = total / static_cast<double>(out.size()) * 10.0;
+        }
+        key[j] = r.leaves[j].prio - pen;
+      }
+      stable_desc(pool, key);
+      out.push_back(pool.front());
+      pool.erase(pool.begin());
+    }
+    return out;
+  }
+  int32_t best = -1;
+  double best_score = 0.0;
+  for (int32_t j = 0; j < n; ++j) {
+    const RefLeaf& l = r.leaves[j];
+    if (!l.healthy) continue;
+    if (l.avail < rq.request - kEps) continue;
+    const int64_t need = rq.memory > 0
+        ? rq.memory
+        : static_cast<int64_t>(rq.request * static_cast<double>(l.full));
+    if (l.fmem < need) continue;
+    const double usage = (1.0 - l.avail) * 100.0;
+    const double score =
+        rq.guarantee ? l.prio - usage : l.prio + usage;
+    if (best < 0 || score > best_score) {
+      best = j;
+      best_score = score;
+    }
+  }
+  if (best >= 0) out.push_back(best);
+  return out;
+}
+
+int failures = 0;
+
+#define CHECK(cond, ...)                                   \
+  do {                                                     \
+    if (!(cond)) {                                         \
+      std::fprintf(stderr, "FAIL %s:%d: ", __FILE__, __LINE__); \
+      std::fprintf(stderr, __VA_ARGS__);                   \
+      std::fprintf(stderr, "\n");                          \
+      if (++failures > 20) std::exit(1);                   \
+    }                                                      \
+  } while (0)
+
+void export_row(void* store, int32_t row, const RefRow& r) {
+  const int32_t n = static_cast<int32_t>(r.leaves.size());
+  std::vector<double> avail(n), prio(n);
+  std::vector<int64_t> fmem(n), full(n);
+  std::vector<uint8_t> healthy(n);
+  for (int32_t j = 0; j < n; ++j) {
+    avail[j] = r.leaves[j].avail;
+    prio[j] = r.leaves[j].prio;
+    fmem[j] = r.leaves[j].fmem;
+    full[j] = r.leaves[j].full;
+    healthy[j] = r.leaves[j].healthy ? 1 : 0;
+  }
+  const int32_t rc = pc_set_row(
+      store, row, n, avail.data(), fmem.data(), full.data(),
+      prio.data(), healthy.data(), /*simple=*/1,
+      r.cell_ok ? 1 : 0, r.cell_mem, r.port_full ? 1 : 0,
+      r.dist.empty() ? nullptr : r.dist.data());
+  CHECK(rc == 0, "pc_set_row rc=%d", rc);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int iterations = argc > 1 ? std::atoi(argv[1]) : 400;
+  const unsigned seed = argc > 2 ? std::atoi(argv[2]) : 1;
+  std::mt19937 rng(seed);
+
+  CHECK(pc_abi_version() == 1, "abi version");
+  CHECK(pc_sizeof_request() == (int64_t)sizeof(PCRequest),
+        "PCRequest size %" PRId64 " vs %zu", pc_sizeof_request(),
+        sizeof(PCRequest));
+  CHECK(pc_sizeof_decision() == (int64_t)sizeof(PCDecision),
+        "PCDecision size");
+
+  // probe round trip, C-side: fill then mirror into the check pattern
+  {
+    PCRequest rq;
+    PCDecision d;
+    pc_probe_fill(&rq, &d);
+    CHECK(rq.chip_count == 0x01020304 && d.total_mem == INT64_MAX,
+          "probe fill pattern");
+    rq.kind = 0;
+    rq.guarantee = 7;
+    rq.chip_count = -0x01020304;
+    rq._pad = 0x1234;
+    rq.request = 0.125;
+    rq.memory = -0x0102030405060708LL;
+    d.status = -5;
+    d.feasible = 1024;
+    d.winner = -1;
+    d.runner = 0x00010203;
+    d.winner_score = -2.5;
+    d.runner_score = 6.0e-300;
+    d.n_leaves = PC_MAX_SELECT;
+    d.reserved = -9;
+    d.leaf_slot[0] = INT32_MAX;
+    d.leaf_slot[PC_MAX_SELECT - 1] = -0x0504;
+    d.leaf_mem[0] = 0x1112131415161718LL;
+    d.leaf_mem[PC_MAX_SELECT - 1] = INT64_MIN;
+    d.total_mem = -42;
+    CHECK(pc_probe_check(&rq, &d) == 0, "probe check");
+  }
+
+  std::uniform_real_distribution<double> frac(0.0, 1.0);
+
+  for (int it = 0; it < iterations; ++it) {
+    const int32_t n_rows = 1 + static_cast<int32_t>(rng() % 48);
+    std::vector<RefRow> ref(n_rows);
+    void* store = pc_store_new(n_rows);
+    const int64_t gib = int64_t(1) << 30;
+    for (int32_t i = 0; i < n_rows; ++i) {
+      RefRow& r = ref[i];
+      const int32_t n = static_cast<int32_t>(rng() % 7);
+      r.leaves.resize(n);
+      int64_t cell_free = 0;
+      for (int32_t j = 0; j < n; ++j) {
+        RefLeaf& l = r.leaves[j];
+        const double quarters = static_cast<double>(rng() % 5) / 4.0;
+        l.avail = quarters;
+        l.full = (4 + static_cast<int64_t>(rng() % 13)) * gib;
+        l.fmem = ref_whole(l) ? l.full
+                              : static_cast<int64_t>(
+                                    frac(rng) * static_cast<double>(l.full));
+        if (l.avail == 1.0 && (rng() % 2) == 0) l.fmem = l.full;
+        l.prio = static_cast<double>(rng() % 101);
+        l.healthy = (rng() % 8) != 0;
+        cell_free += l.fmem;
+      }
+      // node-cell HBM can exceed the model's leaves (other models
+      // under the same cell): pad it sometimes
+      r.cell_mem = n ? cell_free + static_cast<int64_t>(rng() % 3) * gib
+                     : -1;
+      r.cell_ok = n > 0 && (rng() % 8) != 0;
+      r.port_full = (rng() % 10) == 0;
+      r.dist.resize(static_cast<size_t>(n) * n);
+      for (int32_t a = 0; a < n; ++a) {
+        for (int32_t b = a; b < n; ++b) {
+          const double d =
+              a == b ? 0.0 : static_cast<double>((rng() % 12) + 1);
+          r.dist[a * n + b] = d;
+          r.dist[b * n + a] = d;
+        }
+      }
+      export_row(store, i, r);
+    }
+
+    // a burst of attempts, some reserving (mirror + reference move
+    // together), interleaved with external reclaims via pc_apply
+    for (int q = 0; q < 40; ++q) {
+      PCRequest rq;
+      std::memset(&rq, 0, sizeof(rq));
+      const bool multi = (rng() % 3) == 0;
+      rq.kind = multi ? 1 : 0;
+      rq.guarantee = (rng() % 2);
+      rq.chip_count = multi ? 1 + static_cast<int32_t>(rng() % 5) : 0;
+      rq.request = multi ? static_cast<double>(rq.chip_count)
+                         : static_cast<double>(1 + rng() % 4) / 4.0;
+      rq.memory = (rng() % 3) == 0
+          ? 0
+          : static_cast<int64_t>(rng() % 18) * (gib / 2);
+
+      // reference verdicts
+      std::vector<int32_t> rows;
+      std::vector<double> vals;
+      for (int32_t i = 0; i < n_rows; ++i) {
+        if (!ref_feasible(ref[i], rq)) continue;
+        double opp, guar;
+        ref_scores(ref[i], &opp, &guar);
+        rows.push_back(i);
+        vals.push_back(rq.guarantee ? guar : opp);
+      }
+
+      // mask agreement
+      std::vector<int32_t> got(n_rows);
+      const int32_t got_n =
+          pc_feasible(store, &rq, got.data(), n_rows);
+      CHECK(got_n == static_cast<int32_t>(rows.size()),
+            "it=%d q=%d mask count %d vs %zu", it, q, got_n,
+            rows.size());
+      for (int32_t k = 0;
+           k < got_n && k < static_cast<int32_t>(rows.size()); ++k) {
+        CHECK(got[k] == rows[k], "mask row %d: %d vs %d", k, got[k],
+              rows[k]);
+      }
+
+      const bool do_reserve = (rng() % 2) == 0;
+      PCDecision dec;
+      pc_attempt(store, &rq, do_reserve ? 1 : 0, &dec);
+      CHECK(dec.feasible == static_cast<int32_t>(rows.size()),
+            "feasible %d vs %zu", dec.feasible, rows.size());
+      if (rows.empty()) {
+        CHECK(dec.status == 1 && dec.winner == -1, "empty mask status");
+        continue;
+      }
+      int32_t best, runner;
+      double braw, rraw;
+      ref_pick(rows, vals, &best, &runner, &braw, &rraw);
+      CHECK(dec.winner == best, "winner %d vs %d", dec.winner, best);
+      CHECK(dec.winner_score == braw, "winner score %.17g vs %.17g",
+            dec.winner_score, braw);
+      if (rows.size() > 1) {
+        CHECK(dec.runner == runner, "runner %d vs %d", dec.runner,
+              runner);
+        CHECK(dec.runner_score == rraw, "runner score");
+      } else {
+        CHECK(dec.runner == -1 && dec.runner_score == 0.0,
+              "single-candidate runner");
+      }
+
+      std::vector<int32_t> sel = ref_select(ref[best], rq);
+      CHECK(dec.n_leaves == static_cast<int32_t>(sel.size()),
+            "n_leaves %d vs %zu (it=%d q=%d)", dec.n_leaves,
+            sel.size(), it, q);
+      for (int32_t k = 0; k < dec.n_leaves; ++k) {
+        CHECK(dec.leaf_slot[k] == sel[k], "slot %d: %d vs %d", k,
+              dec.leaf_slot[k], sel[k]);
+      }
+      if (dec.n_leaves == 0) {
+        CHECK(dec.status == 2 && dec.reserved == 0,
+              "no-chips not reserved");
+        continue;
+      }
+      // resolved memory + the reference-side mirror of the reserve
+      int64_t total = 0;
+      for (int32_t k = 0; k < dec.n_leaves; ++k) {
+        RefLeaf& l = ref[best].leaves[dec.leaf_slot[k]];
+        const int64_t want = multi
+            ? l.full
+            : (rq.memory > 0
+                   ? rq.memory
+                   : static_cast<int64_t>(
+                         rq.request * static_cast<double>(l.full)));
+        CHECK(dec.leaf_mem[k] == want, "leaf_mem %" PRId64 " vs %" PRId64,
+              dec.leaf_mem[k], want);
+        total += want;
+        if (do_reserve) {
+          double v = l.avail - (multi ? 1.0 : rq.request);
+          if (v <= 0.0) v = 0.0;
+          l.avail = v;
+          l.fmem -= want;
+        }
+      }
+      CHECK(dec.total_mem == total, "total_mem");
+      CHECK(dec.reserved == (do_reserve ? 1 : 0), "reserved flag");
+      if (do_reserve && ref[best].cell_mem >= 0) {
+        ref[best].cell_mem -= total;
+      }
+
+      // occasionally reclaim something via pc_apply and mirror it
+      if (do_reserve && (rng() % 3) == 0) {
+        const int32_t j = dec.leaf_slot[0];
+        RefLeaf& l = ref[best].leaves[j];
+        const double dr = multi ? 1.0 : rq.request;
+        const int64_t dm = dec.leaf_mem[0];
+        const int32_t slots[1] = {j};
+        const double dreq[1] = {dr};
+        const int64_t dmem[1] = {dm};
+        CHECK(pc_apply(store, best, 1, slots, dreq, dmem) == 0,
+              "pc_apply");
+        double v = l.avail + dr;
+        if (v <= 0.0) v = 0.0;
+        l.avail = v;
+        l.fmem += dm;
+        if (ref[best].cell_mem >= 0) ref[best].cell_mem += dm;
+      }
+    }
+    pc_store_free(store);
+  }
+
+  if (failures) {
+    std::fprintf(stderr, "place_core_stress: %d failures\n", failures);
+    return 1;
+  }
+  std::printf("place_core_stress: OK (%d iterations, seed %u)\n",
+              iterations, seed);
+  return 0;
+}

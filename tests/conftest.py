@@ -15,3 +15,13 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # the tier-1 runner deselects with `-m 'not slow'`; register the
+    # marker so using it (tests/test_native_abi.py's clean-rebuild
+    # compile) is not an unknown-mark warning
+    config.addinivalue_line(
+        "markers", "slow: long-running (compiles, big replays); "
+        "excluded from the tier-1 fast pass"
+    )

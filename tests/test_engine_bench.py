@@ -50,6 +50,11 @@ COUNTERS = (
     "column_row_refreshes",
     "column_rebuilds",
     "column_ambiguous_resolves",
+    # PR-14: native attempt core (0 on every row with the kernel off;
+    # the native_ab section's ON arm proves the C path engaged)
+    "native_attempts",
+    "native_fallbacks",
+    "native_row_refreshes",
 )
 
 
@@ -71,7 +76,8 @@ class TestCommittedArtifact:
             for key in COUNTERS:
                 assert key in r["counters"], (r["nodes"], key)
         assert doc["scaling_ratio_1024_over_32"] > 0
-        for section in ("backlog", "gang", "journal_ab", "vector_ab"):
+        for section in ("backlog", "gang", "journal_ab", "vector_ab",
+                        "native_ab"):
             assert section in doc, section
 
     def test_recorded_counters_prove_fast_path_engaged(self):
@@ -104,6 +110,9 @@ class TestCommittedArtifact:
             # is structurally gone on these rows
             assert c["score_cache_misses"] == 0, r["nodes"]
             assert c["score_cache_evictions"] == 0, r["nodes"]
+            # PR-14: the native kernel is opt-in (--native); the
+            # standard idle rows run the vector engine
+            assert c["native_attempts"] == 0, r["nodes"]
         off = doc["vector_ab"]["off"]["counters"]
         assert off["vector_attempts"] == 0
         assert off["filter_fast_hits"] > 0
@@ -260,6 +269,43 @@ class TestCommittedArtifact:
         assert len(v["vector_speedup_per_rep"]) >= 3
         assert v["vector_on_placements_per_sec"] > \
             v["vector_off_placements_per_sec"]
+
+    def test_native_ab_recorded(self):
+        """PR-14 tentpole A/B: the native attempt core vs the PR-13
+        vector engine, paired-ratio medians on the engine-core DRAIN
+        protocol (a 2000-pod backlog drained by schedule_wave at 1024
+        nodes — the ported hot path itself, with the sim loop's
+        symmetric per-placement machinery out of the timed window;
+        the artifact also records the diluted full-sim-loop ratio).
+        Decision identity between the arms is pinned by
+        tests/test_scheduler_native.py. The committed figure must
+        show the kernel actually BUYS speed — >= 1.2x paired drain
+        median (measured ~1.3-1.45x on this box; the ISSUE's
+        1.8x-at-1024 acceptance aspiration was NOT reached and
+        CHANGES.md/DESIGN.md say so: with Filter/Score/select and the
+        mirror bookkeeping all in C, the floor is the authoritative
+        Python write tail — PodStatus/ledger/journal/cluster verbs —
+        which is ROADMAP's process-parallel rung, not a
+        single-thread rung)."""
+        doc = _doc()
+        na = doc["native_ab"]
+        assert na["nodes"] == 1024
+        assert na["protocol"] == "drain"
+        assert na["native_speedup"] >= 1.2
+        assert len(na["native_speedup_per_rep"]) >= 5
+        # the end-to-end sim-loop ratio is recorded honestly (diluted
+        # by symmetric sim machinery, must still never LOSE)
+        assert na["sim_loop_speedup"] >= 1.0
+        # mechanism proof: the ON arm was served by the kernel (no
+        # fallbacks on an idle solo trace), the OFF arm by the
+        # columnar path, and both arms placed the same backlog
+        on, off = na["on"], na["off"]
+        assert on["counters"]["native_attempts"] > 0
+        assert on["counters"]["native_fallbacks"] == 0
+        assert on["counters"]["native_skips_consumed"] > 0
+        assert off["counters"]["native_attempts"] == 0
+        assert off["counters"]["vector_attempts"] > 0
+        assert on["bound"] == off["bound"] > 0
 
 
 class TestFreshRunFloor:

@@ -313,6 +313,13 @@ class TestExpositionHygiene:
             ("tpu_scheduler_column_row_refreshes_total", "gauge"),
             ("tpu_scheduler_column_rebuilds_total", "gauge"),
             ("tpu_scheduler_column_ambiguous_resolves_total", "gauge"),
+            # PR-14: native attempt core families
+            ("tpu_scheduler_native_attempts_total", "gauge"),
+            ("tpu_scheduler_native_fallbacks_total", "gauge"),
+            ("tpu_scheduler_native_loaded", "gauge"),
+            ("tpu_scheduler_native_row_refreshes_total", "gauge"),
+            ("tpu_scheduler_native_rebuilds_total", "gauge"),
+            ("tpu_scheduler_native_skips_consumed_total", "gauge"),
         ]:
             assert kinds.get(fam) == kind, (fam, kinds.get(fam))
 
@@ -331,6 +338,70 @@ class TestExpositionHygiene:
         assert vals["tpu_scheduler_column_row_refreshes_total"] > 0
         assert vals["tpu_scheduler_column_rebuilds_total"] > 0
         assert vals["tpu_scheduler_vector_numpy"] in (0.0, 1.0)
+
+    def test_native_families_live(self, scraped):
+        """PR-14: the native-core families export on every engine
+        (0s with the kernel off — this fixture runs the vector
+        engine, so loaded must be 0 and attempts 0 while the
+        families still scrape cleanly end-to-end). A kernel-backed
+        live scrape is exercised separately when the .so is built."""
+        parsed = expfmt.parse(scraped)
+        vals = {
+            s.name: s.value for s in parsed
+            if s.name.startswith("tpu_scheduler_native")
+        }
+        assert vals["tpu_scheduler_native_loaded"] == 0
+        assert vals["tpu_scheduler_native_attempts_total"] == 0
+        assert vals["tpu_scheduler_native_fallbacks_total"] == 0
+
+    def test_native_engine_scrape(self):
+        """With the kernel built, a native engine's bind rides the C
+        path and the families carry real values through a live
+        /metrics scrape (skips cleanly on a compiler-less box)."""
+        import pytest
+
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.scheduler import constants as SC
+        from kubeshare_tpu.scheduler.native import load_place_core
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        lib, why = load_place_core()
+        if lib is None:
+            pytest.skip(f"libplace_core.so unavailable: {why}")
+        cluster = FakeCluster()
+        cluster.add_node("nat-a", [
+            ChipInfo(f"nat-a-c{j}", "tpu-v5e", 16 << 30, j)
+            for j in range(4)
+        ])
+        topo = {
+            "cell_types": {"v5e-node": {
+                "child_cell_type": "tpu-v5e", "child_cell_number": 4,
+                "child_cell_priority": 50, "is_node_level": True,
+            }},
+            "cells": [{"cell_type": "v5e-node", "cell_id": "nat-a"}],
+        }
+        eng = TpuShareScheduler(topo, cluster, clock=lambda: 0.0,
+                                native=True)
+        assert eng._native is not None
+        d = eng.schedule_one(cluster.create_pod(Pod(
+            name="np", namespace="t",
+            labels={SC.LABEL_TPU_REQUEST: "0.5",
+                    SC.LABEL_TPU_LIMIT_ALIASES[1]: "1.0"},
+            scheduler_name=SC.SCHEDULER_NAME,
+        )))
+        assert d.status == "bound"
+        text = expfmt.render(eng.utilization_samples())
+        vals = {
+            s.name: s.value for s in expfmt.parse(text)
+            if s.name.startswith("tpu_scheduler_native")
+        }
+        assert vals["tpu_scheduler_native_loaded"] == 1
+        assert vals["tpu_scheduler_native_attempts_total"] == 1
+        assert vals["tpu_scheduler_native_fallbacks_total"] == 0
+        assert vals["tpu_scheduler_native_rebuilds_total"] >= 1
+        assert vals["tpu_scheduler_native_skips_consumed_total"] >= 1
 
     def test_alert_rules_all_exported(self, scraped):
         """Every standard rule exports an active gauge AND a fired
@@ -481,8 +552,8 @@ class TestExpositionHygiene:
             for s in select("tpu_scheduler_cost_seconds_total")
         }
         assert set(phases) == {
-            "parse", "quota", "filter", "score", "reserve_permit",
-            "journal", "commit", "migrate",
+            "parse", "quota", "filter", "score", "reserve",
+            "permit_bind", "journal", "commit", "migrate",
         }
         assert sum(phases.values()) > 0
         # the shard plane's one commit charged the arbiter critical

@@ -465,6 +465,49 @@ class TestCostSentinel:
         assert st.active and st.fired_total == 1
         assert st.last_context["phase"] in ("filter", "score")
 
+    def test_phase_drift_ignores_single_gc_stall(self):
+        """PR-14: the graded share is the MEDIAN over three
+        sub-windows — one step where a stall lands 10x the usual
+        wall in a single phase (a GC pause inside reserve) must NOT
+        page, even though that sub-window's share alone drifts far
+        past the threshold."""
+        phases = {"filter": 0.0, "score": 0.0}
+
+        def grow(f, s):
+            phases["filter"] += f
+            phases["score"] += s
+
+        steps = [lambda: grow(0.008, 0.002)] * 45
+        # one 80ms stall charged to score (usual step total is 10ms)
+        steps[40] = lambda: grow(0.008, 0.082)
+        rule = phase_drift_rule(lambda: dict(phases), COST_CFG)
+        ev = self._drive(rule, steps)
+        st = ev.state(RULE_PHASE_DRIFT)
+        assert st.fired_total == 0, st.last_context
+        assert not st.active
+
+    def test_phase_drift_median_actually_computed_per_subwindow(self):
+        """A sustained flip confined to the NEWEST third of the slow
+        window must not fire yet (median still steady), proving the
+        rule grades three genuine sub-windows rather than one
+        whole-window share."""
+        phases = {"filter": 0.0, "score": 0.0}
+
+        def grow(f, s):
+            phases["filter"] += f
+            phases["score"] += s
+
+        rule = phase_drift_rule(lambda: dict(phases), COST_CFG)
+        # 40 steady steps (seeds baselines once the window fills),
+        # then 9 flipped steps = 90s < slow_window/3 (100s): only the
+        # newest sub-window sees the flip
+        ev = self._drive(
+            rule,
+            [lambda: grow(0.008, 0.002)] * 40
+            + [lambda: grow(0.002, 0.008)] * 9,
+        )
+        assert ev.state(RULE_PHASE_DRIFT).fired_total == 0
+
     def test_phase_drift_quiet_on_steady_mix(self):
         phases = {"filter": 0.0, "score": 0.0}
 

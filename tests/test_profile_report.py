@@ -28,8 +28,12 @@ ARTIFACT = os.path.join(REPO, "PROFILE.json")
 # (no shard plane in these replays) but always exported, so coverage
 # sums are unchanged while the phase vocabulary includes it; same for
 # "migrate" (PR-12) — 0.0 with the migration plane off
-PHASES = {"parse", "quota", "filter", "score", "reserve_permit",
-          "journal", "commit", "migrate"}
+# reserve/permit_bind split reserve_permit in PR-14 (the native
+# kernel's reserve-side win must be attributable); "commit" is PR-11's
+# arbiter critical section and "migrate" PR-12's lane — both always
+# exported, 0.0 when their plane is off
+PHASES = {"parse", "quota", "filter", "score", "reserve",
+          "permit_bind", "journal", "commit", "migrate"}
 
 
 def _doc():

@@ -1,0 +1,385 @@
+"""Differential suite for the native attempt core (PR-14,
+runtime_native/place_core.cc via scheduler/native.py) — the PR-13
+columnar suite's claims, re-pinned against the C kernel:
+
+1. **Store ≡ scalar oracles.** ``NativeStore.feasible_names`` equals
+   the exhaustive walk oracles on the probe grid after every mutation
+   of a randomized reserve/reclaim/health/rebind/port sequence, and
+   ``attempt`` returns pick_top2_seq's winner/runner/raw scores AND
+   select_leaves' exact leaf choice with the resolved memory — the
+   whole decision record, not just the argmax.
+2. **Engine decisions are identical.** A ``native=True`` sim is
+   bind-for-bind identical to the PR-13 vector engine (the native-off
+   default) on underloaded, saturated, defrag, quota, and
+   migration-pin traces — with the in-engine ``_native_oracle``
+   (tree.check_aggregates) doubling every native attempt against the
+   scalar walk, dry-run graded then re-run reserving.
+3. **The mirror never drifts.** After a full run (binds, releases,
+   retries), every row of the live mirror compares EQUAL, stat for
+   stat, to a store rebuilt from the tree — the arm_skip consumption
+   and the release lane left nothing stale.
+4. **Absent kernel = the Python engine.** With the library missing
+   the engine demotes to the vector path with a warning and identical
+   decisions; the suite itself skips (cleanly, not with collection
+   errors) where it genuinely needs the .so.
+
+Seeded, no JAX; tier-1 fast (the .so is prebuilt by `make native`).
+"""
+
+import random
+
+import pytest
+
+from kubeshare_tpu.scheduler.native import load_place_core
+from kubeshare_tpu.scheduler.scoring import (
+    pick_top2_seq, score_node, select_leaves, _resolved_memory,
+)
+from kubeshare_tpu.scheduler.labels import PodKind
+from kubeshare_tpu.sim.simulator import Simulator
+from kubeshare_tpu.sim.trace import (
+    TraceEvent, generate_backlog_trace, generate_trace,
+)
+
+# the columnar suite's fixtures are this suite's fixtures: same
+# heterogeneous tree, same probe grid, same walk oracles
+from test_scheduler_vector import (  # noqa: E402
+    HETERO, MODELS, NODES, PROBES, chips_for, oracle_feasible,
+    sim_topo,
+)
+
+_LIB, _WHY = load_place_core()
+
+pytestmark = pytest.mark.skipif(
+    _LIB is None, reason=f"libplace_core.so unavailable: {_WHY}"
+)
+
+
+def build_native_store():
+    from kubeshare_tpu.cells import CellTree, load_topology
+    from kubeshare_tpu.scheduler.native import NativeStore
+
+    tree = CellTree(load_topology(HETERO))
+    for node, model in NODES.items():
+        tree.bind_node(
+            node,
+            chips_for(node, model, mem=8 * (1 << 30))[:2]
+            + chips_for(node, model)[2:],
+        )
+    full_ports = set()
+    store = NativeStore(_LIB, tree, full_ports)
+    tree.on_delta = store.note_delta
+    tree.on_structural = store.note_structural
+    return tree, store, full_ports
+
+
+def assert_native_agrees(tree, store, full_ports):
+    for req in PROBES:
+        expected = oracle_feasible(tree, full_ports, req)
+        got = store.feasible_names(req, req.model)
+        assert got == expected, (req, got, expected)
+        dec = store.attempt(req, req.model, do_reserve=False)
+        assert dec is not None
+        ms = store.membership(req.model)
+        assert dec.feasible == len(expected)
+        if not expected:
+            assert dec.winner == -1
+            continue
+        values = [score_node(tree, n, req) for n in expected]
+        b2, r2, braw2, rraw2 = pick_top2_seq(expected, values)
+        assert ms.nodes[dec.winner] == b2
+        assert dec.winner_score == braw2
+        if len(expected) > 1:
+            assert ms.nodes[dec.runner] == r2
+            assert dec.runner_score == rraw2
+        else:
+            assert dec.runner == -1 and dec.runner_score == 0.0
+        # the decision record's selection half: same leaves, same
+        # resolved memory, as the Python reserve would choose
+        sel = select_leaves(tree, b2, req)
+        row_leaves = ms.leaves[dec.winner]
+        native_sel = [
+            row_leaves[dec.leaf_slot[k]] for k in range(dec.n_leaves)
+        ]
+        assert [l.uuid for l in native_sel] == [l.uuid for l in sel]
+        if req.kind == PodKind.MULTI_CHIP:
+            want_mem = [l.full_memory for l in sel]
+        else:
+            want_mem = [_resolved_memory(l, req) for l in sel]
+        assert [dec.leaf_mem[k] for k in range(dec.n_leaves)] == want_mem
+
+
+class TestNativeStoreDifferential:
+    def test_fresh_tree_agrees(self):
+        tree, store, ports = build_native_store()
+        assert_native_agrees(tree, store, ports)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_randomized_mutation_sequence(self, seed):
+        """The PR-13 mutation gauntlet against the C mirror: after
+        every random reserve / reclaim / health-flip / rebind /
+        port-toggle, the full probe grid agrees with the walk oracles
+        — covering the heterogeneous-HBM ambiguity resolve (the
+        kernel's exact lane scan) and the structural re-export path."""
+        rng = random.Random(seed)
+        tree, store, ports = build_native_store()
+        reservations = []
+        down = set()
+        GIB = 1 << 30
+        for _ in range(120):
+            op = rng.random()
+            if op < 0.40:
+                node = rng.choice(list(NODES))
+                free = [
+                    l for l in tree.leaves_on_node(node)
+                    if l.healthy and l.available > 0
+                ]
+                if free:
+                    leaf = rng.choice(free)
+                    request = rng.choice([
+                        f for f in (0.25, 0.5, 0.75, 1.0)
+                        if f <= leaf.available + 1e-9
+                    ])
+                    memory = min(
+                        leaf.free_memory,
+                        rng.choice((1 * GIB, 4 * GIB, 8 * GIB)),
+                    )
+                    tree.reserve(leaf, request, memory)
+                    reservations.append((leaf, request, memory))
+            elif op < 0.62 and reservations:
+                leaf, request, memory = reservations.pop(
+                    rng.randrange(len(reservations))
+                )
+                tree.reclaim(leaf, request, memory)
+            elif op < 0.74:
+                node = rng.choice(list(NODES))
+                if node in down:
+                    tree.set_node_health(node, True)
+                    down.discard(node)
+                else:
+                    tree.set_node_health(node, False)
+                    down.add(node)
+            elif op < 0.86:
+                node = rng.choice(list(NODES))
+                if node in down or any(
+                    l.node == node for l, _, _ in reservations
+                ):
+                    continue
+                batch = chips_for(node, NODES[node])
+                tree.bind_node(node, batch)
+            else:
+                node = rng.choice(list(NODES))
+                if node in ports:
+                    ports.discard(node)
+                else:
+                    ports.add(node)
+                store.note_port_flip(node)
+            assert_native_agrees(tree, store, ports)
+        assert store.row_refreshes > 0
+
+    def test_release_lane_matches_reexport(self):
+        """``NativeStore.release`` (the pc_apply reclaim lane) must
+        leave the mirror exactly where a dirty-mark re-export would:
+        apply a reserve+release pair through both paths and compare
+        every row stat."""
+        tree, store, ports = build_native_store()
+        leaf = tree.leaves_view("lite-1", "tpu-v5e")[0]
+        GIB = 1 << 30
+        store.membership("tpu-v5e")  # build + flush
+        tree.reserve(leaf, 0.5, 2 * GIB)   # dirty -> re-export path
+        before = store.row_stats("tpu-v5e", "lite-1")
+        # native release lane: mirror first, then the (consumed) delta
+        assert store.release(
+            "lite-1", "tpu-v5e", [(leaf, 0.5, 2 * GIB)]
+        )
+        tree.reclaim(leaf, 0.5, 2 * GIB)
+        lane = store.row_stats("tpu-v5e", "lite-1")
+        # against a from-scratch rebuild of the same tree state
+        store.note_structural("lite-1")
+        store._struct_dirty = {"lite-1"}
+        rebuilt = store.row_stats("tpu-v5e", "lite-1")
+        assert lane == rebuilt
+        assert before != lane  # the pair actually moved state
+
+    def test_unmapped_release_falls_back(self):
+        tree, store, ports = build_native_store()
+        store.membership("tpu-v5e")
+
+        class FakeLeaf:
+            uuid = "nonexistent"
+
+        assert store.release(
+            "lite-1", "tpu-v5e", [(FakeLeaf(), 0.5, 0)]
+        ) is False
+        assert store.release("lite-1", "no-such-model", []) is False
+
+
+def make_sim(n_nodes, native, check=False, **kw):
+    sim = Simulator(
+        sim_topo(n_nodes), {f"n{i:03d}": 4 for i in range(n_nodes)},
+        seed=7, use_waves=True, vector=True, native=native, **kw,
+    )
+    sim.engine.tree.check_aggregates = check
+    return sim
+
+
+def record_binds(sim):
+    log = []
+    orig = sim.cluster.bind
+
+    def bind(key, node):
+        orig(key, node)
+        log.append((key, node, sim.clock_now))
+
+    sim.cluster.bind = bind
+    return log
+
+
+def run_pair(trace, n_nodes, check=True, **kw):
+    """native=True vs native=False (the PR-13 vector engine): the
+    Python engine is the oracle the kernel must not diverge from.
+    Node counts stay at/under the full-scan floor so the comparison
+    is exact (same caveat as the columnar suite)."""
+    nat = make_sim(n_nodes, native=True, check=check, **kw)
+    assert nat.engine._native is not None, "kernel failed to load"
+    nat_binds = record_binds(nat)
+    nat_report = nat.run(list(trace))
+    vec = make_sim(n_nodes, native=False, **kw)
+    vec_binds = record_binds(vec)
+    vec_report = vec.run(list(trace))
+    return nat, nat_binds, nat_report, vec_binds, vec_report
+
+
+class TestEngineNativeDifferential:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_underloaded_identical(self, seed):
+        trace = generate_trace(count=120, seed=seed,
+                               mean_interarrival=4.0)
+        nat, nb, nr, vb, vr = run_pair(trace, 8)
+        assert nb == vb
+        assert nr.bound == vr.bound
+        assert nat.engine.native_attempts > 0
+        assert nat.engine.native_fallbacks == 0
+
+    def test_saturated_identical(self):
+        """Backlog at ~112% capacity: nobody-fits verdicts (the
+        native empty-mask rejection classifier), retry waves, and
+        head-of-line holds (native fallbacks mid-trace) agree."""
+        trace = generate_backlog_trace(count=48)
+        nat, nb, nr, vb, vr = run_pair(trace, 16, check=False)
+        assert nb == vb
+        assert (nr.bound, nr.unschedulable) == (vr.bound, vr.unschedulable)
+        assert nat.engine.native_attempts > 0
+
+    def test_defrag_holds_identical(self):
+        trace = generate_backlog_trace(count=48)
+        nat, nb, nr, vb, vr = run_pair(trace, 16, check=False,
+                                       defrag=True)
+        assert nb == vb
+        assert nr.defrag_evicted == vr.defrag_evicted
+        assert nat.engine.native_attempts > 0
+
+    def test_quota_tenants_identical(self):
+        tenants = {
+            "anna": {"weight": 2.0, "guaranteed": 0.5},
+            "bob": {"weight": 1.0, "borrow_limit": 0.25},
+        }
+        rng = random.Random(5)
+        events = []
+        t = 0.0
+        for i in range(80):
+            t += rng.expovariate(0.8)
+            events.append(TraceEvent(
+                round(t, 3), round(rng.uniform(0.2, 0.9), 2),
+                150.0, 50 if i % 2 else 0, 1,
+                "anna" if i % 3 else "bob",
+            ))
+        nat, nb, nr, vb, vr = run_pair(events, 6, tenants=tenants)
+        assert nb == vb
+        assert nr.to_dict() == vr.to_dict()
+        assert nat.engine.native_attempts > 0
+
+    def test_migration_pins_identical(self):
+        trace = generate_trace(count=100, seed=5,
+                               fractional_ratio=0.8)
+        nat, nb, nr, vb, vr = run_pair(
+            trace, 8, defrag=True, migrate=True,
+        )
+        assert nb == vb
+        assert nr.bound == vr.bound
+
+    def test_mirror_never_drifts(self):
+        """After a full run of binds, releases, and retries, every
+        live mirror row compares stat-for-stat equal to a store
+        rebuilt from the tree: the armed-skip consumption and the
+        release lane left nothing stale."""
+        from kubeshare_tpu.scheduler.native import NativeStore
+
+        trace = generate_trace(count=150, seed=11)
+        nat = make_sim(8, native=True)
+        nat.run(list(trace))
+        engine = nat.engine
+        live = engine._native
+        fresh = NativeStore(_LIB, engine.tree,
+                            engine._full_port_nodes)
+        for model in engine.tree.chip_priority:
+            live_ms = live.membership(model)
+            fresh_ms = fresh.membership(model)
+            assert live_ms.nodes == fresh_ms.nodes
+            for node in live_ms.nodes:
+                assert live.row_stats(model, node) == \
+                    fresh.row_stats(model, node), (model, node)
+
+    def test_unknown_model_and_gang_anchor_fallbacks(self):
+        """Gate misses walk Python and are counted — an engine with
+        the kernel on but a bogus model label must not mint a store."""
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.scheduler import constants as C
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        cluster = FakeCluster()
+        cluster.add_node("n000", [
+            ChipInfo(f"n000-c{j}", "tpu-v5e", 16 << 30, j)
+            for j in range(4)
+        ])
+        eng = TpuShareScheduler(sim_topo(1), cluster,
+                                clock=lambda: 0.0, native=True)
+        d = eng.schedule_one(cluster.create_pod(Pod(
+            name="bogus", namespace="t",
+            labels={
+                C.LABEL_TPU_REQUEST: "0.5",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+                C.LABEL_TPU_MODEL: "tpu-vTYPO",
+            },
+            scheduler_name=C.SCHEDULER_NAME,
+        )))
+        assert d.status == "unschedulable"
+        assert eng.native_fallbacks == 1 and eng.native_attempts == 0
+        assert "tpu-vTYPO" not in eng._native._models
+
+
+class TestAbsentKernelDemotes:
+    def test_missing_library_falls_back_to_vector(self, monkeypatch):
+        """native=True with no .so: the engine logs and runs the
+        vector path — same decisions, native counters stay zero,
+        tpu_scheduler_native_loaded exports 0."""
+        monkeypatch.setenv("KUBESHARE_PLACE_CORE",
+                           "/nonexistent/libplace_core.so")
+        trace = generate_trace(count=60, seed=2)
+        demoted = make_sim(6, native=True)
+        assert demoted.engine._native is None
+        assert demoted.engine._columns is not None
+        db = record_binds(demoted)
+        demoted.run(list(trace))
+        vec = make_sim(6, native=False)
+        vbs = record_binds(vec)
+        vec.run(list(trace))
+        assert db == vbs
+        assert demoted.engine.native_attempts == 0
+        samples = demoted.engine.utilization_samples()
+        loaded = [
+            s for s in samples
+            if s.name == "tpu_scheduler_native_loaded"
+        ]
+        assert loaded and loaded[0].value == 0

@@ -324,7 +324,8 @@ class TestSchedulerIntegration:
         assert d.status == "bound"
         assert sched.vector_attempts == 1
         assert sched.cost_seconds["filter"] > 0.0
-        assert sched.cost_seconds["reserve_permit"] > 0.0
+        assert sched.cost_seconds["reserve"] > 0.0
+        assert sched.cost_seconds["permit_bind"] > 0.0
         names = {e.name for e in tracer.events()}
         assert {"prefilter", "reserve", "permit"} <= names
 

@@ -79,7 +79,7 @@ def topology(n_nodes: int) -> dict:
 
 
 def _simulate(n_nodes, trace, use_waves, backfill, explain_capacity=512,
-              vector=True):
+              vector=True, native=False):
     # no tracer: span overhead is not part of the engine hot path
     # being measured, and the per-attempt percentiles now come from
     # the engine's own raw-duration ring (exact, not bucket edges)
@@ -91,6 +91,7 @@ def _simulate(n_nodes, trace, use_waves, backfill, explain_capacity=512,
         backfill=backfill,
         explain_capacity=explain_capacity,
         vector=vector,
+        native=native,
     )
     wall0 = time.perf_counter()
     report = sim.run(trace)
@@ -99,9 +100,10 @@ def _simulate(n_nodes, trace, use_waves, backfill, explain_capacity=512,
 
 
 def _row(n_nodes, trace, use_waves=True, backfill=False,
-         explain_capacity=512, events=None, vector=True):
+         explain_capacity=512, events=None, vector=True, native=False):
     sim, report, wall = _simulate(
-        n_nodes, trace, use_waves, backfill, explain_capacity, vector
+        n_nodes, trace, use_waves, backfill, explain_capacity, vector,
+        native,
     )
     engine = sim.engine
     tree = engine.tree
@@ -145,6 +147,11 @@ def _row(n_nodes, trace, use_waves=True, backfill=False,
             "column_ambiguous_resolves": (
                 engine._columns.ambiguous_resolves
                 if engine._columns else 0
+            ),
+            "native_attempts": engine.native_attempts,
+            "native_fallbacks": engine.native_fallbacks,
+            "native_row_refreshes": (
+                engine._native.row_refreshes if engine._native else 0
             ),
         },
         "wave_phase_seconds": {
@@ -342,11 +349,150 @@ def vector_ab(reps: int) -> dict:
     }
 
 
+def _drain_arm(n_nodes, trace, native):
+    """Engine-core drain: the whole trace staged as one pending
+    backlog, drained by ``schedule_wave`` against a FakeCluster —
+    placements/s of the attempt core itself (PreFilter -> quota ->
+    Filter/Score -> Reserve -> Permit -> bind), with the sim's event
+    machinery (completions, virtual clock, job table) out of the
+    timed window. This is the instrument that isolates what PR-14
+    ports: the native-vs-vector gap inside the full sim loop is the
+    same absolute microseconds, diluted by ~100us/placement of
+    symmetric sim overhead (the ``sim_loop`` figure records that
+    end-to-end view honestly)."""
+    import random
+
+    from kubeshare_tpu.cells.cell import ChipInfo
+    from kubeshare_tpu.cluster.api import Pod
+    from kubeshare_tpu.cluster.fake import FakeCluster
+    from kubeshare_tpu.scheduler import constants as C
+    from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+    cluster = FakeCluster()
+    for i in range(n_nodes):
+        cluster.add_node(f"node-{i:03d}", [
+            ChipInfo(f"node-{i:03d}-c{j}", "tpu-v5e", 16 << 30, j)
+            for j in range(CHIPS_PER_NODE)
+        ])
+    engine = TpuShareScheduler(
+        topology(n_nodes), cluster, clock=lambda: 0.0,
+        vector=True, native=native,
+    )
+    # the sim's priority assignment (priority_ratio 0.5), seeded so
+    # both arms stage the identical backlog
+    rng = random.Random(0)
+    pods = []
+    for i, event in enumerate(trace):
+        chips = event.chips
+        labels = {
+            C.LABEL_TPU_REQUEST: str(chips),
+            C.LABEL_TPU_LIMIT_ALIASES[1]: str(max(chips, 1.0)),
+        }
+        if rng.random() < 0.5:
+            labels[C.LABEL_PRIORITY] = str(rng.randint(1, 100))
+        pods.append(cluster.create_pod(Pod(
+            name=f"bench-{i:05d}", namespace="bench", labels=labels,
+            scheduler_name=C.SCHEDULER_NAME, created_at=1e-9,
+        )))
+    wall0 = time.perf_counter()
+    decisions = engine.schedule_wave(pods, backfill=False)
+    wall = time.perf_counter() - wall0
+    bound = sum(1 for d in decisions if d.status == "bound")
+    return {
+        "bound": bound,
+        "wall_seconds": round(wall, 3),
+        "placements_per_sec": round(bound / wall, 1),
+        "counters": {
+            "native_attempts": engine.native_attempts,
+            "native_fallbacks": engine.native_fallbacks,
+            "vector_attempts": engine.vector_attempts,
+            "native_skips_consumed": (
+                engine._native.skip_consumed if engine._native else 0
+            ),
+        },
+    }
+
+
+def native_ab(reps: int) -> dict:
+    """PR-14 tentpole A/B: the native attempt core (--native) vs the
+    PR-13 vector engine (the native-off default), decisions
+    bind-for-bind identical (tests/test_scheduler_native.py).
+    Paired-ratio protocol throughout (journal_ab's drift defense).
+
+    Two figures, honestly separated:
+
+    - ``drain`` (the headline + floor): engine-core placements/s over
+      a 2000-pod backlog at 1024 nodes — the ported hot path itself.
+    - ``sim_loop``: the same idle trace through the full virtual-clock
+      simulator — the end-to-end dilution of the same win by the
+      symmetric per-placement machinery (completions, event loop)
+      both arms share.
+    """
+    from kubeshare_tpu.scheduler.native import (
+        load_place_core, native_available,
+    )
+
+    if not native_available():
+        raise SystemExit(
+            "native_ab: libplace_core.so unavailable "
+            f"({load_place_core()[1]}); run `make native` first"
+        )
+    trace = generate_trace(count=EVENTS, seed=0)
+    drain_pairs = []
+    best = {}
+    # drain reps are cheap (~seconds per arm): always take at least 5
+    # paired ratios — this box's per-rep spread demands a real median
+    for _ in range(max(5, reps)):
+        rep_pair = {}
+        for key, native in (("on", True), ("off", False)):
+            row = _drain_arm(1024, trace, native)
+            rep_pair[key] = row["placements_per_sec"]
+            if key not in best or \
+                    row["wall_seconds"] < best[key]["wall_seconds"]:
+                best[key] = row
+        drain_pairs.append(rep_pair["on"] / rep_pair["off"])
+    assert best["on"]["bound"] == best["off"]["bound"]
+    drain_pairs.sort()
+    n = len(drain_pairs)
+    drain_median = drain_pairs[n // 2] if n % 2 else (
+        (drain_pairs[n // 2 - 1] + drain_pairs[n // 2]) / 2
+    )
+    sim_pairs = []
+    for _ in range(max(1, min(3, reps))):
+        pair = {}
+        for key, native in (("on", True), ("off", False)):
+            _, report, wall = _simulate(
+                1024, list(trace), True, False, native=native,
+            )
+            pair[key] = report.bound / wall
+        sim_pairs.append(pair["on"] / pair["off"])
+    sim_pairs.sort()
+    m = len(sim_pairs)
+    sim_median = sim_pairs[m // 2] if m % 2 else (
+        (sim_pairs[m // 2 - 1] + sim_pairs[m // 2]) / 2
+    )
+    return {
+        "nodes": 1024,
+        "protocol": "drain",
+        "native_on_placements_per_sec":
+            best["on"]["placements_per_sec"],
+        "native_off_placements_per_sec":
+            best["off"]["placements_per_sec"],
+        "native_speedup": round(drain_median, 2),
+        "native_speedup_per_rep": [round(p, 2) for p in drain_pairs],
+        "sim_loop_speedup": round(sim_median, 2),
+        "sim_loop_speedup_per_rep": [round(p, 2) for p in sim_pairs],
+        "on": best["on"],
+        "off": best["off"],
+    }
+
+
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
         "--mode",
-        choices=("idle", "backlog", "gang", "journal", "vector", "all"),
+        choices=("idle", "backlog", "gang", "journal", "vector",
+                 "native", "all"),
         default="all",
     )
     parser.add_argument(
@@ -441,6 +587,17 @@ def main(argv=None) -> None:
             f"{v['vector_on_placements_per_sec']:,.0f}/s, off "
             f"{v['vector_off_placements_per_sec']:,.0f}/s "
             f"({v['vector_speedup']}x paired-median speedup)"
+        )
+
+    if args.mode in ("native", "all"):
+        doc["native_ab"] = native_ab(args.reps)
+        na = doc["native_ab"]
+        print(
+            f"native A/B @1024 (drain): on "
+            f"{na['native_on_placements_per_sec']:,.0f}/s, off "
+            f"{na['native_off_placements_per_sec']:,.0f}/s "
+            f"({na['native_speedup']}x paired-median; sim-loop "
+            f"{na['sim_loop_speedup']}x)"
         )
 
     with open(args.out, "w") as f:
