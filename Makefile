@@ -50,6 +50,14 @@ explain-report:
 serving-sim:
 	$(PYTHON) tools/serving_sim.py
 
+# request-QoS A/B on the same fixed pool -> SERVING_QOS.json
+# (adversarial 3-tenant burst mix: FIFO vs weighted-DRF tenant lanes
+# graded on Jain fairness + quiet-tenant p50 wait at equal served;
+# slot-level JSQ vs token-level drain-aware admission graded on TTFT
+# p50 at >=90% occupancy; conservation exact in every row)
+serving-qos-sim:
+	$(PYTHON) tools/serving_qos_sim.py
+
 # 128-node chaos gauntlet -> CHAOS.json (node flaps, pod kills, API
 # error drizzle + flake outages, scheduler crash/restarts incl. one
 # armed mid-pass; graded by hard invariants: zero double-binds, exact
@@ -125,4 +133,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay migrate-sim fairness-sim autoscale-sim explain-report serving-sim chaos-sim incident-report profile-report dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay migrate-sim fairness-sim autoscale-sim explain-report serving-sim serving-qos-sim chaos-sim incident-report profile-report dryrun images push save kind-e2e perf-evidence clean

@@ -574,6 +574,10 @@ def main() -> None:
         doc = _base_doc()
         doc["error"] = probe.get("error", "chip probe failed")
         doc["probe_attempts"] = probe.get("probe_attempts", 1)
+        # the clean-skip marker: the hunt is over, no round started —
+        # consumers read "live isolation evidence explicitly absent
+        # this run", not "the bench died mid-round"
+        doc["device_optional"] = True
         doc["elapsed_s"] = round(time.monotonic() - _T0, 1)
         log(f"FATAL: {doc['error']} — emitting diagnostic and exiting")
         emit(doc, final=True)

@@ -202,6 +202,18 @@ def build_parser() -> argparse.ArgumentParser:
              "explain` / `... incidents`)",
     )
     parser.add_argument(
+        "--serve-router", action="store_true",
+        help="run the serving request plane in-process: replicas "
+             "register from serving-pod bind/delete events "
+             "(sharedtpu/serving_* labels), requests route with "
+             "per-tenant weighted-DRF lanes + token-level admission + "
+             "prefix affinity, backlog files slot demand into the "
+             "autoscaler's ledger, and the metrics port answers "
+             "/router (QoS state) and /router/submit (smoke surface). "
+             "Tenant weights come from --tenants, shared with the pod "
+             "quota plane",
+    )
+    parser.add_argument(
         "--explain-capacity", type=int, default=512,
         help="decision-journal bound: at most this many pods' "
              "provenance kept (LRU; evictions counted on "
@@ -758,6 +770,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ),
         )
 
+    # request plane: the router lives in the daemon, replicas arrive
+    # through the informer (ServingPodWatch), and the SAME tenant
+    # registry + pod-layer share keys the quota plane uses order the
+    # per-tenant request lanes — one fairness currency, both layers
+    router = None
+    if args.serve_router:
+        from ..serving import PrefixAffinity, RequestRouter
+        from ..serving.live import ServingPodWatch
+
+        router = RequestRouter(
+            demand=engine.demand,
+            tenants=engine.quota.registry,
+            share_base=engine.quota.share_key,
+            qos=True,
+            token_admission=True,
+            affinity=PrefixAffinity(),
+        )
+        engine.serving_watch = ServingPodWatch(
+            router, clock=engine.clock, log=log.info,
+        )
+        log.info("request router enabled (DRF lanes + token-level "
+                 "admission + prefix affinity)")
+
     # incident plane: burn-rate/error/drift alert rules evaluated on
     # every pass + the flight recorder cutting bundles when one fires;
     # serves /healthz + /incidents on the metrics port below
@@ -781,6 +816,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         obs_plane = build_plane(
             lambda: engine,
             cluster=cluster if args.kube else None,
+            router=router,
             tracer=tracer,
             spool=incident_spool,
             # cost_rules: the daemon's steady traffic is what the
@@ -802,6 +838,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
                                elector=elector, planner=planner,
+                               router=router,
                                cluster=cluster if args.kube else None,
                                obs=obs_plane, profiler=profiler_hub)
     metrics_server = None
@@ -823,11 +860,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from ..obs.profile import register_profile
 
         register_profile(metrics_server, profiler_hub)
+        if router is not None:
+            from ..serving.http import register_router
+
+            register_router(metrics_server, router, clock=engine.clock)
         metrics_server.start()
         log.info(
-            "self-metrics on :%d/metrics (+ /explain + /profile%s)",
+            "self-metrics on :%d/metrics (+ /explain + /profile%s%s)",
             metrics_server.port,
             " + /healthz + /incidents" if obs_plane is not None else "",
+            " + /router" if router is not None else "",
         )
 
     # guard: re-proves (and when due, renews) leadership before every
@@ -858,6 +900,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 run_pass(engine, cluster, journal, metrics, guard,
                          wave_size=args.wave_size,
                          backfill=args.backfill)
+                if router is not None:
+                    router.tick(engine.clock())
                 if obs_plane is not None:
                     obs_plane.tick(engine.clock())
                     obs_plane.flush()
@@ -901,6 +945,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          requeue=requeue, wave_size=args.wave_size,
                          backfill=args.backfill)
                 requeue = []
+                if router is not None:
+                    # dispatch onto replicas that bound since the last
+                    # pass, shed timeouts, refresh the slot backlog in
+                    # the demand ledger the planner reads below
+                    router.tick(engine.clock())
                 if obs_plane is not None:
                     # evaluated on the scheduler tick — the alert
                     # plane reads the in-process surface, no scrape

@@ -128,6 +128,15 @@ def default_snapshot(engine_ref: Callable, cluster=None, router=None):
         if router is not None:
             submitted, shed = router.request_totals()
             doc["serving"] = {"submitted": submitted, "shed": shed}
+            try:
+                by_tenant = router.request_totals(by_tenant=True)
+            except TypeError:
+                by_tenant = None
+            if isinstance(by_tenant, dict):
+                # the tenant breakdown a tenant-graded shed bundle's
+                # pre-window needs: who was being refused, who was
+                # quiet, while the burn developed
+                doc["serving"]["by_tenant"] = by_tenant
         return doc
 
     return snap

@@ -428,6 +428,49 @@ def shed_rate_rule(totals: Callable[[], Tuple[int, int]],
                      clear_after=cfg.clear_after)
 
 
+def tenant_shed_rate_rule(by_tenant: Callable[[], Dict[str, dict]],
+                          cfg: AlertConfig) -> AlertRule:
+    """The shed-rate rule graded PER TENANT (same rule name — this
+    supersedes the fleet-wide grading wherever the router exposes the
+    tenant breakdown). Level is the WORST tenant's windowed shed
+    fraction, and each tenant clears the ``shed_min_requests`` floor
+    against its OWN windowed submissions — a noisy tenant being
+    shed cannot page on behalf of a quiet tenant, and a quiet
+    tenant's handful of sheds never clears the floor in the first
+    place (the isolation tests/test_obs_alerts.py pins). Context
+    names the offender, the queue-spike rule's convention."""
+    series: Dict[str, WindowSeries] = {}
+
+    def level(now: float) -> Tuple[float, dict]:
+        worst, context = 0.0, {}
+        current = by_tenant()
+        for tenant, row in current.items():
+            s = series.get(tenant)
+            if s is None:
+                s = series[tenant] = WindowSeries(cfg.fast_window)
+            s.observe(now, (float(row.get("submitted", 0)),
+                            float(row.get("shed", 0))))
+            d = s.delta(now, cfg.fast_window)
+            if not d or d[0] < cfg.shed_min_requests:
+                continue
+            rate = d[1] / d[0]
+            if rate > worst:
+                worst = rate
+                context = {"tenant": tenant, "submitted": int(d[0]),
+                           "shed": int(d[1]), "rate": round(rate, 3)}
+        # tenants that stopped submitting drop their window state
+        # once it ages out, not their alert history
+        for tenant in list(series):
+            if tenant not in current:
+                del series[tenant]
+        return worst, context
+
+    return AlertRule(RULE_SHED_RATE, level,
+                     threshold=cfg.shed_rate_threshold,
+                     clear_ratio=cfg.clear_ratio,
+                     clear_after=cfg.clear_after)
+
+
 def ledger_drift_rule(drift: Callable[[], dict],
                       cfg: AlertConfig) -> AlertRule:
     """Hard CRITICAL rule: the usage ledger disagreeing with the sum
@@ -766,7 +809,20 @@ def standard_rules(engine_ref: Callable, cluster=None, router=None,
             ),
         ]
     if router is not None:
-        rules.append(shed_rate_rule(router.request_totals, cfg))
+        # grade per tenant when the router exposes the breakdown
+        # (RequestRouter does); fleet-wide totals otherwise — same
+        # rule name either way, so dashboards and the pinned rule set
+        # see ONE shed-rate rule
+        try:
+            sample = router.request_totals(by_tenant=True)
+        except TypeError:
+            sample = None
+        if isinstance(sample, dict):
+            rules.append(tenant_shed_rate_rule(
+                lambda: router.request_totals(by_tenant=True), cfg,
+            ))
+        else:
+            rules.append(shed_rate_rule(router.request_totals, cfg))
     if shard is not None:
         # shard.ShardedScheduler (or any object with txn_totals())
         rules.append(conflict_storm_rule(shard.txn_totals, cfg))

@@ -22,6 +22,15 @@ LABEL_TPU_MODEL = DOMAIN + "tpu_model"            # chip generation pin (e.g. tp
 LABEL_TENANT = DOMAIN + "tenant"                  # quota tenant override
                                                   # (default: namespace)
 
+# serving-replica labels: a pod carrying serving_model is a
+# DecodeServer replica; on BIND the informer registers it with the
+# request router (serving/live.py), on DELETE it deregisters and its
+# requests requeue. slots / max_prompt fall back to the router's
+# replica template when unset.
+LABEL_SERVING_MODEL = DOMAIN + "serving_model"        # served model id
+LABEL_SERVING_SLOTS = DOMAIN + "serving_slots"        # decode slots
+LABEL_SERVING_MAX_PROMPT = DOMAIN + "serving_max_prompt"  # prompt ceiling
+
 # compat aliases: accept the short names used in docs/examples too
 LABEL_TPU_LIMIT_ALIASES = (LABEL_TPU_LIMIT, DOMAIN + "tpu_limit")
 

@@ -192,6 +192,10 @@ class TpuShareScheduler:
         # scratch state, rebuilt by the next pass after a restart. Its
         # transition hook feeds the journal's reason timeline.
         self.demand = DemandLedger(on_transition=self.explain.note_reason)
+        # optional request-plane adapter (serving/live.ServingPodWatch):
+        # when attached, serving-pod bind/delete events flow to the
+        # RequestRouter so replicas register from the informer, not a sim
+        self.serving_watch = None
         self.ports: Dict[str, RRBitmap] = {}
         # nodes whose pod-manager port pool is exhausted — maintained
         # at every bitmap mutation site so the inline Filter loop's
@@ -706,6 +710,12 @@ class TpuShareScheduler:
             return
         if not pod.is_bound or pod.is_completed:
             return
+        if self.serving_watch is not None:
+            # before the status early-return: our own bind echoes back
+            # through the informer with state BOUND, and that echo IS
+            # the daemon's replica-registration event (idempotent —
+            # the watch skips pods already in the routing table)
+            self.serving_watch.pod_bound(pod)
         status = self.status.get(pod.key)
         if status is not None:
             if status.state == PodState.BOUND:
@@ -730,6 +740,10 @@ class TpuShareScheduler:
             self._bound_queue.setdefault(pod.node_name, []).append(pod)
 
     def _on_pod_delete(self, pod: Pod) -> None:
+        if self.serving_watch is not None:
+            # deregister first: the replica's queued/in-flight requests
+            # requeue before any other teardown observes the pod gone
+            self.serving_watch.pod_deleted(pod)
         self._defrag_last.pop(pod.key, None)
         self._defrag_inflight.discard(pod.key)  # eviction completed
         self._drop_defrag_holds(pod.key)  # beneficiary gone -> free the space
@@ -3849,6 +3863,7 @@ class TpuShareScheduler:
         group_key = status.group_key if status else ""
         if group_key and group_key in self._waiting:
             self._waiting[group_key].pop(pod_key, None)
+        self._notify_serving_bound(pod_key)
 
     def _bind_regular(self, pod: Pod, node_name: str,
                       req: Optional[PodRequirements] = None) -> None:
@@ -3860,6 +3875,19 @@ class TpuShareScheduler:
             tenant=req.tenant if req is not None else pod.namespace,
             shape="regular",
         )
+        self._notify_serving_bound(pod.key)
+
+    def _notify_serving_bound(self, pod_key: str) -> None:
+        """Replica registration at the bind choke point. Adapters
+        without a watch stream (snapshot files) never echo our own
+        bind back through ``_on_pod_add``, so the daemon tells the
+        serving watch directly; the kube echo then finds the replica
+        already registered (``pod_bound`` is idempotent)."""
+        if self.serving_watch is None:
+            return
+        bound = self.cluster.get_pod(pod_key)
+        if bound is not None:
+            self.serving_watch.pod_bound(bound)
 
     def _ensure_synced(self, node_name: str) -> None:
         if node_name not in self._unsynced:

@@ -20,17 +20,38 @@ requirement export feeding placement):
   ``no-free-slot`` reason code — the signal the recommender's
   slot-sizing term converts into serving-pod replicas, which the
   scheduler then places and the router picks up.
+- ``qos``      — the request-layer QoS plane: ``RequestDrfClock``
+  (weighted-DRF accounting on the SAME TenantRegistry weights the pod
+  quota plane reads) and ``LaneQueue`` (per-tenant FIFO lanes served
+  most-underserved-first; one tenant degenerates to the seed's plain
+  FIFO), plus the drain-time model behind token-level admission.
+- ``affinity`` — ``PrefixAffinity``: bounded LRU from hashed prompt
+  heads to the replica whose KV cache is warm; consulted only among
+  free-slot candidates, exact least-loaded fallback otherwise.
+- ``live``     — ``ServingPodWatch``: registers/deregisters replicas
+  from the informer's serving-pod bind/delete events (the
+  ``sharedtpu/serving_*`` labels), closing the loop outside the sim.
+- ``http``     — ``register_router``: the ``/router`` JSON state and
+  ``/router/submit`` surfaces on the launcher's MetricServer
+  (``cmd/scheduler.py --serve-router``).
 - ``sim``      — ``ServingLoopSim``: drives diurnal request arrival
   curves against replicas backed by bound serving pods on the real
   engine, closing the loop end to end. ``tools/serving_sim.py``
   (``make serving-sim``) banks SERVING_LOOP.json: autoscaled replicas
   vs a fixed baseline with TTFT / queue-wait percentiles, shed rate,
-  and slot-occupancy traces.
+  and slot-occupancy traces; ``tools/serving_qos_sim.py``
+  (``make serving-qos-sim``) banks SERVING_QOS.json: DRF fairness vs
+  FIFO on an adversarial tenant mix and the token-admission TTFT win
+  at high occupancy.
 """
 
+from .affinity import PrefixAffinity
+from .live import ServingPodWatch
+from .qos import LaneQueue, RequestDrfClock
 from .registry import Replica, ReplicaRegistry
 from .router import (
-    SHED_OVERSIZED, SHED_POOL_FULL, SHED_TIMEOUT, Request, RequestRouter,
+    SHED_DRAIN_BOUND, SHED_OVERSIZED, SHED_POOL_FULL, SHED_TIMEOUT,
+    Request, RequestRouter,
     RouteResult, SlotDemand,
 )
 
@@ -48,13 +69,18 @@ def __getattr__(name):
     )
 
 __all__ = [
+    "LaneQueue",
+    "PrefixAffinity",
     "Replica",
     "ReplicaRegistry",
     "Request",
+    "RequestDrfClock",
     "RequestRouter",
     "RouteResult",
     "ServingLoopSim",
+    "ServingPodWatch",
     "SlotDemand",
+    "SHED_DRAIN_BOUND",
     "SHED_OVERSIZED",
     "SHED_POOL_FULL",
     "SHED_TIMEOUT",

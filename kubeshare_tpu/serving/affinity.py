@@ -1,0 +1,103 @@
+"""Prefix-cache affinity: route repeat prompts back to the replica
+whose KV cache is already warm.
+
+Serving fleets see heavy prefix reuse — few-shot templates, system
+prompts, multi-turn chats all share long prompt heads. A replica that
+just prefilled a prefix holds its KV blocks hot; routing the next
+request with the same head to the SAME replica turns its prefill into
+a (modeled) cache hit, while a cold replica pays the full prefill.
+
+``PrefixAffinity`` is a bounded LRU from hashed prompt heads
+(``qos.prefix_key`` — first ``prefix_tokens`` tokens, or a
+client-supplied ``Request.prefix_hash``) to the pod key that last
+served that head. The router consults it ONLY when slots are free:
+
+- **model match** is structural — the registry partitions replicas
+  per served model, so candidates already speak the request's model;
+- among free-slot candidates, a remembered owner wins (warm cache
+  beats one extra free slot); ties and misses fall back to the exact
+  seed least-loaded choice, so fleets with no affinity signal route
+  byte-for-byte like the seed router;
+- the memory never overrides capacity decisions: a warm-but-full
+  replica is not waited on — queue placement stays pure
+  JSQ/drain-time, because a modeled cache hit is worth one prefill,
+  not an unbounded queue wait.
+
+Deregistration forgets every key owned by the dead pod (a restarted
+replica is cold) and the LRU bound keeps the memory a few hundred KB
+regardless of traffic.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional
+
+from .qos import prefix_key
+
+
+class PrefixAffinity:
+    def __init__(self, prefix_tokens: int = 32, capacity: int = 4096):
+        if prefix_tokens < 1:
+            raise ValueError(
+                f"prefix_tokens must be >= 1, got {prefix_tokens}"
+            )
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.prefix_tokens = prefix_tokens
+        self.capacity = capacity
+        # (model, prefix digest) -> pod key, LRU order
+        self._memory: "OrderedDict[tuple, str]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def key_for(self, req) -> Optional[tuple]:
+        """The affinity key for a request, or None when it carries no
+        signal (no tokens and no client-supplied prefix hash)."""
+        if getattr(req, "prefix_hash", None):
+            return (req.model, req.prefix_hash)
+        if req.prompt:
+            return (req.model,
+                    prefix_key(req.prompt, self.prefix_tokens))
+        return None
+
+    def owner(self, key: Optional[tuple]) -> Optional[str]:
+        if key is None:
+            return None
+        return self._memory.get(key)
+
+    def note(self, req, pod_key: str) -> None:
+        """Record that ``pod_key`` just prefilled this request's
+        prefix (called on every admission — last writer wins, which
+        tracks where the cache is actually warm)."""
+        key = self.key_for(req)
+        if key is None:
+            return
+        self._memory[key] = pod_key
+        self._memory.move_to_end(key)
+        while len(self._memory) > self.capacity:
+            self._memory.popitem(last=False)
+
+    def observe(self, hit: bool) -> None:
+        if hit:
+            self.hits += 1
+        else:
+            self.misses += 1
+
+    def forget_replica(self, pod_key: str) -> int:
+        """Drop every key owned by a deregistered pod (its cache is
+        gone with the process). Returns how many keys were dropped."""
+        stale = [k for k, v in self._memory.items() if v == pod_key]
+        for k in stale:
+            del self._memory[k]
+        return len(stale)
+
+    def __len__(self) -> int:
+        return len(self._memory)
+
+    def snapshot(self) -> Dict[str, int]:
+        return {
+            "keys": len(self._memory),
+            "hits": self.hits,
+            "misses": self.misses,
+        }

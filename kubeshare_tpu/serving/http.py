@@ -1,0 +1,107 @@
+"""``/router`` on the scheduler's metrics server — the request
+plane's live surface (``cmd/scheduler.py --serve-router``).
+
+- ``GET /router`` — QoS state as JSON: queue discipline flags,
+  per-tenant DRF shares and submitted/served/shed/in-flight
+  breakdown, affinity memory stats, per-model counts and
+  conservation. What ``/metrics`` exports as numbers, this explains
+  as structure.
+- ``GET /router/submit?model=M&prompt_len=N[&rid=..][&tenant=..]``
+  ``[&prefix=..]`` — submit one request and return the RouteResult
+  (admitted / queued / shed + replica + shed reason). A GET with
+  side effects is deliberate: the MetricServer is GET-only, and this
+  surface exists for smoke tests and operators probing a live
+  router, not as the production data path (that is the replicas'
+  own serving endpoints).
+- ``GET /router/complete?rid=..`` — finish a stream admitted through
+  this surface, freeing its slot (dispatches waiting work).
+
+Handlers run on the metrics thread against scheduling-thread-owned
+state, the same single-writer/torn-read-tolerant convention every
+other surface on this server follows: reads are snapshots, and the
+submit/complete mutations are serialized by GIL-atomic dict/deque
+operations — acceptable for a smoke surface, documented here so
+nobody mistakes it for the hot path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+from typing import Dict, List, Tuple
+
+from .router import Request
+
+_rid_seq = itertools.count(1)
+
+
+def router_state_handler(router, clock):
+    def handle(rest: str, params: Dict[str, List[str]]
+               ) -> Tuple[int, str, str]:
+        def one(name, default=None):
+            vals = params.get(name)
+            return vals[0] if vals else default
+
+        if rest == "submit":
+            model = one("model")
+            prompt_len = one("prompt_len")
+            if not model or prompt_len is None:
+                return 400, "application/json", json.dumps(
+                    {"error": "need model= and prompt_len="}
+                ) + "\n"
+            try:
+                plen = int(prompt_len)
+            except ValueError:
+                return 400, "application/json", json.dumps(
+                    {"error": f"bad prompt_len {prompt_len!r}"}
+                ) + "\n"
+            now = clock()
+            req = Request(
+                rid=one("rid") or f"http-{next(_rid_seq)}",
+                model=model, prompt_len=plen, arrival=now,
+                tenant=one("tenant") or "default",
+                prefix_hash=one("prefix"),
+            )
+            result = router.submit(req, now)
+            return 200, "application/json", json.dumps({
+                "rid": req.rid,
+                "status": result.status,
+                "replica": result.replica,
+                "reason": result.reason,
+                "retryable": result.retryable,
+            }) + "\n"
+        if rest == "complete":
+            rid = one("rid")
+            if not rid:
+                return 400, "application/json", json.dumps(
+                    {"error": "need rid="}
+                ) + "\n"
+            admitted = router.complete(rid, clock())
+            return 200, "application/json", json.dumps({
+                "rid": rid,
+                "dispatched": [
+                    {"rid": req.rid, "replica": pod_key}
+                    for req, pod_key in admitted
+                ],
+            }) + "\n"
+        if rest:
+            return 404, "application/json", json.dumps(
+                {"error": f"no router endpoint {rest!r}"}
+            ) + "\n"
+        doc = router.qos_state()
+        doc["conservation"] = {
+            model: {"submitted": pair[0], "accounted": pair[1],
+                    "exact": pair[0] == pair[1]}
+            for model, pair in (
+                (m, router.conservation(m))
+                for m in doc["models"]
+            )
+        }
+        return 200, "application/json", json.dumps(doc, indent=1) + "\n"
+
+    return handle
+
+
+def register_router(server, router, clock=time.monotonic) -> None:
+    server.route_prefix("/router", router_state_handler(router, clock))

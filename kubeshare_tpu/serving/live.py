@@ -1,0 +1,106 @@
+"""Live daemon wiring: replicas register from the informer, not the
+sim.
+
+``ServingLoopSim`` registers replicas from bind decisions it made
+itself; a real daemon learns about them the same way it learns about
+everything else — pod events. ``ServingPodWatch`` is the adapter the
+scheduler plugin notifies from its informer callbacks:
+
+- a BOUND pod labeled ``sharedtpu/serving_model`` registers with the
+  RequestRouter (slots / prompt ceiling from the
+  ``sharedtpu/serving_slots`` / ``serving_max_prompt`` labels, chips
+  from the pod's ``tpu_request`` — the same label the scheduler
+  granted capacity against, so the router prices backlog off what
+  the pod actually holds);
+- a deleted serving pod deregisters, which requeues its queued and
+  in-flight requests (the router's conservation path — nothing is
+  lost when a replica dies under the daemon either).
+
+Both hooks are idempotent: the informer replays adds on every
+reconnect and the plugin notifies on external-bind reconciliation
+too, so "already registered" is the common case, not an error. The
+watch never raises into the informer thread — a malformed label is
+logged and the pod ignored (it still schedules fine; it just never
+serves traffic), because one bad serving pod must not take down pod
+event handling for the whole cluster.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+from ..scheduler import constants as C
+
+
+class ServingPodWatch:
+    def __init__(self, router, clock: Callable[[], float] = time.monotonic,
+                 log=None):
+        self.router = router
+        self.clock = clock
+        self.log = log or (lambda *a, **k: None)
+        self.registered = 0
+        self.deregistered = 0
+        self.malformed = 0
+
+    @staticmethod
+    def is_serving_pod(pod) -> bool:
+        return bool(pod.labels.get(C.LABEL_SERVING_MODEL))
+
+    def pod_bound(self, pod) -> bool:
+        """A pod the informer reports BOUND. Returns True when a new
+        replica registered (False: not a serving pod / already
+        registered / malformed)."""
+        model = pod.labels.get(C.LABEL_SERVING_MODEL)
+        if not model:
+            return False
+        if self.router.registry.get(pod.key) is not None:
+            return False  # replayed add / our own bind echo
+        try:
+            slots = int(pod.labels.get(
+                C.LABEL_SERVING_SLOTS, self.router.replica_slots
+            ))
+            raw_max = pod.labels.get(C.LABEL_SERVING_MAX_PROMPT)
+            max_prompt = int(raw_max) if raw_max is not None else None
+            raw_chips = pod.labels.get(C.LABEL_TPU_REQUEST)
+            chips = float(raw_chips) if raw_chips is not None else None
+            self.router.register(
+                pod.key, model, slots, chips=chips,
+                max_prompt_len=max_prompt, now=self.clock(),
+            )
+        except (TypeError, ValueError) as exc:
+            # never raise into the informer thread: a bad label on one
+            # serving pod must not break pod event handling
+            self.malformed += 1
+            self.log(f"serving watch: ignoring {pod.key}: {exc}")
+            return False
+        self.registered += 1
+        self.log(f"serving watch: registered {pod.key} "
+                 f"model={model} slots={slots}")
+        return True
+
+    def pod_deleted(self, pod) -> List[str]:
+        """A pod left the cluster. Deregisters its replica if it had
+        one; returns the interrupted in-flight rids (empty for
+        non-serving / unknown pods)."""
+        if self.router.registry.get(pod.key) is None:
+            return []
+        interrupted = self.router.deregister(pod.key, self.clock())
+        self.deregistered += 1
+        self.log(f"serving watch: deregistered {pod.key} "
+                 f"(interrupted {len(interrupted)} streams)")
+        return interrupted
+
+    def snapshot(self) -> dict:
+        return {
+            "registered": self.registered,
+            "deregistered": self.deregistered,
+            "malformed": self.malformed,
+        }
+
+
+def tenant_of(pod) -> Optional[str]:
+    """The quota tenant a serving pod's traffic should be charged to
+    (LABEL_TENANT, else the namespace — the same resolution the pod
+    quota plane uses)."""
+    return pod.labels.get(C.LABEL_TENANT) or pod.namespace

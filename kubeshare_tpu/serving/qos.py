@@ -1,0 +1,242 @@
+"""Request-layer QoS: weighted-DRF tenant lanes and the drain-time
+model for token-level admission.
+
+The pod layer already has weighted dominant-resource fairness — the
+quota plane orders tenants by ``share_key = dominant_share / weight``
+and schedules the most-underserved first (quota/policy.py). This
+module extends that contract to the REQUEST layer with the same
+currency:
+
+- ``RequestDrfClock`` charges each tenant the work it has been
+  granted (prompt tokens admitted — the request-layer analog of chips
+  held) and exposes ``share_key(tenant)`` = normalized charged share
+  / TenantRegistry weight. The weights are the SAME TenantSpec
+  weights the pod layer reads; a tenant weighted 3x at the chip layer
+  is weighted 3x at the request layer with zero extra configuration.
+  An optional ``share_base`` callable folds the pod-layer
+  ``QuotaPlane.share_key`` into the ordering so a tenant hogging
+  chips starts behind in the request queue too.
+- ``LaneQueue`` is the queue discipline: per-tenant FIFO lanes,
+  iterated most-underserved-tenant-first (ascending share_key,
+  deterministic tenant-name tie-break), FIFO within a lane. It is
+  deque-compatible on exactly the surface the router uses (append /
+  len / iter / indexed del / clear / extend), so the router's
+  dispatch scan — "first fitting request in queue order" — becomes
+  weighted DRF without touching the dispatch code.
+
+**The differential pin**: with a single tenant a LaneQueue is ONE
+FIFO lane, and every operation degenerates to the plain deque the
+seed router used — same iteration order, same del semantics, same
+rebuild order under tick. Single-tenant traffic therefore gets
+decision-for-decision identical routing with QoS on
+(tests/test_serving_qos.py replays randomized traffic through both
+and compares every RouteResult).
+
+Token-level admission lives here too: ``slot_drains`` reads per-slot
+decode progress off a live DecodeServer (``generated[i]`` steps
+toward ``max_new`` — host-side mirrors, no device fetch) and
+``modeled_wait`` turns it into "how long would queue position k wait
+on this replica", the k-th soonest slot drain. Slots with no
+progress signal are charged the full ``bound`` — the model never
+promises more than it can see.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import deque
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+from ..quota.tenant import TenantRegistry
+
+
+class RequestDrfClock:
+    """Weighted-DRF accounting for request-layer work.
+
+    Work units are prompt tokens admitted (prefill cost is what a
+    request takes from the fleet at admission time; decode cost is
+    charged by occupancy itself). ``share_key`` is comparable across
+    tenants: charged share of total work, normalized, divided by the
+    tenant's quota-plane weight — ascending order = most underserved
+    first, exactly the pod layer's convention.
+    """
+
+    def __init__(self, tenants: Optional[TenantRegistry] = None,
+                 share_base: Optional[Callable[[str], float]] = None):
+        self.tenants = tenants or TenantRegistry()
+        self.share_base = share_base
+        self._charged: Dict[str, float] = {}
+        self._total = 0.0
+
+    def weight(self, tenant: str) -> float:
+        return self.tenants.spec(tenant).weight
+
+    def charge(self, tenant: str, units: float) -> None:
+        """Grant ``units`` of work (prompt tokens) to ``tenant``."""
+        if units <= 0:
+            units = 1.0  # every admission costs at least one unit
+        self._charged[tenant] = self._charged.get(tenant, 0.0) + units
+        self._total += units
+
+    def charged(self, tenant: str) -> float:
+        return self._charged.get(tenant, 0.0)
+
+    def share_key(self, tenant: str) -> float:
+        """Ascending = most underserved first (ties: tenant name)."""
+        share = self._charged.get(tenant, 0.0) / max(1.0, self._total)
+        if self.share_base is not None:
+            share += self.share_base(tenant)
+        return share / self.weight(tenant)
+
+    def snapshot(self) -> Dict[str, dict]:
+        return {
+            t: {
+                "charged": round(self._charged.get(t, 0.0), 3),
+                "weight": self.weight(t),
+                "share_key": round(self.share_key(t), 6),
+            }
+            for t in sorted(self._charged)
+        }
+
+
+class LaneQueue:
+    """Per-tenant FIFO lanes, iterated in weighted-DRF order.
+
+    Deque-compatible on the router's queue surface. Iteration
+    flattens lanes most-underserved-tenant-first (ascending
+    ``clock.share_key``, tenant name tie-break), FIFO within each
+    lane; ``__delitem__`` indices refer to THAT flattened order, the
+    contract the router's dispatch scan relies on (``enumerate`` the
+    queue, delete the first fitting index). One tenant == one lane ==
+    a plain FIFO deque, which is what pins the single-tenant
+    differential.
+    """
+
+    __slots__ = ("_clock", "_lanes")
+
+    def __init__(self, clock: RequestDrfClock):
+        self._clock = clock
+        self._lanes: Dict[str, deque] = {}
+
+    # -- deque surface ------------------------------------------------
+
+    def append(self, req) -> None:
+        lane = self._lanes.get(req.tenant)
+        if lane is None:
+            lane = self._lanes[req.tenant] = deque()
+        lane.append(req)
+
+    def extend(self, reqs) -> None:
+        for req in reqs:
+            self.append(req)
+
+    def clear(self) -> None:
+        self._lanes.clear()
+
+    def __len__(self) -> int:
+        return sum(len(lane) for lane in self._lanes.values())
+
+    def __bool__(self) -> bool:
+        return any(self._lanes.values())
+
+    def _lane_order(self) -> List[str]:
+        return sorted(
+            (t for t, lane in self._lanes.items() if lane),
+            key=lambda t: (self._clock.share_key(t), t),
+        )
+
+    def __iter__(self) -> Iterator:
+        for tenant in self._lane_order():
+            yield from self._lanes[tenant]
+
+    def __delitem__(self, index: int) -> None:
+        if index < 0:
+            raise IndexError(index)
+        seen = 0
+        for tenant in self._lane_order():
+            lane = self._lanes[tenant]
+            if index < seen + len(lane):
+                del lane[index - seen]
+                if not lane:
+                    del self._lanes[tenant]
+                return
+            seen += len(lane)
+        raise IndexError(index)
+
+    # -- lane-aware backpressure --------------------------------------
+
+    def evict_overserved(self, tenant: str):
+        """Pop (and return) the NEWEST queued request of the most
+        overserved OTHER lane, iff that lane's share_key is strictly
+        above ``tenant``'s — the pool-full relief valve: an
+        underserved tenant arriving at a full queue displaces the
+        noisy tenant's freshest request instead of being refused, so
+        backpressure lands on whoever exceeded their share. None =
+        no strictly-more-overserved lane exists; with a single
+        tenant that is ALWAYS None, so the caller refuses the new
+        request exactly like the seed FIFO router (the differential
+        pin survives)."""
+        key = self._clock.share_key(tenant)
+        for t in reversed(self._lane_order()):
+            if t == tenant:
+                continue
+            if self._clock.share_key(t) <= key:
+                return None  # descending order: nothing above remains
+            lane = self._lanes[t]
+            victim = lane.pop()
+            if not lane:
+                del self._lanes[t]
+            return victim
+        return None
+
+    # -- QoS reads ----------------------------------------------------
+
+    def lane_depths(self) -> Dict[str, int]:
+        return {
+            t: len(lane) for t, lane in sorted(self._lanes.items())
+            if lane
+        }
+
+
+# -- token-level admission: the drain-time model ----------------------
+
+
+def live_slot_drains(server,
+                     decode_s_per_token: float) -> List[float]:
+    """Remaining decode seconds per ACTIVE slot of a live DecodeServer,
+    modeled from its host-side step counters: a slot that has
+    generated ``g`` of ``max_new`` tokens drains in
+    ``(max_new - g) * decode_s_per_token`` (eos may land sooner — the
+    model is an upper bound per slot)."""
+    drains: List[float] = []
+    for i in range(server.slots):
+        if not server.active[i]:
+            continue
+        remaining = max(0, server.max_new - server.generated[i])
+        drains.append(remaining * decode_s_per_token)
+    return drains
+
+
+def modeled_wait(drains: Sequence[Optional[float]], position: int,
+                 bound: float) -> float:
+    """How long queue position ``position`` (0-based) waits on a
+    replica whose busy slots drain in ``drains`` seconds (None = no
+    progress signal — charged the full ``bound``). The request at
+    position k is admitted when the (k+1)-th soonest slot retires;
+    positions beyond the visible slot set wait at least ``bound``
+    (the model refuses to promise past its horizon). Known drains are
+    NOT clamped: an admission rule comparing the result against
+    ``bound`` must be able to see a wait overrunning it."""
+    known = sorted(bound if d is None else float(d) for d in drains)
+    if position < len(known):
+        return known[position]
+    return bound
+
+
+def prefix_key(tokens: Sequence[int], prefix_tokens: int) -> str:
+    """Stable digest of a prompt's first ``prefix_tokens`` tokens —
+    the affinity memory's key. Hashlib, not ``hash()``: the digest
+    must be stable across processes so a router rebuilt after a
+    restart re-learns the same keys the clients resubmit."""
+    head = ",".join(str(t) for t in tokens[:prefix_tokens])
+    return hashlib.blake2s(head.encode(), digest_size=8).hexdigest()

@@ -59,7 +59,12 @@ class Replica:
 
 
 class ReplicaRegistry:
-    def __init__(self):
+    def __init__(self, queue_factory=None):
+        # queue_factory lets the router swap the per-replica queue
+        # discipline (plain FIFO deque by default; per-tenant DRF
+        # lanes when the QoS plane is on — serving/qos.LaneQueue is
+        # deque-compatible on the routing surface)
+        self.queue_factory = queue_factory
         self._by_pod: Dict[str, Replica] = {}
         self._by_model: Dict[str, Dict[str, Replica]] = {}
 
@@ -74,6 +79,8 @@ class ReplicaRegistry:
         replica = Replica(pod_key, model, slots, chips=chips,
                           max_prompt_len=max_prompt_len, server=server,
                           registered_at=now)
+        if self.queue_factory is not None:
+            replica.queue = self.queue_factory()
         self._by_pod[pod_key] = replica
         self._by_model.setdefault(model, {})[pod_key] = replica
         return replica

@@ -8,11 +8,27 @@ Admission policy (tests/test_serving_router.py pins each rule):
   MOST free slots among replicas whose compile buckets fit its prompt
   (deterministic pod-key tie-break). The invariant: the router never
   admits onto a replica while another replica has more free
-  slots.
+  slots. With prefix affinity enabled (``affinity=``), a replica that
+  is REMEMBERED warm for the request's prompt head wins among
+  free-slot candidates instead — the one deliberate, opt-in
+  relaxation of the invariant — and the exact least-loaded choice
+  remains the fallback whenever no affinity signal exists.
 - **Join-shortest-queue**: with every slot busy, the request waits in
   the shortest per-replica queue, bounded at ``queue_depth`` — a
   bounded queue turns overload into fast "retry later" feedback
-  instead of unbounded latency.
+  instead of unbounded latency. With ``token_admission`` on, queue
+  placement uses the drain-time model instead: join the replica whose
+  k-th busy slot retires SOONEST (per-slot decode progress, see
+  serving/qos.py), so TTFT at high occupancy tracks actual slot
+  drains instead of queue lengths; replicas with no progress signal
+  are charged the full ``drain_bound_s``, which makes the policy
+  degrade to exact JSQ when nothing reports progress.
+- **Per-tenant weighted DRF** (``qos=True``): every queue becomes
+  per-tenant FIFO lanes served most-underserved-tenant-first, ordered
+  by the same TenantRegistry weights the pod-layer quota plane uses
+  (serving/qos.py). Single-tenant traffic degenerates to one FIFO
+  lane — decision-for-decision identical to the seed router, which
+  tests/test_serving_qos.py pins differentially.
 - **Shedding, honestly classified**: ``pool-full`` and
   ``queue-timeout`` are *retry later* (more replicas fix them —
   exactly what the demand ledger entry asks the autoscaler for);
@@ -20,9 +36,10 @@ Admission policy (tests/test_serving_router.py pins each rule):
   bucket fits it; retrying forever would be lying to the client —
   the same contract DecodeServer.admit_reason exposes per server).
 - **Conservation**: every submitted request ends in exactly one of
-  served / shed / in-flight (decoding or queued). Replica kill
-  requeues both its queued and in-flight requests with their ORIGINAL
-  arrival times, so disruption stays visible in the wait metrics.
+  served / shed / in-flight (decoding or queued), fleet-wide AND per
+  tenant. Replica kill requeues both its queued and in-flight
+  requests with their ORIGINAL arrival times, so disruption stays
+  visible in the wait metrics.
 
 Backlog that survives a ``tick`` becomes a ``no-free-slot`` entry in
 the DemandLedger — key ``slots::<model>`` (the ``::`` cannot
@@ -40,8 +57,11 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..autoscale.demand import REASON_NO_FREE_SLOT
+from ..quota.tenant import TenantRegistry
 from ..utils import expfmt
 from ..utils.trace import Histogram
+from .affinity import PrefixAffinity
+from .qos import LaneQueue, RequestDrfClock, modeled_wait
 from .registry import Replica, ReplicaRegistry
 
 # Shed reason codes. The first two are load conditions a bigger pool
@@ -52,6 +72,7 @@ from .registry import Replica, ReplicaRegistry
 SHED_POOL_FULL = "pool-full"
 SHED_TIMEOUT = "queue-timeout"
 SHED_OVERSIZED = "oversized-prompt"
+SHED_DRAIN_BOUND = "drain-bound"
 
 # Request-scale latency buckets (seconds): TTFT and queue wait live in
 # the 50ms..minutes range — the scheduler's 1s..4h pod-wait buckets
@@ -90,6 +111,10 @@ class Request:
     # optional live tokens: with a registered DecodeServer the router
     # prefills on admission and hands back the first token
     prompt: Optional[Sequence[int]] = None
+    # optional client-supplied prefix digest for affinity routing when
+    # the router never sees raw tokens (the sim and remote clients set
+    # it; with live tokens the router hashes the head itself)
+    prefix_hash: Optional[str] = None
     # when the request LAST entered a queue (router-maintained):
     # the timeout clock. Distinct from ``arrival`` — a request
     # requeued by a replica kill keeps its arrival for the wait
@@ -121,6 +146,25 @@ class _ModelCounts:
         return sum(self.shed.values())
 
 
+class _TenantCounts:
+    """The per-tenant mirror of _ModelCounts — same outcomes, keyed by
+    who asked instead of what they asked for. The pair lets one shed
+    be attributed twice (model view for capacity, tenant view for
+    fairness) while conservation holds in BOTH projections."""
+
+    __slots__ = ("submitted", "served", "shed", "requeued", "admitted")
+
+    def __init__(self):
+        self.submitted = 0
+        self.served = 0
+        self.shed: Dict[str, int] = {}
+        self.requeued = 0
+        self.admitted = 0
+
+    def shed_total(self) -> int:
+        return sum(self.shed.values())
+
+
 @dataclass
 class _TickOutcome:
     admitted: List[Tuple[Request, str]] = field(default_factory=list)
@@ -138,10 +182,16 @@ class RequestRouter:
         default_max_prompt_len: Optional[int] = None,
         replica_slots: int = 8,
         replica_chips: float = 1.0,
+        tenants=None,
+        qos: bool = False,
+        share_base=None,
+        token_admission: bool = False,
+        decode_s_per_token: float = 0.05,
+        drain_bound_s: float = 30.0,
+        affinity: Optional[PrefixAffinity] = None,
     ):
         if queue_depth < 0:
             raise ValueError(f"queue_depth must be >= 0, got {queue_depth}")
-        self.registry = registry or ReplicaRegistry()
         self.demand = demand
         self.queue_depth = queue_depth
         self.queue_timeout_s = queue_timeout_s
@@ -151,14 +201,45 @@ class RequestRouter:
         # used for demand conversion while no replica is live yet
         self.replica_slots = replica_slots
         self.replica_chips = replica_chips
+        # QoS plane: the DRF clock always exists (per-tenant accounting
+        # and share-key gauges are free); the ``qos`` flag only decides
+        # whether queues are tenant lanes or the seed's plain FIFO
+        self.qos = qos
+        self.qos_clock = RequestDrfClock(
+            TenantRegistry.from_config(tenants), share_base=share_base,
+        )
+        self.token_admission = token_admission
+        self.decode_s_per_token = decode_s_per_token
+        self.drain_bound_s = drain_bound_s
+        self.affinity = affinity
+        self.registry = registry or ReplicaRegistry(
+            queue_factory=self._new_queue if qos else None
+        )
         # rid -> (pod_key, request, live server slot or None)
         self._active: Dict[str, Tuple[str, Request, Optional[int]]] = {}
+        # rid -> modeled absolute finish time (sim note_progress); the
+        # live path reads DecodeServer step counters instead
+        self._drain_at: Dict[str, float] = {}
+        # set by _enqueue when the drain model (not capacity) refused
+        self._drain_refused = False
         # model-level waiting room used only while NO replica
         # exists (cold start / total kill) — bounded like one replica
-        self._unattached: Dict[str, deque] = {}
+        self._unattached: Dict[str, object] = {}
         self._counts: Dict[str, _ModelCounts] = {}
+        self._tenant_counts: Dict[str, _TenantCounts] = {}
         self._wait_hist: Dict[str, Histogram] = {}
         self._ttft_hist: Dict[str, Histogram] = {}
+        self._tenant_wait_hist: Dict[str, Histogram] = {}
+        # per-model pool pricing memory (chips, slots, replicas) — the
+        # last non-empty fleet observed, so a model whose replicas ALL
+        # deregistered keeps pricing its backlog off its own pool
+        # instead of the global template (heterogeneous fleets price
+        # per model, never fleet-mean across models)
+        self._pool_price: Dict[str, Tuple[float, int, int]] = {}
+        self._model_template: Dict[str, Tuple[int, float]] = {}
+
+    def _new_queue(self):
+        return LaneQueue(self.qos_clock) if self.qos else deque()
 
     # -- membership (delegates + conservation) -----------------------
 
@@ -168,7 +249,7 @@ class RequestRouter:
                  server=None, now: float = 0.0) -> Replica:
         """A serving pod bound: it joins the routing table. The next
         ``tick``/``complete`` dispatch pulls waiting requests onto it."""
-        return self.registry.register(
+        replica = self.registry.register(
             pod_key, model, slots,
             chips=self.replica_chips if chips is None else chips,
             max_prompt_len=(max_prompt_len
@@ -176,15 +257,19 @@ class RequestRouter:
                             else self.default_max_prompt_len),
             server=server, now=now,
         )
+        self._note_pool(model)
+        return replica
 
     def register_server(self, pod_key: str, model: str, server,
                         chips: Optional[float] = None,
                         now: float = 0.0) -> Replica:
-        return self.registry.register_server(
+        replica = self.registry.register_server(
             pod_key, model, server,
             chips=self.replica_chips if chips is None else chips,
             now=now,
         )
+        self._note_pool(model)
+        return replica
 
     def deregister(self, pod_key: str, now: float) -> List[str]:
         """The replica's pod was deleted or killed. Its queued AND
@@ -196,10 +281,14 @@ class RequestRouter:
         replica = self.registry.deregister(pod_key)
         if replica is None:
             return []
+        self._note_pool(replica.model)
+        if self.affinity is not None:
+            self.affinity.forget_replica(pod_key)
         interrupted: List[str] = []
         displaced: List[Request] = []
         for rid in list(replica.busy):
             entry = self._active.pop(rid, None)
+            self._drain_at.pop(rid, None)
             if entry is None:
                 continue
             interrupted.append(rid)
@@ -210,14 +299,15 @@ class RequestRouter:
         for req in displaced:
             counts = self._model_counts(req.model)
             counts.requeued += 1
+            self._tenant_counts_for(req.tenant).requeued += 1
             # queue-only placement: admission happens at the next
             # tick/complete dispatch, whose results the caller SEES —
             # admitting here would start streams nobody schedules
             # completions for
             if self._enqueue(req, now=now) is None:
-                counts.shed[SHED_POOL_FULL] = (
-                    counts.shed.get(SHED_POOL_FULL, 0) + 1
-                )
+                self._shed(counts, req.tenant,
+                           SHED_DRAIN_BOUND if self._drain_refused
+                           else SHED_POOL_FULL)
         return interrupted
 
     # -- admission ----------------------------------------------------
@@ -225,6 +315,7 @@ class RequestRouter:
     def submit(self, req: Request, now: float) -> RouteResult:
         counts = self._model_counts(req.model)
         counts.submitted += 1
+        self._tenant_counts_for(req.tenant).submitted += 1
         if self.registry.replica_count(req.model):
             # live replicas define the ceiling; None = some replica
             # takes anything, so "never" would be a lie
@@ -234,16 +325,16 @@ class RequestRouter:
         if limit is not None and req.prompt_len > limit:
             # "never": no replica's largest compile bucket fits it —
             # shed immediately instead of retrying forever
-            counts.shed[SHED_OVERSIZED] = (
-                counts.shed.get(SHED_OVERSIZED, 0) + 1
-            )
+            self._shed(counts, req.tenant, SHED_OVERSIZED)
             return RouteResult("shed", reason=SHED_OVERSIZED,
                                retryable=False)
         result = self._route(req, now, counts)
         if result is not None:
             return result
-        counts.shed[SHED_POOL_FULL] = counts.shed.get(SHED_POOL_FULL, 0) + 1
-        return RouteResult("shed", reason=SHED_POOL_FULL, retryable=True)
+        reason = (SHED_DRAIN_BOUND if self._drain_refused
+                  else SHED_POOL_FULL)
+        self._shed(counts, req.tenant, reason)
+        return RouteResult("shed", reason=reason, retryable=True)
 
     def _route(self, req: Request, now: float,
                counts: _ModelCounts) -> Optional[RouteResult]:
@@ -257,22 +348,47 @@ class RequestRouter:
         if fitting:
             best = min(fitting, key=lambda r: (-r.free_slots, r.pod_key))
             if best.free_slots > 0:
-                return self._admit(best, req, now, counts)
+                warm = self._affinity_pick(req, fitting)
+                return self._admit(warm or best, req, now, counts)
         placed = self._enqueue(req, fitting, now=now)
         if placed is not None:
             return RouteResult("queued", replica=placed)
+        return None
+
+    def _affinity_pick(self, req: Request,
+                       fitting: List[Replica]) -> Optional[Replica]:
+        """The replica remembered warm for this prompt head, IF it has
+        a free slot right now — affinity never overrides capacity
+        (a warm-but-full replica is worth one prefill, not a queue
+        wait). None = no signal / cold / full: caller falls back to
+        the exact least-loaded choice."""
+        if self.affinity is None:
+            return None
+        key = self.affinity.key_for(req)
+        if key is None:
+            return None
+        owner = self.affinity.owner(key)
+        for r in fitting:
+            if r.pod_key == owner and r.free_slots > 0:
+                self.affinity.observe(hit=True)
+                return r
+        self.affinity.observe(hit=False)
         return None
 
     def _enqueue(self, req: Request,
                  fitting: Optional[List[Replica]] = None,
                  now: Optional[float] = None) -> Optional[str]:
         """Queue ``req`` without admitting: shortest fitting bounded
-        queue (JSQ), else the cold-start waiting room. Returns the
-        chosen replica's pod key ("" for the waiting room), or None
-        when everything is full — the ONE queue-placement policy both
-        submit and the deregister requeue go through. Stamps
-        ``queued_since`` so the timeout clock starts at THIS
-        enqueue, not at first arrival."""
+        queue (JSQ) — or, with ``token_admission``, the fitting queue
+        whose modeled drain admits position k soonest — else the
+        cold-start waiting room. Returns the chosen replica's pod key
+        ("" for the waiting room), or None when everything is full —
+        the ONE queue-placement policy both submit and the deregister
+        requeue go through. Stamps ``queued_since`` so the timeout
+        clock starts at THIS enqueue, not at first arrival. A None
+        return with ``_drain_refused`` set means the drain model —
+        not capacity — refused (callers shed it as drain-bound)."""
+        self._drain_refused = False
         if now is not None:
             req.queued_since = now
         if fitting is None:
@@ -281,23 +397,126 @@ class RequestRouter:
                 if r.fits_prompt(req.prompt_len)
             ]
         if fitting:
+            if self.token_admission:
+                open_q = [
+                    r for r in fitting
+                    if len(r.queue) < self.queue_depth
+                ]
+                if not open_q:
+                    return self._evict_into(req, fitting)
+                # queue length stays the PRIMARY key — JSQ's balance
+                # is what protects the median wait. The drain model
+                # does two things on top: it replaces the seed's
+                # pod_key tie-break (among equally-short queues,
+                # admit where a slot is almost free), and it REFUSES
+                # a position whose modeled wait overruns
+                # drain_bound_s — the request is better shed
+                # retryable now than parked where the model already
+                # knows every slot stays busy past the bound. Slots
+                # with no progress signal charge exactly the bound,
+                # so an all-unknown fleet degrades to plain JSQ with
+                # nothing refused. Pure min-modeled-wait was tried
+                # and rejected: greedy placement concentrates
+                # backlog and trades the median for the tail.
+                t = 0.0 if now is None else now
+                bound = self.drain_bound_s
+                scored = []
+                for r in open_q:
+                    wait = modeled_wait(self._replica_drains(r, t),
+                                        len(r.queue), bound)
+                    if wait <= bound:
+                        scored.append((len(r.queue), wait, r.pod_key, r))
+                if not scored:
+                    self._drain_refused = True
+                    return None
+                depth, wait, pod_key, chosen = min(
+                    scored, key=lambda s: s[:3])
+                chosen.queue.append(req)
+                return pod_key
             shortest = min(
                 fitting, key=lambda r: (len(r.queue), r.pod_key)
             )
             if len(shortest.queue) < self.queue_depth:
                 shortest.queue.append(req)
                 return shortest.pod_key
-            return None
-        waiting = self._unattached.setdefault(req.model, deque())
+            return self._evict_into(req, fitting)
+        waiting = self._unattached.get(req.model)
+        if waiting is None:
+            waiting = self._unattached[req.model] = self._new_queue()
         if len(waiting) < self.queue_depth:
             waiting.append(req)
             return ""
+        evict = getattr(waiting, "evict_overserved", None)
+        if evict is not None:
+            victim = evict(req.tenant)
+            if victim is not None:
+                self._shed(self._model_counts(victim.model),
+                           victim.tenant, SHED_POOL_FULL)
+                waiting.append(req)
+                return ""
         return None
+
+    def _evict_into(self, req: Request,
+                    fitting: List[Replica]) -> Optional[str]:
+        """Lane-aware backpressure at pool-full (QoS queues only):
+        displace the most-overserved lane's newest request on the
+        least-loaded fitting replica and queue ``req`` in its place.
+        One request is shed either way — totals are untouched, only
+        WHO absorbs the overflow changes (the tenant past its share,
+        not whoever happened to arrive next). Plain deque queues
+        (qos off) have no evict_overserved, so this is a straight
+        refusal there — the seed behavior."""
+        for r in sorted(fitting, key=lambda r: (len(r.queue), r.pod_key)):
+            evict = getattr(r.queue, "evict_overserved", None)
+            if evict is None:
+                return None
+            victim = evict(req.tenant)
+            if victim is None:
+                continue
+            self._shed(self._model_counts(victim.model),
+                       victim.tenant, SHED_POOL_FULL)
+            r.queue.append(req)
+            return r.pod_key
+        return None
+
+    def _replica_drains(self, replica: Replica,
+                        now: float) -> List[Optional[float]]:
+        """Remaining seconds per busy slot: the sim's ``note_progress``
+        finish times when present, else live DecodeServer step
+        counters (generated/max_new — host-side, no device fetch),
+        else None (no signal, ``modeled_wait`` charges the bound)."""
+        drains: List[Optional[float]] = []
+        server = replica.server
+        for rid in replica.busy:
+            at = self._drain_at.get(rid)
+            if at is not None:
+                drains.append(max(0.0, at - now))
+                continue
+            entry = self._active.get(rid)
+            slot = entry[2] if entry is not None else None
+            if (server is not None and slot is not None
+                    and server.active[slot]):
+                remaining = max(
+                    0, server.max_new - server.generated[slot]
+                )
+                drains.append(remaining * self.decode_s_per_token)
+            else:
+                drains.append(None)
+        return drains
+
+    def note_progress(self, rid: str, finish_at: float) -> None:
+        """An in-flight request's modeled completion time (the sim
+        reports it at admission; live replicas need no call — the
+        router reads their step counters directly). Feeds ONLY the
+        token-admission drain model; ignored for unknown rids."""
+        if rid in self._active:
+            self._drain_at[rid] = finish_at
 
     def _admit(self, replica: Replica, req: Request, now: float,
                counts: _ModelCounts) -> RouteResult:
         wait = max(0.0, now - req.arrival)
         self._hist(self._wait_hist, req.model).observe(wait)
+        self._hist(self._tenant_wait_hist, req.tenant).observe(wait)
         first = None
         slot = None
         if replica.server is not None and req.prompt is not None:
@@ -309,9 +528,7 @@ class RequestRouter:
                 # the probe said yes but the server refused: treat as
                 # pool-full so the request stays accounted (defensive —
                 # the registry's slot mirror makes this unreachable)
-                counts.shed[SHED_POOL_FULL] = (
-                    counts.shed.get(SHED_POOL_FULL, 0) + 1
-                )
+                self._shed(counts, req.tenant, SHED_POOL_FULL)
                 return RouteResult("shed", reason=SHED_POOL_FULL,
                                    retryable=True)
             slot, first = out
@@ -331,6 +548,12 @@ class RequestRouter:
         replica.busy[req.rid] = req
         self._active[req.rid] = (replica.pod_key, req, slot)
         counts.admitted += 1
+        self._tenant_counts_for(req.tenant).admitted += 1
+        # DRF: the tenant just got prompt_len units of fleet work — its
+        # lanes move back accordingly on the next queue iteration
+        self.qos_clock.charge(req.tenant, float(req.prompt_len))
+        if self.affinity is not None:
+            self.affinity.note(req, replica.pod_key)
         return RouteResult("admitted", replica=replica.pod_key,
                            first_token=first)
 
@@ -342,10 +565,12 @@ class RequestRouter:
         admitted ``(request, pod_key)`` pairs (the sim schedules their
         completions from this)."""
         entry = self._active.pop(rid, None)
+        self._drain_at.pop(rid, None)
         if entry is None:
             return []
         pod_key, req, slot = entry
         self._model_counts(req.model).served += 1
+        self._tenant_counts_for(req.tenant).served += 1
         replica = self.registry.get(pod_key)
         if replica is not None:
             replica.busy.pop(rid, None)
@@ -358,7 +583,9 @@ class RequestRouter:
         """Fill free slots from the queues, least-loaded first. A
         replica with free slots drains its own queue, then steals from
         the LONGEST same-model queue (keeps JSQ balanced after a
-        retire burst), then the unattached waiting room."""
+        retire burst), then the unattached waiting room. Queue
+        iteration order IS the QoS policy: plain FIFO by default,
+        most-underserved-tenant-first when the queues are DRF lanes."""
         admitted: List[Tuple[Request, str]] = []
         counts = self._model_counts(model)
         while True:
@@ -383,7 +610,7 @@ class RequestRouter:
                 return admitted
 
     def _take_for(self, replica: Replica, model: str) -> Optional[Request]:
-        sources: List[deque] = [replica.queue]
+        sources: List = [replica.queue]
         sources += [
             r.queue for r in sorted(
                 self.registry.replicas(model),
@@ -440,7 +667,7 @@ class RequestRouter:
                     else:
                         kept.append(req)
                         continue
-                    counts.shed[reason] = counts.shed.get(reason, 0) + 1
+                    self._shed(counts, req.tenant, reason)
                     out.shed.append((req, reason))
                 queue.clear()
                 queue.extend(kept)
@@ -465,14 +692,40 @@ class RequestRouter:
 
     # -- planner surface ----------------------------------------------
 
+    def set_replica_template(self, model: str, slots: int,
+                             chips: float) -> None:
+        """What one replica of THIS model's pool brings — the
+        cold-start pricing for a model that has never had a live
+        replica (a heterogeneous fleet must not size model A's first
+        replica off model B's global template)."""
+        self._model_template[model] = (max(1, int(slots)), float(chips))
+
+    def _note_pool(self, model: str) -> None:
+        replicas = self.registry.replicas(model)
+        if replicas:
+            self._pool_price[model] = (
+                sum(r.chips for r in replicas),
+                sum(r.slots for r in replicas),
+                len(replicas),
+            )
+
     def chips_per_slot(self, model: str) -> float:
-        """Fleet-wide chips/slots ratio (totals, not replicas[0]): a
-        heterogeneous pool must not price its backlog off whichever
-        replica happens to sort first."""
+        """THIS model pool's chips/slots ratio (totals, not
+        replicas[0]): a heterogeneous pool must not price its backlog
+        off whichever replica happens to sort first, and a
+        multi-model fleet must never average across models. Cold
+        fallback chain: the pool's last non-empty fleet, then the
+        per-model template, then the global replica template."""
         replicas = self.registry.replicas(model)
         total_slots = sum(r.slots for r in replicas)
         if total_slots:
             return sum(r.chips for r in replicas) / total_slots
+        remembered = self._pool_price.get(model)
+        if remembered is not None and remembered[1]:
+            return remembered[0] / remembered[1]
+        template = self._model_template.get(model)
+        if template is not None:
+            return template[1] / template[0]
         return self.replica_chips / max(1, self.replica_slots)
 
     def backlog(self, model: str) -> int:
@@ -481,9 +734,10 @@ class RequestRouter:
 
     def capacity_snapshot(self):
         """Per-model ``ServingCapacity`` rows for PlannerSnapshot —
-        models with a backlog but no replica yet (cold start) report
-        with the configured replica template so the slot-sizing term
-        can size the FIRST replica too."""
+        models with a backlog but no replica yet report with their OWN
+        pool's remembered or template sizing (global template only for
+        a model never seen live) so the slot-sizing term can size the
+        FIRST replica of each pool correctly."""
         from ..autoscale.recommend import ServingCapacity
 
         rows = []
@@ -491,15 +745,12 @@ class RequestRouter:
             replicas = self.registry.replicas(model)
             # fleet means (order-independent): what the NEXT replica
             # of this pool is expected to bring
-            slots_per = (
-                max(1, round(sum(r.slots for r in replicas)
-                             / len(replicas)))
-                if replicas else self.replica_slots
-            )
-            chips = (
-                sum(r.chips for r in replicas) / len(replicas)
-                if replicas else self.replica_chips
-            )
+            if replicas:
+                slots_per = max(1, round(sum(r.slots for r in replicas)
+                                         / len(replicas)))
+                chips = sum(r.chips for r in replicas) / len(replicas)
+            else:
+                slots_per, chips = self._cold_template(model)
             rows.append(ServingCapacity(
                 model=model,
                 replicas=len(replicas),
@@ -510,6 +761,16 @@ class RequestRouter:
                 replica_chips=chips,
             ))
         return tuple(sorted(rows, key=lambda r: r.model))
+
+    def _cold_template(self, model: str) -> Tuple[int, float]:
+        remembered = self._pool_price.get(model)
+        if remembered is not None and remembered[2]:
+            chips_total, slots_total, n = remembered
+            return max(1, round(slots_total / n)), chips_total / n
+        template = self._model_template.get(model)
+        if template is not None:
+            return template
+        return self.replica_slots, self.replica_chips
 
     # -- accounting ---------------------------------------------------
 
@@ -539,6 +800,37 @@ class RequestRouter:
         return (c.submitted,
                 c.served + c.shed_total() + self.in_flight(model))
 
+    def in_flight_by_tenant(self) -> Dict[str, int]:
+        """Decoding + queued, keyed by tenant — the third leg of the
+        per-tenant conservation identity."""
+        out: Dict[str, int] = {}
+        for (_, req, _) in self._active.values():
+            out[req.tenant] = out.get(req.tenant, 0) + 1
+        for model in self._models_tracked():
+            for queue in self._queues(model):
+                for req in queue:
+                    out[req.tenant] = out.get(req.tenant, 0) + 1
+        return out
+
+    def queued_by_tenant(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for model in self._models_tracked():
+            for queue in self._queues(model):
+                for req in queue:
+                    out[req.tenant] = out.get(req.tenant, 0) + 1
+        return out
+
+    def conservation_by_tenant(self) -> Dict[str, Tuple[int, int]]:
+        """tenant -> (submitted, served + shed + in-flight): the
+        fleet identity must hold in the tenant projection too, or the
+        fairness numbers are built on lost requests."""
+        in_flight = self.in_flight_by_tenant()
+        return {
+            t: (c.submitted,
+                c.served + c.shed_total() + in_flight.get(t, 0))
+            for t, c in sorted(self._tenant_counts.items())
+        }
+
     def observe_ttft(self, model: str, seconds: float) -> None:
         """Time-to-first-token for one request. Live admissions call
         this inline (prefill happens inside ``admit``); the sim adds
@@ -547,15 +839,46 @@ class RequestRouter:
 
     # -- metrics ------------------------------------------------------
 
-    def request_totals(self) -> Tuple[int, int]:
+    def request_totals(self, by_tenant: bool = False):
         """Cumulative ``(submitted, shed)`` over every model — the
         incident plane's shed-rate rule snapshots this pair instead of
-        re-aggregating the full per-model sample set each evaluation."""
+        re-aggregating the full per-model sample set each evaluation.
+        With ``by_tenant=True``, the per-tenant breakdown instead:
+        ``{tenant: {submitted, served, shed, in_flight}}`` — what the
+        tenant-graded shed rule and the /router surface read."""
+        if by_tenant:
+            in_flight = self.in_flight_by_tenant()
+            return {
+                t: {
+                    "submitted": c.submitted,
+                    "served": c.served,
+                    "shed": c.shed_total(),
+                    "in_flight": in_flight.get(t, 0),
+                }
+                for t, c in sorted(self._tenant_counts.items())
+            }
         submitted = shed = 0
         for counts in self._counts.values():
             submitted += counts.submitted
             shed += counts.shed_total()
         return submitted, shed
+
+    def qos_state(self) -> dict:
+        """The /router JSON surface: discipline flags, per-tenant DRF
+        shares and outcomes, affinity memory, per-model counts."""
+        return {
+            "qos": self.qos,
+            "token_admission": self.token_admission,
+            "drain_bound_s": self.drain_bound_s,
+            "tenants": self.qos_clock.snapshot(),
+            "by_tenant": self.request_totals(by_tenant=True),
+            "queued_by_tenant": self.queued_by_tenant(),
+            "affinity": (self.affinity.snapshot()
+                         if self.affinity is not None else None),
+            "models": {
+                m: self.counts(m) for m in self._models_tracked()
+            },
+        }
 
     def samples(self) -> List["expfmt.Sample"]:
         samples: List[expfmt.Sample] = []
@@ -583,12 +906,40 @@ class RequestRouter:
                 expfmt.Sample("tpu_serving_requeued_total", labels,
                               c.requeued),
             ]
-            for reason in (SHED_POOL_FULL, SHED_TIMEOUT, SHED_OVERSIZED):
+            for reason in (SHED_POOL_FULL, SHED_TIMEOUT,
+                           SHED_OVERSIZED, SHED_DRAIN_BOUND):
                 samples.append(expfmt.Sample(
                     "tpu_serving_shed_total",
                     {**labels, "reason": reason},
                     c.shed.get(reason, 0),
                 ))
+        # tenant projection: same requests_total family keyed by WHO
+        # (no model label — the lint's value() filter keeps the two
+        # projections from colliding), plus the QoS gauges the
+        # fairness alerting grades
+        in_flight = self.in_flight_by_tenant()
+        queued = self.queued_by_tenant()
+        for tenant in sorted(self._tenant_counts):
+            tc = self._tenant_counts[tenant]
+            tl = {"tenant": tenant}
+            samples += [
+                expfmt.Sample("tpu_serving_requests_total",
+                              {**tl, "outcome": "submitted"},
+                              tc.submitted),
+                expfmt.Sample("tpu_serving_requests_total",
+                              {**tl, "outcome": "served"}, tc.served),
+                expfmt.Sample("tpu_serving_requests_total",
+                              {**tl, "outcome": "shed"},
+                              tc.shed_total()),
+                expfmt.Sample("tpu_serving_qos_in_flight", tl,
+                              in_flight.get(tenant, 0)),
+                expfmt.Sample("tpu_serving_qos_lane_depth", tl,
+                              queued.get(tenant, 0)),
+                expfmt.Sample(
+                    "tpu_serving_qos_share_key", tl,
+                    round(self.qos_clock.share_key(tenant), 6),
+                ),
+            ]
         for model, hist in sorted(self._wait_hist.items()):
             samples += hist.samples(
                 "tpu_serving_queue_wait_seconds", {"model": model}
@@ -597,14 +948,30 @@ class RequestRouter:
             samples += hist.samples(
                 "tpu_serving_ttft_seconds", {"model": model}
             )
+        for tenant, hist in sorted(self._tenant_wait_hist.items()):
+            samples += hist.samples(
+                "tpu_serving_qos_wait_seconds", {"tenant": tenant}
+            )
         return samples
 
     # -- internals ----------------------------------------------------
+
+    def _shed(self, counts: _ModelCounts, tenant: str,
+              reason: str) -> None:
+        counts.shed[reason] = counts.shed.get(reason, 0) + 1
+        tc = self._tenant_counts_for(tenant)
+        tc.shed[reason] = tc.shed.get(reason, 0) + 1
 
     def _model_counts(self, model: str) -> _ModelCounts:
         counts = self._counts.get(model)
         if counts is None:
             counts = self._counts[model] = _ModelCounts()
+        return counts
+
+    def _tenant_counts_for(self, tenant: str) -> _TenantCounts:
+        counts = self._tenant_counts.get(tenant)
+        if counts is None:
+            counts = self._tenant_counts[tenant] = _TenantCounts()
         return counts
 
     def _models_tracked(self) -> List[str]:
@@ -614,7 +981,7 @@ class RequestRouter:
             | set(self._unattached)
         )
 
-    def _queues(self, model: str) -> List[deque]:
+    def _queues(self, model: str) -> List:
         queues = [r.queue for r in self.registry.replicas(model)]
         waiting = self._unattached.get(model)
         if waiting is not None:

@@ -66,6 +66,10 @@ class ServingLoopSim:
         decode_s_per_token: float = 0.03,
         replica_priority: int = 80,
         tenants=None,
+        qos: bool = False,
+        token_admission: bool = False,
+        drain_bound_s: float = 30.0,
+        affinity=None,
     ):
         self.cluster = FakeCluster()
         for node, n_chips in nodes.items():
@@ -89,6 +93,9 @@ class ServingLoopSim:
         # one ledger for chips and slots, so the explain plane and the
         # planner read serving starvation from the same place as
         # placement starvation
+        # QoS wiring mirrors the daemon's --serve-router: the SAME
+        # tenant registry orders both the pod quota plane and the
+        # request lanes (one fairness currency, two layers)
         self.router = RequestRouter(
             demand=self.engine.demand,
             queue_depth=queue_depth,
@@ -96,6 +103,12 @@ class ServingLoopSim:
             replica_slots=slots_per_replica,
             replica_chips=replica_chips,
             default_max_prompt_len=max_prompt_len,
+            tenants=self.engine.quota.registry,
+            qos=qos,
+            token_admission=token_admission,
+            decode_s_per_token=decode_s_per_token,
+            drain_bound_s=drain_bound_s,
+            affinity=affinity,
         )
         self._pod_seq = 0
         self._pending_pods: List[Pod] = []
@@ -114,6 +127,7 @@ class ServingLoopSim:
         self._finishes: List = []  # heap of (t, rid, generation)
         self.waits: List[float] = []
         self.ttfts: List[float] = []
+        self.waits_by_tenant: Dict[str, List[float]] = {}
         self.occupancy: List[dict] = []
         self.pool_exhausted_rounds = 0
 
@@ -200,14 +214,18 @@ class ServingLoopSim:
         event = self._events[req.rid]
         wait = max(0.0, now - req.arrival)
         self.waits.append(wait)
+        self.waits_by_tenant.setdefault(req.tenant, []).append(wait)
         ttft = wait + self.prefill_s
         self.ttfts.append(ttft)
         self.router.observe_ttft(req.model, ttft)
         gen = self._gen.get(req.rid, 0) + 1
         self._gen[req.rid] = gen
-        heapq.heappush(
-            self._finishes, (now + self._service_s(event), req.rid, gen)
-        )
+        finish_at = now + self._service_s(event)
+        # the sim's replicas have no live step counters, so it reports
+        # modeled completion times the way a real replica reports
+        # decode progress — the token-admission drain model reads this
+        self.router.note_progress(req.rid, finish_at)
+        heapq.heappush(self._finishes, (finish_at, req.rid, gen))
 
     def _drain_finishes(self, upto: float) -> None:
         while self._finishes and self._finishes[0][0] <= upto:
@@ -288,6 +306,7 @@ class ServingLoopSim:
                     rid=rid, model=event.model,
                     prompt_len=event.prompt_len, arrival=event.start,
                     tenant=event.tenant,
+                    prefix_hash=event.prefix_group or None,
                 )
                 result = self.router.submit(req, next_t)
                 if result.status == "admitted":
@@ -354,6 +373,30 @@ class ServingLoopSim:
 
     # -- reporting ----------------------------------------------------
 
+    def tenant_report(self) -> Dict[str, dict]:
+        """Per-tenant outcomes + wait percentiles + the DRF weight the
+        lane ordering used — the rows the fairness A/B grades (Jain
+        index over served/weight, quiet-tenant p50 wait)."""
+        by_tenant = self.router.request_totals(by_tenant=True)
+        conservation = self.router.conservation_by_tenant()
+        out: Dict[str, dict] = {}
+        for tenant, row in by_tenant.items():
+            waits = self.waits_by_tenant.get(tenant, [])
+            sub, accounted = conservation[tenant]
+            out[tenant] = {
+                **row,
+                "weight": self.router.qos_clock.weight(tenant),
+                "wait_s": {
+                    "p50": percentile(waits, 0.50),
+                    "p90": percentile(waits, 0.90),
+                    "mean": round(
+                        sum(waits) / len(waits), 3
+                    ) if waits else 0.0,
+                },
+                "conservation_exact": sub == accounted,
+            }
+        return out
+
     def report(self, horizon: float) -> dict:
         counts = self.router.counts(self.model)
         submitted, accounted = self.router.conservation(self.model)
@@ -378,6 +421,11 @@ class ServingLoopSim:
                 "accounted": accounted,
                 "exact": submitted == accounted,
             },
+            "qos": {
+                "enabled": self.router.qos,
+                "token_admission": self.router.token_admission,
+            },
+            "tenants": self.tenant_report(),
             "queue_wait_s": {
                 "p50": percentile(self.waits, 0.50),
                 "p90": percentile(self.waits, 0.90),

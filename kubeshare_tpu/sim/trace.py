@@ -54,6 +54,10 @@ class RequestEvent:
     prompt_len: int
     decode_len: int
     tenant: str = "default"
+    # prompt-head identity for prefix-affinity routing: requests with
+    # the same non-empty group share a prompt prefix (few-shot
+    # template, system prompt) and want the same warm replica
+    prefix_group: str = ""
 
 
 def load_trace(path: str) -> List[TraceEvent]:
@@ -331,6 +335,66 @@ def generate_diurnal_request_trace(
             prompt_len=prompt_len,
             decode_len=rng.randint(lo_d, hi_d),
         ))
+    return events
+
+
+def generate_adversarial_tenant_requests(
+    span_s: float = 600.0,
+    model: str = "llama-7b",
+    quiet_tenants=("batch-a", "batch-b"),
+    quiet_rps: float = 0.5,
+    burst_tenant: str = "burst",
+    burst_rps: float = 6.0,
+    burst_on_s: float = 60.0,
+    burst_off_s: float = 60.0,
+    prompt_len_range=(16, 256),
+    decode_len_range=(32, 128),
+    seed: int = 0,
+) -> List[RequestEvent]:
+    """The adversarial multi-tenant request mix for the serving-QoS
+    evidence (tools/serving_qos_sim.py): ``quiet_tenants`` submit
+    steady low-rate Poisson streams while ``burst_tenant`` slams the
+    pool with ``burst_rps`` square-wave bursts (``burst_on_s`` on,
+    ``burst_off_s`` off). Under FIFO queues every burst parks a wall
+    of noisy-tenant requests in front of whatever the quiet tenants
+    submit next — their waits and sheds track the NOISY tenant's
+    traffic. Per-tenant DRF lanes serve the underserved tenants
+    first, so quiet traffic rides through bursts at its fair share;
+    the A/B grades request-layer Jain fairness and quiet-tenant p50
+    wait at equal-or-better served count. Size distributions are
+    IDENTICAL across tenants (same ranges, one rng) so any outcome
+    skew is the queue discipline's doing, not the workload's —
+    generate_tenant_trace's convention one layer up."""
+    rng = random.Random(seed)
+    lo_p, hi_p = prompt_len_range
+    lo_d, hi_d = decode_len_range
+
+    def row(t: float, tenant: str) -> RequestEvent:
+        return RequestEvent(
+            start=round(t, 3),
+            model=model,
+            prompt_len=rng.randint(lo_p, hi_p),
+            decode_len=rng.randint(lo_d, hi_d),
+            tenant=tenant,
+        )
+
+    events: List[RequestEvent] = []
+    for tenant in quiet_tenants:
+        t = 0.0
+        while True:
+            t += rng.expovariate(quiet_rps)
+            if t >= span_s:
+                break
+            events.append(row(t, tenant))
+    period = burst_on_s + burst_off_s
+    t = 0.0
+    while True:
+        t += rng.expovariate(burst_rps)
+        if t >= span_s:
+            break
+        if (t % period) < burst_on_s:
+            events.append(row(t, burst_tenant))
+    events.sort(key=lambda e: e.start)
     return events
 
 

@@ -260,6 +260,10 @@ class TestExpositionHygiene:
             ("tpu_serving_shed_total", "gauge"),
             ("tpu_serving_queue_wait_seconds", "histogram"),
             ("tpu_serving_ttft_seconds", "histogram"),
+            ("tpu_serving_qos_in_flight", "gauge"),
+            ("tpu_serving_qos_lane_depth", "gauge"),
+            ("tpu_serving_qos_share_key", "gauge"),
+            ("tpu_serving_qos_wait_seconds", "histogram"),
             # PR-8: API robustness + crash-recovery + spool families
             ("tpu_scheduler_api_retries_total", "gauge"),
             ("tpu_scheduler_api_errors_total", "gauge"),
@@ -502,6 +506,18 @@ class TestExpositionHygiene:
                          reason=reason) == 1
         assert value("tpu_serving_ttft_seconds_count",
                      model="llama-7b") == 1
+        # the tenant projection of the SAME requests_total family +
+        # the QoS gauges: every submit above ran as tenant "default"
+        assert value("tpu_serving_requests_total", tenant="default",
+                     outcome="submitted") == 5
+        assert value("tpu_serving_requests_total", tenant="default",
+                     outcome="served") == 1
+        assert value("tpu_serving_requests_total", tenant="default",
+                     outcome="shed") == 3
+        assert value("tpu_serving_qos_share_key",
+                     tenant="default") > 0
+        assert value("tpu_serving_qos_wait_seconds_count",
+                     tenant="default") >= 1
         # router backlog files into the SAME demand ledger families
         assert value("tpu_scheduler_demand_pods", tenant="serving",
                      model="llama-7b", shape="slots",

@@ -320,6 +320,63 @@ class TestSimpleRules:
         ])
         assert [s[0] for s in states] == [False, False, True]
 
+    def test_tenant_shed_rate_isolation(self):
+        """The per-tenant grading: the NOISY tenant being shed fires
+        with its name in context, while the quiet tenant — few
+        requests, even if some shed — stays under the
+        shed_min_requests floor and never pages on its own."""
+        from kubeshare_tpu.obs.alerts import tenant_shed_rate_rule
+
+        totals = {
+            "noisy": {"submitted": 0, "shed": 0},
+            "quiet": {"submitted": 0, "shed": 0},
+        }
+        rule = tenant_shed_rate_rule(
+            lambda: {t: dict(row) for t, row in totals.items()}, CFG
+        )
+
+        def step(tenant, sub, shed):
+            def f():
+                totals[tenant]["submitted"] += sub
+                totals[tenant]["shed"] += shed
+            return f
+
+        def both(n_sub, n_shed, q_sub, q_shed):
+            def f():
+                step("noisy", n_sub, n_shed)()
+                step("quiet", q_sub, q_shed)()
+            return f
+
+        # quiet trickles 5/window with 2 sheds (40% — above threshold
+        # but under the 20-submission floor): must never fire.
+        states = run_rule(rule, [
+            both(100, 0, 5, 2),
+            both(100, 5, 5, 2),
+            both(100, 40, 5, 2),
+        ])
+        assert [s[0] for s in states] == [False, False, True]
+        # the firing context names the offender, not the bystander
+        ev = AlertEvaluator([rule], eval_interval=0.0)
+        both(100, 40, 5, 2)()
+        ev.evaluate(0.0, force=True)
+        ctx = ev.state(rule.name).last_context
+        assert ctx["tenant"] == "noisy"
+
+    def test_tenant_shed_rate_quiet_alone_never_pages(self):
+        from kubeshare_tpu.obs.alerts import tenant_shed_rate_rule
+
+        totals = {"quiet": {"submitted": 0, "shed": 0}}
+        rule = tenant_shed_rate_rule(
+            lambda: {t: dict(row) for t, row in totals.items()}, CFG
+        )
+
+        def step():
+            totals["quiet"]["submitted"] += 4
+            totals["quiet"]["shed"] += 3  # 75% shed — of 4 requests
+
+        states = run_rule(rule, [step] * 5)
+        assert not any(s[0] for s in states)
+
     def test_rule_exception_counted_not_fatal(self):
         def boom(now):
             raise RuntimeError("source away")

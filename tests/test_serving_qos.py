@@ -1,0 +1,630 @@
+"""Request-layer QoS (kubeshare_tpu/serving/qos.py + affinity.py):
+weighted-DRF tenant lanes, token-level admission against the drain
+model, prefix-cache affinity, and the live daemon wiring.
+
+The pinned invariants:
+
+- **single-tenant differential**: with one tenant, QoS-on routing is
+  decision-for-decision identical to the seed FIFO router (replayed
+  randomized traffic, every RouteResult compared);
+- **conservation** holds in the fleet AND tenant projections under
+  randomized multi-tenant traffic with kills and re-registers;
+- lane-aware eviction moves backpressure onto the overserved tenant
+  without changing totals, and degenerates to the seed's pool-full
+  refusal when there is no other lane;
+- the drain model refuses (retryable, ``drain-bound``) only when it
+  can SEE every slot staying busy past the bound — an all-unknown
+  fleet degrades to plain JSQ with nothing refused;
+- the informer bind event registers a replica that immediately
+  routes traffic, and the delete event deregisters it.
+"""
+
+import random
+
+import pytest
+
+from kubeshare_tpu.quota.tenant import TenantRegistry
+from kubeshare_tpu.serving import (
+    SHED_DRAIN_BOUND, SHED_POOL_FULL,
+    PrefixAffinity, Request, RequestRouter,
+)
+from kubeshare_tpu.serving.qos import (
+    LaneQueue, RequestDrfClock, modeled_wait, prefix_key,
+)
+
+
+def treq(rid, tenant="default", prompt_len=16, arrival=0.0, model="m",
+         prefix_hash=None):
+    return Request(rid=rid, model=model, prompt_len=prompt_len,
+                   arrival=arrival, tenant=tenant,
+                   prefix_hash=prefix_hash)
+
+
+def weights(**tenants):
+    return {"tenants": {t: {"weight": w} for t, w in tenants.items()}}
+
+
+# -- DRF clock --------------------------------------------------------
+
+
+class TestRequestDrfClock:
+    def test_charge_floor_is_one_unit(self):
+        clock = RequestDrfClock()
+        clock.charge("a", 0.0)
+        clock.charge("a", -5.0)
+        assert clock.charged("a") == 2.0
+
+    def test_share_key_orders_most_underserved_first(self):
+        clock = RequestDrfClock()
+        clock.charge("a", 300.0)
+        clock.charge("b", 100.0)
+        assert clock.share_key("b") < clock.share_key("a")
+
+    def test_weight_divides_the_share(self):
+        reg = TenantRegistry.from_config(weights(a=1.0, b=2.0))
+        clock = RequestDrfClock(reg)
+        clock.charge("a", 100.0)
+        clock.charge("b", 100.0)
+        # b paid the same work but is weighted 2x: half the key
+        assert clock.share_key("b") == pytest.approx(
+            clock.share_key("a") / 2.0
+        )
+
+    def test_share_base_folds_pod_layer_share_in(self):
+        clock = RequestDrfClock(
+            share_base=lambda t: 0.5 if t == "a" else 0.0
+        )
+        clock.charge("a", 10.0)
+        clock.charge("b", 10.0)
+        # equal request-layer work, but a hogs chips at the pod
+        # layer: it sorts behind b in the request queue too
+        assert clock.share_key("a") > clock.share_key("b")
+
+
+# -- lane queue -------------------------------------------------------
+
+
+def lane_fixture():
+    clock = RequestDrfClock()
+    clock.charge("noisy", 900.0)
+    clock.charge("quiet", 100.0)
+    return clock, LaneQueue(clock)
+
+
+class TestLaneQueue:
+    def test_iteration_is_underserved_lane_first(self):
+        _, q = lane_fixture()
+        q.append(treq("n1", "noisy"))
+        q.append(treq("n2", "noisy"))
+        q.append(treq("q1", "quiet"))
+        # quiet's lane drains first (lower share), FIFO inside lanes
+        assert [r.rid for r in q] == ["q1", "n1", "n2"]
+
+    def test_delitem_uses_flattened_order(self):
+        _, q = lane_fixture()
+        q.extend([treq("n1", "noisy"), treq("q1", "quiet"),
+                  treq("q2", "quiet")])
+        del q[1]  # flattened order is q1, q2, n1
+        assert [r.rid for r in q] == ["q1", "n1"]
+        with pytest.raises(IndexError):
+            del q[5]
+
+    def test_empty_lane_disappears(self):
+        _, q = lane_fixture()
+        q.append(treq("q1", "quiet"))
+        del q[0]
+        assert q.lane_depths() == {}
+        assert not q
+
+    def test_evict_overserved_pops_newest_of_worst_lane(self):
+        _, q = lane_fixture()
+        q.extend([treq("n1", "noisy"), treq("n2", "noisy")])
+        victim = q.evict_overserved("quiet")
+        assert victim.rid == "n2"  # newest, not FIFO head
+        assert [r.rid for r in q] == ["n1"]
+
+    def test_evict_needs_a_strictly_more_overserved_lane(self):
+        clock, q = lane_fixture()
+        q.append(treq("q1", "quiet"))
+        # noisy asks: quiet's lane is BELOW its share key -> nothing
+        # to displace, the arrival must take the refusal itself
+        assert q.evict_overserved("noisy") is None
+        assert len(q) == 1
+
+    def test_evict_single_tenant_is_always_none(self):
+        _, q = lane_fixture()
+        q.extend([treq("n1", "noisy"), treq("n2", "noisy")])
+        # only the tenant's own lane exists: the differential pin —
+        # the caller refuses exactly like the seed FIFO router
+        assert q.evict_overserved("noisy") is None
+        assert len(q) == 2
+
+
+# -- drain model ------------------------------------------------------
+
+
+class TestModeledWait:
+    def test_position_k_waits_for_kth_soonest_drain(self):
+        assert modeled_wait([5.0, 1.0, 3.0], 0, 30.0) == 1.0
+        assert modeled_wait([5.0, 1.0, 3.0], 2, 30.0) == 5.0
+
+    def test_no_signal_slots_charge_the_bound(self):
+        assert modeled_wait([None, 1.0], 1, 30.0) == 30.0
+
+    def test_beyond_horizon_is_the_bound(self):
+        assert modeled_wait([1.0], 5, 30.0) == 30.0
+        assert modeled_wait([], 0, 30.0) == 30.0
+
+    def test_known_drains_are_not_clamped(self):
+        # an admission rule comparing against the bound must SEE the
+        # overrun, or it could never refuse anything
+        assert modeled_wait([90.0], 0, 30.0) == 90.0
+
+
+class TestPrefixKey:
+    def test_stable_and_head_only(self):
+        a = prefix_key([1, 2, 3, 4, 5, 6], 4)
+        b = prefix_key([1, 2, 3, 4, 99, 98], 4)
+        assert a == b == prefix_key([1, 2, 3, 4], 4)
+        assert a != prefix_key([1, 2, 3, 5], 4)
+
+
+# -- the single-tenant differential pin -------------------------------
+
+
+class TestSingleTenantDifferential:
+    def test_qos_on_equals_seed_fifo_decision_for_decision(self):
+        """Randomized single-tenant traffic through a QoS router and
+        the seed FIFO router: every RouteResult, every dispatch
+        promotion, every timeout shed, and the final counters must
+        be identical — one tenant means one lane means the seed's
+        plain deque."""
+        rng = random.Random(1234)
+        routers = [
+            RequestRouter(queue_depth=2, queue_timeout_s=5.0, qos=on)
+            for on in (False, True)
+        ]
+        for r in routers:
+            r.register("s/a", "m", 2, now=0.0)
+            r.register("s/b", "m", 3, now=0.0)
+        active = []
+        for i in range(400):
+            now = i * 0.25
+            op = rng.random()
+            if op < 0.55:
+                plen = rng.choice([8, 16, 64, 200])
+                results = [
+                    r.submit(treq(f"r{i}", prompt_len=plen,
+                                  arrival=now), now)
+                    for r in routers
+                ]
+                assert results[0] == results[1], f"op {i}"
+                if results[0].status == "admitted":
+                    active.append(f"r{i}")
+            elif op < 0.9 and active:
+                rid = active.pop(rng.randrange(len(active)))
+                promos = [
+                    [(q.rid, k) for q, k in r.complete(rid, now)]
+                    for r in routers
+                ]
+                assert promos[0] == promos[1], f"op {i}"
+                active.extend(rid for rid, _ in promos[0])
+            else:
+                outs = [r.tick(now) for r in routers]
+                admitted = [[(q.rid, k) for q, k in o.admitted]
+                            for o in outs]
+                shed = [[(q.rid, why) for q, why in o.shed]
+                        for o in outs]
+                assert admitted[0] == admitted[1], f"op {i}"
+                assert shed[0] == shed[1], f"op {i}"
+                active.extend(rid for rid, _ in admitted[0])
+        assert routers[0].counts("m") == routers[1].counts("m")
+        assert active, "differential never admitted anything"
+
+
+# -- token-level admission --------------------------------------------
+
+
+class TestTokenAdmission:
+    def make(self, **kw):
+        kw.setdefault("queue_depth", 4)
+        kw.setdefault("token_admission", True)
+        return RequestRouter(**kw)
+
+    def test_drain_breaks_queue_length_ties(self):
+        router = self.make()
+        router.register("s/a", "m", 1, now=0.0)
+        router.register("s/b", "m", 1, now=0.0)
+        assert router.submit(treq("r1"), 0.0).replica == "s/a"
+        assert router.submit(treq("r2"), 0.0).replica == "s/b"
+        router.note_progress("r1", finish_at=10.0)
+        router.note_progress("r2", finish_at=1.0)
+        # equal queue depth (0 each): the seed's pod-key tie-break
+        # would park on s/a, but s/b's slot is almost free
+        assert router.submit(treq("q1"), 0.0).replica == "s/b"
+
+    def test_queue_length_stays_the_primary_key(self):
+        router = self.make()
+        router.register("s/a", "m", 1, now=0.0)
+        router.register("s/b", "m", 1, now=0.0)
+        router.submit(treq("r1"), 0.0)
+        router.submit(treq("r2"), 0.0)
+        router.note_progress("r1", finish_at=1.0)   # s/a drains soon
+        router.note_progress("r2", finish_at=20.0)  # s/b drains late
+        assert router.submit(treq("q1"), 0.0).replica == "s/a"
+        # s/a now has the shorter-drain slot AND a queued request;
+        # JSQ balance beats the greedy drain pick: q2 goes to s/b
+        assert router.submit(treq("q2"), 0.0).replica == "s/b"
+
+    def test_drain_bound_refusal_is_retryable_and_labeled(self):
+        router = self.make(drain_bound_s=5.0)
+        router.register("s/a", "m", 1, now=0.0)
+        router.submit(treq("r1"), 0.0)
+        router.note_progress("r1", finish_at=100.0)
+        out = router.submit(treq("q1"), 0.0)
+        assert out.status == "shed"
+        assert out.reason == SHED_DRAIN_BOUND
+        assert out.retryable
+        c = router.counts("m")
+        assert c["shed"] == {SHED_DRAIN_BOUND: 1}
+
+    def test_no_signal_degrades_to_plain_jsq(self):
+        """Without note_progress/servers every slot is unknown and
+        charged exactly the bound: the inclusive comparison admits,
+        nothing is refused, and every placement matches the JSQ
+        router byte for byte."""
+        rng = random.Random(77)
+        token = self.make()
+        jsq = RequestRouter(queue_depth=4)
+        for r in (token, jsq):
+            r.register("s/a", "m", 2, now=0.0)
+            r.register("s/b", "m", 2, now=0.0)
+        active = []
+        for i in range(200):
+            now = i * 0.5
+            if rng.random() < 0.6 or not active:
+                ra = token.submit(treq(f"r{i}", arrival=now), now)
+                rb = jsq.submit(treq(f"r{i}", arrival=now), now)
+                assert ra == rb, f"op {i}"
+                if ra.status == "admitted":
+                    active.append(f"r{i}")
+            else:
+                rid = active.pop(rng.randrange(len(active)))
+                pa = [(q.rid, k) for q, k in token.complete(rid, now)]
+                pb = [(q.rid, k) for q, k in jsq.complete(rid, now)]
+                assert pa == pb, f"op {i}"
+                active.extend(rid for rid, _ in pa)
+        assert token.counts("m") == jsq.counts("m")
+        assert token.counts("m")["shed"].get(SHED_DRAIN_BOUND, 0) == 0
+
+
+# -- lane-aware eviction backpressure ---------------------------------
+
+
+class TestEvictionBackpressure:
+    def test_underserved_arrival_displaces_the_noisy_newest(self):
+        router = RequestRouter(queue_depth=2, qos=True,
+                               tenants=weights(noisy=1.0, quiet=1.0))
+        router.register("s/a", "m", 1, now=0.0)
+        router.submit(treq("a1", "noisy", prompt_len=64), 0.0)
+        router.submit(treq("n1", "noisy"), 0.0)
+        router.submit(treq("n2", "noisy"), 0.0)
+        # pool full. quiet has been charged nothing -> strictly
+        # underserved: its arrival evicts noisy's NEWEST (n2), not
+        # the FIFO head, and queues in its place
+        out = router.submit(treq("q1", "quiet"), 1.0)
+        assert out.status == "queued"
+        assert router.queued_by_tenant() == {"noisy": 1, "quiet": 1}
+        by_tenant = router.request_totals(by_tenant=True)
+        assert by_tenant["noisy"]["shed"] == 1
+        assert by_tenant["quiet"]["shed"] == 0
+        # one in, one out: totals conserved in both projections
+        assert router.conservation("m")[0] == router.conservation("m")[1]
+        for got, want in router.conservation_by_tenant().values():
+            assert got == want
+
+    def test_overserved_arrival_takes_the_refusal_itself(self):
+        router = RequestRouter(queue_depth=1, qos=True,
+                               tenants=weights(noisy=1.0, quiet=1.0))
+        router.register("s/a", "m", 1, now=0.0)
+        router.submit(treq("q0", "quiet", prompt_len=64), 0.0)
+        router.submit(treq("q1", "quiet"), 0.0)
+        # noisy was just charged nothing... flip it: charge noisy up
+        router.qos_clock.charge("noisy", 1000.0)
+        out = router.submit(treq("n1", "noisy"), 1.0)
+        assert out.status == "shed"
+        assert out.reason == SHED_POOL_FULL
+        # quiet's queue untouched
+        assert router.queued_by_tenant() == {"quiet": 1}
+
+    def test_single_tenant_pool_full_matches_seed_refusal(self):
+        router = RequestRouter(queue_depth=1, qos=True)
+        router.register("s/a", "m", 1, now=0.0)
+        router.submit(treq("r1"), 0.0)
+        router.submit(treq("r2"), 0.0)
+        out = router.submit(treq("r3"), 0.0)
+        assert out.status == "shed"
+        assert out.reason == SHED_POOL_FULL
+        assert out.retryable
+
+
+# -- DRF dispatch order (no starvation) -------------------------------
+
+
+class TestDrfDispatch:
+    def test_underserved_lane_promotes_first(self):
+        router = RequestRouter(queue_depth=8, qos=True,
+                               tenants=weights(heavy=1.0, light=1.0))
+        router.register("s/a", "m", 1, now=0.0)
+        router.submit(treq("h0", "heavy", prompt_len=500), 0.0)
+        for i in range(4):
+            router.submit(treq(f"h{i + 1}", "heavy", prompt_len=500),
+                          0.0)
+        router.submit(treq("l1", "light", prompt_len=8), 0.0)
+        # heavy holds the slot and 4 queue positions; light queued
+        # LAST but is the underserved lane: first promotion is l1
+        promos = [q.rid for q, _ in router.complete("h0", 1.0)]
+        assert promos == ["l1"]
+
+    def test_weighted_tenant_is_served_proportionally_more(self):
+        router = RequestRouter(queue_depth=64, queue_timeout_s=1e9,
+                               qos=True,
+                               tenants=weights(gold=3.0, bronze=1.0))
+        router.register("s/a", "m", 1, now=0.0)
+        rng = random.Random(5)
+        served = {"gold": 0, "bronze": 0}
+        rid = 0
+        active = []
+        for step in range(300):
+            now = float(step)
+            for t in ("gold", "bronze"):
+                out = router.submit(
+                    treq(f"r{rid}", t, prompt_len=100, arrival=now),
+                    now)
+                if out.status == "admitted":
+                    active.append(f"r{rid}")
+                rid += 1
+            if active:
+                done = active.pop(0)
+                for q, _ in router.complete(done, now):
+                    active.append(q.rid)
+        by_tenant = router.request_totals(by_tenant=True)
+        for t in served:
+            served[t] = by_tenant[t]["served"] + by_tenant[t]["in_flight"]
+        # equal demand, 3x weight: gold should get strictly more
+        # service and bronze must not starve
+        assert served["bronze"] > 0
+        assert served["gold"] > served["bronze"]
+
+
+# -- prefix affinity --------------------------------------------------
+
+
+class TestAffinity:
+    def make(self):
+        router = RequestRouter(queue_depth=2,
+                               affinity=PrefixAffinity(prefix_tokens=4))
+        router.register("s/a", "m", 2, now=0.0)
+        router.register("s/b", "m", 2, now=0.0)
+        return router
+
+    def test_warm_owner_beats_least_loaded(self):
+        router = self.make()
+        assert router.submit(treq("r1", prefix_hash="h1"),
+                             0.0).replica == "s/a"
+        router.complete("r1", 1.0)
+        # tilt the load: filler occupies s/a so least-loaded says s/b
+        router.submit(treq("f1"), 1.0)
+        out = router.submit(treq("r2", prefix_hash="h1"), 2.0)
+        assert out.replica == "s/a"  # warm cache beats one free slot
+        assert router.affinity.hits == 1
+
+    def test_no_signal_routes_exactly_least_loaded(self):
+        router = self.make()
+        router.submit(treq("f1"), 0.0)          # s/a
+        out = router.submit(treq("r1"), 0.0)    # no prompt, no hash
+        assert out.replica == "s/b"
+        assert router.affinity.hits == 0
+        assert router.affinity.misses == 0  # no signal != a miss
+
+    def test_full_owner_is_not_waited_on(self):
+        router = self.make()
+        router.submit(treq("w1", prefix_hash="h1"), 0.0)  # s/a warm
+        router.submit(treq("f1"), 0.0)  # s/b (least loaded)
+        router.submit(treq("f2"), 0.0)  # s/a — now full
+        out = router.submit(treq("r2", prefix_hash="h1"), 1.0)
+        assert out.status == "admitted"
+        assert out.replica == "s/b"  # capacity wins over warmth
+
+    def test_deregister_forgets_the_dead_pods_keys(self):
+        router = self.make()
+        router.submit(treq("r1", prefix_hash="h1"), 0.0)  # warm s/a
+        router.complete("r1", 1.0)
+        router.deregister("s/a", now=2.0)
+        assert len(router.affinity) == 0
+        out = router.submit(treq("r2", prefix_hash="h1"), 3.0)
+        assert out.replica == "s/b"  # cold again: plain least-loaded
+
+
+# -- randomized multi-tenant conservation -----------------------------
+
+
+class TestConservationProperty:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_conservation_under_churn(self, seed):
+        """Randomized multi-tenant, multi-model traffic with replica
+        kills and re-registers, QoS + token admission + affinity all
+        on: submitted == served + shed + in-flight at every step, in
+        the fleet projection AND the tenant projection."""
+        rng = random.Random(seed)
+        tenants = ("alpha", "beta", "gamma")
+        models = ("m0", "m1")
+        router = RequestRouter(
+            queue_depth=3, queue_timeout_s=8.0, qos=True,
+            token_admission=True, drain_bound_s=50.0,
+            affinity=PrefixAffinity(),
+            tenants=weights(alpha=2.0, beta=1.0, gamma=1.0),
+        )
+        pods = {}
+        for i, model in enumerate(("m0", "m0", "m1")):
+            router.register(f"s/p{i}", model, 2, now=0.0)
+            pods[f"s/p{i}"] = model
+        active = []
+        for step in range(600):
+            now = step * 0.3
+            op = rng.random()
+            if op < 0.5:
+                r = treq(f"r{step}", rng.choice(tenants),
+                         prompt_len=rng.choice([8, 32, 128]),
+                         arrival=now, model=rng.choice(models),
+                         prefix_hash=rng.choice(["h1", "h2", None]))
+                out = router.submit(r, now)
+                if out.status == "admitted":
+                    active.append((r.rid, r.model))
+                    router.note_progress(r.rid, now + rng.uniform(1, 20))
+            elif op < 0.8 and active:
+                rid, _ = active.pop(rng.randrange(len(active)))
+                for q, _ in router.complete(rid, now):
+                    active.append((q.rid, q.model))
+            elif op < 0.9:
+                out = router.tick(now)
+                for q, key in out.admitted:
+                    active.append((q.rid, q.model))
+            elif op < 0.95 and pods:
+                key = rng.choice(sorted(pods))
+                model = pods.pop(key)
+                router.deregister(key, now=now)
+                # the kill requeued (or shed) its in-flight work:
+                # drop rids the router no longer tracks as decoding
+                active = [(rid, m) for rid, m in active
+                          if rid in router._active]
+            else:
+                key = f"s/n{step}"
+                model = rng.choice(models)
+                router.register(key, model, 2, now=now)
+                pods[key] = model
+            for model in models:
+                got, want = router.conservation(model)
+                assert got == want, f"seed {seed} step {step} {model}"
+            for t, (got, want) in router.conservation_by_tenant().items():
+                assert got == want, f"seed {seed} step {step} {t}"
+
+
+# -- live daemon wiring -----------------------------------------------
+
+
+class TestLiveWiring:
+    def make_engine(self):
+        from kubeshare_tpu.cells.cell import ChipInfo
+        from kubeshare_tpu.cluster.fake import FakeCluster
+        from kubeshare_tpu.scheduler.plugin import TpuShareScheduler
+
+        gib = 1 << 30
+        topo = {
+            "cell_types": {
+                "v5e-node": {
+                    "child_cell_type": "tpu-v5e",
+                    "child_cell_number": 4,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+            },
+            "cells": [{"cell_type": "v5e-node", "cell_id": "n00"}],
+        }
+        cluster = FakeCluster()
+        cluster.add_node("n00", [
+            ChipInfo(f"n00-c{j}", "tpu-v5e", 16 * gib, j)
+            for j in range(4)
+        ])
+        clock = [0.0]
+        engine = TpuShareScheduler(topo, cluster,
+                                   clock=lambda: clock[0])
+        return engine, cluster, clock
+
+    def serving_pod(self, cluster, name="srv0", model="gpt"):
+        from kubeshare_tpu.cluster.api import Pod
+        from kubeshare_tpu.scheduler import constants as C
+
+        return cluster.create_pod(Pod(
+            name=name, namespace="team", labels={
+                C.LABEL_TPU_REQUEST: "1.0",
+                C.LABEL_TPU_LIMIT_ALIASES[1]: "1.0",
+                C.LABEL_SERVING_MODEL: model,
+                C.LABEL_SERVING_SLOTS: "2",
+                C.LABEL_SERVING_MAX_PROMPT: "256",
+            }, scheduler_name=C.SCHEDULER_NAME,
+        ))
+
+    def test_bind_event_registers_and_routes(self):
+        """The ISSUE's smoke: informer bind event -> replica
+        registered in the router -> a submitted request routes onto
+        it; the delete event deregisters and requeues nothing is
+        lost."""
+        from kubeshare_tpu.serving.live import ServingPodWatch
+
+        engine, cluster, clock = self.make_engine()
+        router = RequestRouter(qos=True, token_admission=True,
+                               affinity=PrefixAffinity())
+        engine.serving_watch = ServingPodWatch(
+            router, clock=lambda: clock[0]
+        )
+        pod = self.serving_pod(cluster)
+        assert engine.schedule_one(pod)  # binds on the real engine
+        bound = cluster.get_pod(pod.key)
+        assert bound.is_bound
+        # the bind echoes back through the informer: THAT event is
+        # the registration
+        engine._on_pod_add(bound)
+        assert engine.serving_watch.registered == 1
+        replica = router.registry.get(bound.key)
+        assert replica is not None
+        assert replica.slots == 2
+        assert replica.max_prompt_len == 256
+        assert replica.chips == 1.0
+        # replayed add (informer reconnect): idempotent
+        engine._on_pod_add(bound)
+        assert engine.serving_watch.registered == 1
+        # traffic routes onto the informer-registered replica
+        out = router.submit(treq("r1", model="gpt", prompt_len=64),
+                            1.0)
+        assert out.status == "admitted"
+        assert out.replica == bound.key
+        # oversized honors the label ceiling end to end
+        assert router.submit(
+            treq("big", model="gpt", prompt_len=512), 1.0
+        ).status == "shed"
+        # delete deregisters through the same hook
+        engine._on_pod_delete(bound)
+        assert engine.serving_watch.deregistered == 1
+        assert router.registry.get(bound.key) is None
+        got, want = router.conservation("gpt")
+        assert got == want
+
+    def test_malformed_label_never_raises_into_the_informer(self):
+        from kubeshare_tpu.serving.live import ServingPodWatch
+
+        engine, cluster, clock = self.make_engine()
+        router = RequestRouter()
+        watch = ServingPodWatch(router, clock=lambda: clock[0])
+        engine.serving_watch = watch
+        pod = self.serving_pod(cluster, name="bad")
+        from kubeshare_tpu.scheduler import constants as C
+
+        pod.labels[C.LABEL_SERVING_SLOTS] = "not-a-number"
+        pod.node_name = "n00"
+        engine._on_pod_add(pod)  # must not raise
+        assert watch.malformed == 1
+        assert router.registry.get(pod.key) is None
+
+    def test_non_serving_pod_is_ignored(self):
+        from kubeshare_tpu.serving.live import ServingPodWatch
+
+        router = RequestRouter()
+        watch = ServingPodWatch(router)
+
+        class P:
+            labels = {}
+            key = "x/y"
+
+        assert watch.pod_bound(P()) is False
+        assert watch.registered == 0

@@ -14,7 +14,8 @@ from kubeshare_tpu.autoscale.demand import (
     REASON_NO_FREE_SLOT, DemandLedger, shape_of,
 )
 from kubeshare_tpu.serving import (
-    SHED_OVERSIZED, SHED_POOL_FULL, SHED_TIMEOUT, ReplicaRegistry,
+    SHED_DRAIN_BOUND, SHED_OVERSIZED, SHED_POOL_FULL, SHED_TIMEOUT,
+    ReplicaRegistry,
     Request, RequestRouter,
 )
 
@@ -284,6 +285,46 @@ class TestDemandFiling:
         assert cap.replica_chips == pytest.approx(2.5)
         assert cap.slots_per_replica == 8
 
+    def test_mixed_fleet_demand_prices_per_model_pool(self):
+        """Multi-model fleets: each model's slots:: demand entry is
+        priced off ITS pool's chips-per-slot, never a cross-model
+        average — a fat v6e pool next to a thin v5e pool must not
+        inflate the thin pool's node demand (or starve the fat
+        one's)."""
+        demand = DemandLedger()
+        router = make_router(demand=demand, queue_depth=4)
+        router.register("s/fat", "big", 2, chips=4.0)   # 2.0 per slot
+        router.register("s/thin", "small", 2, chips=0.5)  # 0.25/slot
+        for i in range(4):
+            router.submit(req(f"b{i}", model="big"), 0.0)
+            router.submit(req(f"s{i}", model="small"), 0.0)
+        router.tick(1.0)
+        entries = {e.pod_key: e for e in demand.entries()}
+        # 2 queued each; the cross-model average (1.125/slot) would
+        # put 2.25 on both — per-pool pricing must not
+        assert entries["slots::big"].chips == pytest.approx(2 * 2.0)
+        assert entries["slots::small"].chips == pytest.approx(2 * 0.25)
+        # snapshot rows carry each pool's own template too
+        caps = {c.model: c for c in router.capacity_snapshot()}
+        assert caps["big"].replica_chips == pytest.approx(4.0)
+        assert caps["small"].replica_chips == pytest.approx(0.5)
+
+    def test_pool_price_survives_full_deregistration(self):
+        """A pool that scaled to zero remembers its own last price:
+        the NEXT backlog for that model sizes the first replica off
+        what the pool actually ran, not the global template."""
+        demand = DemandLedger()
+        router = make_router(demand=demand, queue_depth=8,
+                             replica_slots=8, replica_chips=1.0)
+        router.register("s/a", "m", 4, chips=2.0)
+        router.deregister("s/a", now=1.0)
+        assert router.chips_per_slot("m") == pytest.approx(0.5)
+        for i in range(4):
+            router.submit(req(f"r{i}", arrival=2.0), 2.0)
+        router.tick(3.0)
+        entry = {e.pod_key: e for e in demand.entries()}["slots::m"]
+        assert entry.chips == pytest.approx(4 * 0.5)
+
     def test_slot_demand_shape(self):
         from kubeshare_tpu.serving import SlotDemand
 
@@ -487,4 +528,5 @@ class TestMetrics:
             s.labels["reason"] for s in router.samples()
             if s.name == "tpu_serving_shed_total"
         }
-        assert reasons == {SHED_POOL_FULL, SHED_TIMEOUT, SHED_OVERSIZED}
+        assert reasons == {SHED_POOL_FULL, SHED_TIMEOUT,
+                           SHED_OVERSIZED, SHED_DRAIN_BOUND}
