@@ -66,6 +66,16 @@ serving-qos-sim:
 chaos-sim:
 	$(PYTHON) tools/chaos_sim.py
 
+# whole-system scenario gauntlet -> GAUNTLET.json (10k-node
+# heterogeneous v4/v5e/v6e fleet, diurnal multi-tenant gang +
+# fractional + serving traces, fault script + closed autoscale loop,
+# backfill reservations, serving-loop section; floors: exact
+# conservation, zero double-binds, zero ledger drift, alerts silent
+# fault-free / exactly classified under faults, Jain >= 0.9 on the
+# fairness row, goodput retention vs the fault-free arm)
+gauntlet:
+	$(PYTHON) tools/gauntlet.py
+
 # incident flight-recorder gauntlet -> INCIDENTS.json (fault-free
 # baseline vs scheduler crash / API flake / node flap with the alert
 # plane + black-box recorder attached; invariants: zero baseline
@@ -133,4 +143,4 @@ perf-evidence:
 clean:
 	$(MAKE) -C runtime_native clean
 
-.PHONY: all native test bench engine-bench sim-replay migrate-sim fairness-sim autoscale-sim explain-report serving-sim serving-qos-sim chaos-sim incident-report profile-report dryrun images push save kind-e2e perf-evidence clean
+.PHONY: all native test bench engine-bench sim-replay migrate-sim fairness-sim autoscale-sim explain-report serving-sim serving-qos-sim chaos-sim gauntlet incident-report profile-report dryrun images push save kind-e2e perf-evidence clean

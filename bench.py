@@ -110,6 +110,16 @@ MIN_PROBE_WALL = 8.0
 # contract-test hook: force the first N probe attempts to fail without
 # spawning a subprocess, so the retry loop is testable on any box
 PROBE_FAIL_N = int(os.environ.get("KUBESHARE_BENCH_PROBE_FAIL_N", "0"))
+# contract-test hook in the same spirit: force the first N rounds to
+# read as chip-drifted, so the re-run/annotate policy is testable
+# without a genuinely throttling chip
+DRIFT_FAIL_N = int(os.environ.get("KUBESHARE_BENCH_DRIFT_N", "0"))
+# a drifted round's gated/solo ratio compares throughput across two
+# different effective chips (BENCH_r05 banked exactly that: round 0
+# drifted 1.6x mid-round yet sat in the 5-round median pool). Drifted
+# rounds are replaced when the wall allows — up to this many extra
+# rounds — and excluded from the median whenever a clean round exists.
+MAX_DRIFT_RERUNS = 2
 _T0 = time.monotonic()
 
 _state = {"doc": None, "final": False, "child": None, "arbiter": None}
@@ -406,18 +416,27 @@ def run_headline(probe: dict) -> dict:
     # workload keeps its duty cycle instead of silently saturating —
     # a saturated chip makes the gated phase pay slot-queueing the
     # ungated free-for-all doesn't; (2) a post-round probe flags rounds
-    # whose chip slowed >1.5x mid-round so the drift is visible in the
-    # log and the JSON. The reported round is the median by gated/solo
-    # ratio, with the worst gated/ungated ratio alongside. The round
-    # count adapts to the wall budget: stop adding rounds once the
-    # next one would eat the kernel reserve (but always run at least
-    # one; prefer >= MIN_ROUNDS). try/finally: a failed round must not
-    # leak the arbiter holding ARBITER_PORT for the next invocation.
+    # whose chip slowed >1.5x mid-round — that round's gated/solo is a
+    # CROSS-CHIP comparison (solo ran on the fast chip, gated on the
+    # slow one) and must not be banked as if it measured gating. A
+    # drifted round earns a replacement round when the wall budget
+    # allows (<= MAX_DRIFT_RERUNS extras) and is excluded from the
+    # median whenever at least one clean round exists; an all-drifted
+    # run banks the least-bad round but says so in the JSON. The
+    # reported round is the median by gated/solo ratio, with the worst
+    # gated/ungated ratio alongside. The round count adapts to the
+    # wall budget: stop adding rounds once the next one would eat the
+    # kernel reserve (but always run at least one; prefer >=
+    # MIN_ROUNDS). try/finally: a failed round must not leak the
+    # arbiter holding ARBITER_PORT for the next invocation.
     rounds = []
     next_pre_step_s = step_s  # each round's post-probe doubles as the
     round_cost = None         # next round's pre-probe
+    rounds_rerun = 0
     try:
-        for r in range(MAX_ROUNDS):
+        r = -1
+        while r + 1 < MAX_ROUNDS + rounds_rerun:
+            r += 1
             if rounds:
                 reserve = KERNEL_RESERVE if len(rounds) >= MIN_ROUNDS else 0
                 if remaining() < round_cost + reserve + 2 * SAFETY_S:
@@ -442,7 +461,7 @@ def run_headline(probe: dict) -> dict:
             )
             post_step_s = probe_step_s()
             next_pre_step_s = post_step_s
-            drifted = post_step_s > 1.5 * pre_step_s
+            drifted = post_step_s > 1.5 * pre_step_s or r < DRIFT_FAIL_N
             round_cost = time.perf_counter() - t_round
             rounds.append({
                 "solo": solo_r, "ungated": raw_r, "gated": gated_r,
@@ -455,11 +474,21 @@ def run_headline(probe: dict) -> dict:
                 f"gated {gated_r:,.0f} samples/s ({gated_r / solo_r:.2f}x)"
                 + (f" [chip drifted {post_step_s / pre_step_s:.1f}x "
                    f"mid-round]" if drifted else ""))
+            if (drifted and rounds_rerun < MAX_DRIFT_RERUNS
+                    and remaining() >= (round_cost + KERNEL_RESERVE
+                                        + 2 * SAFETY_S)):
+                rounds_rerun += 1
+                log(f"round {r}: drifted — re-running on the post-drift "
+                    f"chip (replacement {rounds_rerun}/{MAX_DRIFT_RERUNS})")
     except BaseException:
         stop_arbiter(arbiter)
         raise
 
-    mid = sorted(rounds, key=lambda x: x["ratio"])[len(rounds) // 2]
+    # BENCH_r05 fix: drifted rounds carry a cross-chip gated/solo and
+    # never represent the run when a clean round exists
+    clean = [x for x in rounds if not x["drifted"]]
+    pool = clean or rounds
+    mid = sorted(pool, key=lambda x: x["ratio"])[len(pool) // 2]
     solo, raw_aggregate, aggregate = (
         mid["solo"], mid["ungated"], mid["gated"]
     )
@@ -495,6 +524,15 @@ def run_headline(probe: dict) -> dict:
         "isolation_overhead": round(overhead, 4),
         "worst_round_gated_vs_ungated": round(worst["gated_vs_ungated"], 3),
         "worst_round_chip_drifted": worst["drifted"],
+        # drift accounting: how many rounds the mid-round probe flagged,
+        # how many replacements the wall budget granted, and whether the
+        # banked median actually dodged the drifted rounds (False with
+        # rounds_drifted > 0 means EVERY round drifted — the value is a
+        # cross-chip comparison and downstream floors should treat it
+        # as advisory)
+        "rounds_drifted": sum(1 for x in rounds if x["drifted"]),
+        "rounds_rerun": rounds_rerun,
+        "median_excludes_drifted": bool(clean) and len(clean) < len(rounds),
         "device": probe.get("device", ""),
         "probe_attempts": probe.get("probe_attempts", 1),
         # measurement provenance: a late probe shrinks the per-phase

@@ -294,10 +294,25 @@ class DemandLedger:
         deterministic tie-break. A mixed v5e/v6e fleet therefore sends
         an x8 "*" entry to the v6e pool instead of uselessly growing
         4-chip v5e nodes — the first-sorted-model rewrite this
-        replaces did exactly that. Entries NO model fits fall back to
-        the cheapest template (the pool-headroom clamp will surface
-        the impossibility). Without ``capacity`` the first sorted
-        model is kept for determinism with legacy callers."""
+        replaces did exactly that.
+
+        Feasibility-SPLIT (the depth past cheapest-model-that-fits):
+        assignment is ABSORPTION-AWARE. Each pool can absorb at most
+        ``free_chips + (pool_nodes - bound_nodes) * chips_per_node``
+        more demand — its idle capacity plus every node the pool may
+        still grow. Concrete-model entries are charged against their
+        pool first (that demand is committed wherever it is pinned);
+        then each "*" entry takes the cheapest FITTING pool with
+        absorption left, spilling to the next-cheapest when the cheap
+        pool is exhausted. One wildcard shape's backlog therefore
+        splits across several pools at different prices, and the
+        recommender sizes BOTH pools instead of filing the overflow
+        into the cheap pool's headroom clamp where it vanishes.
+        Entries NO model fits (or that overflow every fitting pool)
+        fall back to the cheapest fitting/overall template (the
+        pool-headroom clamp will surface the impossibility). Without
+        ``capacity`` the first sorted model is kept for determinism
+        with legacy callers."""
         if not models:
             return [e for e in entries if e.model != "*"]
 
@@ -317,13 +332,45 @@ class DemandLedger:
             return template(model) > 0
 
         ordered = sorted(models, key=lambda m: (template(m), m))
+        entries = list(entries)
+        remaining: Dict[str, float] = {}
+        if capacity is not None:
+            for m in ordered:
+                cap = capacity.get(m)
+                if cap is None:
+                    remaining[m] = 0.0
+                    continue
+                spare_nodes = max(0, cap.pool_nodes - cap.bound_nodes)
+                remaining[m] = (
+                    max(0.0, cap.free_chips)
+                    + spare_nodes * cap.chips_per_node
+                )
+            # concrete-model demand is committed wherever it is
+            # pinned: charge it before any wildcard takes the room
+            for e in entries:
+                if e.model != "*" and e.model in remaining:
+                    remaining[e.model] -= e.chips
         out = []
         for e in entries:
             if e.model == "*":
                 fitting = [m for m in ordered if fits(m, e)]
-                target = fitting[0] if fitting else (
-                    ordered[0] if capacity is not None else models[0]
-                )
+                target = None
+                if capacity is not None:
+                    for m in fitting:
+                        if remaining.get(m, 0.0) >= e.chips:
+                            target = m
+                            break
+                if target is None:
+                    # nothing fits, or every fitting pool is full:
+                    # cheapest fitting (or cheapest overall) absorbs
+                    # the overflow and the headroom clamp reports it
+                    target = fitting[0] if fitting else (
+                        ordered[0] if capacity is not None else models[0]
+                    )
+                if capacity is not None:
+                    remaining[target] = (
+                        remaining.get(target, 0.0) - e.chips
+                    )
                 e = replace(e, model=target)
             out.append(e)
         return out

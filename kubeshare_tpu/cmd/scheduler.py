@@ -202,6 +202,13 @@ def build_parser() -> argparse.ArgumentParser:
              "explain` / `... incidents`)",
     )
     parser.add_argument(
+        "--gauntlet-json", default="",
+        help="path to a banked GAUNTLET.json; its scenario rows are "
+             "re-exported as tpu_scheduler_gauntlet_* gauges on "
+             "/metrics (the last whole-system grade next to the live "
+             "series; missing/torn file = no gauntlet families)",
+    )
+    parser.add_argument(
         "--serve-router", action="store_true",
         help="run the serving request plane in-process: replicas "
              "register from serving-pod bind/delete events "
@@ -377,7 +384,7 @@ class SchedulerMetrics:
 
     def __init__(self, clock=time.time, tracer=None, engine=None,
                  elector=None, planner=None, router=None, cluster=None,
-                 obs=None, profiler=None, shard=None):
+                 obs=None, profiler=None, shard=None, gauntlet=None):
         self.clock = clock
         self.tracer = tracer
         self.engine = engine
@@ -400,6 +407,11 @@ class SchedulerMetrics:
         # exhausted-budget counters, watch reconnects, quarantined
         # poison events, the degraded flag
         self.cluster = cluster
+        # gauntlet.GauntletScoreboard (optional): merges the banked
+        # GAUNTLET.json verdict — tpu_scheduler_gauntlet_* gauges —
+        # so dashboards read the last whole-system grade next to the
+        # live series (the BENCH.json cost-sentinel pattern)
+        self.gauntlet = gauntlet
         self.decisions = {"bound": 0, "waiting": 0, "unschedulable": 0}
         self.passes = 0
         self.last_pass_seconds = 0.0
@@ -458,6 +470,8 @@ class SchedulerMetrics:
             samples += self.obs.samples()
         if self.profiler is not None:
             samples += self.profiler.samples()
+        if self.gauntlet is not None:
+            samples += self.gauntlet.samples()
         if self.tracer is not None:
             samples += self.tracer.metric_samples("tpu_scheduler_phase")
         return expfmt.render(samples)
@@ -836,11 +850,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         profiler_hub = ProfilerHub(hz=args.profile_hz)
 
+    gauntlet_board = None
+    if args.gauntlet_json:
+        from ..gauntlet import GauntletScoreboard
+
+        gauntlet_board = GauntletScoreboard.load(args.gauntlet_json)
+
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
                                elector=elector, planner=planner,
                                router=router,
                                cluster=cluster if args.kube else None,
-                               obs=obs_plane, profiler=profiler_hub)
+                               obs=obs_plane, profiler=profiler_hub,
+                               gauntlet=gauntlet_board)
     metrics_server = None
     if args.metrics_port:
         from ..utils.httpserv import MetricServer

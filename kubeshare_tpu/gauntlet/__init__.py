@@ -1,0 +1,21 @@
+"""Scenario gauntlet: trace-driven whole-system grading.
+
+Composes the planes this repo grew one PR at a time — heterogeneous
+fleet placement, quota/fairness, autoscale, backfill (+ cross-wave
+reservations), migration/compaction, the serving loop, fault
+injection, and the incident plane — into declarative
+:class:`Scenario` specs that one :class:`GauntletRunner` replays
+through ``kubeshare_tpu/sim`` and one :class:`Grader` scores against
+hard floors (exact conservation, zero double-binds, zero ledger
+drift, Jain fairness over entitlement-normalized service, goodput vs
+the fault-free arm, per-tenant wait-SLO attainment, and
+exactly-classified alerts). ``tools/gauntlet.py`` banks the scenario
+bank as ``GAUNTLET.json``; :class:`GauntletScoreboard` re-exports the
+banked rows as ``tpu_scheduler_gauntlet_*`` metric families.
+"""
+
+from .bank import SCENARIOS, scenario  # noqa: F401
+from .grader import Grader, conservation, failed_floors, jain  # noqa: F401
+from .runner import GauntletRunner, RunOutcome  # noqa: F401
+from .scenario import FaultSpec, PoolSpec, Scenario  # noqa: F401
+from .scoreboard import GauntletScoreboard  # noqa: F401

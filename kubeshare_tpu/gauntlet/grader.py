@@ -1,0 +1,272 @@
+"""Scoring: RunOutcome -> artifact row -> floor verdicts.
+
+The split matters: :meth:`Grader.grade` reduces a live
+:class:`RunOutcome` to a plain-JSON row (everything the verdict needs,
+nothing that can't be committed), and :func:`failed_floors` judges a
+ROW — so ``tests/test_gauntlet.py`` re-grades the committed
+``GAUNTLET.json`` with the very same code that gated it at bank time.
+A floor that only existed in the banking script would be a floor the
+repo could silently lose.
+
+Hard floors (every scenario): exact pod conservation (the chaos
+plane's identity plus ``migrated``), zero double-binds, zero ledger
+drift, zero ledger-rebuild mismatches, and the alert contract — the
+fired set must equal ``expected_alerts`` exactly, with extras
+tolerated only when listed in ``allowed_alerts``, and the fault-free
+arm must be silent. Soft floors (graded when the scenario pins them):
+Jain fairness over entitlement-normalized service, goodput retention
+vs the fault-free arm, per-tenant wait-SLO attainment.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .runner import ArmResult, RunOutcome
+from .scenario import Scenario
+
+
+def jain(values: Sequence[float]) -> float:
+    """Jain fairness index: 1.0 = perfectly even, 1/n = one hog."""
+    vals = [v for v in values if v >= 0.0]
+    if not vals:
+        return 1.0
+    num = sum(vals) ** 2
+    den = len(vals) * sum(v * v for v in vals)
+    return round(num / den, 6) if den else 1.0
+
+
+def percentile(values: Sequence[float], frac: float) -> float:
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = min(len(ordered) - 1, int(frac * len(ordered)))
+    return round(ordered[idx], 3)
+
+
+def conservation(report) -> dict:
+    """Exact pod conservation over every terminal and live state.
+    Same identity the chaos plane banks, plus ``migrated``: a
+    checkpoint/restore move is its own terminal ledger row (the pod
+    re-enters as a rebind), so a migrating gauntlet run must count
+    it or a single compaction sweep reads as pod loss."""
+    terminal = (
+        report.completed + report.unschedulable + report.killed
+        + report.defrag_evicted + report.gang_requeued
+        + report.running_at_end + report.pending_at_end
+    )
+    return {
+        "submitted": report.submitted,
+        "accounted": terminal,
+        "migrated": report.migrated,
+        "exact": report.submitted == terminal,
+    }
+
+
+def _wait_histogram(waits: Sequence[float], slo_s: float) -> dict:
+    return {
+        "count": len(waits),
+        "p50": percentile(waits, 0.50),
+        "p95": percentile(waits, 0.95),
+        "p99": percentile(waits, 0.99),
+        "max": round(max(waits), 3) if waits else 0.0,
+        "slo_attainment": round(
+            sum(1 for w in waits if w <= slo_s) / len(waits), 4
+        ) if waits else 1.0,
+    }
+
+
+class Grader:
+    def __init__(self, scenario: Scenario):
+        self.scenario = scenario
+
+    # -- reductions ----------------------------------------------------
+
+    def _arm_row(self, arm: ArmResult) -> dict:
+        s = self.scenario
+        report = arm.report
+        drift = arm.sim.engine.ledger_drift()
+        row = {
+            "submitted": report.submitted,
+            "bound": report.bound,
+            "completed": report.completed,
+            "unschedulable": report.unschedulable,
+            "killed": report.killed,
+            "resubmitted": report.resubmitted,
+            "defrag_evicted": report.defrag_evicted,
+            "gang_requeued": report.gang_requeued,
+            "migrated": report.migrated,
+            "crashes": report.crashes,
+            "failed_passes": report.failed_passes,
+            "nodes_added": report.nodes_added,
+            "nodes_removed": report.nodes_removed,
+            "goodput_chip_s": round(report.chip_seconds_goodput, 1),
+            "utilization": round(report.utilization, 4),
+            "goodput": round(report.goodput, 4),
+            "mean_wait_s": round(report.mean_wait, 3),
+            "conservation": conservation(report),
+            "double_binds": len(
+                getattr(arm.sim.cluster, "double_binds", ()) or ()
+            ),
+            "ledger_drift_tenants": len(drift),
+            "ledger_rebuild_mismatches":
+                report.ledger_rebuild_mismatches,
+            "alerts_fired": dict(sorted(arm.alerts_fired.items())),
+            "tenant_waits": {
+                tenant: _wait_histogram(waits, s.wait_slo_s)
+                for tenant, waits in sorted(report.tenant_waits.items())
+            },
+        }
+        weights = s.entitlement_weights()
+        if weights:
+            # entitlement-normalized service: each tenant's delivered
+            # chip-seconds divided by its fair-share weight — Jain over
+            # the normalized vector grades weighted fairness, not raw
+            # equality
+            normalized = {
+                tenant: report.tenant_chip_seconds.get(tenant, 0.0)
+                / max(weights.get(tenant, 1.0), 1e-9)
+                for tenant in weights
+            }
+            row["tenant_chip_s"] = {
+                t: round(v, 1)
+                for t, v in sorted(report.tenant_chip_seconds.items())
+            }
+            row["jain"] = jain(list(normalized.values()))
+        return row
+
+    def grade(self, outcome: RunOutcome) -> dict:
+        s = self.scenario
+        row = {
+            "scenario": s.name,
+            "note": s.note,
+            "fleet": {
+                p.name: {
+                    "model": p.model, "nodes": p.nodes,
+                    "chips_per_node": p.chips_per_node,
+                    "spare_nodes": p.spare_nodes,
+                }
+                for p in s.pools
+            },
+            "total_nodes": s.total_nodes,
+            "total_chips": s.total_chips,
+            "events": outcome.events,
+            "horizon_s": s.horizon,
+            "faults": len(s.faults),
+            "toggles": {
+                "autoscale": s.autoscale, "backfill": s.backfill,
+                "backfill_reservations": s.backfill_reservations,
+                "migrate": s.migrate, "compaction": s.compaction,
+                "serving": bool(s.serving),
+            },
+            "floors": {
+                "wait_slo_s": s.wait_slo_s,
+                "jain": s.jain_floor,
+                "goodput_ratio": s.goodput_floor,
+                "expected_alerts": sorted(s.expected_alerts),
+                "allowed_alerts": sorted(s.allowed_alerts),
+            },
+            "main": self._arm_row(outcome.main),
+        }
+        if outcome.baseline is not None:
+            row["baseline"] = self._arm_row(outcome.baseline)
+            base = outcome.baseline.report.chip_seconds_goodput
+            faulted = outcome.main.report.chip_seconds_goodput
+            row["goodput_ratio"] = (
+                round(faulted / base, 4) if base else 1.0
+            )
+        if outcome.autoscale_audit is not None:
+            row["autoscale"] = dict(outcome.autoscale_audit)
+        if outcome.serving is not None:
+            sv = outcome.serving
+            row["serving"] = {
+                "requests": sv.get("requests", 0),
+                "served": sv.get("served", 0),
+                "shed_rate": sv.get("shed_rate", 0.0),
+                "conservation": sv.get("conservation", {}),
+                "queue_wait_s": sv.get("queue_wait_s", {}),
+                "ttft_s": sv.get("ttft_s", {}),
+                "replicas": sv.get("replicas", {}),
+            }
+        row["failed_floors"] = failed_floors(row)
+        row["ok"] = not row["failed_floors"]
+        return row
+
+
+def failed_floors(row: dict) -> List[str]:
+    """Judge one artifact row. Pure dict-in / list-out so the tier-1
+    suite holds the COMMITTED ``GAUNTLET.json`` to the same floors
+    the banking run enforced."""
+    bad: List[str] = []
+    floors = row.get("floors", {})
+    main = row.get("main", {})
+
+    def check_arm(arm: dict, label: str) -> None:
+        cons = arm.get("conservation", {})
+        if not cons.get("exact", False):
+            bad.append(
+                f"{label}: conservation {cons.get('submitted')} != "
+                f"{cons.get('accounted')}"
+            )
+        if arm.get("double_binds", 0) != 0:
+            bad.append(f"{label}: double_binds={arm['double_binds']}")
+        if arm.get("ledger_drift_tenants", 0) != 0:
+            bad.append(
+                f"{label}: ledger drift in "
+                f"{arm['ledger_drift_tenants']} tenants"
+            )
+        if arm.get("ledger_rebuild_mismatches", 0) != 0:
+            bad.append(
+                f"{label}: ledger_rebuild_mismatches="
+                f"{arm['ledger_rebuild_mismatches']}"
+            )
+
+    check_arm(main, "main")
+    baseline = row.get("baseline")
+    if baseline is not None:
+        check_arm(baseline, "baseline")
+        # the fault-free arm is the silence check: a rule that fires
+        # with no fault in the script is a false positive
+        if baseline.get("alerts_fired"):
+            bad.append(
+                "baseline: alerts fired fault-free: "
+                + ",".join(sorted(baseline["alerts_fired"]))
+            )
+
+    fired = set(main.get("alerts_fired", {}))
+    expected = set(floors.get("expected_alerts", ()))
+    allowed = set(floors.get("allowed_alerts", ()))
+    missing = expected - fired
+    unexpected = fired - expected - allowed
+    if missing:
+        bad.append("alerts missing: " + ",".join(sorted(missing)))
+    if unexpected:
+        bad.append("alerts unexpected: " + ",".join(sorted(unexpected)))
+
+    jain_floor = floors.get("jain", 0.0)
+    if jain_floor and main.get("jain", 1.0) < jain_floor:
+        bad.append(f"jain {main.get('jain')} < {jain_floor}")
+
+    goodput_floor = floors.get("goodput_ratio", 0.0)
+    if goodput_floor and row.get("goodput_ratio") is not None:
+        if row["goodput_ratio"] < goodput_floor:
+            bad.append(
+                f"goodput_ratio {row['goodput_ratio']} < "
+                f"{goodput_floor}"
+            )
+
+    audit = row.get("autoscale")
+    if audit is not None and audit.get(
+        "drain_guarantee_violations", 0
+    ):
+        bad.append(
+            "autoscale drained nodes holding guarantee pods: "
+            f"{audit['drain_guarantee_violations']}"
+        )
+
+    serving = row.get("serving")
+    if serving is not None:
+        if not serving.get("conservation", {}).get("exact", False):
+            bad.append("serving: request conservation broken")
+
+    return bad

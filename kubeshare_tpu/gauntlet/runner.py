@@ -1,0 +1,300 @@
+"""Scenario -> simulator wiring.
+
+``GauntletRunner`` turns one :class:`Scenario` into the full replay
+stack: a heterogeneous topology (one node-level cell type per pool),
+the synthesized node inventory + per-node chip models, the trace, the
+resolved fault script, an incident plane built exactly the way the
+daemon builds it (``obs.build_plane`` with an engine_ref that
+survives crash rebuilds), and — per the scenario's toggles — the
+closed autoscale loop (planner rebuilt against the CURRENT engine
+every round, so a mid-run scheduler crash does not leave the
+controller planning against a dead object) and a serving-loop section.
+
+Faulted scenarios run TWO arms off the same seed: a fault-free
+baseline (the goodput yardstick and the alert-silence check) and the
+faulted run. Fault-free scenarios run one arm that serves both
+purposes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..obs import AlertConfig, build_plane
+from ..sim.simulator import SimReport, Simulator
+from ..sim.trace import (
+    TraceEvent, generate_fleet_trace, generate_starvation_trace,
+    generate_tenant_trace,
+)
+from .scenario import Scenario
+
+_TRACE_GENERATORS = {
+    "fleet": generate_fleet_trace,
+    "tenant": generate_tenant_trace,
+    "starvation": generate_starvation_trace,
+}
+
+
+@dataclass
+class ArmResult:
+    """One replay arm: the simulator (still holding its engine,
+    cluster, and obs plane) plus its report and the alert counters."""
+
+    sim: Simulator
+    report: SimReport
+    alerts_fired: Dict[str, int]
+
+
+@dataclass
+class RunOutcome:
+    scenario: Scenario
+    events: int
+    main: ArmResult
+    baseline: Optional[ArmResult] = None  # fault-free arm (faulted runs)
+    serving: Optional[dict] = None
+    autoscale_audit: Optional[dict] = None
+
+
+class GauntletRunner:
+    def __init__(self, scenario: Scenario, log: Callable = None):
+        self.scenario = scenario
+        self.log = log or (lambda *a: None)
+
+    # -- fleet synthesis ----------------------------------------------
+
+    def topology(self) -> dict:
+        """One node-level cell type per pool; the topology declares
+        spare nodes too (the planner's pool_nodes — headroom the
+        autoscale loop may claim — comes from declared cells)."""
+        cell_types = {}
+        cells = []
+        for p in self.scenario.pools:
+            cell_types[f"{p.name}-node"] = {
+                "child_cell_type": p.model,
+                "child_cell_number": p.chips_per_node,
+                "child_cell_priority": p.priority,
+                "is_node_level": True,
+            }
+            cells.extend(
+                {"cell_type": f"{p.name}-node", "cell_id": p.node_name(i)}
+                for i in range(p.total_nodes)
+            )
+        return {"cell_types": cell_types, "cells": cells}
+
+    def nodes(self) -> Dict[str, int]:
+        """Initial live inventory (spares held back)."""
+        return {
+            p.node_name(i): p.chips_per_node
+            for p in self.scenario.pools
+            for i in range(p.nodes)
+        }
+
+    def node_models(self) -> Dict[str, str]:
+        return {
+            p.node_name(i): p.model
+            for p in self.scenario.pools
+            for i in range(p.total_nodes)
+        }
+
+    def spares(self) -> Dict[str, List[str]]:
+        """model -> spare node names, in pool order."""
+        out: Dict[str, List[str]] = {}
+        for p in self.scenario.pools:
+            if p.spare_nodes:
+                out.setdefault(p.model, []).extend(
+                    p.node_name(i)
+                    for i in range(p.nodes, p.total_nodes)
+                )
+        return out
+
+    def build_trace(self) -> List[TraceEvent]:
+        s = self.scenario
+        try:
+            gen = _TRACE_GENERATORS[s.trace_kind]
+        except KeyError:
+            raise ValueError(
+                f"scenario {s.name}: unknown trace_kind {s.trace_kind!r}"
+            ) from None
+        return gen(**s.trace_kwargs())
+
+    # -- arm construction ---------------------------------------------
+
+    def _make_sim(self, with_faults: bool) -> Simulator:
+        s = self.scenario
+        inject = with_faults and any(
+            f.kind == "api_flake" for f in s.faults
+        )
+        sim = Simulator(
+            self.topology(),
+            self.nodes(),
+            chip_model=s.pools[0].model,
+            node_models=self.node_models(),
+            seed=s.seed,
+            defrag=True,
+            tenants=s.tenants_config(),
+            backfill=s.backfill,
+            backfill_reservations=s.backfill_reservations,
+            stamp_estimates=s.backfill_reservations,
+            migrate=s.migrate,
+            compaction=s.compaction,
+            inject_faults=inject,
+            fault_seed=s.seed,
+        )
+        # alert windows scaled to the virtual horizon, mirroring
+        # tools/incident_report.py: "fast" spans a handful of passes,
+        # "slow" about a quarter of the run. The scenario's wait-SLO
+        # drives the burn rule too — one number grades both the wait
+        # histograms and the alert plane, so "silent fault-free" means
+        # silent AGAINST THE SLO THE SCENARIO DECLARES.
+        cfg = AlertConfig(
+            eval_interval=2.0,
+            fast_window=s.horizon * 0.08,
+            slow_window=s.horizon * 0.3,
+            slo_wait_seconds=s.wait_slo_s,
+        )
+        sim.obs_plane = build_plane(
+            lambda: sim.engine, cluster=sim.cluster, config=cfg,
+        )
+        return sim
+
+    def _make_controller(self, audit: dict, spares_by_model):
+        """Closed autoscale loop. The CapacityPlanner is rebuilt
+        against ``sim.engine`` every round — scheduler_crash replaces
+        the engine object, and a planner holding the dead one would
+        read a frozen cell tree. The Recommender persists (it carries
+        the cooldown clocks)."""
+        from ..autoscale import CapacityPlanner, Recommender
+
+        recommender = Recommender(
+            up_cooldown_s=60.0,
+            down_cooldown_s=240.0,
+            down_stable_s=120.0,
+            max_surge_nodes=4,
+        )
+
+        def controller(sim, report):
+            planner = CapacityPlanner(sim.engine,
+                                      recommender=recommender)
+            rec, snap = planner.plan()
+            audit["rounds"] += 1
+            by_node = {c.node: c for c in snap.drains}
+            for plan in rec.plans:
+                ups = max(0, plan.delta_nodes + len(plan.drain_nodes))
+                pool = spares_by_model.get(plan.model, [])
+                for _ in range(ups):
+                    if not pool:
+                        audit["pool_exhausted"] += 1
+                        break
+                    sim.add_node(pool.pop(0))
+                    audit["scale_up_nodes"] += 1
+                for node in plan.drain_nodes:
+                    cand = by_node.get(node)
+                    if cand is not None and cand.guarantee_pods != 0:
+                        audit["drain_guarantee_violations"] += 1
+                    sim.remove_node(node)
+                    spares_by_model.setdefault(
+                        plan.model, []
+                    ).append(node)
+                    audit["drained_nodes"] += 1
+
+        return controller
+
+    def _run_arm(self, events, with_faults: bool,
+                 audit: Optional[dict]) -> ArmResult:
+        s = self.scenario
+        sim = self._make_sim(with_faults)
+        controller = None
+        if audit is not None:
+            controller = self._make_controller(audit, self.spares())
+        faults = s.resolved_faults() if with_faults else []
+        report = sim.run(
+            list(events), horizon=s.horizon, faults=faults,
+            controller=controller, controller_interval=30.0,
+        )
+        plane = sim.obs_plane
+        plane.flush(sim.clock_now)
+        evaluator = plane.evaluator
+        fired = {
+            rule.name: evaluator.state(rule.name).fired_total
+            for rule in evaluator.rules
+            if evaluator.state(rule.name).fired_total
+        }
+        return ArmResult(sim=sim, report=report, alerts_fired=fired)
+
+    def _run_serving(self) -> Optional[dict]:
+        """The serving-loop section: an independent ServingLoopSim
+        (request plane + slot-sizing loop + the REAL engine placing
+        replica pods) whose SLO percentiles and conservation totals
+        fold into the scenario row."""
+        s = self.scenario
+        kw = s.serving_kwargs()
+        if not kw:
+            return None
+        from ..serving import ServingLoopSim
+        from ..sim.trace import generate_diurnal_request_trace
+
+        nodes = int(kw.pop("nodes", 8))
+        chips_per_node = int(kw.pop("chips_per_node", 4))
+        chip_model = kw.pop("chip_model", "tpu-v5e")
+        horizon = float(kw.pop("horizon", s.horizon))
+        initial_replicas = int(kw.pop("initial_replicas", 2))
+        max_replicas = int(kw.pop("max_replicas", nodes * 2))
+        requests_kw = dict(kw.pop("requests", {}))
+        topo = {
+            "cell_types": {
+                "serving-node": {
+                    "child_cell_type": chip_model,
+                    "child_cell_number": chips_per_node,
+                    "child_cell_priority": 50,
+                    "is_node_level": True,
+                },
+            },
+            "cells": [
+                {"cell_type": "serving-node", "cell_id": f"sv{i:03d}"}
+                for i in range(nodes)
+            ],
+        }
+        sv = ServingLoopSim(
+            topo,
+            {f"sv{i:03d}": chips_per_node for i in range(nodes)},
+            chip_model=chip_model,
+            **kw,
+        )
+        events = generate_diurnal_request_trace(**requests_kw)
+        row = sv.run(
+            events, horizon=horizon,
+            initial_replicas=initial_replicas,
+            autoscale=True, max_replicas=max_replicas,
+        )
+        row["nodes"] = nodes
+        row["requests"] = len(events)
+        return row
+
+    # -- the whole scenario -------------------------------------------
+
+    def run(self) -> RunOutcome:
+        s = self.scenario
+        events = self.build_trace()
+        self.log(f"{s.name}: {s.total_nodes} nodes / {s.total_chips} "
+                 f"chips, {len(events)} events, horizon {s.horizon}s")
+        audit = None
+        if s.autoscale:
+            audit = {
+                "rounds": 0, "scale_up_nodes": 0, "drained_nodes": 0,
+                "pool_exhausted": 0, "drain_guarantee_violations": 0,
+            }
+        baseline = None
+        if s.faults:
+            self.log(f"{s.name}: fault-free baseline arm")
+            baseline = self._run_arm(events, with_faults=False,
+                                     audit=None)
+        self.log(f"{s.name}: main arm ({len(s.faults)} faults)")
+        main = self._run_arm(events, with_faults=bool(s.faults),
+                             audit=audit)
+        serving = self._run_serving()
+        return RunOutcome(
+            scenario=s, events=len(events), main=main,
+            baseline=baseline, serving=serving,
+            autoscale_audit=audit,
+        )

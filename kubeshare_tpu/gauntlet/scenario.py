@@ -1,0 +1,203 @@
+"""Declarative gauntlet scenarios.
+
+A :class:`Scenario` is everything one whole-system replay needs,
+stated as data: the heterogeneous fleet (``pools`` of v4/v5e/v6e
+nodes with per-pool node templates), the arrival curve (a named trace
+generator plus its kwargs), the tenant/quota config, the fault script
+(fractions of the horizon, so one spec scales), the plane toggles
+(autoscale / backfill / reservations / migration / compaction /
+serving), and the floors the grader holds it to. ``scaled()`` shrinks
+a banked 10k-node scenario to something tier-1 can replay live in
+seconds while keeping every structural property — same pools, same
+trace shape, same fault script, same floors that still apply.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Tuple
+
+from ..sim.simulator import FaultEvent
+
+
+@dataclass(frozen=True)
+class PoolSpec:
+    """One homogeneous slice of the fleet: ``nodes`` live nodes of
+    ``model`` with ``chips_per_node`` chips each, plus ``spare_nodes``
+    declared in the topology but held back for the autoscale
+    controller to add (the node-pool headroom)."""
+
+    name: str
+    model: str
+    nodes: int
+    chips_per_node: int
+    priority: int = 50
+    spare_nodes: int = 0
+
+    def node_name(self, i: int) -> str:
+        return f"{self.name}-{i:05d}"
+
+    @property
+    def total_nodes(self) -> int:
+        return self.nodes + self.spare_nodes
+
+    @property
+    def chips(self) -> int:
+        return self.nodes * self.chips_per_node
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """A fault stated in horizon fractions, so the same script drives
+    the banked 10k-node run and the scaled-down tier-1 replay.
+    ``pool``/``index`` name a node target symbolically; ``duration``
+    is a horizon fraction too (api_flake)."""
+
+    at: float                 # fraction of the horizon in (0, 1)
+    kind: str                 # FaultEvent kind
+    pool: str = ""            # pool name for node-targeted kinds
+    index: int = 0            # node index within the pool
+    chips: int = 0            # scheduler_crash: arm mid-pass after N binds
+    duration: float = 0.0     # api_flake: outage as a horizon fraction
+
+    def resolve(self, scenario: "Scenario") -> FaultEvent:
+        target = ""
+        if self.pool:
+            pool = scenario.pool(self.pool)
+            # modulo: a scaled-down fleet keeps the script valid
+            target = pool.node_name(self.index % pool.nodes)
+        return FaultEvent(
+            time=round(self.at * scenario.horizon, 3),
+            kind=self.kind,
+            target=target,
+            chips=self.chips,
+            duration=round(self.duration * scenario.horizon, 3),
+        )
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One gauntlet entry. ``trace_kind`` picks the generator
+    (``fleet`` / ``tenant`` / ``starvation``), ``trace`` its kwargs.
+    ``expected_alerts`` are the rules that MUST fire (exactly — any
+    other firing rule fails the scenario unless listed in
+    ``allowed_alerts``); a fault-free scenario with an empty expected
+    set is therefore graded alert-silent. ``entitlements`` weight the
+    Jain index's per-tenant service normalization (falls back to the
+    quota config's weights). Floors at 0.0 are not graded."""
+
+    name: str
+    note: str
+    pools: Tuple[PoolSpec, ...]
+    horizon: float
+    trace_kind: str = "fleet"
+    trace: Tuple[Tuple[str, object], ...] = ()
+    tenants: Optional[Tuple[Tuple[str, Tuple[Tuple[str, float], ...]], ...]] = None
+    entitlements: Tuple[Tuple[str, float], ...] = ()
+    faults: Tuple[FaultSpec, ...] = ()
+    expected_alerts: Tuple[str, ...] = ()
+    allowed_alerts: Tuple[str, ...] = ()
+    autoscale: bool = False
+    backfill: bool = False
+    backfill_reservations: bool = False
+    migrate: bool = False
+    compaction: bool = False
+    serving: Tuple[Tuple[str, object], ...] = ()
+    wait_slo_s: float = 300.0
+    jain_floor: float = 0.0
+    goodput_floor: float = 0.0
+    seed: int = 0
+
+    # -- spec accessors (tuple-encoded maps keep the spec hashable
+    #    and trivially JSON-serializable) ------------------------------
+
+    def pool(self, name: str) -> PoolSpec:
+        for p in self.pools:
+            if p.name == name:
+                return p
+        raise KeyError(f"scenario {self.name}: no pool {name!r}")
+
+    def trace_kwargs(self) -> dict:
+        return {k: v for k, v in self.trace}
+
+    def serving_kwargs(self) -> dict:
+        return {k: v for k, v in self.serving}
+
+    def tenants_config(self) -> Optional[dict]:
+        if self.tenants is None:
+            return None
+        return {
+            "tenants": {
+                t: {k: v for k, v in spec} for t, spec in self.tenants
+            }
+        }
+
+    def entitlement_weights(self) -> Dict[str, float]:
+        """Tenant -> fair-share weight for the Jain normalization:
+        the explicit ``entitlements`` map, else the quota config's
+        weights."""
+        if self.entitlements:
+            return {t: w for t, w in self.entitlements}
+        cfg = self.tenants_config() or {"tenants": {}}
+        return {
+            t: float(spec.get("weight", 1.0))
+            for t, spec in cfg["tenants"].items()
+        }
+
+    @property
+    def total_nodes(self) -> int:
+        return sum(p.nodes for p in self.pools)
+
+    @property
+    def total_chips(self) -> int:
+        return sum(p.chips for p in self.pools)
+
+    def resolved_faults(self) -> List[FaultEvent]:
+        return sorted(
+            (f.resolve(self) for f in self.faults), key=lambda f: f.time
+        )
+
+    # -- tier-1 scaling ------------------------------------------------
+
+    def scaled(
+        self,
+        node_factor: float,
+        trace_overrides: Optional[dict] = None,
+        horizon: Optional[float] = None,
+        suffix: str = "-scaled",
+    ) -> "Scenario":
+        """A structurally identical, smaller scenario: every pool's
+        node counts multiplied by ``node_factor`` (floored at 1 live
+        node; spares keep at least one when they had any, so the
+        autoscale toggle still has headroom), the trace generator's
+        kwargs overridden by ``trace_overrides`` (counts, spans), the
+        fault script untouched (it is horizon-fractional)."""
+        pools = tuple(
+            replace(
+                p,
+                nodes=max(1, int(round(p.nodes * node_factor))),
+                spare_nodes=(
+                    max(1, int(round(p.spare_nodes * node_factor)))
+                    if p.spare_nodes else 0
+                ),
+            )
+            for p in self.pools
+        )
+        trace = dict(self.trace)
+        trace.update(trace_overrides or {})
+        return replace(
+            self,
+            name=self.name + suffix,
+            pools=pools,
+            horizon=horizon if horizon is not None else self.horizon,
+            trace=tuple(sorted(trace.items())),
+        )
+
+
+def tenants_spec(config: dict) -> Tuple:
+    """Encode a ``{"tenants": {...}}`` quota config as the Scenario's
+    tuple form."""
+    return tuple(
+        (t, tuple(sorted(spec.items())))
+        for t, spec in sorted(config["tenants"].items())
+    )

@@ -1,0 +1,96 @@
+"""Banked-gauntlet metric surface.
+
+The committed ``GAUNTLET.json`` is the repo's whole-system grade; the
+scoreboard re-exports its rows as ``tpu_scheduler_gauntlet_*`` gauges
+so the daemon's /metrics (and therefore any dashboard watching the
+deployment) carries the last banked verdict next to the live series —
+the same pattern the cost sentinel uses for BENCH.json baselines.
+Families:
+
+- ``tpu_scheduler_gauntlet_scenarios`` — rows banked
+- ``tpu_scheduler_gauntlet_floor_failures`` — failed floors, summed
+- ``tpu_scheduler_gauntlet_ok{scenario}`` — 1/0 per row
+- ``tpu_scheduler_gauntlet_jain{scenario}`` — entitlement-normalized
+  Jain index (rows with tenants)
+- ``tpu_scheduler_gauntlet_goodput_ratio{scenario}`` — faulted arm's
+  goodput over the fault-free arm's (faulted rows)
+- ``tpu_scheduler_gauntlet_wait_p99_seconds{scenario,tenant}``
+- ``tpu_scheduler_gauntlet_alerts_fired{scenario,rule}`` — main arm
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from ..utils import expfmt
+
+
+class GauntletScoreboard:
+    def __init__(self, rows: Optional[List[dict]] = None):
+        self.rows: List[dict] = list(rows or [])
+
+    @classmethod
+    def load(cls, path: str) -> "GauntletScoreboard":
+        """From a banked ``GAUNTLET.json`` (tolerates a missing or
+        torn file — a daemon must come up without one)."""
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return cls()
+        rows = doc.get("scenarios") if isinstance(doc, dict) else None
+        return cls([r for r in rows or [] if isinstance(r, dict)])
+
+    def record(self, row: dict) -> None:
+        """Replace-or-append by scenario name (re-banking idiom)."""
+        name = row.get("scenario")
+        self.rows = [r for r in self.rows if r.get("scenario") != name]
+        self.rows.append(row)
+
+    def samples(self) -> List[expfmt.Sample]:
+        out = [
+            expfmt.Sample("tpu_scheduler_gauntlet_scenarios", {},
+                          float(len(self.rows))),
+            expfmt.Sample(
+                "tpu_scheduler_gauntlet_floor_failures", {},
+                float(sum(
+                    len(r.get("failed_floors", ())) for r in self.rows
+                )),
+            ),
+        ]
+        for row in self.rows:
+            name = str(row.get("scenario", ""))
+            lbl = {"scenario": name}
+            out.append(expfmt.Sample(
+                "tpu_scheduler_gauntlet_ok", dict(lbl),
+                1.0 if row.get("ok") else 0.0,
+            ))
+            main = row.get("main", {})
+            if "jain" in main:
+                out.append(expfmt.Sample(
+                    "tpu_scheduler_gauntlet_jain", dict(lbl),
+                    float(main["jain"]),
+                ))
+            if row.get("goodput_ratio") is not None:
+                out.append(expfmt.Sample(
+                    "tpu_scheduler_gauntlet_goodput_ratio", dict(lbl),
+                    float(row["goodput_ratio"]),
+                ))
+            for tenant, hist in sorted(
+                main.get("tenant_waits", {}).items()
+            ):
+                out.append(expfmt.Sample(
+                    "tpu_scheduler_gauntlet_wait_p99_seconds",
+                    {"scenario": name, "tenant": tenant},
+                    float(hist.get("p99", 0.0)),
+                ))
+            for rule, fired in sorted(
+                main.get("alerts_fired", {}).items()
+            ):
+                out.append(expfmt.Sample(
+                    "tpu_scheduler_gauntlet_alerts_fired",
+                    {"scenario": name, "rule": rule},
+                    float(fired),
+                ))
+        return out

@@ -21,6 +21,14 @@ LABEL_TPU_MEMORY = DOMAIN + "tpu_mem"             # HBM bytes cap
 LABEL_TPU_MODEL = DOMAIN + "tpu_model"            # chip generation pin (e.g. tpu-v5e)
 LABEL_TENANT = DOMAIN + "tenant"                  # quota tenant override
                                                   # (default: namespace)
+LABEL_RUNTIME_ESTIMATE = DOMAIN + "runtime_estimate"  # declared expected
+                                                  # runtime (seconds) —
+                                                  # advisory: backfill's
+                                                  # cross-wave EASY rule
+                                                  # admits small pods
+                                                  # that finish before a
+                                                  # blocked head's
+                                                  # estimated start
 
 # serving-replica labels: a pod carrying serving_model is a
 # DecodeServer replica; on BIND the informer registers it with the

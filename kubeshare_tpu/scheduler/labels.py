@@ -72,6 +72,12 @@ class PodRequirements:
     priority: int = 0
     gang: Optional[GangSpec] = None
     tenant: str = ""  # resolved quota tenant (label override or namespace)
+    # declared expected runtime in seconds (sharedtpu/runtime_estimate,
+    # advisory): 0.0 = undeclared. Backfill's cross-wave EASY rule only
+    # admits pods that DECLARE an estimate ending before the blocked
+    # head's estimated start; undeclared pods keep the conservative
+    # capacity-disjoint rule.
+    est_runtime: float = 0.0
 
     @property
     def is_guarantee(self) -> bool:
@@ -166,6 +172,16 @@ def cached_req(pod: Pod) -> PodRequirements:
     return req
 
 
+def parse_estimate(pod: Pod) -> float:
+    """Declared runtime estimate in seconds (advisory; 0.0 = absent).
+    Validated like every other numeric label — a malformed estimate is
+    a misconfiguration, not a silent no-hint."""
+    raw = pod.labels.get(C.LABEL_RUNTIME_ESTIMATE, "")
+    if not raw:
+        return 0.0
+    return _parse_float(pod, "runtime_estimate", raw)
+
+
 def parse_pod(pod: Pod) -> PodRequirements:
     """Parse + validate. Raises ``LabelError`` on misconfiguration
     (maps to Unschedulable in PreFilter); returns kind=REGULAR for pods
@@ -173,6 +189,7 @@ def parse_pod(pod: Pod) -> PodRequirements:
     priority = parse_priority(pod)
     gang = parse_gang(pod)
     tenant = parse_tenant(pod)
+    est_runtime = parse_estimate(pod)
 
     raw_limit = None
     for label in C.LABEL_TPU_LIMIT_ALIASES:
@@ -184,7 +201,8 @@ def parse_pod(pod: Pod) -> PodRequirements:
 
     if raw_limit is None and raw_request is None and raw_memory is None:
         return PodRequirements(
-            kind=PodKind.REGULAR, priority=priority, gang=gang, tenant=tenant
+            kind=PodKind.REGULAR, priority=priority, gang=gang,
+            tenant=tenant, est_runtime=est_runtime,
         )
 
     if raw_limit is None:
@@ -200,7 +218,8 @@ def parse_pod(pod: Pod) -> PodRequirements:
 
     if limit == 0.0 and request == 0.0:
         return PodRequirements(
-            kind=PodKind.REGULAR, priority=priority, gang=gang, tenant=tenant
+            kind=PodKind.REGULAR, priority=priority, gang=gang,
+            tenant=tenant, est_runtime=est_runtime,
         )
 
     if limit > 1.0 + _EPS:
@@ -242,4 +261,5 @@ def parse_pod(pod: Pod) -> PodRequirements:
         priority=priority,
         gang=gang,
         tenant=tenant,
+        est_runtime=est_runtime,
     )

@@ -41,7 +41,7 @@ from .labels import (
 )
 from .podgroup import PodGroupRegistry
 from .scoring import (
-    anchor_fingerprint, pick_top2_seq, score_node,
+    SeedNeighborhood, anchor_fingerprint, pick_top2_seq, score_node,
     seed_eligible, select_leaves, _resolved_memory,
 )
 from .state import PodState, PodStatus, PodStatusStore
@@ -133,6 +133,7 @@ class TpuShareScheduler:
         compaction_interval: float = 60.0,
         vector: bool = True,
         native: bool = False,
+        backfill_reservations: bool = False,
     ):
         # function-scope import: quota depends on scheduler.labels /
         # scheduler.constants, so a module-level import here would be
@@ -399,6 +400,25 @@ class TpuShareScheduler:
         )
         self.backfill_binds = 0        # binds placed behind a blocked head
         self.backfill_head_delays = 0  # safety violations (must stay 0)
+        # Cross-wave backfill reservations (EASY proper): carry the
+        # blocked head's identity across waves and compute an
+        # ESTIMATED START from occupants' declared runtime estimates
+        # (sharedtpu/runtime_estimate) — a pod whose own declared
+        # runtime provably ends before the head could possibly start
+        # may bind ONTO held capacity (it will be gone by then),
+        # which is what admits longer-running smaller pods safely.
+        # Opt-in: with it off, schedule_wave keeps the per-wave
+        # conservative rule (capacity-disjoint / non-blocking only).
+        self.backfill_reservations = backfill_reservations
+        # head_key / req / head_size / head_reason of the reservation
+        # carried from the last wave (None = no head blocked). The
+        # hold map + est_start are recomputed at each wave's seed —
+        # capacity moved between waves, so a stale snapshot would
+        # both over-hold and mis-time admissions.
+        self._wave_reservation: Optional[dict] = None
+        self.backfill_easy_binds = 0   # estimate-admitted binds onto
+                                       # held capacity (subset of
+                                       # backfill_binds)
         # per-phase wave wall time (seconds, cumulative): where a
         # wave's budget goes — inventory sync, queue sort, the
         # attempt loop, journal flush. Plain perf_counter sums, not
@@ -1129,7 +1149,7 @@ class TpuShareScheduler:
         req: PodRequirements,
         node_name: str,
         anchors: Optional[List[Cell]] = None,
-        seed_frees: Optional[List[Cell]] = None,
+        seed_frees: Optional["SeedNeighborhood"] = None,
     ) -> float:
         """``anchors`` — the gang's already-placed leaves — may be
         passed in to amortize the group lookup over a many-node loop;
@@ -1644,6 +1664,37 @@ class TpuShareScheduler:
         # clears the memo (capacity_releases counts every _release).
         failed_shapes: Dict[tuple, List[Tuple[float, int]]] = {}
         releases_at_start = self.capacity_releases
+        # Cross-wave reservation seed (EASY proper, opt-in): a head
+        # left blocked by the LAST wave re-establishes its claim
+        # before this wave's first attempt, so pods sorted ahead of
+        # it cannot eat its capacity in the gap. est_start is a LOWER
+        # bound on when the head could possibly start, derived from
+        # occupants' declared runtime estimates — the admission bound
+        # for longer-running smaller pods onto held capacity. Both
+        # the hold map and the estimate are recomputed here, never
+        # carried stale: binds and completions moved since last wave.
+        est_start: Optional[float] = None
+        reservations_on = backfill and self.backfill_reservations
+        carried = self._wave_reservation if reservations_on else None
+        if carried is not None:
+            pod0 = self.cluster.get_pod(carried["head_key"])
+            st0 = self.status.get(carried["head_key"])
+            if (pod0 is None or pod0.is_bound or pod0.is_completed
+                    or (st0 is not None
+                        and st0.state != PodState.PENDING)):
+                # the head bound or left between waves: claim dissolves
+                carried = self._wave_reservation = None
+        if carried is not None:
+            head_key = carried["head_key"]
+            head_req = carried["req"]
+            head_size = carried["head_size"]
+            head_reason = carried["head_reason"]
+            hold, whole_counts = self._backfill_hold_map(head_req)
+            backfill_open = (
+                whole_counts is not None
+                or len(hold) < len(self._node_index)
+            )
+            est_start = self._estimate_head_start(head_req, hold)
         try:
             if len(pods) > 1:
                 order = sorted(pods, key=self.queue_sort_key)
@@ -1665,7 +1716,8 @@ class TpuShareScheduler:
                     # RESERVED survivor retries its failed bind verb
                     decisions.append(self._handle_existing(pod, existing))
                     continue
-                if head_key is not None:
+                easy = False
+                if head_key is not None and pod.key != head_key:
                     # head-of-line: only strictly-smaller pods may
                     # attempt, and only behind the head's hold set;
                     # everyone else waits without paying a filter scan
@@ -1692,13 +1744,34 @@ class TpuShareScheduler:
                         )
                         size = self._req_size(req0)
                         mem0 = req0.memory
-                        pts = failed_shapes.get(shape_key)
-                        skip = size >= head_size or (
-                            pts is not None and any(
-                                fr <= size and fm <= mem0
-                                for fr, fm in pts
+                        if (est_start is not None
+                                and req0.est_runtime > 0
+                                and size < head_size
+                                and (req0.gang is None
+                                     or req0.gang.headcount <= 1)
+                                and self.clock() + req0.est_runtime
+                                <= est_start):
+                            # EASY admission: the pod DECLARES it will
+                            # finish before the head could possibly
+                            # start, so it may bind onto held capacity
+                            # (it will be gone by then). Gang members
+                            # are excluded — a Permit-parked member's
+                            # clock does not start at reserve, so its
+                            # estimate bounds nothing. The dominance
+                            # memo is skipped too: it records failures
+                            # under the hold screen, a strictly
+                            # smaller capacity view than this attempt
+                            # gets.
+                            easy = True
+                            skip = False
+                        else:
+                            pts = failed_shapes.get(shape_key)
+                            skip = size >= head_size or (
+                                pts is not None and any(
+                                    fr <= size and fm <= mem0
+                                    for fr, fm in pts
+                                )
                             )
-                        )
                     if skip:
                         # still DEMAND: the autoscale planner sizes
                         # node pools from the ledger, and a skipped
@@ -1749,9 +1822,51 @@ class TpuShareScheduler:
                             whole_counts is not None
                             or len(hold) < len(self._node_index)
                         )
+                        if reservations_on:
+                            est_start = self._estimate_head_start(
+                                req, hold
+                            )
                     continue
-                # backfill attempt behind the blocked head
-                self._backfill_hold = hold
+                if pod.key == head_key:
+                    # the carried head re-attempts first-class (no
+                    # hold screen): a bind dissolves the claim, a
+                    # fresh capacity failure re-anchors it to the
+                    # post-attempt capacity view
+                    decision = self._attempt(pod, journal_on, batch)
+                    decisions.append(decision)
+                    req = self._last_attempt_req
+                    if (
+                        decision.status == "unschedulable"
+                        and decision.retryable
+                        and req is not None
+                        and self._last_demand_reason in (
+                            D.REASON_NO_FEASIBLE_CELL,
+                            D.REASON_FRAGMENTATION,
+                        )
+                    ):
+                        head_req = req
+                        head_size = self._req_size(req)
+                        head_reason = self._last_demand_reason
+                        hold, whole_counts = self._backfill_hold_map(req)
+                        backfill_open = (
+                            whole_counts is not None
+                            or len(hold) < len(self._node_index)
+                        )
+                        est_start = self._estimate_head_start(req, hold)
+                    else:
+                        # bound, waiting, or permanently rejected:
+                        # the wave continues unblocked
+                        head_key = None
+                        head_req = None
+                        hold = {}
+                        whole_counts = None
+                        backfill_open = False
+                        est_start = None
+                    continue
+                # backfill attempt behind the blocked head; an EASY
+                # admission sees the FULL capacity view — its safety
+                # comes from the time bound, not the hold screen
+                self._backfill_hold = {} if easy else hold
                 try:
                     decision = self._attempt(pod, journal_on, batch)
                 finally:
@@ -1760,9 +1875,12 @@ class TpuShareScheduler:
                 if self.capacity_releases != releases_at_start:
                     # capacity was freed mid-wave (eviction, deny/
                     # conflict unreserve, delete): the monotone-loss
-                    # premise is void — forget proven failures
+                    # premise is void — forget proven failures, and
+                    # stop trusting est_start (capacity freeing EARLY
+                    # means the head may start before the estimate)
                     failed_shapes.clear()
                     releases_at_start = self.capacity_releases
+                    est_start = None
                 elif (
                     decision.status == "unschedulable"
                     and decision.retryable
@@ -1780,6 +1898,8 @@ class TpuShareScheduler:
                     )
                 if decision.status == "bound":
                     self.backfill_binds += 1
+                    if easy:
+                        self.backfill_easy_binds += 1
                 req_b = self._last_attempt_req
                 if (
                     decision.node
@@ -1787,15 +1907,47 @@ class TpuShareScheduler:
                     and req_b is not None
                     and req_b.kind != PodKind.REGULAR
                 ):
-                    # reserve is the consumption point: verify the
-                    # head's claim survived this placement. REGULAR
-                    # pods reserve no leaves — binding one onto a held
-                    # node is not a violation (they cannot delay
-                    # anything)
-                    self._check_head_delay(
-                        decision.node, head_req, hold, whole_counts
-                    )
+                    if easy:
+                        # estimate-admitted binds are governed by the
+                        # TIME rule (gone before est_start), not the
+                        # capacity rule — refresh the head's held-
+                        # leaf snapshot so later conservative binds
+                        # are judged against post-EASY reality
+                        # instead of being blamed for this admission
+                        if (whole_counts is not None
+                                and decision.node in hold):
+                            model0 = head_req.model or None
+                            held0 = hold[decision.node]
+                            whole_counts[decision.node] = sum(
+                                1 for l in self.tree.leaves_view(
+                                    decision.node, model0)
+                                if l.healthy and l.is_whole_free
+                                and l.uuid in held0
+                            )
+                    else:
+                        # reserve is the consumption point: verify the
+                        # head's claim survived this placement. REGULAR
+                        # pods reserve no leaves — binding one onto a
+                        # held node is not a violation (they cannot
+                        # delay anything)
+                        self._check_head_delay(
+                            decision.node, head_req, hold, whole_counts
+                        )
         finally:
+            if reservations_on:
+                # persist the blocked head's claim across the wave
+                # boundary (identity + shape only — hold and estimate
+                # re-anchor at the next wave's seed); a wave that
+                # ended unblocked dissolves any prior claim
+                if head_key is not None and head_req is not None:
+                    self._wave_reservation = {
+                        "head_key": head_key,
+                        "req": head_req,
+                        "head_size": head_size,
+                        "head_reason": head_reason,
+                    }
+                else:
+                    self._wave_reservation = None
             t3 = perf()
             phase["attempts"] += t3 - t2
             self.quota.wave_end()
@@ -1864,6 +2016,75 @@ class TpuShareScheduler:
                 whole_counts[node] = whole
         return hold, (whole_counts if whole_only else None)
 
+    def _estimate_head_start(self, req: PodRequirements,
+                             hold: Dict[str, frozenset]):
+        """LOWER bound on when the blocked head could possibly start,
+        from occupants' declared runtime estimates
+        (``sharedtpu/runtime_estimate``): per feasible (hold) node,
+        the time the last of the MISSING leaves frees — an occupant
+        without a declared estimate never frees its leaf for this
+        computation — then the min over nodes. Returns None when no
+        node has a finite estimate (nothing can be admitted on time
+        grounds; the conservative rule still applies).
+
+        The bound is only as honest as the declarations: an occupant
+        that underruns its estimate frees capacity EARLY, which the
+        mid-wave capacity-release guard handles by dropping the
+        estimate; an occupant that overruns delays the head for
+        reasons no backfill admission caused. The sim-level property
+        test (accurate estimates) pins that the head's bind time is
+        never later with reservations on.
+
+        Only MULTI_CHIP heads get an estimate: whole-free supply has
+        an exact drain order (a leaf is whole-free when ALL occupants
+        are gone). A fractional/gang head's feasibility can turn on
+        partial headroom appearing mid-drain, which has no such
+        monotone bound — those heads keep the conservative rule."""
+        if req.kind != PodKind.MULTI_CHIP:
+            return None
+        now = self.clock()
+        inf = float("inf")
+        # leaf uuid -> latest estimated free time among its occupants
+        # (a shared leaf frees when ALL of its occupants finish)
+        free_at: Dict[str, float] = {}
+        for status in self.status.values():
+            if status.state not in (PodState.RESERVED, PodState.WAITING,
+                                    PodState.BOUND):
+                continue
+            est = status.requirements.est_runtime
+            if est > 0:
+                fin = (status.bound_at or now) + est
+            else:
+                fin = inf
+            for uuid in status.uuids:
+                if fin > free_at.get(uuid, 0.0):
+                    free_at[uuid] = fin
+        needed = req.chip_count
+        model = req.model or None
+        best = inf
+        for node, held in hold.items():
+            # held = currently whole-free leaves; the head waits for
+            # (needed - |held|) occupied leaves to drain
+            missing = needed - len(held)
+            if missing <= 0:
+                # the head can start HERE already (capacity raced in
+                # since it failed): nothing may be admitted on time
+                # grounds — the bound collapses to now
+                best = now
+                break
+            times = sorted(
+                free_at.get(leaf.uuid, inf)
+                for leaf in self.tree.leaves_view(node, model)
+                if leaf.healthy and leaf.uuid not in held
+            )
+            if len(times) >= missing:
+                cand = times[missing - 1]
+            else:
+                cand = inf
+            if cand < best:
+                best = cand
+        return best if best != inf else None
+
     def _check_head_delay(
         self, node: str, head_req, hold: Dict[str, frozenset],
         whole_counts: Optional[Dict[str, int]],
@@ -1872,7 +2093,15 @@ class TpuShareScheduler:
         on a hold-set node must not have reduced the head's prospects
         there. Violations are counted (``backfill_head_delays``, must
         stay 0) and logged — the counter existing means the rule is
-        CHECKED, not assumed."""
+        CHECKED, not assumed.
+
+        Only HELD leaves are scored: a leaf that frees mid-wave
+        (eviction, deny/conflict unreserve) was not part of the
+        head's claim — the claim is the whole-free supply AS OF the
+        capacity failure — so a backfill consuming it merely returns
+        the node to its hold-time state, leaving the head no worse
+        off than when it failed. Counting ALL whole-free leaves
+        would flag exactly that legal consumption as a violation."""
         if node not in hold:
             return
         if whole_counts is None:
@@ -1885,9 +2114,10 @@ class TpuShareScheduler:
             )
             return
         model = head_req.model or None
+        held = hold[node]
         whole = sum(
             1 for l in self.tree.leaves_view(node, model)
-            if l.healthy and l.is_whole_free
+            if l.healthy and l.is_whole_free and l.uuid in held
         )
         before = whole_counts.get(node, 0)
         if whole < before:
@@ -3107,7 +3337,7 @@ class TpuShareScheduler:
             held.update(leaves)
         return frozenset(held)
 
-    def _gang_seed_frees(self, req, feasible) -> Optional[List[Cell]]:
+    def _gang_seed_frees(self, req, feasible) -> Optional[SeedNeighborhood]:
         """Eligible-free-leaf set for anchorless gang seeding
         (scoring.gang_seed_bonus), drawn from the FEASIBLE candidate
         nodes. Returns None — no seeding — for everything except the
@@ -3127,7 +3357,10 @@ class TpuShareScheduler:
             for leaf in self.tree.leaves_view(name, req.model or None):
                 if seed_eligible(leaf, req):
                     frees.append(leaf)
-        return frees
+        # indexed once per walk: every candidate node's seed bonus
+        # queries the same neighborhood buckets instead of re-scanning
+        # the whole free set (the 10k-node fleet wall-time fix)
+        return SeedNeighborhood(frees)
 
     def _feasible_target(self, n_nodes: int) -> int:
         """How many feasible nodes to find before scoring (kube's
@@ -3667,6 +3900,12 @@ class TpuShareScheduler:
             expfmt.Sample(
                 "tpu_scheduler_backfill_head_delays_total", {},
                 self.backfill_head_delays,
+            ),
+            # estimate-admitted backfill binds onto held capacity
+            # (cross-wave reservations; subset of backfill_binds)
+            expfmt.Sample(
+                "tpu_scheduler_backfill_easy_binds_total", {},
+                self.backfill_easy_binds,
             ),
             # crash-recovery activity: bind verbs retried for
             # reservations an API failure stranded, and half-gangs

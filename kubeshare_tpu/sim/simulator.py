@@ -285,15 +285,24 @@ class Simulator:
         obs_plane=None,
         vector: bool = True,
         native: bool = False,
+        node_models: Optional[Dict[str, str]] = None,
+        stamp_estimates: bool = False,
+        backfill_reservations: bool = False,
     ):
         import random
 
+        # Heterogeneous fleets (gauntlet plane): node_models maps node
+        # name -> chip model for nodes that differ from ``chip_model``
+        # — a v4/v5e/v6e mix synthesizes per-pool chip inventories and
+        # model-pinned trace rows (TraceEvent.model) route to them
+        self.node_models: Dict[str, str] = dict(node_models or {})
         raw = FakeCluster()
         for node, n_chips in nodes.items():
+            model = self.node_models.get(node, chip_model)
             raw.add_node(
                 node,
                 [
-                    ChipInfo(f"{node}-chip-{i}", chip_model, chip_memory, i)
+                    ChipInfo(f"{node}-chip-{i}", model, chip_memory, i)
                     for i in range(n_chips)
                 ],
             )
@@ -324,7 +333,13 @@ class Simulator:
             compaction_interval=compaction_interval,
             vector=vector,
             native=native,
+            backfill_reservations=backfill_reservations,
         )
+        # stamp each pod's declared runtime estimate from its trace
+        # row (sharedtpu/runtime_estimate) — the cross-wave backfill
+        # reservation's admission input; off by default so committed
+        # artifacts replay byte-identically
+        self.stamp_estimates = stamp_estimates
         # parse the topology ONCE: a rebuild must see the exact config
         # the crashed engine ran, not whatever the path resolves to at
         # restart time
@@ -472,6 +487,10 @@ class Simulator:
                 labels[C.LABEL_PRIORITY] = str(event.priority)
         elif self._rng.random() < self.priority_ratio:
             labels[C.LABEL_PRIORITY] = str(self._rng.randint(1, 100))
+        if event.model:  # heterogeneous rows pin their pool's model
+            labels[C.LABEL_TPU_MODEL] = event.model
+        if self.stamp_estimates and event.runtime > 0:
+            labels[C.LABEL_RUNTIME_ESTIMATE] = f"{event.runtime:.10g}"
         name = f"sim-{idx}"
         if event.gang > 1:
             # one PodGroup per trace row: all-or-nothing co-scheduling
@@ -648,13 +667,17 @@ class Simulator:
 
     # ---- elastic capacity (node-pool actuator verbs) ---------------
 
-    def add_node(self, name: str, n_chips: int = 0) -> None:
+    def add_node(self, name: str, n_chips: int = 0,
+                 model: str = "") -> None:
         """Bring a node up mid-replay: a fresh node joins with
         ``n_chips`` chips (default: the initial nodes' size), or a
         previously drained node re-joins with its original chips. The
         engine binds the inventory through the same informer path a
         real node registration takes; quota denominators grow with the
-        bound capacity automatically."""
+        bound capacity automatically. ``model`` pins the new node's
+        chip model on heterogeneous fleets (default: the node's pool
+        model if it is a known spare, else the fleet default) — and is
+        remembered, so a drain/re-add cycle keeps the pool's model."""
         existing = self.cluster.get_node(name)
         if existing is not None:
             if not existing.ready:
@@ -664,10 +687,12 @@ class Simulator:
                     self._report.nodes_added += 1
             return
         n = n_chips or self.default_chips_per_node
+        chip_model = model or self.node_models.get(name, self.chip_model)
+        self.node_models[name] = chip_model
         self.cluster.add_node(
             name,
             [
-                ChipInfo(f"{name}-chip-{i}", self.chip_model,
+                ChipInfo(f"{name}-chip-{i}", chip_model,
                          self.chip_memory, i)
                 for i in range(n)
             ],

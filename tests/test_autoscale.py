@@ -836,6 +836,80 @@ class TestResolveModels:
         assert by_model["tpu-v6e"].delta_nodes > 0
         assert by_model["tpu-v5e"].delta_nodes == 0
 
+    def test_wildcard_backlog_splits_across_pools(self):
+        """Feasibility-SPLIT: one wildcard shape's backlog larger than
+        the cheap pool's absorption spills the overflow to the
+        next-cheapest fitting pool instead of piling it all onto v5e
+        where the headroom clamp would swallow it."""
+        # v5e absorbs 0 free + 2 spare nodes * 4 = 8 chips;
+        # v6e absorbs 2 spare nodes * 8 = 16 chips
+        capacity = self.caps(**{"tpu-v5e": 4, "tpu-v6e": 8})
+        resolved = DemandLedger.resolve_models(
+            [self.entry("x4", chips=4.0) for _ in range(4)],
+            sorted(capacity), capacity=capacity,
+        )
+        assert [e.model for e in resolved] == [
+            "tpu-v5e", "tpu-v5e", "tpu-v6e", "tpu-v6e",
+        ]
+
+    def test_concrete_demand_charges_its_pool_before_wildcards(self):
+        """Pinned v5e demand is committed to v5e no matter what, so it
+        eats the v5e absorption first and the wildcard routes around
+        it."""
+        capacity = self.caps(**{"tpu-v5e": 4, "tpu-v6e": 8})
+        resolved = DemandLedger.resolve_models(
+            [
+                self.entry("x4", model="tpu-v5e", chips=8.0),
+                self.entry("x4", chips=4.0),
+            ],
+            sorted(capacity), capacity=capacity,
+        )
+        assert [e.model for e in resolved] == ["tpu-v5e", "tpu-v6e"]
+
+    def test_overflow_past_every_pool_lands_on_cheapest_fitting(self):
+        """When every fitting pool is full the overflow still needs a
+        deterministic home: the cheapest fitting pool absorbs it and
+        the recommender's headroom clamp reports the impossibility."""
+        capacity = self.caps(**{"tpu-v5e": 4, "tpu-v6e": 8})
+        resolved = DemandLedger.resolve_models(
+            [self.entry("x4", chips=4.0) for _ in range(7)],  # 28 > 8+16
+            sorted(capacity), capacity=capacity,
+        )
+        assert [e.model for e in resolved][-1] == "tpu-v5e"
+        assert [e.model for e in resolved][:6] == [
+            "tpu-v5e", "tpu-v5e",
+            "tpu-v6e", "tpu-v6e", "tpu-v6e", "tpu-v6e",
+        ]
+
+    def test_recommend_sizes_both_pools_on_split_backlog(self):
+        """End to end through recommend(): 16 chips of wildcard x4
+        backlog on a fleet whose v5e pool can only grow by 8 chips —
+        the split sends half to v6e and the recommender sizes BOTH
+        pools (the pre-split rewrite overbought v5e, hit its headroom
+        clamp, and dropped the rest on the floor)."""
+        from kubeshare_tpu.autoscale.demand import DemandEntry
+
+        entries = tuple(
+            DemandEntry(
+                pod_key=f"prod/p{i}", tenant="prod", model="*",
+                shape="x4", guarantee=True, chips=4.0, mem=0,
+                reason=REASON_NO_FEASIBLE_CELL, since=0.0, updated=0.0,
+            )
+            for i in range(4)
+        )
+        snap = PlannerSnapshot(
+            now=0.0, total_chips=24.0,
+            capacity=self.caps(**{"tpu-v5e": 4, "tpu-v6e": 8}),
+            demand=entries,
+            guarantee_used={"prod": 0.0},
+            guaranteed_fraction={"prod": 1.0},
+            deficits={"prod": 16.0},
+        )
+        rec = Recommender(max_surge_nodes=8).recommend(snap)
+        by_model = {p.model: p for p in rec.plans}
+        assert by_model["tpu-v5e"].delta_nodes > 0
+        assert by_model["tpu-v6e"].delta_nodes > 0
+
 
 # ================ serving slot-sizing term ===========================
 

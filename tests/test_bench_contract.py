@@ -115,6 +115,31 @@ class TestBenchContract:
         # headline is distinguishable from a full-length one
         assert 1.5 <= last["phase_s"] <= 10.0, last
 
+    def test_drifted_round_is_excluded_from_the_banked_median(self):
+        """The BENCH_r05 failure mode: a round whose chip drifted
+        mid-round compares solo (fast chip) against gated (slow chip)
+        — a cross-chip ratio, not a gating measurement — yet it sat in
+        the median pool. With drift injected into round 0 the banked
+        median must come from a clean round and the doc must account
+        for the drift."""
+        proc, lines = _run({
+            "KUBESHARE_BENCH_PLATFORM": "cpu",
+            "KUBESHARE_BENCH_BATCH": "64",
+            "KUBESHARE_BENCH_DRIFT_N": "1",
+            "KUBESHARE_BENCH_TOTAL_WALL": "150",
+            "KUBESHARE_BENCH_KERNELS": "0",
+        }, wall=230)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        # the exactly-two-lines emit contract survives the drift path
+        assert len(lines) == 2, proc.stdout
+        last = lines[-1]
+        assert last["value"] > 0
+        assert last["rounds_drifted"] == 1, last
+        assert last["rounds"] >= 2, last  # a clean round still ran
+        # the annotation downstream floors key on: the median dodged
+        # the cross-chip round instead of banking it
+        assert last["median_excludes_drifted"] is True, last
+
 
 class TestKernelRowResilience:
     def test_run_all_banks_surviving_rows_past_failures(self, monkeypatch):

@@ -1,0 +1,266 @@
+"""Gauntlet floors: the committed GAUNTLET.json + scaled live replays.
+
+Two layers, on purpose:
+
+1. **Committed-artifact invariants** — re-grade every banked row with
+   :func:`kubeshare_tpu.gauntlet.failed_floors`, the SAME code that
+   gated banking. A floor that only lived in ``tools/gauntlet.py``
+   would be a floor the repo could silently lose; here the tier-1
+   suite holds the committed artifact to it on every run. These pin
+   the ISSUE's acceptance numbers: >= 4 scenarios including a
+   10k-node heterogeneous fleet, Jain >= 0.9 on the fairness row,
+   goodput retention vs the fault-free baseline, exact conservation /
+   zero double-binds / zero ledger drift in every arm, and the alert
+   contract (silent fault-free, exactly classified under faults).
+
+2. **Scaled-down live replays** — ``Scenario.scaled()`` shrinks a
+   banked 10k-node scenario to tier-1 size (same pools, same trace
+   shape, same horizon-fractional fault script, same floors) and runs
+   it through the real ``GauntletRunner`` + ``Grader``. This is what
+   keeps the artifact honest: the committed numbers came from this
+   exact pipeline, replayed here live in seconds.
+
+Seeded, CPU-only, no JAX.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from kubeshare_tpu.gauntlet import (
+    GauntletRunner, GauntletScoreboard, Grader, SCENARIOS,
+    failed_floors, jain, scenario,
+)
+
+ROOT = Path(__file__).resolve().parent.parent
+ARTIFACT = ROOT / "GAUNTLET.json"
+
+
+@pytest.fixture(scope="module")
+def doc():
+    assert ARTIFACT.exists(), \
+        "GAUNTLET.json missing — bank it with `make gauntlet`"
+    return json.loads(ARTIFACT.read_text())
+
+
+@pytest.fixture(scope="module")
+def rows(doc):
+    return {row["scenario"]: row for row in doc["scenarios"]}
+
+
+class TestJain:
+    def test_even_is_one(self):
+        assert jain([3.0, 3.0, 3.0]) == 1.0
+        assert jain([]) == 1.0
+
+    def test_one_hog_is_one_over_n(self):
+        assert jain([1.0, 0.0, 0.0, 0.0]) == 0.25
+
+    def test_scale_invariant(self):
+        assert jain([1.0, 2.0, 3.0]) == jain([10.0, 20.0, 30.0])
+
+
+class TestCommittedArtifact:
+    def test_bank_shape(self, doc, rows):
+        """>= 4 scenarios, all banked from the in-repo registry, all
+        marked ok at bank time."""
+        assert doc["ok"] is True
+        assert len(rows) >= 4
+        registry = {s.name for s in SCENARIOS}
+        assert set(rows) <= registry
+        for row in rows.values():
+            assert row["ok"] is True
+            assert row["failed_floors"] == []
+
+    def test_rows_pass_floors_regraded(self, rows):
+        """The committed rows still pass the CURRENT grader — the
+        same failed_floors() that gated banking, not a stale copy of
+        its verdict."""
+        for name, row in rows.items():
+            assert failed_floors(row) == [], f"{name}: regrade failed"
+
+    def test_ten_k_heterogeneous_row(self, rows):
+        """At least one banked run is the 10k-node heterogeneous
+        fleet: >= 10000 nodes across >= 3 chip models, with a real
+        diurnal load behind it."""
+        big = [r for r in rows.values() if r["total_nodes"] >= 10000]
+        assert big, "no 10k-node scenario banked"
+        models = {
+            pool["model"] for r in big for pool in r["fleet"].values()
+        }
+        assert len(models) >= 3
+        assert all(r["events"] >= 1000 for r in big)
+        assert all(r["main"]["submitted"] >= 1000 for r in big)
+
+    def test_hard_invariants_every_arm(self, rows):
+        """Exact conservation, zero double-binds, zero ledger drift,
+        zero rebuild mismatches — every scenario, every arm."""
+        for name, row in rows.items():
+            arms = {"main": row["main"]}
+            if row.get("baseline"):
+                arms["baseline"] = row["baseline"]
+            for label, arm in arms.items():
+                where = f"{name}/{label}"
+                assert arm["conservation"]["exact"], where
+                assert arm["double_binds"] == 0, where
+                assert arm["ledger_drift_tenants"] == 0, where
+                assert arm["ledger_rebuild_mismatches"] == 0, where
+
+    def test_alert_contract(self, rows):
+        """Fault-free rows fire nothing outside their allowed set;
+        the chaos row fires its expected rules exactly (extras only
+        from the allowed set); its fault-free baseline arm is
+        silent."""
+        for name, row in rows.items():
+            fired = set(row["main"]["alerts_fired"])
+            expected = set(row["floors"]["expected_alerts"])
+            allowed = set(row["floors"]["allowed_alerts"])
+            assert expected <= fired, f"{name}: missing {expected - fired}"
+            assert fired <= expected | allowed, \
+                f"{name}: unexpected {fired - expected - allowed}"
+            if row["faults"] == 0:
+                assert expected == set(), name
+            if row.get("baseline"):
+                assert row["baseline"]["alerts_fired"] == {}, name
+
+    def test_chaos_row_floors(self, rows):
+        """The chaos+autoscale gauntlet: goodput within the floor of
+        the fault-free baseline, faults actually exercised (kills,
+        crashes, node churn), the autoscale loop closed without ever
+        draining a guarantee pod's node."""
+        row = rows["fleet-10k-chaos-autoscale"]
+        assert row["faults"] >= 10
+        assert row["goodput_ratio"] >= row["floors"]["goodput_ratio"] >= 0.9
+        assert row["main"]["killed"] > 0
+        assert row["main"]["crashes"] >= 2
+        assert row["main"]["nodes_removed"] > 0
+        audit = row["autoscale"]
+        assert audit["rounds"] > 0
+        assert audit["drain_guarantee_violations"] == 0
+
+    def test_fairness_floor(self, rows):
+        """Jain over entitlement-normalized service >= 0.9 on the
+        fairness row — and the floor itself is pinned in the
+        artifact, so a regenerated bank cannot quietly drop it."""
+        row = rows["fairness-weighted"]
+        assert row["floors"]["jain"] >= 0.9
+        assert row["main"]["jain"] >= 0.9
+        # the 2x-weighted tenant really got ~2x the raw service of a
+        # 1x tenant (fairness is weighted, not raw-equal)
+        chip_s = row["main"]["tenant_chip_s"]
+        assert chip_s["anna"] > 1.5 * chip_s["bob"]
+
+    def test_wait_histograms_present(self, rows):
+        """Per-tenant wait-time SLO histograms are part of every
+        banked row (the grading plane's wait evidence)."""
+        for name, row in rows.items():
+            waits = row["main"]["tenant_waits"]
+            assert waits, name
+            for tenant, hist in waits.items():
+                assert hist["count"] > 0, f"{name}/{tenant}"
+                assert hist["p50"] <= hist["p99"] <= hist["max"] + 1e-9
+                assert 0.0 <= hist["slo_attainment"] <= 1.0
+
+    def test_serving_section(self, rows):
+        """The diurnal mixed scenario carries the serving-loop
+        section: exact request conservation and a sane shed rate."""
+        row = rows["diurnal-serving-mix"]
+        sv = row["serving"]
+        assert sv["conservation"]["exact"]
+        assert sv["requests"] > 1000
+        assert sv["shed_rate"] < 0.1
+        assert sv["replicas"]["final"] >= 1
+
+    def test_starvation_row_reclaims(self, rows):
+        """The starved-guarantee scenario really drove the reclaim:
+        the autoscale loop added nodes from the spare pool."""
+        row = rows["starved-guarantee-reclaim"]
+        assert row["autoscale"]["scale_up_nodes"] > 0
+        assert row["autoscale"]["pool_exhausted"] == 0
+
+    def test_scoreboard_round_trip(self, doc):
+        """The daemon-side re-export: GauntletScoreboard loads the
+        committed artifact and emits the tpu_scheduler_gauntlet_*
+        gauges /metrics serves (metrics-lint pins the family names;
+        this pins the values against the artifact)."""
+        board = GauntletScoreboard.load(ARTIFACT)
+        samples = {}
+        for s in board.samples():
+            samples.setdefault(s.name, []).append(s)
+        n = len(doc["scenarios"])
+        assert samples["tpu_scheduler_gauntlet_scenarios"][0].value == n
+        assert samples["tpu_scheduler_gauntlet_floor_failures"][0].value == 0
+        oks = samples["tpu_scheduler_gauntlet_ok"]
+        assert len(oks) == n and all(s.value == 1.0 for s in oks)
+        jains = {
+            s.labels["scenario"]: s.value
+            for s in samples["tpu_scheduler_gauntlet_jain"]
+        }
+        assert jains["fairness-weighted"] >= 0.9
+
+
+def _replay(s):
+    outcome = GauntletRunner(s).run()
+    return Grader(s).grade(outcome)
+
+
+class TestScaledLiveReplays:
+    """The banked pipeline, live at tier-1 size. Floors travel with
+    the scenario through ``scaled()`` — a replay row is judged by the
+    very same failed_floors()."""
+
+    def test_steady_scaled(self):
+        """fleet-10k-steady at ~60 nodes: same 3-model pool mix, same
+        diurnal trace shape; every hard floor still holds."""
+        s = scenario("fleet-10k-steady").scaled(
+            0.006,
+            trace_overrides={"count": 120, "span_s": 450.0},
+            horizon=700.0,
+        )
+        assert s.total_nodes < 100
+        assert len({p.model for p in s.pools}) == 3
+        row = _replay(s)
+        assert row["failed_floors"] == []
+        assert row["main"]["submitted"] > 100
+        assert row["main"]["conservation"]["exact"]
+
+    def test_chaos_autoscale_scaled(self):
+        """fleet-10k-chaos-autoscale at ~100 nodes: the SAME
+        horizon-fractional fault script (node flaps, pod kills, a
+        mid-pass crash arm, API flakes) resolves onto the small
+        fleet; expected alerts still classify exactly, the baseline
+        arm stays silent, goodput holds the floor."""
+        s = scenario("fleet-10k-chaos-autoscale").scaled(
+            0.01, trace_overrides={"count": 260, "span_s": 1440.0},
+        )
+        assert s.total_nodes <= 101
+        assert len(s.resolved_faults()) == len(s.faults)
+        row = _replay(s)
+        assert row["failed_floors"] == []
+        assert row["baseline"]["alerts_fired"] == {}
+        assert set(row["floors"]["expected_alerts"]) <= \
+            set(row["main"]["alerts_fired"])
+        assert row["goodput_ratio"] >= 0.9
+        assert row["autoscale"]["drain_guarantee_violations"] == 0
+
+    def test_fairness_scaled(self):
+        """fairness-weighted with a third of the jobs: the weighted
+        Jain floor (>= 0.9) holds live, not just in the artifact."""
+        s = scenario("fairness-weighted").scaled(
+            1.0, trace_overrides={"jobs_per_tenant": 100},
+            horizon=700.0, suffix="-short",
+        )
+        row = _replay(s)
+        assert row["failed_floors"] == []
+        assert row["main"]["jain"] >= 0.9
+
+    def test_starvation_live(self):
+        """starved-guarantee-reclaim is tier-1 sized as banked — run
+        it verbatim: the reclaim proof (spare nodes added, guarantees
+        never drained) reproduces."""
+        s = scenario("starved-guarantee-reclaim")
+        row = _replay(s)
+        assert row["failed_floors"] == []
+        assert row["autoscale"]["scale_up_nodes"] > 0
+        assert row["main"]["conservation"]["exact"]

@@ -172,10 +172,27 @@ def scraped(tmp_path_factory):
     hub = ProfilerHub()
     hub.run_profile(0.1, hz=200)
 
+    # the banked-gauntlet scoreboard rides the same exposition: one
+    # representative row with every per-scenario family populated
+    # (jain, goodput ratio, per-tenant wait p99, fired alerts)
+    from kubeshare_tpu.gauntlet import GauntletScoreboard
+
+    gauntlet = GauntletScoreboard([{
+        "scenario": "lint-row",
+        "ok": True,
+        "failed_floors": [],
+        "goodput_ratio": 0.97,
+        "main": {
+            "jain": 0.93,
+            "tenant_waits": {WEIRD_TENANT: {"p99": 12.5}},
+            "alerts_fired": {"scheduler-restart": 1},
+        },
+    }])
+
     metrics = SchedulerMetrics(tracer=tracer, engine=engine,
                                router=router, cluster=kube,
                                obs=plane, profiler=hub,
-                               shard=shard_plane)
+                               shard=shard_plane, gauntlet=gauntlet)
     metrics.record_pass(0.01, 4)
 
     server = MetricServer(host="127.0.0.1", port=0)
@@ -317,6 +334,18 @@ class TestExpositionHygiene:
             ("tpu_scheduler_column_row_refreshes_total", "gauge"),
             ("tpu_scheduler_column_rebuilds_total", "gauge"),
             ("tpu_scheduler_column_ambiguous_resolves_total", "gauge"),
+            # backfill head-of-line safety + estimate-admission
+            ("tpu_scheduler_backfill_binds_total", "gauge"),
+            ("tpu_scheduler_backfill_head_delays_total", "gauge"),
+            ("tpu_scheduler_backfill_easy_binds_total", "gauge"),
+            # banked-gauntlet scoreboard families (GAUNTLET.json)
+            ("tpu_scheduler_gauntlet_scenarios", "gauge"),
+            ("tpu_scheduler_gauntlet_floor_failures", "gauge"),
+            ("tpu_scheduler_gauntlet_ok", "gauge"),
+            ("tpu_scheduler_gauntlet_jain", "gauge"),
+            ("tpu_scheduler_gauntlet_goodput_ratio", "gauge"),
+            ("tpu_scheduler_gauntlet_wait_p99_seconds", "gauge"),
+            ("tpu_scheduler_gauntlet_alerts_fired", "gauge"),
             # PR-14: native attempt core families
             ("tpu_scheduler_native_attempts_total", "gauge"),
             ("tpu_scheduler_native_fallbacks_total", "gauge"),

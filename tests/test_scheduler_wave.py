@@ -57,11 +57,11 @@ def topo(n):
 
 
 def make_sim(n_nodes, use_waves, backfill=False, check=True,
-             defrag=False, tenants=None, wave_size=0):
+             defrag=False, tenants=None, wave_size=0, **kw):
     sim = Simulator(
         topo(n_nodes), {f"n{i:03d}": 4 for i in range(n_nodes)},
         seed=7, use_waves=use_waves, backfill=backfill,
-        defrag=defrag, tenants=tenants, wave_size=wave_size,
+        defrag=defrag, tenants=tenants, wave_size=wave_size, **kw,
     )
     sim.engine.tree.check_aggregates = check
     return sim
@@ -447,6 +447,159 @@ class TestBackfillSafety:
         # the node hosting the fractional pod has 3 whole-free chips,
         # the untouched one all 4 (which node won is scoring's call)
         assert sorted(whole_counts.values()) == [3, 4]
+
+
+class TestCrossWaveReservations:
+    """Opt-in cross-wave backfill reservations (EASY backfill).
+
+    The safety floor: with accurate declared estimates
+    (``stamp_estimates`` copies each trace row's true runtime into
+    ``sharedtpu/runtime_estimate``), a blocked head's virtual bind
+    time with reservations ON is never later than with backfill OFF
+    entirely, and the engine's own oracle ``backfill_head_delays``
+    stays 0. Plus the two mechanisms behind it: the claim surviving
+    the wave boundary, and estimate-bounded (EASY) admission onto
+    held capacity.
+    """
+
+    def _run(self, *, backfill, reservations, seed):
+        trace = generate_backlog_trace(count=3 * 12, seed=seed)
+        sim = make_sim(
+            12, use_waves=True, backfill=backfill,
+            backfill_reservations=reservations, stamp_estimates=True,
+        )
+        binds = record_binds(sim)
+        report = sim.run(list(trace))
+        return sim, report, {k: t for k, _, t in binds}
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_reservations_never_delay_guarantee_heads(self, seed):
+        """Property: every GUARANTEE pod (the class heads come from)
+        binds no later with reservations on than with backfill off —
+        the carried claim + EASY admission reclaim idle capacity,
+        they never spend the head's."""
+        sim_on, rep_on, t_on = self._run(
+            backfill=True, reservations=True, seed=seed)
+        sim_off, rep_off, t_off = self._run(
+            backfill=False, reservations=False, seed=seed)
+        assert rep_on.bound == rep_off.bound  # everything drains
+        assert sim_on.engine.backfill_binds > 0
+        assert sim_on.engine.backfill_head_delays == 0
+        delayed_guarantee = []
+        for k in set(t_on) & set(t_off):
+            if t_on[k] <= t_off[k] + 1e-9:
+                continue
+            status = sim_on.engine.status.get(k)
+            if status is not None and status.requirements.is_guarantee:
+                delayed_guarantee.append(k)
+        assert delayed_guarantee == []
+
+    @staticmethod
+    def _fragmented(reservations):
+        """2 nodes x 4 chips, both fragmented by a 0.5 guarantee
+        filler (declared runtime 1000s) so a 4-chip head can never
+        place: 3 whole-free leaves per node."""
+        from kubeshare_tpu.cells.cell import ChipInfo
+
+        cluster = FakeCluster()
+        for i in range(2):
+            cluster.add_node(f"n{i:03d}", [
+                ChipInfo(f"n{i:03d}-c{j}", "tpu-v5e", 16 * GIB, j)
+                for j in range(4)
+            ])
+        eng = TpuShareScheduler(
+            topo(2), cluster, clock=lambda: 0.0,
+            backfill_reservations=reservations,
+        )
+
+        def mk(name, req, prio=0, est=0.0):
+            labels = {
+                C.LABEL_TPU_REQUEST: str(req),
+                C.LABEL_TPU_LIMIT_ALIASES[1]: str(max(float(req), 1.0)),
+            }
+            if prio:
+                labels[C.LABEL_PRIORITY] = str(prio)
+            if est:
+                labels[C.LABEL_RUNTIME_ESTIMATE] = str(est)
+            return cluster.create_pod(Pod(
+                name=name, namespace="default", labels=labels,
+                scheduler_name=C.SCHEDULER_NAME,
+            ))
+
+        filler = [mk(f"f{i}", "0.5", prio=90, est=1000.0)
+                  for i in range(2)]
+        assert all(
+            d.status == "bound"
+            for d in eng.schedule_wave(filler, backfill=True)
+        )
+        return eng, mk
+
+    def test_claim_survives_wave_boundary(self):
+        """A wave that never saw the head still screens equal-size
+        followers behind its carried claim — without reservations the
+        follower burns a full (failing) filter scan instead."""
+        eng, mk = self._fragmented(reservations=True)
+        head = mk("head", "4", prio=80)
+        (d,) = eng.schedule_wave([head], backfill=True)
+        assert d.status == "unschedulable" and d.retryable
+        late = mk("late", "4", prio=70)
+        (d2,) = eng.schedule_wave([late], backfill=True)
+        assert d2.status == "unschedulable"
+        assert "head-of-line" in d2.message
+        assert "default/head" in d2.message
+        assert eng.backfill_head_delays == 0
+
+    def test_claim_off_means_no_carry(self):
+        """Same sequence with reservations OFF: the next wave starts
+        unblocked, the follower attempts first-class (and fails on
+        capacity, not on the hold screen)."""
+        eng, mk = self._fragmented(reservations=False)
+        head = mk("head", "4", prio=80)
+        (d,) = eng.schedule_wave([head], backfill=True)
+        assert d.status == "unschedulable"
+        late = mk("late", "4", prio=70)
+        (d2,) = eng.schedule_wave([late], backfill=True)
+        assert d2.status == "unschedulable"
+        assert "head-of-line" not in (d2.message or "")
+
+    def test_claim_dissolves_when_head_binds(self):
+        """The carried claim re-validates against the head's live
+        status: once the head binds (filler completes), a held claim
+        from an earlier wave stops screening followers."""
+        eng, mk = self._fragmented(reservations=True)
+        head = mk("head", "4", prio=80)
+        (d,) = eng.schedule_wave([head], backfill=True)
+        assert d.status == "unschedulable"
+        # a filler completes -> its node is 4 whole-free -> head fits
+        # (delete_pod fires the engine's informer delete handler)
+        eng.cluster.delete_pod("default/f0")
+        (d2,) = eng.schedule_wave([head], backfill=True)
+        assert d2.status == "bound"
+        late = mk("late", "0.5")
+        (d3,) = eng.schedule_wave([late], backfill=True)
+        assert d3.status == "bound"
+        assert "head-of-line" not in (d3.message or "")
+
+    def test_easy_admission_respects_estimate_bound(self):
+        """EASY proper: a pod declaring it finishes before the head
+        could possibly start (est_start = occupants' declared drain,
+        1000s here) binds onto held capacity and is counted; a pod
+        declaring a longer runtime keeps the conservative hold
+        screen. Neither delays the head."""
+        eng, mk = self._fragmented(reservations=True)
+        head = mk("head", "4", prio=80)
+        quick = mk("quick", "1", est=100.0)   # 0 + 100 <= 1000: EASY
+        slow = mk("slow", "1", est=5000.0)    # over the bound: screened
+        decisions = eng.schedule_wave([head, quick, slow],
+                                      backfill=True)
+        by = {d.pod_key: d for d in decisions}
+        assert by["default/head"].status == "unschedulable"
+        assert by["default/quick"].status == "bound"
+        assert eng.backfill_easy_binds == 1
+        # slow: 1 whole chip, every whole-free leaf is held, no
+        # estimate pass -> it must NOT consume the head's supply
+        assert by["default/slow"].status == "unschedulable"
+        assert eng.backfill_head_delays == 0
 
 
 class TestPickTop2:
